@@ -1,0 +1,38 @@
+"""fdtd3d_tpu — a TPU-native FDTD Maxwell-equations framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of the reference
+C++/CUDA/MPI solver ``xj361685640/fdtd3d`` (fork of ``zer011b/fdtd3d``):
+1D/2D/3D Yee-grid leapfrog E/H updates across all 13 scheme modes, CPML
+absorbing boundaries, TFSF plane-wave injection, dispersive (Drude) media,
+near-to-far-field transform, dump/load tooling, and spatial domain
+decomposition — here via ``shard_map`` over a TPU device mesh with
+``lax.ppermute`` halo exchange in place of MPI ghost-cell buffers.
+
+Reference parity map (see SURVEY.md §2; reference paths are path-level
+citations — the mount was empty during the survey):
+
+==========================  =============================================
+Reference component          This package
+==========================  =============================================
+Source/Settings              fdtd3d_tpu.config (+ .txt cmd-file parser)
+Source/Coordinate            implicit (jnp indexing + layout offsets)
+Source/Kernels (FieldValue)  jnp dtypes (f32/f64/complex)
+Source/Grid/Grid             state pytree of jnp arrays
+Source/Grid/ParallelGrid     fdtd3d_tpu.parallel (mesh + ppermute halo)
+Source/Grid/CudaGrid         XLA TPU backend (nothing to write)
+Source/Layout/YeeGridLayout  fdtd3d_tpu.layout
+Source/Scheme/InternalScheme fdtd3d_tpu.solver + fdtd3d_tpu.ops
+Source/Scheme/Scheme         fdtd3d_tpu.solver.Simulation
+Source/File                  fdtd3d_tpu.io
+Source/Physics               fdtd3d_tpu.physics
+NTFF (in Source/Scheme)      fdtd3d_tpu.ntff
+CallBacks (exact solutions)  fdtd3d_tpu.exact
+main.cpp CLI                 fdtd3d_tpu.cli (console entry `fdtd3d`)
+==========================  =============================================
+"""
+
+__version__ = "0.1.0"
+
+from fdtd3d_tpu import physics  # noqa: F401
+from fdtd3d_tpu.layout import SCHEME_MODES, SchemeMode, get_mode  # noqa: F401
+from fdtd3d_tpu.config import SimConfig  # noqa: F401

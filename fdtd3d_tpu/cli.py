@@ -1,0 +1,302 @@
+"""``fdtd3d`` console entry point.
+
+Reference parity: ``Source/main.cpp`` + the ``Source/Settings`` flag surface
+(SURVEY.md §2 main/Settings rows): reference-style long flags, ``.txt``
+command files replayed via ``--cmd-from-file`` (one flag, or flag+value, per
+line; ``#`` comments allowed), and ``--save-cmd-to-file`` re-emission. The
+parsed flags populate one runtime ``SimConfig`` (config.py) — the rebuild's
+replacement for the reference's compile-time CMake matrix + runtime
+``solverSettings`` singleton.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+import time
+from typing import List, Optional
+
+from fdtd3d_tpu import diag
+from fdtd3d_tpu.config import (MaterialsConfig, OutputConfig, ParallelConfig,
+                               PmlConfig, PointSourceConfig, SimConfig,
+                               SphereConfig, TfsfConfig)
+from fdtd3d_tpu.layout import SCHEME_MODES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fdtd3d",
+        description="TPU-native 1D/2D/3D FDTD Maxwell solver "
+                    "(JAX/XLA rebuild of fdtd3d)")
+    g = p.add_argument_group("scheme / grid")
+    g.add_argument("--scheme", choices=sorted(SCHEME_MODES), default=None,
+                   help="solver mode (reference SchemeType)")
+    g.add_argument("--1d", dest="dim1", metavar="PAIR",
+                   help="1D mode shorthand, e.g. --1d EzHy")
+    g.add_argument("--2d", dest="dim2", metavar="POL",
+                   help="2D mode shorthand, e.g. --2d TMz")
+    g.add_argument("--3d", dest="dim3", action="store_true",
+                   help="3D mode shorthand")
+    g.add_argument("--sizex", type=int, default=32)
+    g.add_argument("--sizey", type=int, default=32)
+    g.add_argument("--sizez", type=int, default=32)
+    g.add_argument("--same-size", type=int, metavar="N",
+                   help="set sizex=sizey=sizez=N")
+    g.add_argument("--time-steps", type=int, default=100)
+    g.add_argument("--dx", type=float, default=1e-3, help="cell size, m")
+    g.add_argument("--courant-factor", type=float, default=0.5)
+    g.add_argument("--wavelength", type=float, default=20e-3,
+                   help="source wavelength, m")
+    g.add_argument("--dtype", choices=["float32", "float64", "bfloat16"],
+                   default="float32")
+    g.add_argument("--complex-field-values", action="store_true")
+
+    g = p.add_argument_group("boundaries (CPML)")
+    g.add_argument("--use-pml", action="store_true")
+    g.add_argument("--pml-size", type=int, default=8,
+                   help="thickness on every active axis")
+    g.add_argument("--pml-sizex", type=int, default=None)
+    g.add_argument("--pml-sizey", type=int, default=None)
+    g.add_argument("--pml-sizez", type=int, default=None)
+
+    g = p.add_argument_group("TFSF plane-wave source")
+    g.add_argument("--use-tfsf", action="store_true")
+    g.add_argument("--tfsf-margin", type=int, default=8)
+    g.add_argument("--angle-teta", type=float, default=0.0)
+    g.add_argument("--angle-phi", type=float, default=0.0)
+    g.add_argument("--angle-psi", type=float, default=0.0)
+    g.add_argument("--tfsf-amplitude", type=float, default=1.0)
+    g.add_argument("--tfsf-waveform", default="sin",
+                   choices=["sin", "gauss_pulse"])
+
+    g = p.add_argument_group("point source")
+    g.add_argument("--point-source", metavar="COMP",
+                   help="enable soft point source on component, e.g. Ez")
+    g.add_argument("--point-source-x", type=int, default=None)
+    g.add_argument("--point-source-y", type=int, default=None)
+    g.add_argument("--point-source-z", type=int, default=None)
+    g.add_argument("--point-source-amplitude", type=float, default=1.0)
+    g.add_argument("--point-source-waveform", default="sin",
+                   choices=["sin", "gauss_pulse", "ricker"])
+
+    g = p.add_argument_group("materials")
+    g.add_argument("--eps", type=float, default=1.0)
+    g.add_argument("--mu", type=float, default=1.0)
+    g.add_argument("--sigma-e", type=float, default=0.0)
+    g.add_argument("--sigma-m", type=float, default=0.0)
+    g.add_argument("--eps-sphere", type=float, default=None,
+                   metavar="EPSVAL", help="spherical inclusion permittivity")
+    g.add_argument("--eps-sphere-center-x", type=float, default=0.0)
+    g.add_argument("--eps-sphere-center-y", type=float, default=0.0)
+    g.add_argument("--eps-sphere-center-z", type=float, default=0.0)
+    g.add_argument("--eps-sphere-radius", type=float, default=0.0)
+    g.add_argument("--load-eps-from-file", metavar="PATH", default=None)
+    g.add_argument("--load-mu-from-file", metavar="PATH", default=None)
+    g.add_argument("--use-drude", action="store_true")
+    g.add_argument("--eps-inf", type=float, default=1.0)
+    g.add_argument("--omega-p", type=float, default=0.0, help="rad/s")
+    g.add_argument("--gamma-d", type=float, default=0.0, help="rad/s")
+    g.add_argument("--drude-sphere-center-x", type=float, default=0.0)
+    g.add_argument("--drude-sphere-center-y", type=float, default=0.0)
+    g.add_argument("--drude-sphere-center-z", type=float, default=0.0)
+    g.add_argument("--drude-sphere-radius", type=float, default=0.0)
+
+    g = p.add_argument_group("parallel decomposition")
+    g.add_argument("--topology", choices=["none", "auto", "manual"],
+                   default="none")
+    g.add_argument("--manual-topology", metavar="PXxPYxPZ", default=None,
+                   help="e.g. 2x2x2 (reference --manual-topology)")
+    g.add_argument("--num-devices", type=int, default=None)
+
+    g = p.add_argument_group("output")
+    g.add_argument("--save-res", type=int, default=0,
+                   help="dump fields every N steps")
+    g.add_argument("--save-dir", default="out")
+    g.add_argument("--save-formats", default="dat",
+                   help="comma list of dat,txt,bmp")
+    g.add_argument("--save-materials", action="store_true")
+    g.add_argument("--checkpoint-every", type=int, default=0)
+    g.add_argument("--load-checkpoint", metavar="PATH", default=None)
+    g.add_argument("--norms-every", type=int, default=0,
+                   help="print field norms every N steps")
+    g.add_argument("--log-level", type=int, default=1)
+
+    g = p.add_argument_group("command files")
+    g.add_argument("--cmd-from-file", metavar="FILE", default=None,
+                   help="read flags from a .txt command file (reference "
+                        "format: one flag [value] per line)")
+    g.add_argument("--save-cmd-to-file", metavar="FILE", default=None,
+                   help="re-emit the effective flags to a command file")
+    return p
+
+
+def read_cmd_file(path: str) -> List[str]:
+    """Reference-style .txt command file -> argv list."""
+    argv: List[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                argv.extend(shlex.split(line))
+    return argv
+
+
+def _resolve_scheme(args) -> str:
+    if args.dim3:
+        return "3D"
+    if args.dim2:
+        return f"2D_{args.dim2}"
+    if args.dim1:
+        return f"1D_{args.dim1}"
+    return args.scheme or "3D"
+
+
+def args_to_config(args) -> SimConfig:
+    if args.same_size:
+        args.sizex = args.sizey = args.sizez = args.same_size
+    pml_size = (0, 0, 0)
+    if args.use_pml:
+        pml_size = tuple(
+            args.pml_sizex if (a == 0 and args.pml_sizex is not None) else
+            args.pml_sizey if (a == 1 and args.pml_sizey is not None) else
+            args.pml_sizez if (a == 2 and args.pml_sizez is not None) else
+            args.pml_size for a in range(3))
+    manual = None
+    if args.manual_topology:
+        parts = args.manual_topology.lower().split("x")
+        if len(parts) != 3:
+            raise SystemExit("--manual-topology must look like 2x2x1")
+        manual = tuple(int(v) for v in parts)
+    ps_default = {0: args.sizex // 2, 1: args.sizey // 2,
+                  2: args.sizez // 2}
+    cfg = SimConfig(
+        scheme=_resolve_scheme(args),
+        size=(args.sizex, args.sizey, args.sizez),
+        time_steps=args.time_steps,
+        dx=args.dx,
+        courant_factor=args.courant_factor,
+        wavelength=args.wavelength,
+        dtype=args.dtype,
+        complex_fields=args.complex_field_values,
+        pml=PmlConfig(size=pml_size),
+        tfsf=TfsfConfig(
+            enabled=args.use_tfsf,
+            margin=(args.tfsf_margin,) * 3,
+            angle_teta=args.angle_teta, angle_phi=args.angle_phi,
+            angle_psi=args.angle_psi, amplitude=args.tfsf_amplitude,
+            waveform=args.tfsf_waveform),
+        point_source=PointSourceConfig(
+            enabled=args.point_source is not None,
+            component=args.point_source or "Ez",
+            position=(
+                args.point_source_x if args.point_source_x is not None
+                else ps_default[0],
+                args.point_source_y if args.point_source_y is not None
+                else ps_default[1],
+                args.point_source_z if args.point_source_z is not None
+                else ps_default[2]),
+            amplitude=args.point_source_amplitude,
+            waveform=args.point_source_waveform),
+        materials=MaterialsConfig(
+            eps=args.eps, mu=args.mu,
+            sigma_e=args.sigma_e, sigma_m=args.sigma_m,
+            eps_sphere=SphereConfig(
+                enabled=args.eps_sphere is not None,
+                center=(args.eps_sphere_center_x, args.eps_sphere_center_y,
+                        args.eps_sphere_center_z),
+                radius=args.eps_sphere_radius,
+                value=args.eps_sphere or 1.0),
+            use_drude=args.use_drude,
+            eps_inf=args.eps_inf, omega_p=args.omega_p, gamma=args.gamma_d,
+            drude_sphere=SphereConfig(
+                enabled=args.drude_sphere_radius > 0,
+                center=(args.drude_sphere_center_x,
+                        args.drude_sphere_center_y,
+                        args.drude_sphere_center_z),
+                radius=args.drude_sphere_radius),
+            eps_file=args.load_eps_from_file,
+            mu_file=args.load_mu_from_file),
+        parallel=ParallelConfig(
+            topology="manual" if manual else args.topology,
+            manual_topology=manual, n_devices=args.num_devices),
+        output=OutputConfig(
+            save_res=args.save_res, save_dir=args.save_dir,
+            formats=tuple(args.save_formats.split(",")),
+            save_materials=args.save_materials,
+            checkpoint_every=args.checkpoint_every,
+            norms_every=args.norms_every, log_level=args.log_level),
+    )
+    return cfg
+
+
+def save_cmd_file(args, path: str):
+    """Re-emit effective flags (reference --save-cmd-to-file)."""
+    parser = build_parser()
+    lines = []
+    for action in parser._actions:
+        if not action.option_strings or action.dest in (
+                "help", "cmd_from_file", "save_cmd_to_file"):
+            continue
+        val = getattr(args, action.dest, None)
+        if val is None or val == action.default:
+            continue
+        opt = action.option_strings[0]
+        if isinstance(val, bool):
+            if val:
+                lines.append(opt)
+        else:
+            lines.append(f"{opt} {val}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.cmd_from_file:
+        file_argv = read_cmd_file(args.cmd_from_file)
+        # CLI flags override the command file (parse file first, then argv).
+        args = parser.parse_args(file_argv + argv)
+    if args.save_cmd_to_file:
+        save_cmd_file(args, args.save_cmd_to_file)
+
+    cfg = args_to_config(args)
+    from fdtd3d_tpu.sim import Simulation  # deferred: jax init is slow
+    sim = Simulation(cfg)
+    if args.log_level >= 1:
+        import jax
+        print(f"fdtd3d-tpu: scheme={cfg.scheme} size={cfg.grid_shape} "
+              f"steps={cfg.time_steps} dt={cfg.dt:.3e}s "
+              f"topology={sim.topology} devices={jax.device_count()}")
+
+    t0 = time.time()
+    interval = 0
+    for v in (cfg.output.save_res, cfg.output.norms_every,
+              cfg.output.checkpoint_every):
+        if v:
+            interval = min(interval, v) if interval else v
+
+    def on_interval(s):
+        if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
+            norms = diag.field_norms(s)
+            txt = " ".join(f"{k}={v:.4e}" for k, v in sorted(norms.items()))
+            print(f"[t={s.t}] {txt}")
+
+    sim.run(on_interval=on_interval if interval else None,
+            interval=interval)
+    sim.block_until_ready()
+    dt_wall = time.time() - t0
+    cells = 1.0
+    for a in sim.static.mode.active_axes:
+        cells *= cfg.grid_shape[a]
+    mcps = cells * cfg.time_steps / dt_wall / 1e6
+    if args.log_level >= 1:
+        print(f"done: {cfg.time_steps} steps in {dt_wall:.2f}s "
+              f"({mcps:.1f} Mcells/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,229 @@
+"""Runtime configuration.
+
+TPU-first replacement for the reference's dual compile-time (CMake matrix) +
+runtime (``Source/Settings`` macro flag table, ~100 flags, global
+``solverSettings`` singleton — SURVEY.md §2, §3.5) configuration: ONE runtime
+dataclass. Compile-time axes of the reference (value type, complex fields,
+parallel mode, dim modes) become plain fields (``dtype``, ``complex_fields``,
+``parallel.topology``, ``scheme``).
+
+The reference-compatible command-line surface (including ``--cmd-from-file
+x.txt`` replay and ``--save-cmd-to-file``) lives in ``fdtd3d_tpu.cli``,
+which parses flags into this dataclass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+from fdtd3d_tpu import physics
+from fdtd3d_tpu.layout import get_mode
+
+
+@dataclasses.dataclass
+class PmlConfig:
+    """CPML absorbing boundary (reference PML/CPML flags, SURVEY.md §0/§2).
+
+    ``size``: thickness in cells per axis (0 disables on that axis). Applied
+    on both ends of each active axis, backed by the PEC wall.
+    Grading follows Roden & Gedney recursive-convolution CPML:
+    sigma ~ sigma_max * d^m, kappa = 1+(kappa_max-1) d^m, alpha linear in
+    (1-d), with sigma_max = -(m+1) ln(R0) / (2 eta0 dx * size).
+    """
+
+    size: Tuple[int, int, int] = (0, 0, 0)
+    m: float = 3.0                 # polynomial grading order
+    r0: float = 1e-8               # target normal-incidence reflection
+    kappa_max: float = 5.0
+    alpha_max: float = 0.05
+    sigma_scale: float = 1.0       # multiplier on the optimal sigma_max
+
+    @property
+    def enabled(self) -> bool:
+        return any(s > 0 for s in self.size)
+
+
+@dataclasses.dataclass
+class TfsfConfig:
+    """Total-field/scattered-field plane-wave injection.
+
+    Reference: TFSF source with 1D auxiliary incident grids EInc/HInc and
+    ``--angle-teta/phi/psi`` oblique incidence (SURVEY.md §3.4).
+    ``margin``: distance in cells from the domain wall (or from the PML inner
+    face if PML is on) to the TFSF box face, per axis.
+    Angles in degrees: teta = polar from +z, phi = azimuth from +x,
+    psi = polarization rotation about the propagation direction
+    (psi=0 -> E along the unit theta vector).
+    """
+
+    enabled: bool = False
+    margin: Tuple[int, int, int] = (8, 8, 8)
+    angle_teta: float = 0.0
+    angle_phi: float = 0.0
+    angle_psi: float = 0.0
+    amplitude: float = 1.0
+    # Incident waveform: "sin" (CW ramp-up) | "gauss_pulse" (modulated)
+    waveform: str = "sin"
+
+
+@dataclasses.dataclass
+class PointSourceConfig:
+    """Soft point (current) source on one field component.
+
+    Reference analog: point-source excitation used by BASELINE config #2
+    ("2D TMz point source"). Position in global cells.
+    """
+
+    enabled: bool = False
+    component: str = "Ez"
+    position: Tuple[int, int, int] = (0, 0, 0)
+    amplitude: float = 1.0
+    waveform: str = "sin"          # "sin" | "gauss_pulse" | "ricker"
+
+
+@dataclasses.dataclass
+class SphereConfig:
+    """Spherical inclusion (reference ``--eps-sphere*`` style material init)."""
+
+    enabled: bool = False
+    center: Tuple[float, float, float] = (0.0, 0.0, 0.0)  # cells
+    radius: float = 0.0                                   # cells
+    value: float = 1.0
+
+
+@dataclasses.dataclass
+class MaterialsConfig:
+    """Material definition (reference ``Scheme::initGrids`` fills, SURVEY §2).
+
+    Uniform background + optional sphere inclusions + optional load-from-file
+    (array path, .npy/.dat). Drude media: eps(w) = eps_inf -
+    wp^2 / (w^2 + i gamma w), active where omega_p > 0.
+    """
+
+    eps: float = 1.0               # background relative permittivity
+    mu: float = 1.0                # background relative permeability
+    sigma_e: float = 0.0           # electric conductivity S/m
+    sigma_m: float = 0.0           # magnetic loss
+    eps_sphere: SphereConfig = dataclasses.field(default_factory=SphereConfig)
+    mu_sphere: SphereConfig = dataclasses.field(default_factory=SphereConfig)
+    # Drude
+    use_drude: bool = False
+    eps_inf: float = 1.0
+    omega_p: float = 0.0           # rad/s (0 -> no plasma response)
+    gamma: float = 0.0             # collision rate, rad/s
+    drude_sphere: SphereConfig = dataclasses.field(default_factory=SphereConfig)
+    # load-from-file (path to .npy with shape (Nx,Ny,Nz) or broadcastable)
+    eps_file: Optional[str] = None
+    mu_file: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Spatial domain decomposition (reference ParallelGrid modes, SURVEY §2.9).
+
+    topology: "none" | "auto" | explicit (px,py,pz) via manual_topology.
+    Auto picks the factorization of n_devices over the ACTIVE axes minimizing
+    total halo surface (the reference's optimal-node-grid heuristic).
+    halo: ghost width in cells (reference ``--buffer-size``); the stencil
+    radius is 1, wider halos are accepted and validated but exchange width 1.
+    """
+
+    topology: str = "none"
+    manual_topology: Optional[Tuple[int, int, int]] = None
+    n_devices: Optional[int] = None  # default: all visible devices
+    halo: int = 1
+
+
+@dataclasses.dataclass
+class OutputConfig:
+    """Dump/diagnostics cadence (reference --save-res/dumpers, SURVEY §2)."""
+
+    save_res: int = 0              # every N steps dump fields (0 = never)
+    save_dir: str = "out"
+    formats: Tuple[str, ...] = ("dat",)   # subset of {"dat","txt","bmp"}
+    save_materials: bool = False
+    checkpoint_every: int = 0      # orbax/npz full-state checkpoint cadence
+    norms_every: int = 0           # print L2/Linf norms every N steps
+    log_level: int = 1
+
+
+@dataclasses.dataclass
+class SimConfig:
+    """Top-level solver configuration (reference Settings + CMake matrix)."""
+
+    scheme: str = "3D"
+    size: Tuple[int, int, int] = (32, 32, 32)   # cells per axis (global)
+    time_steps: int = 100
+    dx: float = 1e-3               # uniform spatial step, meters
+    courant_factor: float = 0.5
+    wavelength: float = 20e-3      # source wavelength, meters
+    dtype: str = "float32"         # "float32" | "float64" | "bfloat16"
+    complex_fields: bool = False   # reference COMPLEX_FIELD_VALUES mode
+
+    pml: PmlConfig = dataclasses.field(default_factory=PmlConfig)
+    tfsf: TfsfConfig = dataclasses.field(default_factory=TfsfConfig)
+    point_source: PointSourceConfig = dataclasses.field(
+        default_factory=PointSourceConfig)
+    materials: MaterialsConfig = dataclasses.field(
+        default_factory=MaterialsConfig)
+    parallel: ParallelConfig = dataclasses.field(
+        default_factory=ParallelConfig)
+    output: OutputConfig = dataclasses.field(default_factory=OutputConfig)
+
+    use_pallas: bool = False       # fused Pallas kernels for the 3D hot path
+
+    # ---- derived ----
+    @property
+    def mode(self):
+        return get_mode(self.scheme)
+
+    @property
+    def grid_shape(self) -> Tuple[int, int, int]:
+        return self.mode.grid_shape(self.size)
+
+    @property
+    def dt(self) -> float:
+        return physics.courant_dt(self.dx, self.courant_factor,
+                                  self.mode.ndim)
+
+    @property
+    def omega(self) -> float:
+        return 2.0 * math.pi * physics.C0 / self.wavelength
+
+    def np_dtype(self):
+        import numpy as np
+        base = {"float32": np.float32, "float64": np.float64,
+                "bfloat16": None}[self.dtype]
+        if self.dtype == "bfloat16":
+            import jax.numpy as jnp
+            base = jnp.bfloat16
+        if self.complex_fields:
+            return {"float32": np.complex64,
+                    "float64": np.complex128}[self.dtype]
+        return base
+
+    def validate(self) -> "SimConfig":
+        mode = self.mode  # raises on bad scheme
+        if not (0.0 < self.courant_factor <= 1.0):
+            raise ValueError("courant_factor must be in (0, 1]")
+        for a in range(3):
+            if a in mode.active_axes and self.size[a] < 4:
+                raise ValueError(f"active axis {a} needs >= 4 cells")
+        if self.pml.enabled:
+            for a in mode.active_axes:
+                if self.pml.size[a] * 2 + 4 > self.size[a] and \
+                        self.pml.size[a] > 0:
+                    raise ValueError(f"PML too thick on axis {a}")
+        if self.dtype not in ("float32", "float64", "bfloat16"):
+            raise ValueError(f"bad dtype {self.dtype}")
+        if self.point_source.enabled and \
+                self.point_source.component not in mode.e_components:
+            raise ValueError(
+                f"point source component {self.point_source.component!r} "
+                f"is not an active E component of scheme {self.scheme} "
+                f"(active: {mode.e_components})")
+        if self.complex_fields and self.dtype == "bfloat16":
+            raise ValueError("complex_fields requires float32/float64")
+        return self

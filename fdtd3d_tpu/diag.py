@@ -1,0 +1,71 @@
+"""Diagnostics: EM energy, error norms, per-step metrics.
+
+Reference parity: the printed L2/Linf error norms vs exact-solution
+callbacks and per-interval norm prints (SURVEY.md §2 "Exact solutions /
+callbacks", §5.5 metrics/observability). Norms are computed on GLOBAL
+arrays outside shard_map — XLA inserts the reduction collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from fdtd3d_tpu import materials, physics
+from fdtd3d_tpu.layout import component_axis
+
+
+def _energy_weights(sim):
+    """eps/mu weights per component, built once and cached on the sim."""
+    cache = getattr(sim, "_energy_weights", None)
+    if cache is not None:
+        return cache
+    cfg, mode = sim.cfg, sim.static.mode
+    mat = cfg.materials
+    cache = {}
+    for c in mode.e_components:
+        cache[c] = materials.scalar_or_grid(
+            c, sim.static.grid_shape, mode.active_axes, mat.eps,
+            mat.eps_sphere, mat.eps_file)
+    for c in mode.h_components:
+        cache[c] = materials.scalar_or_grid(
+            c, sim.static.grid_shape, mode.active_axes, mat.mu,
+            mat.mu_sphere, mat.mu_file)
+    sim._energy_weights = cache
+    return cache
+
+
+def em_energy(sim) -> float:
+    """Total electromagnetic field energy, J."""
+    mode = sim.static.mode
+    cell = sim.cfg.dx ** mode.ndim
+    weights = _energy_weights(sim)
+    total = 0.0
+    for c in mode.e_components:
+        total += 0.5 * physics.EPS0 * float(jnp.sum(
+            jnp.asarray(weights[c]) * jnp.abs(sim.state["E"][c]) ** 2)) * cell
+    for c in mode.h_components:
+        total += 0.5 * physics.MU0 * float(jnp.sum(
+            jnp.asarray(weights[c]) * jnp.abs(sim.state["H"][c]) ** 2)) * cell
+    return total
+
+
+def error_norms(actual: np.ndarray, expected: np.ndarray) -> Dict[str, float]:
+    """L2 (RMS) and Linf absolute error norms, plus relative L2."""
+    diff = np.abs(np.asarray(actual) - np.asarray(expected))
+    l2 = float(np.sqrt(np.mean(diff ** 2)))
+    linf = float(np.max(diff))
+    ref = float(np.sqrt(np.mean(np.abs(expected) ** 2)))
+    return {"l2": l2, "linf": linf,
+            "rel_l2": l2 / ref if ref > 0 else float("inf")}
+
+
+def field_norms(sim) -> Dict[str, float]:
+    """max|comp| for every stored field component (cheap health metric)."""
+    out = {}
+    for g in ("E", "H"):
+        for c, v in sim.state[g].items():
+            out[c] = float(jnp.max(jnp.abs(v)))
+    return out

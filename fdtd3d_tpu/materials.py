@@ -1,0 +1,86 @@
+"""Material grids evaluated at Yee-staggered component positions.
+
+Reference parity: ``Scheme::initGrids`` material fills (SURVEY.md §2 —
+uniform, spherical inclusions like ``--eps-sphere``, or loaded from file)
+and the dispersive OmegaPE/GammaE grids of the Drude update.
+
+Memory-conscious design: a uniform material evaluates to a python float
+(broadcast by XLA at trace time — zero HBM), only spatially-varying
+materials materialize full 3D arrays. Positions are taken at each
+component's own staggered location (layout.YEE_OFFSETS), matching the
+reference's per-component material sampling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import numpy as np
+
+from fdtd3d_tpu.layout import YEE_OFFSETS
+
+Material = Union[float, np.ndarray]
+
+
+def _positions(comp: str, shape, active_axes):
+    """Broadcastable (px, py, pz) position arrays, in cell units."""
+    off = YEE_OFFSETS[comp]
+    out = []
+    for a in range(3):
+        n = shape[a]
+        p = np.arange(n, dtype=np.float64) + (off[a] if n > 1 else 0.0)
+        bshape = [1, 1, 1]
+        bshape[a] = n
+        out.append(p.reshape(bshape))
+    return out
+
+
+def _sphere_mask(comp, shape, active_axes, sphere):
+    px, py, pz = _positions(comp, shape, active_axes)
+    d2 = 0.0
+    for a, p in enumerate((px, py, pz)):
+        if a in active_axes:
+            d2 = d2 + (p - sphere.center[a]) ** 2
+    return d2 <= sphere.radius ** 2
+
+
+def _load_file(path: str, shape) -> np.ndarray:
+    arr = np.load(path) if path.endswith(".npy") else np.fromfile(
+        path, dtype=np.float64).reshape(shape)
+    return np.broadcast_to(arr, shape).astype(np.float64)
+
+
+def scalar_or_grid(comp: str, shape, active_axes, base: float,
+                   sphere, file_path: Optional[str]) -> Material:
+    """Evaluate one material channel at ``comp``'s staggered positions."""
+    if file_path:
+        return _load_file(file_path, shape)
+    if sphere is not None and sphere.enabled and sphere.radius > 0:
+        grid = np.full(shape, base, dtype=np.float64)
+        grid[_sphere_mask(comp, shape, active_axes, sphere)] = sphere.value
+        return grid
+    return float(base)
+
+
+def drude_params(comp: str, shape, active_axes, mat) -> tuple:
+    """(omega_p, gamma, region_is_uniform) at comp positions.
+
+    When ``drude_sphere`` is enabled the plasma is confined to the sphere
+    (omega_p = 0 outside); otherwise the whole domain is Drude.
+    """
+    if mat.drude_sphere.enabled and mat.drude_sphere.radius > 0:
+        wp = np.zeros(shape, dtype=np.float64)
+        wp[_sphere_mask(comp, shape, active_axes, mat.drude_sphere)] = \
+            mat.omega_p
+        return wp, float(mat.gamma), False
+    return float(mat.omega_p), float(mat.gamma), True
+
+
+def merge_drude_eps(eps: Material, omega_p, eps_inf: float) -> Material:
+    """Background eps_r is eps_inf wherever the Drude plasma is active."""
+    if np.isscalar(omega_p):
+        return float(eps_inf) if omega_p > 0 else eps
+    grid = np.asarray(np.broadcast_to(np.asarray(eps, dtype=np.float64),
+                                      omega_p.shape)).copy()
+    grid[omega_p > 0] = eps_inf
+    return grid

@@ -1,0 +1,1 @@
+"""Numerical kernels: stencils, CPML, TFSF, Drude, sources."""

@@ -1,0 +1,51 @@
+"""Source waveforms and point-source injection.
+
+Reference parity: source excitation paths in ``Source/Scheme`` — TFSF uses
+the 1D incident line (ops/tfsf.py); point/hard sources excite a single cell
+(BASELINE config #2 "2D TMz point source"). All injections here are
+mask-driven (built from the sharded 1D global-coordinate arrays), so the
+same code runs unsharded and under shard_map.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def waveform(kind: str, t: jnp.ndarray, omega: float, dt: float):
+    """Scalar source waveform at physical time ``t`` (seconds).
+
+    kind:
+      "sin"         — CW sinusoid with a smooth half-period ramp (avoids
+                      the DC/step transient a cold-started sine carries)
+      "gauss_pulse" — sine-modulated Gaussian pulse, spectrum centered on
+                      omega
+      "ricker"      — Ricker (Mexican-hat) wavelet, peak frequency omega/2pi
+    """
+    period = 2.0 * math.pi / omega
+    if kind == "sin":
+        ramp = jnp.clip(t / (2.0 * period), 0.0, 1.0)
+        ramp = ramp * ramp * (3.0 - 2.0 * ramp)  # smoothstep
+        return ramp * jnp.sin(omega * t)
+    if kind == "gauss_pulse":
+        tau = 1.5 * period
+        t0 = 4.0 * tau
+        return jnp.sin(omega * t) * jnp.exp(-(((t - t0) / tau) ** 2))
+    if kind == "ricker":
+        f0 = omega / (2.0 * math.pi)
+        t0 = 1.5 / f0
+        a = (math.pi * f0) ** 2 * (t - t0) ** 2
+        return (1.0 - 2.0 * a) * jnp.exp(-a)
+    raise ValueError(f"unknown waveform {kind!r}")
+
+
+def point_mask(gx, gy, gz, pos, active_axes):
+    """One-hot 3D mask at a global cell, from sharded 1D coordinate arrays."""
+    ms = []
+    for a, g, p in ((0, gx, pos[0]), (1, gy, pos[1]), (2, gz, pos[2])):
+        m = (g == p) if a in active_axes else jnp.ones_like(g, dtype=bool)
+        ms.append(m)
+    return (ms[0][:, None, None] & ms[1][None, :, None]
+            & ms[2][None, None, :])

@@ -1,0 +1,96 @@
+"""Shift/difference stencil ops, halo-aware.
+
+The TPU-native replacement for the reference's per-cell ``calcField`` curl
+helpers and ``ParallelGrid::share()`` ghost exchange (SURVEY.md §2
+InternalScheme + ParallelGrid rows, §3.2): a finite difference along a
+sharded axis fetches its one-plane halo from the neighbor device with
+``lax.ppermute`` over the mesh axis; at the global domain edge the permute
+delivers zeros, which is exactly the PEC ghost value the reference uses.
+
+``make_diff_ops`` returns forward/backward difference closures bound to a
+mesh-axis mapping. With no mesh (or an unsharded axis) the halo is a zero
+plane. The SAME closures serve the single-chip path and the shard_map path —
+there is no separate "parallel kernel" the way the reference has
+``#ifdef PARALLEL_GRID`` twins.
+
+Sign/time conventions (leapfrog):
+  E-update uses BACKWARD differences of H:  (H[i] - H[i-1]) / d
+  H-update uses FORWARD  differences of E:  (E[i+1] - E[i]) / d
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# axis index (0/1/2) -> mesh axis name, or None when that axis is unsharded.
+MeshAxes = Dict[int, Optional[str]]
+
+
+def _neighbor_plane(plane: jnp.ndarray, axis_name: Optional[str],
+                    n_shards: int, downstream: bool) -> jnp.ndarray:
+    """Plane received from the adjacent shard, zeros at the global edge.
+
+    downstream=True: every shard sends `plane` to shard i+1 (so the result
+    each shard holds came from its LEFT neighbor). Non-periodic: shard 0
+    receives zeros — the PEC ghost value.
+    """
+    if axis_name is None or n_shards <= 1:
+        return jnp.zeros_like(plane)
+    if downstream:
+        perm = [(i, i + 1) for i in range(n_shards - 1)]
+    else:
+        perm = [(i + 1, i) for i in range(n_shards - 1)]
+    return lax.ppermute(plane, axis_name, perm)
+
+
+def make_diff_ops(
+    mesh_axes: Optional[MeshAxes] = None,
+    mesh_shape: Optional[Dict[str, int]] = None,
+) -> Tuple[Callable, Callable]:
+    """Build (diff_b, diff_f) difference ops.
+
+    diff_b(f, axis): f[i] - f[i-1]  (halo: last plane of left neighbor)
+    diff_f(f, axis): f[i+1] - f[i]  (halo: first plane of right neighbor)
+
+    A size-1 (inactive) axis yields exactly zero — this is what lets all 13
+    scheme modes share one kernel (layout.py module docstring).
+    """
+    mesh_axes = mesh_axes or {}
+    mesh_shape = mesh_shape or {}
+
+    def _shards(axis: int) -> Tuple[Optional[str], int]:
+        name = mesh_axes.get(axis)
+        return name, mesh_shape.get(name, 1) if name else 1
+
+    def diff_b(f: jnp.ndarray, axis: int) -> jnp.ndarray:
+        if f.shape[axis] == 1:
+            name, n = _shards(axis)
+            if n <= 1:
+                return jnp.zeros_like(f)
+        name, n = _shards(axis)
+        last = lax.slice_in_dim(f, f.shape[axis] - 1, f.shape[axis],
+                                axis=axis)
+        ghost = _neighbor_plane(last, name, n, downstream=True)
+        shifted = jnp.concatenate(
+            [ghost, lax.slice_in_dim(f, 0, f.shape[axis] - 1, axis=axis)],
+            axis=axis)
+        return f - shifted
+
+    def diff_f(f: jnp.ndarray, axis: int) -> jnp.ndarray:
+        if f.shape[axis] == 1:
+            name, n = _shards(axis)
+            if n <= 1:
+                return jnp.zeros_like(f)
+        name, n = _shards(axis)
+        first = lax.slice_in_dim(f, 0, 1, axis=axis)
+        ghost = _neighbor_plane(first, name, n, downstream=False)
+        shifted = jnp.concatenate(
+            [lax.slice_in_dim(f, 1, f.shape[axis], axis=axis), ghost],
+            axis=axis)
+        return shifted - f
+
+    return diff_b, diff_f

@@ -1,0 +1,263 @@
+"""Total-field / scattered-field (TFSF) plane-wave injection.
+
+Reference parity: the TFSF source with 1D auxiliary incident grids and
+oblique incidence (SURVEY.md §3.4 — ``performPlaneWave{E,H}Steps`` +
+``YeeGridLayout``'s 3D-border-point -> 1D-line interpolation;
+``--angle-teta/phi/psi``).
+
+Mechanism (standard Taflove ch.5 consistency corrections, re-derived for
+this codebase's accumulator formulation):
+
+* A 1D incident line (Einc at integer positions, Hinc at half positions,
+  spacing = dx) is leapfrogged each step with a hard source at cell 0 and a
+  graded matched-loss absorbing tail at the far end.
+* The total-field box is [lo_a, hi_a] per active axis in E-integer
+  coordinates. Stored fields inside are total, outside scattered. Every
+  curl difference that straddles the border is corrected by the incident
+  value of the missing field, interpolated off the line at the straddling
+  sample's own staggered position:
+
+    E-update of comp c, curl term (axis a, H comp d, sign s):
+      at g_a == lo_a : acc -= s * Hinc_d(pos_a = lo_a - 0.5) / dx
+      at g_a == hi_a : acc += s * Hinc_d(pos_a = hi_a + 0.5) / dx
+    H-update of comp c, curl term (axis a, E comp d, sign s):
+      at g_a == lo_a - 1 : acc -= s * Einc_d(pos_a = lo_a) / dx
+      at g_a == hi_a     : acc += s * Einc_d(pos_a = hi_a) / dx
+
+  (acc is the curl accumulator later multiplied by +cb for E and -db for H.)
+
+Shard-safety: every correction is (one-hot 1D mask along a) x (transverse
+slab of interpolated incident values). Both are computed from the SHARDED
+1D global-coordinate arrays in the coeffs pytree, so the same code runs
+single-chip and under shard_map; the incident line itself is replicated.
+
+Time alignment: Einc is advanced to t^{n+1} BEFORE the main E update (which
+consumes Hinc at t^{n+1/2}); Hinc advances after the E update. This mirrors
+the reference's performPlaneWaveESteps-before-field-steps ordering
+(SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from fdtd3d_tpu import physics
+from fdtd3d_tpu.layout import (CURL_TERMS, YEE_OFFSETS, component_axis)
+from fdtd3d_tpu.ops.sources import waveform
+
+_TAIL = 24  # absorbing-tail length on the incident line, cells
+
+
+@dataclasses.dataclass(frozen=True)
+class Correction:
+    """One face-plane consistency correction (static descriptor)."""
+
+    field: str        # "E" | "H": which update this correction belongs to
+    comp: str         # component being updated (e.g. "Ez")
+    axis: int         # derivative axis a
+    plane: int        # global integer coordinate g_a of the corrected cells
+    src: str          # incident component sampled (e.g. "Hy")
+    sign: float       # +-s/dx premultiplied sign (without 1/dx)
+    pos_a: float      # position along `axis` at which src is sampled (cells)
+    mask_comp: str    # component whose TRANSVERSE box membership gates the
+                      # correction: the updated comp for E-side (it must be
+                      # total-field), the sampled E comp for H-side (the
+                      # straddling sample must be total-field)
+
+
+@dataclasses.dataclass(frozen=True)
+class TfsfSetup:
+    """Static TFSF geometry: box, incidence basis, line length, corrections."""
+
+    lo: Tuple[int, int, int]
+    hi: Tuple[int, int, int]
+    khat: Tuple[float, float, float]
+    ehat: Tuple[float, float, float]
+    hhat: Tuple[float, float, float]
+    origin: Tuple[float, float, float]
+    zeta0: float            # guard offset added to projections (cells)
+    n_inc: int              # incident-line length
+    corrections: Tuple[Correction, ...]
+    waveform: str
+    amplitude: float
+
+
+def _incidence_basis(teta_deg, phi_deg, psi_deg):
+    """k/E/H unit vectors from the reference's teta/phi/psi angles."""
+    th, ph, ps = (math.radians(v) for v in (teta_deg, phi_deg, psi_deg))
+    khat = np.array([math.sin(th) * math.cos(ph),
+                     math.sin(th) * math.sin(ph),
+                     math.cos(th)])
+    # Spherical unit vectors at (th, ph); for th == 0 they default to (x, y).
+    theta_hat = np.array([math.cos(th) * math.cos(ph),
+                          math.cos(th) * math.sin(ph),
+                          -math.sin(th)])
+    phi_hat = np.array([-math.sin(ph), math.cos(ph), 0.0])
+    ehat = math.cos(ps) * theta_hat + math.sin(ps) * phi_hat
+    hhat = np.cross(khat, ehat)
+    return tuple(khat), tuple(ehat), tuple(hhat)
+
+
+def build_setup(cfg, static) -> TfsfSetup:
+    mode = static.mode
+    shape = static.grid_shape
+    lo, hi = [0, 0, 0], [0, 0, 0]
+    for a in range(3):
+        if a in mode.active_axes:
+            pad = cfg.pml.size[a] + cfg.tfsf.margin[a]
+            lo[a], hi[a] = pad, shape[a] - 1 - pad
+            if hi[a] - lo[a] < 2:
+                raise ValueError(f"TFSF box empty on axis {a}")
+    khat, ehat, hhat = _incidence_basis(
+        cfg.tfsf.angle_teta, cfg.tfsf.angle_phi, cfg.tfsf.angle_psi)
+    # Wave must not propagate along an inactive axis component-wise:
+    for a in range(3):
+        if a not in mode.active_axes and abs(khat[a]) > 1e-12:
+            raise ValueError(
+                f"incidence direction has a component along inactive axis "
+                f"{a} for scheme {mode.name}")
+    origin = tuple(
+        float(lo[a]) if khat[a] >= 0.0 else float(hi[a]) for a in range(3))
+    zeta0 = 2.0  # guard so slightly-negative projections stay in range
+    span = sum(abs(khat[a]) * (hi[a] - lo[a]) for a in mode.active_axes)
+    n_inc = int(math.ceil(span + zeta0)) + 8 + _TAIL
+
+    corrections: List[Correction] = []
+    # E-update corrections (incident H sampled at half positions).
+    for c in mode.e_components:
+        ca = component_axis(c)
+        for (a, d_axis, s) in CURL_TERMS[ca]:
+            d = "H" + "xyz"[d_axis]
+            if a not in mode.active_axes or d not in mode.h_components:
+                continue
+            corrections.append(Correction("E", c, a, lo[a], d, -s,
+                                          lo[a] - 0.5, c))
+            corrections.append(Correction("E", c, a, hi[a], d, +s,
+                                          hi[a] + 0.5, c))
+    # H-update corrections (incident E sampled at integer positions).
+    for c in mode.h_components:
+        ca = component_axis(c)
+        for (a, d_axis, s) in CURL_TERMS[ca]:
+            d = "E" + "xyz"[d_axis]
+            if a not in mode.active_axes or d not in mode.e_components:
+                continue
+            corrections.append(Correction("H", c, a, lo[a] - 1, d, -s,
+                                          float(lo[a]), d))
+            corrections.append(Correction("H", c, a, hi[a], d, +s,
+                                          float(hi[a]), d))
+    return TfsfSetup(tuple(lo), tuple(hi), khat, ehat, hhat, origin, zeta0,
+                     n_inc, tuple(corrections), cfg.tfsf.waveform,
+                     cfg.tfsf.amplitude)
+
+
+def line_loss_profiles(n_inc: int, dt: float, dx: float, dtype):
+    """Matched graded-loss absorbing tail for the 1D incident line.
+
+    In 1D a layer with sigma_m/mu0 == sigma_e/eps0 is perfectly matched at
+    the continuous level; cubic grading keeps the discrete reflection tiny.
+    Returns (ae, be, ah, bh): Einc = ae*Einc - be*dHinc ; likewise H.
+    """
+    sigma = np.zeros(n_inc, dtype=np.float64)
+    d = (np.arange(n_inc) - (n_inc - 1 - _TAIL)) / _TAIL
+    d = np.clip(d, 0.0, 1.0)
+    smax = 4.0 / (physics.ETA0 * _TAIL * dx)  # ~R0 1e-5 at normal incidence
+    sigma = smax * d ** 3
+    se = sigma * dt / (2.0 * physics.EPS0)
+    ae = ((1.0 - se) / (1.0 + se)).astype(dtype)
+    be = ((dt / (physics.EPS0 * dx)) / (1.0 + se)).astype(dtype)
+    # matched magnetic loss at half positions
+    d_h = (np.arange(n_inc) + 0.5 - (n_inc - 1 - _TAIL)) / _TAIL
+    d_h = np.clip(d_h, 0.0, 1.0)
+    sh = (smax * d_h ** 3) * dt / (2.0 * physics.EPS0)  # sigma_m/mu = sig/eps
+    ah = ((1.0 - sh) / (1.0 + sh)).astype(dtype)
+    bh = ((dt / (physics.MU0 * dx)) / (1.0 + sh)).astype(dtype)
+    return ae, be, ah, bh
+
+
+def advance_einc(inc: Dict[str, jnp.ndarray], coeffs, t, dt, omega,
+                 setup: TfsfSetup):
+    """Einc^{n} -> Einc^{n+1} using Hinc^{n+1/2}; hard source at cell 0."""
+    einc, hinc = inc["Einc"], inc["Hinc"]
+    dh = hinc - jnp.concatenate([jnp.zeros_like(hinc[:1]), hinc[:-1]])
+    einc = coeffs["inc_ae"] * einc - coeffs["inc_be"] * dh
+    src = setup.amplitude * waveform(setup.waveform,
+                                     (t.astype(einc.dtype) + 1.0) * dt,
+                                     omega, dt)
+    einc = einc.at[0].set(src.astype(einc.dtype))
+    return dict(inc, Einc=einc)
+
+
+def advance_hinc(inc: Dict[str, jnp.ndarray], coeffs, setup: TfsfSetup):
+    """Hinc^{n+1/2} -> Hinc^{n+3/2} using Einc^{n+1}."""
+    einc, hinc = inc["Einc"], inc["Hinc"]
+    de = jnp.concatenate([einc[1:], jnp.zeros_like(einc[:1])]) - einc
+    hinc = coeffs["inc_ah"] * hinc - coeffs["inc_bh"] * de
+    return dict(inc, Hinc=hinc)
+
+
+def _interp_line(line: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
+    """Linear interpolation of the 1D line at fractional index u (clipped)."""
+    u = jnp.clip(u, 0.0, line.shape[0] - 1.001)
+    i0 = jnp.floor(u).astype(jnp.int32)
+    w = (u - i0.astype(u.dtype))
+    return (1.0 - w) * jnp.take(line, i0) + w * jnp.take(line, i0 + 1)
+
+
+def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
+                    inc: Dict[str, jnp.ndarray], active_axes,
+                    dx: float) -> Optional[jnp.ndarray]:
+    """Sum of this component's TFSF curl-accumulator corrections (or None).
+
+    Built as sum over face planes of onehot_1d(axis) * slab(transverse),
+    everything derived from the sharded coordinate arrays gx/gy/gz.
+    """
+    gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
+    total = None
+    for corr in setup.corrections:
+        if corr.field != field or corr.comp != comp:
+            continue
+        # zeta at the sample position, as broadcastable sum of 1D arrays.
+        off = YEE_OFFSETS[corr.src]
+        zeta = setup.zeta0 + setup.khat[corr.axis] * (
+            corr.pos_a - setup.origin[corr.axis])
+        zeta = jnp.asarray(zeta, dtype=inc["Einc"].dtype)
+        for b in range(3):
+            if b == corr.axis or b not in active_axes:
+                continue
+            pb = gs[b].astype(inc["Einc"].dtype) + off[b]
+            shape = [1, 1, 1]
+            shape[b] = pb.shape[0]
+            zeta = zeta + setup.khat[b] * (
+                pb - setup.origin[b]).reshape(shape)
+        if corr.src[0] == "E":
+            val = _interp_line(inc["Einc"], zeta)
+            pol = setup.ehat[component_axis(corr.src)]
+        else:
+            # Hinc samples live at half positions on the line.
+            val = _interp_line(inc["Hinc"], zeta - 0.5)
+            pol = setup.hhat[component_axis(corr.src)]
+        if abs(pol) < 1e-14:
+            continue
+        onehot_shape = [1, 1, 1]
+        onehot_shape[corr.axis] = gs[corr.axis].shape[0]
+        gate = (gs[corr.axis] == corr.plane).reshape(onehot_shape)
+        gate = gate.astype(val.dtype)
+        # Restrict to the box's transverse cross-section (mask_comp's own
+        # staggered membership: half-offset positions occupy [lo, hi-1]).
+        m_off = YEE_OFFSETS[corr.mask_comp]
+        for b in range(3):
+            if b == corr.axis or b not in active_axes:
+                continue
+            hi_b = setup.hi[b] - 1 if m_off[b] == 0.5 else setup.hi[b]
+            ind = (gs[b] >= setup.lo[b]) & (gs[b] <= hi_b)
+            shape_b = [1, 1, 1]
+            shape_b[b] = ind.shape[0]
+            gate = gate * ind.reshape(shape_b).astype(val.dtype)
+        term = (corr.sign * pol / dx) * gate * val
+        total = term if total is None else total + term
+    return total

@@ -1,0 +1,9 @@
+"""Spatial domain decomposition over a TPU device mesh.
+
+The ParallelGrid/BufferShare replacement (SURVEY.md §2): 1/2/3-axis meshes,
+auto or manual topology, shard_map execution with ppermute halo exchange
+(the exchange itself lives in ops/stencil.py next to the differences).
+"""
+
+from fdtd3d_tpu.parallel.mesh import (  # noqa: F401
+    choose_topology, build_mesh, coeff_specs, state_specs, shard_tree)

@@ -1,0 +1,141 @@
+"""Device-mesh construction, topology selection, sharding specs.
+
+Reference parity (SURVEY.md §2 ParallelGrid row, §2.9 parallelism list):
+
+* 7 decomposition modes (x, y, z, xy, yz, xz, xyz) -> a 1/2/3-axis
+  ``jax.sharding.Mesh`` with axis names "x"/"y"/"z"; only active scheme axes
+  may be sharded.
+* auto-optimal node grid (``ParallelGridCore``'s topology heuristic) ->
+  ``choose_topology``: over all factorizations of n_devices onto the active
+  axes, minimize total halo-exchange surface (the same surface/volume
+  criterion the reference optimizes).
+* ``--manual-topology`` -> ``ParallelConfig.manual_topology``.
+* ghost/buffer exchange -> ``lax.ppermute`` inside the difference ops
+  (ops/stencil.py); the E-share/H-share points per step match §3.2.
+* ``DYNAMIC_GRID`` rebalancing is a deliberate non-goal (SPMD on homogeneous
+  chips; SURVEY.md §2.9 item 4).
+
+Sharding-spec conventions (inferred from coeffs/state key names + rank):
+rank-3 field arrays shard as P(x?, y?, z?); 1D arrays whose key ends in
+``_x``/``_y``/``_z`` (or equals gx/gy/gz) shard along that axis; everything
+else (incident line, scalars) is replicated.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = "xyz"
+
+
+def _factorizations(n: int, k: int):
+    """All ordered k-tuples of positive ints with product n."""
+    if k == 1:
+        yield (n,)
+        return
+    for f in range(1, n + 1):
+        if n % f == 0:
+            for rest in _factorizations(n // f, k - 1):
+                yield (f,) + rest
+
+
+def choose_topology(n_devices: int, grid_shape: Tuple[int, int, int],
+                    active_axes: Tuple[int, ...]) -> Tuple[int, int, int]:
+    """Minimal-halo-surface factorization of n_devices onto active axes.
+
+    Cost = per-device ghost-plane area exchanged per half-step
+         = sum over sharded axes a of 2 * (local cells / local n_a) —
+    the same surface-to-volume criterion the reference's auto topology
+    minimizes. Ties prefer fewer sharded axes (fewer collectives). Sharded
+    axes must divide evenly.
+    """
+    act = list(active_axes)
+    best, best_cost = None, None
+    for fac in _factorizations(n_devices, len(act)):
+        topo = [1, 1, 1]
+        ok = True
+        for a, f in zip(act, fac):
+            if grid_shape[a] % f != 0:
+                ok = False
+                break
+            topo[a] = f
+        if not ok:
+            continue
+        local = [grid_shape[a] / topo[a] for a in range(3)]
+        local_cells = float(np.prod([local[a] for a in act]))
+        cost = sum(2.0 * local_cells / local[a] for a in act if topo[a] > 1)
+        n_sharded = sum(1 for a in act if topo[a] > 1)
+        key = (cost, n_sharded)
+        if best is None or key < best_cost:
+            best, best_cost = tuple(topo), key
+    if best is None:
+        raise ValueError(
+            f"cannot factor {n_devices} devices onto grid {grid_shape} "
+            f"active axes {active_axes} with even division")
+    return best
+
+
+def build_mesh(topology: Tuple[int, int, int], devices=None) -> Mesh:
+    """Mesh with axis names x/y/z from an (px, py, pz) topology."""
+    n = int(np.prod(topology))
+    devices = devices if devices is not None else jax.devices()[:n]
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    dev_array = np.asarray(devices[:n]).reshape(topology)
+    return Mesh(dev_array, axis_names=("x", "y", "z"))
+
+
+def mesh_axis_map(topology: Tuple[int, int, int]) -> Dict[int, Optional[str]]:
+    """axis index -> mesh axis name for sharded axes (>1 shards) else None."""
+    return {a: (AXES[a] if topology[a] > 1 else None) for a in range(3)}
+
+
+def _axis_suffix(key: str) -> Optional[str]:
+    if key in ("gx", "gy", "gz"):
+        return key[1]
+    if len(key) > 2 and key[-2] == "_" and key[-1] in AXES:
+        return key[-1]
+    return None
+
+
+def _rank3_spec(topology) -> P:
+    return P(*[AXES[a] if topology[a] > 1 else None for a in range(3)])
+
+
+def coeff_specs(coeffs: Dict, topology) -> Dict:
+    """PartitionSpec tree for the coeffs pytree (see module docstring)."""
+    specs = {}
+    for k, v in coeffs.items():
+        nd = getattr(v, "ndim", 0)
+        if nd == 3:
+            specs[k] = _rank3_spec(topology)
+        elif nd == 1:
+            ax = _axis_suffix(k)
+            if ax is not None and topology[AXES.index(ax)] > 1:
+                specs[k] = P(ax)
+            else:
+                specs[k] = P()
+        else:
+            specs[k] = P()
+    return specs
+
+
+def state_specs(state: Dict, topology) -> Dict:
+    """PartitionSpec tree for the state pytree: fields sharded, rest repl."""
+    r3 = _rank3_spec(topology)
+
+    def spec_of(leaf):
+        return r3 if getattr(leaf, "ndim", 0) == 3 else P()
+
+    return jax.tree.map(spec_of, state)
+
+
+def shard_tree(tree, specs, mesh: Mesh):
+    """device_put every leaf with its NamedSharding."""
+    return jax.tree.map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)), tree, specs)

@@ -1,0 +1,302 @@
+"""Functional solver core: state pytree, coefficients, leapfrog step.
+
+TPU-native replacement for the reference's ``InternalScheme`` (hot stencils)
++ ``Scheme`` (orchestration) pair (SURVEY.md §2, §3.1). Design stance per
+SURVEY.md §7: the solver state is a pytree
+``{E, H, psi_E, psi_H, J, inc, t}``; materials/profiles are a coeffs pytree;
+one pure ``step(state, coeffs) -> state``; ``lax.scan`` over steps; ``jit``
+around the whole loop; the SAME step runs single-chip or inside
+``shard_map`` (halo exchange is inside the difference ops, stencil.py).
+
+Update equations (SI units; leapfrog; acc is the curl accumulator):
+
+  E_c^{n+1} = ca_c E_c^n + cb_c (acc_E - J_c^{n+1/2})
+      acc_E = sum_terms s * (ik_a * dH_d/da + psi_{c,a}) + TFSF corrections
+      psi_{c,a}^{n+1} = b_a psi + c_a dH_d/da            (CPML, "e" profiles)
+  H_c^{n+3/2} = da_c H_c^{n+1/2} - db_c acc_H            ("h" profiles)
+  J_c^{n+1/2} = kj J_c^{n-1/2} + bj E_c^n                (Drude ADE)
+
+with ca = (1 - se)/(1 + se), cb = dt/(eps0 eps_r)/(1 + se),
+se = sigma_e dt/(2 eps0 eps_r) (dually da/db with mu, sigma_m), and
+kj = (1 - g dt/2)/(1 + g dt/2), bj = eps0 wp^2 dt/(1 + g dt/2).
+
+The 13 scheme modes share this one kernel: inactive axes are singleton dims
+(zero derivative), inactive components are absent from the pytree
+(layout.py). PEC walls are 1D multiplicative masks on tangential E.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fdtd3d_tpu import materials, physics
+from fdtd3d_tpu.config import SimConfig
+from fdtd3d_tpu.layout import (CURL_TERMS, component_axis, get_mode)
+from fdtd3d_tpu.ops import cpml, tfsf
+from fdtd3d_tpu.ops.sources import point_mask, waveform
+from fdtd3d_tpu.ops.stencil import make_diff_ops
+
+AXES = "xyz"
+
+
+@dataclasses.dataclass(frozen=True)
+class StaticSetup:
+    """Everything trace-static: closed over by the step function."""
+
+    cfg: SimConfig
+    mode: Any
+    grid_shape: Tuple[int, int, int]
+    dt: float
+    dx: float
+    omega: float
+    pml_axes: Tuple[int, ...]        # active axes with a PML slab
+    tfsf_setup: Optional[tfsf.TfsfSetup]
+    use_drude: bool
+    field_dtype: Any
+    real_dtype: Any
+
+
+def build_static(cfg: SimConfig) -> StaticSetup:
+    cfg.validate()
+    if cfg.dtype == "float64" and not jax.config.jax_enable_x64:
+        # The reference computes in C++ double; honor float64 requests
+        # instead of letting jax silently truncate to f32.
+        jax.config.update("jax_enable_x64", True)
+    mode = cfg.mode
+    real = {"float32": np.float32, "float64": np.float64,
+            "bfloat16": jnp.bfloat16}[cfg.dtype]
+    field = cfg.np_dtype()
+    pml_axes = tuple(a for a in mode.active_axes if cfg.pml.size[a] > 0)
+    st = StaticSetup(
+        cfg=cfg, mode=mode, grid_shape=cfg.grid_shape, dt=cfg.dt,
+        dx=cfg.dx, omega=cfg.omega, pml_axes=pml_axes, tfsf_setup=None,
+        use_drude=cfg.materials.use_drude, field_dtype=field,
+        real_dtype=real)
+    if cfg.tfsf.enabled:
+        st = dataclasses.replace(st, tfsf_setup=tfsf.build_setup(cfg, st))
+    return st
+
+
+# --------------------------------------------------------------------------
+# coefficients (host-built numpy; device_put + sharding happens in parallel/)
+# --------------------------------------------------------------------------
+
+def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
+    cfg, mode = static.cfg, static.mode
+    shape = static.grid_shape
+    dt, rd = static.dt, static.real_dtype
+    mat = cfg.materials
+    out: Dict[str, Any] = {}
+
+    for a in range(3):
+        out[f"g{AXES[a]}"] = np.arange(shape[a], dtype=np.int32)
+        wall = np.ones(shape[a], dtype=rd)
+        if a in mode.active_axes:
+            wall[0] = 0.0
+            wall[-1] = 0.0
+        out[f"wall_{AXES[a]}"] = wall
+
+    def _cast(v):
+        return rd(v) if np.isscalar(v) else v.astype(rd)
+
+    for c in mode.e_components:
+        eps = materials.scalar_or_grid(c, shape, mode.active_axes, mat.eps,
+                                       mat.eps_sphere, mat.eps_file)
+        if static.use_drude:
+            wp, gamma, _ = materials.drude_params(c, shape,
+                                                  mode.active_axes, mat)
+            eps = materials.merge_drude_eps(eps, wp, mat.eps_inf)
+            out[f"kj_{c}"] = _cast((1.0 - gamma * dt / 2.0)
+                                   / (1.0 + gamma * dt / 2.0))
+            out[f"bj_{c}"] = _cast(physics.EPS0 * np.square(wp) * dt
+                                   / (1.0 + gamma * dt / 2.0))
+        se = mat.sigma_e * dt / (2.0 * physics.EPS0 * np.asarray(eps))
+        out[f"ca_{c}"] = _cast((1.0 - se) / (1.0 + se))
+        out[f"cb_{c}"] = _cast(dt / (physics.EPS0 * np.asarray(eps))
+                               / (1.0 + se))
+
+    for c in mode.h_components:
+        mu = materials.scalar_or_grid(c, shape, mode.active_axes, mat.mu,
+                                      mat.mu_sphere, mat.mu_file)
+        sm = mat.sigma_m * dt / (2.0 * physics.MU0 * np.asarray(mu))
+        out[f"da_{c}"] = _cast((1.0 - sm) / (1.0 + sm))
+        out[f"db_{c}"] = _cast(dt / (physics.MU0 * np.asarray(mu))
+                               / (1.0 + sm))
+
+    if static.pml_axes:
+        out.update(cpml.build_cpml_coeffs(cfg, static, rd))
+
+    if static.tfsf_setup is not None:
+        ae, be, ah, bh = tfsf.line_loss_profiles(
+            static.tfsf_setup.n_inc, dt, static.dx, rd)
+        out.update(inc_ae=ae, inc_be=be, inc_ah=ah, inc_bh=bh)
+
+    return out
+
+
+def init_state(static: StaticSetup) -> Dict[str, Any]:
+    shape, fd = static.grid_shape, static.field_dtype
+    mode = static.mode
+    zeros = lambda: jnp.zeros(shape, dtype=fd)  # noqa: E731
+    state: Dict[str, Any] = {
+        "E": {c: zeros() for c in mode.e_components},
+        "H": {c: zeros() for c in mode.h_components},
+        "t": jnp.zeros((), dtype=jnp.int32),
+    }
+    psi_e, psi_h = {}, {}
+    for c in mode.e_components:
+        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+            if a in static.pml_axes:
+                psi_e[f"{c}_{AXES[a]}"] = zeros()
+    for c in mode.h_components:
+        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+            if a in static.pml_axes:
+                psi_h[f"{c}_{AXES[a]}"] = zeros()
+    if psi_e:
+        state["psi_E"] = psi_e
+        state["psi_H"] = psi_h
+    if static.use_drude:
+        state["J"] = {c: zeros() for c in mode.e_components}
+    if static.tfsf_setup is not None:
+        n = static.tfsf_setup.n_inc
+        state["inc"] = {"Einc": jnp.zeros(n, dtype=fd),
+                        "Hinc": jnp.zeros(n, dtype=fd)}
+    return state
+
+
+# --------------------------------------------------------------------------
+# the step
+# --------------------------------------------------------------------------
+
+def _bcast1d(arr: jnp.ndarray, axis: int) -> jnp.ndarray:
+    shape = [1, 1, 1]
+    shape[axis] = arr.shape[0]
+    return arr.reshape(shape)
+
+
+def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
+    """Build the pure leapfrog step. mesh_axes/mesh_shape: see stencil.py."""
+    mode, cfg = static.mode, static.cfg
+    diff_b, diff_f = make_diff_ops(mesh_axes, mesh_shape)
+    inv_dx = 1.0 / static.dx
+    setup = static.tfsf_setup
+    ps = cfg.point_source
+
+    def _half_update(field: str, state, coeffs, new_psi):
+        """One family update (field='E' or 'H'). Returns new component dict."""
+        upd_comps = mode.e_components if field == "E" else mode.h_components
+        src = state["H"] if field == "E" else state["E"]
+        tag = "e" if field == "E" else "h"
+        diff = diff_b if field == "E" else diff_f
+        psi_key = "psi_E" if field == "E" else "psi_H"
+        out = {}
+        for c in upd_comps:
+            acc = None
+            for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+                d = ("H" if field == "E" else "E") + AXES[d_axis]
+                if d not in src:
+                    continue
+                dfa = diff(src[d], a) * inv_dx
+                if a in static.pml_axes:
+                    ax = AXES[a]
+                    b = _bcast1d(coeffs[f"pml_b{tag}_{ax}"], a)
+                    cc = _bcast1d(coeffs[f"pml_c{tag}_{ax}"], a)
+                    ik = _bcast1d(coeffs[f"pml_ik{tag}_{ax}"], a)
+                    key = f"{c}_{ax}"
+                    psi = b * state[psi_key][key] + cc * dfa
+                    new_psi[psi_key][key] = psi
+                    term = ik * dfa + psi
+                else:
+                    term = dfa
+                acc = s * term if acc is None else acc + s * term
+            if acc is None:
+                acc = jnp.zeros(static.grid_shape, static.field_dtype)
+            if setup is not None:
+                corr = tfsf.corrections_for(field, c, setup, coeffs,
+                                            state["inc"], mode.active_axes,
+                                            static.dx)
+                if corr is not None:
+                    acc = acc + corr
+            out[c] = acc
+        return out
+
+    def step(state, coeffs):
+        t = state["t"]
+        new_state = dict(state)
+        new_psi = {"psi_E": dict(state.get("psi_E", {})),
+                   "psi_H": dict(state.get("psi_H", {}))}
+
+        # 1. incident line E advance (Einc -> t^{n+1}); see tfsf.py timing.
+        if setup is not None:
+            new_state["inc"] = tfsf.advance_einc(
+                state["inc"], coeffs, t, static.dt, static.omega, setup)
+            state = dict(state, inc=new_state["inc"])
+
+        # 2. E family
+        acc_e = _half_update("E", state, coeffs, new_psi)
+        new_E = {}
+        for c in mode.e_components:
+            acc = acc_e[c]
+            if static.use_drude:
+                j_new = coeffs[f"kj_{c}"] * state["J"][c] \
+                    + coeffs[f"bj_{c}"] * state["E"][c]
+                new_state.setdefault("J", {})
+                new_state["J"] = dict(new_state.get("J", {}), **{c: j_new})
+                acc = acc - j_new
+            if ps.enabled and ps.component == c:
+                mask = point_mask(coeffs["gx"], coeffs["gy"], coeffs["gz"],
+                                  ps.position, mode.active_axes)
+                wf = waveform(ps.waveform,
+                              (t.astype(static.real_dtype) + 0.5)
+                              * static.dt, static.omega, static.dt)
+                acc = acc + ps.amplitude * wf * mask.astype(acc.dtype)
+            e = coeffs[f"ca_{c}"] * state["E"][c] + coeffs[f"cb_{c}"] * acc
+            # PEC walls: zero tangential E on the walls of transverse axes.
+            for a in mode.active_axes:
+                if a != component_axis(c):
+                    e = e * _bcast1d(coeffs[f"wall_{AXES[a]}"], a)
+            new_E[c] = e.astype(static.field_dtype)
+        new_state["E"] = new_E
+        state = dict(state, E=new_E)
+
+        # 3. incident line H advance (Hinc -> t^{n+3/2})
+        if setup is not None:
+            new_state["inc"] = tfsf.advance_hinc(new_state["inc"], coeffs,
+                                                 setup)
+            state = dict(state, inc=new_state["inc"])
+
+        # 4. H family
+        acc_h = _half_update("H", state, coeffs, new_psi)
+        new_H = {}
+        for c in mode.h_components:
+            h = coeffs[f"da_{c}"] * state["H"][c] \
+                - coeffs[f"db_{c}"] * acc_h[c]
+            new_H[c] = h.astype(static.field_dtype)
+        new_state["H"] = new_H
+
+        if new_psi["psi_E"]:
+            new_state["psi_E"] = new_psi["psi_E"]
+            new_state["psi_H"] = new_psi["psi_H"]
+        new_state["t"] = t + 1
+        return new_state
+
+    return step
+
+
+def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
+    """scan-over-steps runner: run_chunk(state, coeffs, n) with static n."""
+    step = make_step(static, mesh_axes, mesh_shape)
+
+    def run_chunk(state, coeffs, n: int):
+        def body(s, _):
+            return step(s, coeffs), None
+        out, _ = jax.lax.scan(body, state, None, length=n)
+        return out
+
+    return run_chunk

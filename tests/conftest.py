@@ -1,0 +1,14 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's oversubscribed single-host `mpirun -n N` unit-test
+pattern for ParallelGrid (SURVEY.md §4) with XLA's host-platform device
+count, per the driver's instructions.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,116 @@
+"""Independent pure-numpy FDTD oracle for cross-checking the JAX solver.
+
+Deliberately written in a different style (explicit slice indexing, float64
+throughout, per-step python loop) so that shared indexing/sign bugs with the
+production kernels are unlikely. Implements the reference physics oracle
+role of the exact-solution callbacks (SURVEY.md §4: "the physics itself is
+the oracle").
+
+Conventions matched to the production solver:
+  * zero ghost values outside the grid (PEC-backed),
+  * tangential E forced to 0 on the walls of active axes,
+  * soft point source adds A*wf((t+1/2) dt) into the curl accumulator,
+  * E update first (uses H^{n+1/2}), then H.
+"""
+
+import math
+
+import numpy as np
+
+EPS0 = 8.8541878128e-12
+MU0 = 1.25663706212e-6
+C0 = 299792458.0
+
+
+def wf_sin(t, omega):
+    period = 2.0 * math.pi / omega
+    r = min(max(t / (2.0 * period), 0.0), 1.0)
+    r = r * r * (3.0 - 2.0 * r)
+    return r * math.sin(omega * t)
+
+
+def run_tmz(n, steps, dx, dt, omega, src, amp=1.0):
+    """2D TMz vacuum, soft Ez point source at `src`=(i,j). Returns Ez,Hx,Hy."""
+    ez = np.zeros((n, n))
+    hx = np.zeros((n, n))
+    hy = np.zeros((n, n))
+    cb = dt / EPS0
+    db = dt / MU0
+    for t in range(steps):
+        curl = np.zeros_like(ez)
+        curl += hy / dx
+        curl[1:, :] -= hy[:-1, :] / dx
+        curl -= hx / dx
+        curl[:, 1:] += hx[:, :-1] / dx
+        curl[src] += amp * wf_sin((t + 0.5) * dt, omega)
+        ez = ez + cb * curl
+        ez[0, :] = 0.0
+        ez[-1, :] = 0.0
+        ez[:, 0] = 0.0
+        ez[:, -1] = 0.0
+        # Hx -= db * dEz/dy ; Hy += db * dEz/dx  (forward differences)
+        dey = np.zeros_like(ez)
+        dey[:, :-1] = (ez[:, 1:] - ez[:, :-1]) / dx
+        dey[:, -1] = (0.0 - ez[:, -1]) / dx
+        dex = np.zeros_like(ez)
+        dex[:-1, :] = (ez[1:, :] - ez[:-1, :]) / dx
+        dex[-1, :] = (0.0 - ez[-1, :]) / dx
+        hx = hx - db * dey
+        hy = hy + db * dex
+    return ez, hx, hy
+
+
+def run_3d(n, steps, dx, dt, omega, src, amp=1.0):
+    """3D vacuum, soft Ez point source. Returns dict of all six fields."""
+    shp = (n, n, n)
+    F = {k: np.zeros(shp) for k in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz")}
+    cb = dt / EPS0
+    db = dt / MU0
+
+    def bdiff(f, ax):
+        out = f.copy()
+        sl = [slice(None)] * 3
+        sr = [slice(None)] * 3
+        sl[ax] = slice(1, None)
+        sr[ax] = slice(None, -1)
+        out[tuple(sl)] -= f[tuple(sr)]
+        return out / dx
+
+    def fdiff(f, ax):
+        out = -f.copy()
+        sl = [slice(None)] * 3
+        sr = [slice(None)] * 3
+        sl[ax] = slice(None, -1)
+        sr[ax] = slice(1, None)
+        out[tuple(sl)] += f[tuple(sr)]
+        return out / dx
+
+    def pec(f, comp_axis):
+        for a in range(3):
+            if a == comp_axis:
+                continue
+            sl0 = [slice(None)] * 3
+            sl1 = [slice(None)] * 3
+            sl0[a] = 0
+            sl1[a] = -1
+            f[tuple(sl0)] = 0.0
+            f[tuple(sl1)] = 0.0
+
+    for t in range(steps):
+        cex = bdiff(F["Hz"], 1) - bdiff(F["Hy"], 2)
+        cey = bdiff(F["Hx"], 2) - bdiff(F["Hz"], 0)
+        cez = bdiff(F["Hy"], 0) - bdiff(F["Hx"], 1)
+        cez[src] += amp * wf_sin((t + 0.5) * dt, omega)
+        F["Ex"] = F["Ex"] + cb * cex
+        F["Ey"] = F["Ey"] + cb * cey
+        F["Ez"] = F["Ez"] + cb * cez
+        pec(F["Ex"], 0)
+        pec(F["Ey"], 1)
+        pec(F["Ez"], 2)
+        chx = fdiff(F["Ez"], 1) - fdiff(F["Ey"], 2)
+        chy = fdiff(F["Ex"], 2) - fdiff(F["Ez"], 0)
+        chz = fdiff(F["Ey"], 0) - fdiff(F["Ex"], 1)
+        F["Hx"] = F["Hx"] - db * chx
+        F["Hy"] = F["Hy"] - db * chy
+        F["Hz"] = F["Hz"] - db * chz
+    return F

@@ -1,0 +1,67 @@
+"""TFSF plane-wave injection tests (3D containment, oblique incidence).
+
+The scattered-field region outside the TFSF box must stay clean in vacuum:
+machine-precision clean for axis-aligned incidence (the 1D line and the
+grid share the same discrete dispersion along an axis), and below the
+standard interpolation/dispersion floor (~-45 dB) for oblique incidence.
+"""
+
+import numpy as np
+
+from fdtd3d_tpu.config import SimConfig, TfsfConfig
+from fdtd3d_tpu.sim import Simulation
+
+
+def _scattered_max(field, shell):
+    return max(
+        np.abs(field[:shell]).max(), np.abs(field[-shell:]).max(),
+        np.abs(field[:, :shell]).max(), np.abs(field[:, -shell:]).max(),
+        np.abs(field[:, :, :shell]).max(), np.abs(field[:, :, -shell:]).max())
+
+
+def test_3d_normal_incidence_containment():
+    cfg = SimConfig(
+        scheme="3D", size=(40, 40, 40), time_steps=60, dx=1e-3,
+        courant_factor=0.5, wavelength=15e-3,
+        tfsf=TfsfConfig(enabled=True, margin=(10, 10, 10),
+                        angle_teta=0.0, angle_phi=0.0, angle_psi=0.0))
+    sim = Simulation(cfg)
+    sim.run()
+    ex = sim.field("Ex")
+    inside = np.abs(ex[12:28, 12:28, 12:28]).max()
+    assert inside > 0.1, "incident wave did not enter the box"
+    leak = _scattered_max(ex, 8)
+    assert leak < 1e-6 * inside, f"leak {leak} vs inside {inside}"
+
+
+def test_3d_oblique_incidence_contained_below_dispersion_floor():
+    cfg = SimConfig(
+        scheme="3D", size=(40, 40, 40), time_steps=80, dx=1e-3,
+        courant_factor=0.5, wavelength=15e-3,
+        tfsf=TfsfConfig(enabled=True, margin=(10, 10, 10),
+                        angle_teta=45.0, angle_phi=30.0, angle_psi=20.0))
+    sim = Simulation(cfg)
+    sim.run()
+    leak, inside = 0.0, 0.0
+    for comp in ("Ex", "Ey", "Ez"):
+        f = sim.field(comp)
+        inside = max(inside, np.abs(f[12:28, 12:28, 12:28]).max())
+        leak = max(leak, _scattered_max(f, 8))
+    assert inside > 0.05
+    assert leak < 2e-2 * inside, f"oblique leak {leak} vs inside {inside}"
+
+
+def test_2d_tmz_tfsf_containment():
+    cfg = SimConfig(
+        scheme="2D_TMz", size=(48, 48, 1), time_steps=70, dx=1e-3,
+        courant_factor=0.5, wavelength=15e-3,
+        tfsf=TfsfConfig(enabled=True, margin=(12, 12, 0),
+                        angle_teta=90.0, angle_phi=0.0, angle_psi=180.0))
+    sim = Simulation(cfg)
+    sim.run()
+    ez = sim.field("Ez")[:, :, 0]
+    inside = np.abs(ez[14:34, 14:34]).max()
+    leak = max(np.abs(ez[:10]).max(), np.abs(ez[-10:]).max(),
+               np.abs(ez[:, :10]).max(), np.abs(ez[:, -10:]).max())
+    assert inside > 0.1
+    assert leak < 1e-5 * inside, f"leak {leak} vs inside {inside}"
