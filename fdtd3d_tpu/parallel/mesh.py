@@ -51,8 +51,9 @@ def choose_topology(n_devices: int, grid_shape: Tuple[int, int, int],
     Cost = per-device ghost-plane area exchanged per half-step
          = sum over sharded axes a of 2 * (local cells / local n_a) —
     the same surface-to-volume criterion the reference's auto topology
-    minimizes. Ties prefer fewer sharded axes (fewer collectives). Sharded
-    axes must divide evenly.
+    minimizes. Ties prefer MORE sharded axes: on the TPU torus each mesh
+    axis rides its own ICI links, so 3-axis halos move concurrently.
+    Sharded axes must divide evenly.
     """
     act = list(active_axes)
     best, best_cost = None, None
@@ -70,7 +71,7 @@ def choose_topology(n_devices: int, grid_shape: Tuple[int, int, int],
         local_cells = float(np.prod([local[a] for a in act]))
         cost = sum(2.0 * local_cells / local[a] for a in act if topo[a] > 1)
         n_sharded = sum(1 for a in act if topo[a] > 1)
-        key = (cost, n_sharded)
+        key = (cost, -n_sharded)
         if best is None or key < best_cost:
             best, best_cost = tuple(topo), key
     if best is None:

@@ -12,3 +12,10 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's TPU plugin overrides JAX_PLATFORMS at registration, so
+# pin the platform through the config API too (verified: env var alone
+# still yields the TPU; config.update yields the 8 virtual CPU devices).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,89 @@
+"""Drude dispersive-media tests.
+
+Physics oracle (SURVEY.md §4 posture): a Drude metal driven below its
+plasma frequency has eps(w) = eps_inf - wp^2/(w^2 + i g w) < 0 — waves
+must reflect off it and decay evanescently inside, at the analytic skin
+depth. Reference parity: the dispersive (Drude "metamaterial") update with
+OmegaPE/GammaE grids (SURVEY.md §2 InternalScheme row; BASELINE config #5).
+"""
+
+import math
+
+import numpy as np
+
+from fdtd3d_tpu import physics
+from fdtd3d_tpu.config import (MaterialsConfig, PmlConfig, SimConfig,
+                               SphereConfig, TfsfConfig)
+from fdtd3d_tpu.sim import Simulation
+
+
+def test_drude_metal_reflects_and_is_evanescent_inside():
+    n = 160
+    wavelength = 15e-3
+    omega = 2 * math.pi * physics.C0 / wavelength
+    wp = 3.0 * omega  # eps(omega) = 1 - 9 = -8: strongly metallic
+    # "slab": a huge drude sphere centered deep in the right half.
+    cfg = SimConfig(
+        scheme="1D_EzHy", size=(n, 1, 1), time_steps=1600, dx=1e-3,
+        courant_factor=0.5, wavelength=wavelength,
+        # PML so the wave reflected off the metal is absorbed once it
+        # leaves the TFSF box (a bare PEC wall would bounce it back in).
+        pml=PmlConfig(size=(10, 0, 0)),
+        tfsf=TfsfConfig(enabled=True, margin=(8, 0, 0),
+                        angle_teta=90.0, angle_phi=0.0, angle_psi=180.0),
+        materials=MaterialsConfig(
+            use_drude=True, eps_inf=1.0, omega_p=wp, gamma=0.0,
+            drude_sphere=SphereConfig(enabled=True, center=(n, 0.0, 0.0),
+                                      radius=n - 100.0)),
+    )
+    sim = Simulation(cfg)
+    sim.run()
+    interface = 100  # drude region starts at x = 100
+
+    # Standing wave in front of the metal: |Ez| has temporal nodes, so
+    # sample several snapshots across one optical period (~33 steps) and
+    # take the envelope; full reflection gives max approaching 2x incident.
+    front_max, inside_max = 0.0, 0.0
+    for _ in range(6):
+        sim.advance(7)
+        ez = sim.field("Ez")[:, 0, 0]
+        front_max = max(front_max, np.abs(ez[40:interface - 5]).max())
+        inside_max = max(inside_max,
+                         np.abs(ez[interface + 12: interface + 18]).max())
+    assert front_max > 1.5, f"no standing wave, max {front_max:.2f}"
+    ez = sim.field("Ez")[:, 0, 0]
+
+    # Evanescent decay inside: analytic kappa = k0 * sqrt(|eps|)
+    k0 = omega / physics.C0 * cfg.dx  # per cell
+    kappa = k0 * math.sqrt(8.0)
+    depth = 12
+    expected_bound = 2.0 * math.exp(-kappa * depth)
+    assert inside_max < 3.0 * expected_bound + 0.02, (
+        f"not evanescent: |Ez|={inside_max:.3f} at depth {depth}, "
+        f"bound {expected_bound:.4f}")
+
+    # And the fields stayed finite/stable over the whole run.
+    assert np.isfinite(ez).all()
+
+
+def test_drude_transparent_above_plasma_frequency():
+    """wp << omega: eps -> eps_inf, the wave passes essentially unchanged."""
+    n = 160
+    wavelength = 15e-3
+    omega = 2 * math.pi * physics.C0 / wavelength
+    cfg = SimConfig(
+        scheme="1D_EzHy", size=(n, 1, 1), time_steps=1100, dx=1e-3,
+        courant_factor=0.5, wavelength=wavelength,
+        tfsf=TfsfConfig(enabled=True, margin=(10, 0, 0),
+                        angle_teta=90.0, angle_phi=0.0, angle_psi=180.0),
+        materials=MaterialsConfig(
+            use_drude=True, eps_inf=1.0, omega_p=0.05 * omega, gamma=0.0,
+            drude_sphere=SphereConfig(enabled=True, center=(n, 0.0, 0.0),
+                                      radius=n - 100.0)),
+    )
+    sim = Simulation(cfg)
+    sim.run()
+    ez = sim.field("Ez")[:, 0, 0]
+    # Deep inside the weak plasma the CW amplitude stays near 1.
+    inside = np.abs(ez[120:145]).max()
+    assert 0.8 < inside < 1.3, f"transmission wrong: {inside:.3f}"

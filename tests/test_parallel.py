@@ -1,0 +1,123 @@
+"""Domain-decomposition tests on the 8-device virtual CPU mesh.
+
+The ParallelGrid/BufferShare test analog (SURVEY.md §4: the reference runs
+unit-test-parallel-grid under oversubscribed `mpirun -n N` for each
+buffer-dimension mode). Here: every decomposition topology the reference
+supports (x, y, z, xy, yz, xz, xyz — SURVEY.md §2.9) must produce fields
+IDENTICAL (up to f32 roundoff) to the unsharded run, with the full physics
+stack active (CPML + TFSF + Drude) so every ppermute halo path is hit.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig, SphereConfig,
+                               TfsfConfig)
+from fdtd3d_tpu.parallel.mesh import choose_topology
+from fdtd3d_tpu.sim import Simulation
+
+TOPOLOGIES = [
+    (2, 1, 1), (1, 2, 1), (1, 1, 2),          # 1-axis (x | y | z)
+    (2, 2, 1), (1, 2, 2), (2, 1, 2),          # 2-axis (xy | yz | xz)
+    (2, 2, 2),                                # 3-axis (xyz)
+    (4, 2, 1),                                # uneven 2-axis
+]
+
+
+def _full_physics_cfg(parallel=None):
+    n = 16
+    return SimConfig(
+        scheme="3D", size=(n, n, n), time_steps=12, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(3, 3, 3)),
+        tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                        angle_teta=30.0, angle_phi=40.0, angle_psi=15.0),
+        materials=MaterialsConfig(
+            eps=1.0, use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+            drude_sphere=SphereConfig(enabled=True,
+                                      center=(8.0, 8.0, 8.0), radius=3.0)),
+        parallel=parallel or ParallelConfig(),
+    )
+
+
+def test_mesh_has_8_devices():
+    assert jax.device_count() == 8, (
+        "conftest must provision 8 virtual CPU devices BEFORE jax init")
+
+
+@pytest.fixture(scope="module")
+def reference_fields():
+    sim = Simulation(_full_physics_cfg())
+    sim.run()
+    return sim.fields()
+
+
+@pytest.mark.parametrize("topo", TOPOLOGIES)
+def test_sharded_matches_unsharded(topo, reference_fields):
+    cfg = _full_physics_cfg(ParallelConfig(topology="manual",
+                                           manual_topology=topo))
+    sim = Simulation(cfg)
+    assert sim.mesh is not None, "sharded path not engaged"
+    sim.run()
+    got = sim.fields()
+    for comp, ref in reference_fields.items():
+        scale = np.abs(ref).max() + 1e-30
+        err = np.abs(got[comp] - ref).max()
+        assert err < 1e-5 * scale, f"{comp}: {err/scale:.2e} on {topo}"
+
+
+def test_auto_topology_runs():
+    cfg = _full_physics_cfg(ParallelConfig(topology="auto", n_devices=8))
+    sim = Simulation(cfg)
+    assert sim.mesh is not None
+    assert int(np.prod(sim.topology)) == 8
+    sim.run()
+    for comp, v in sim.fields().items():
+        assert np.isfinite(v).all()
+
+
+def test_2d_decomposition():
+    """2D TMz sharded over xy must match unsharded."""
+    n = 32
+    def cfg(par=None):
+        return SimConfig(
+            scheme="2D_TMz", size=(n, n, 1), time_steps=20, dx=1e-3,
+            courant_factor=0.5, wavelength=10e-3,
+            pml=PmlConfig(size=(4, 4, 0)),
+            point_source=PointSourceConfig(enabled=True, component="Ez",
+                                           position=(n // 2, n // 2, 0)),
+            parallel=par or ParallelConfig())
+    ref = Simulation(cfg()); ref.run()
+    shd = Simulation(cfg(ParallelConfig(topology="manual",
+                                        manual_topology=(4, 2, 1))))
+    shd.run()
+    for comp, r in ref.fields().items():
+        scale = np.abs(r).max() + 1e-30
+        assert np.abs(shd.fields()[comp] - r).max() < 1e-5 * scale
+
+
+# ---- topology chooser unit tests (reference auto-topology analog) -------
+
+def test_choose_topology_prefers_single_long_axis():
+    # 256x64x64: all 8 cuts along x minimize the exchanged plane area.
+    assert choose_topology(8, (256, 64, 64), (0, 1, 2)) == (8, 1, 1)
+
+
+def test_choose_topology_cube_prefers_3d_blocks():
+    # cube: (2,2,2) has less per-device halo than (8,1,1) slabs.
+    topo = choose_topology(8, (64, 64, 64), (0, 1, 2))
+    assert sorted(topo) == [2, 2, 2]
+
+
+def test_choose_topology_respects_divisibility():
+    # 96 divides by 3; 64 doesn't: 3 must land on axis 0.
+    topo = choose_topology(3, (96, 64, 64), (0, 1, 2))
+    assert topo == (3, 1, 1)
+
+
+def test_choose_topology_inactive_axes_never_sharded():
+    topo = choose_topology(4, (64, 64, 1), (0, 1))
+    assert topo[2] == 1
