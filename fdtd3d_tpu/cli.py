@@ -263,8 +263,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         save_cmd_file(args, args.save_cmd_to_file)
 
     cfg = args_to_config(args)
+    from fdtd3d_tpu import io
     from fdtd3d_tpu.sim import Simulation  # deferred: jax init is slow
     sim = Simulation(cfg)
+    if args.load_checkpoint:
+        sim.restore(args.load_checkpoint)
+        if args.log_level >= 1:
+            print(f"restored checkpoint {args.load_checkpoint} at t={sim.t}")
+    if cfg.output.save_materials:
+        io.write_materials(sim)
     if args.log_level >= 1:
         import jax
         print(f"fdtd3d-tpu: scheme={cfg.scheme} size={cfg.grid_shape} "
@@ -272,19 +279,35 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"topology={sim.topology} devices={jax.device_count()}")
 
     t0 = time.time()
+    # gcd, not min: with cadences 10 and 3, chunking by 3 would never land
+    # on a multiple of 10 and those dumps would silently be skipped.
+    import math
     interval = 0
     for v in (cfg.output.save_res, cfg.output.norms_every,
               cfg.output.checkpoint_every):
         if v:
-            interval = min(interval, v) if interval else v
+            interval = math.gcd(interval, v)
 
     def on_interval(s):
         if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
             norms = diag.field_norms(s)
             txt = " ".join(f"{k}={v:.4e}" for k, v in sorted(norms.items()))
             print(f"[t={s.t}] {txt}")
+        if cfg.output.save_res and s.t % cfg.output.save_res == 0:
+            io.write_outputs(s, s.t)
+        if cfg.output.checkpoint_every and \
+                s.t % cfg.output.checkpoint_every == 0:
+            import os
+            os.makedirs(cfg.output.save_dir, exist_ok=True)
+            s.checkpoint(os.path.join(cfg.output.save_dir,
+                                      f"ckpt_t{s.t:06d}.npz"))
 
-    sim.run(on_interval=on_interval if interval else None,
+    # After a checkpoint restore, run only the REMAINING steps so the
+    # resumed run ends at the same t as the uninterrupted one.
+    remaining = max(0, cfg.time_steps - sim.t) if args.load_checkpoint \
+        else cfg.time_steps
+    sim.run(time_steps=remaining,
+            on_interval=on_interval if interval else None,
             interval=interval)
     sim.block_until_ready()
     dt_wall = time.time() - t0

@@ -1,0 +1,96 @@
+"""Exact solutions of the DISCRETE Yee scheme, for oracle tests and norms.
+
+Reference parity: the exact-solution callbacks + printed error norms that
+back the reference's acceptance tests (SURVEY.md §2 "Exact solutions /
+callbacks", §4). Where the reference uses polynomial fields (exact because
+central differences reproduce low-order polynomials), we use two families
+that are exact eigenfunctions/solutions of the discrete operator itself:
+
+* PEC-cavity eigenmodes — sin-product mode shapes diagonalize the discrete
+  curl-curl with PEC walls; their discrete frequency follows the exact
+  discrete dispersion relation. Machine-precision oracle in any dimension.
+* Discrete-dispersion plane waves — k solved from the Yee dispersion
+  relation, matching TFSF-driven steady states far beyond what the
+  continuum k would.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Sequence, Tuple
+
+import numpy as np
+
+from fdtd3d_tpu import physics
+
+
+def discrete_omega(k_cells: Sequence[float], dx: float, dt: float) -> float:
+    """Discrete Yee dispersion: frequency of a mode with per-axis wave
+    numbers ``k_cells`` (radians per CELL; pass 0 for inactive axes).
+
+    sin^2(w dt/2) = (c dt/dx)^2 * sum_a sin^2(k_a / 2)
+    """
+    s = sum(math.sin(k / 2.0) ** 2 for k in k_cells)
+    arg = (physics.C0 * dt / dx) * math.sqrt(s)
+    if arg > 1.0:
+        raise ValueError("mode beyond the stability limit")
+    return 2.0 / dt * math.asin(arg)
+
+
+def discrete_k_1d(omega: float, dx: float, dt: float) -> float:
+    """Inverse dispersion: wave number (rad/cell) of a CW at ``omega``."""
+    s = math.sin(omega * dt / 2.0) / (physics.C0 * dt / dx)
+    if s > 1.0:
+        raise ValueError("frequency beyond the grid's passband")
+    return 2.0 * math.asin(s)
+
+
+def cavity_mode_tmz(size: Tuple[int, int], m: int, n: int,
+                    dx: float, dt: float):
+    """2D TMz PEC-cavity eigenmode.
+
+    Returns (Ez0 mode shape on the (Nx, Ny) E-grid, omega_discrete).
+    Walls at i=0, i=Nx-1, j=0, j=Ny-1 (where tangential Ez is pinned);
+    Ez0 = sin(m pi i/(Nx-1)) sin(n pi j/(Ny-1)).
+
+    Evolution from the solver's init convention (E^0 = mode, H = 0, and the
+    step consumes H as H^{n+1/2}): E^t = mode * cos(w(t - 1/2)dt)/cos(w dt/2).
+    """
+    nx, ny = size
+    kx = m * math.pi / (nx - 1)
+    ky = n * math.pi / (ny - 1)
+    i = np.arange(nx)[:, None]
+    j = np.arange(ny)[None, :]
+    shape = np.sin(kx * i) * np.sin(ky * j)
+    return shape, discrete_omega((kx, ky, 0.0), dx, dt)
+
+
+def cavity_mode_3d(size: Tuple[int, int, int], mnp: Tuple[int, int, int],
+                   dx: float, dt: float):
+    """3D PEC-cavity TM-like eigenmode with E = Ez only (p=0 along z).
+
+    With k = (m pi/(Nx-1), n pi/(Ny-1), 0), Ez = sin(kx i) sin(ky j)
+    (constant along z) solves the discrete equations with Hz = 0 — the
+    z-invariant TMz mode embedded in 3D; exact in the 3D update too.
+    """
+    nx, ny, nz = size
+    m, n, p = mnp
+    if p != 0:
+        raise NotImplementedError("only z-invariant (p=0) modes")
+    shape2d, omega = cavity_mode_tmz((nx, ny), m, n, dx, dt)
+    return np.repeat(shape2d[:, :, None], nz, axis=2), omega
+
+
+def cavity_expectation(mode_shape: np.ndarray, omega: float, dt: float,
+                       t: int) -> np.ndarray:
+    """Expected E-field of a cavity mode at step ``t`` (solver convention)."""
+    return mode_shape * (math.cos(omega * (t - 0.5) * dt)
+                         / math.cos(omega * 0.5 * dt))
+
+
+def plane_wave_1d_steady(x_cells: np.ndarray, t: int, omega: float,
+                         dx: float, dt: float, amplitude: float = 1.0,
+                         phase0: float = 0.0) -> np.ndarray:
+    """Steady-state CW plane wave with the DISCRETE wave number."""
+    k = discrete_k_1d(omega, dx, dt)
+    return amplitude * np.sin(omega * t * dt - k * x_cells + phase0)

@@ -1,0 +1,238 @@
+"""Grid dump/load: DAT (raw binary), TXT, BMP; full-state checkpoints.
+
+Reference parity: ``Source/File/`` dumper/loader hierarchy (SURVEY.md §2 —
+BMPDumper/BMPLoader/DATDumper/DATLoader/TXTDumper/TXTLoader + BMPHelper)
+and the DAT-as-checkpoint posture of §5.4:
+
+* DAT — bare little-endian binary of the grid values (bit-exact roundtrip;
+  doubles as the material/field exchange format). A ``.manifest.json``
+  sidecar records shape/dtype/step so files are self-describing without
+  breaking the bare-values layout.
+* TXT — human-readable ``i j k value`` lines.
+* BMP — colormapped 2D cut (central slice of the first two active axes),
+  written by a dependency-free 24-bit BMP encoder (the reference vendors
+  EasyBMP; we need ~40 lines, SURVEY.md §7 non-goals).
+* checkpoint — one ``.npz`` of the ENTIRE solver state pytree (fields,
+  CPML psi, Drude J, incident line, step counter), the orbax-free
+  equivalent of the reference's save->load-from-DAT resume workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# DAT
+# ---------------------------------------------------------------------------
+
+
+def dump_dat(arr: np.ndarray, path: str, step: Optional[int] = None):
+    """Bare binary dump (little-endian, C order) + .manifest.json sidecar."""
+    arr = np.asarray(arr)
+    le = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
+    le.tofile(path)
+    # record the dtype of the bytes actually written (little-endian) —
+    # recording the source dtype breaks roundtrip for big-endian input.
+    manifest = {"shape": list(arr.shape), "dtype": le.dtype.str,
+                "order": "C", "endian": "little"}
+    if step is not None:
+        manifest["step"] = int(step)
+    with open(path + ".manifest.json", "w") as f:
+        json.dump(manifest, f)
+
+
+def load_dat(path: str, shape: Optional[Tuple[int, ...]] = None,
+             dtype=None) -> np.ndarray:
+    """Load a DAT dump; shape/dtype from the sidecar when not given."""
+    if shape is None or dtype is None:
+        with open(path + ".manifest.json") as f:
+            manifest = json.load(f)
+        shape = shape or tuple(manifest["shape"])
+        dtype = dtype or np.dtype(manifest["dtype"])
+    return np.fromfile(path, dtype=dtype).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# TXT
+# ---------------------------------------------------------------------------
+
+
+def dump_txt(arr: np.ndarray, path: str):
+    """Reference-style human-readable dump: one ``i j k value`` per line."""
+    arr = np.asarray(arr)
+    with open(path, "w") as f:
+        it = np.nditer(arr, flags=["multi_index"])
+        for v in it:
+            idx = " ".join(str(i) for i in it.multi_index)
+            if np.iscomplexobj(arr):
+                f.write(f"{idx} {v.real:.9e} {v.imag:.9e}\n")
+            else:
+                f.write(f"{idx} {float(v):.9e}\n")
+
+
+def load_txt(path: str, shape: Tuple[int, ...],
+             dtype=np.float64) -> np.ndarray:
+    out = np.zeros(shape, dtype=dtype)
+    nd = len(shape)
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            idx = tuple(int(p) for p in parts[:nd])
+            vals = [float(p) for p in parts[nd:]]
+            out[idx] = vals[0] + 1j * vals[1] if np.iscomplexobj(out) \
+                else vals[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# BMP (dependency-free 24-bit encoder + diverging colormap)
+# ---------------------------------------------------------------------------
+
+
+def _bmp_encode(rgb: np.ndarray) -> bytes:
+    """uint8 (H, W, 3) RGB -> 24-bit uncompressed BMP bytes."""
+    h, w, _ = rgb.shape
+    row = w * 3
+    pad = (4 - row % 4) % 4
+    body = bytearray()
+    for y in range(h - 1, -1, -1):  # BMP rows bottom-up, BGR
+        body += rgb[y, :, ::-1].tobytes() + b"\x00" * pad
+    size = 54 + len(body)
+    header = struct.pack("<2sIHHI", b"BM", size, 0, 0, 54)
+    info = struct.pack("<IiiHHIIiiII", 40, w, h, 1, 24, 0, len(body),
+                       2835, 2835, 0, 0)
+    return bytes(header + info + body)
+
+
+def colormap_diverging(v: np.ndarray) -> np.ndarray:
+    """Symmetric blue-white-red map on [-max|v|, +max|v|] -> uint8 RGB."""
+    v = np.asarray(v, dtype=np.float64)
+    scale = np.max(np.abs(v)) or 1.0
+    x = np.clip(v / scale, -1.0, 1.0)
+    rgb = np.empty(v.shape + (3,), dtype=np.uint8)
+    up = np.clip(1.0 + x, 0.0, 1.0)     # 0 at -1 .. 1 at >=0
+    dn = np.clip(1.0 - x, 0.0, 1.0)     # 1 at <=0 .. 0 at +1
+    rgb[..., 0] = np.round(255 * np.where(x >= 0, 1.0, up))
+    rgb[..., 1] = np.round(255 * np.minimum(up, dn))
+    rgb[..., 2] = np.round(255 * np.where(x <= 0, 1.0, dn))
+    return rgb
+
+
+def dump_bmp(arr: np.ndarray, path: str, active_axes=(0, 1)):
+    """Central 2D cut of a rank-3 grid -> colormapped BMP.
+
+    The cut plane is spanned by the first two active axes (for 1D modes a
+    horizontal strip is emitted). Real part is shown for complex fields.
+    """
+    arr = np.asarray(arr)
+    if np.iscomplexobj(arr):
+        arr = arr.real
+    axes = list(active_axes)
+    if len(axes) == 0:
+        axes = [0, 1]
+    if len(axes) == 1:
+        a = axes[0]
+        line = np.moveaxis(arr, a, 0).reshape(arr.shape[a], -1)[:, 0]
+        img = np.tile(line[None, :], (24, 1))
+    else:
+        a, b = axes[0], axes[1]
+        rest = [ax for ax in range(arr.ndim) if ax not in (a, b)]
+        sl = [slice(None)] * arr.ndim
+        for r in rest:
+            sl[r] = arr.shape[r] // 2
+        cut = arr[tuple(sl)]
+        if a > b:  # keep (a, b) order as (rows, cols)
+            cut = cut.T
+        img = cut.T  # rows = axis b (vertical), cols = axis a
+    with open(path, "wb") as f:
+        f.write(_bmp_encode(colormap_diverging(img)))
+
+
+def load_bmp_size(path: str) -> Tuple[int, int]:
+    """(width, height) of a BMP file (sanity-check helper)."""
+    with open(path, "rb") as f:
+        head = f.read(26)
+    return struct.unpack_from("<ii", head, 18)
+
+
+# ---------------------------------------------------------------------------
+# checkpoints (full solver state pytree)
+# ---------------------------------------------------------------------------
+
+
+def _flatten(prefix: str, tree, out: Dict[str, np.ndarray]):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            _flatten(f"{prefix}/{k}" if prefix else k, v, out)
+    else:
+        out[prefix] = np.asarray(tree)
+
+
+def save_checkpoint(state, path: str, extra: Optional[Dict] = None):
+    """Bit-exact .npz snapshot of the whole state pytree."""
+    flat: Dict[str, np.ndarray] = {}
+    _flatten("", state, flat)
+    meta = json.dumps(extra or {})
+    np.savez(path, __meta__=np.frombuffer(
+        zlib.compress(meta.encode()), dtype=np.uint8), **flat)
+
+
+def load_checkpoint(path: str) -> Tuple[Dict, Dict]:
+    """-> (state pytree of numpy arrays, extra metadata dict)."""
+    with np.load(path, allow_pickle=False) as z:
+        extra = {}
+        state: Dict = {}
+        for key in z.files:
+            if key == "__meta__":
+                extra = json.loads(zlib.decompress(z[key].tobytes()))
+                continue
+            parts = key.split("/")
+            node = state
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = z[key]
+    return state, extra
+
+
+# ---------------------------------------------------------------------------
+# periodic output hook (Scheme's dump cadence, SURVEY.md §3.1)
+# ---------------------------------------------------------------------------
+
+
+def write_outputs(sim, step: int):
+    """Dump every stored field component in each configured format."""
+    out = sim.cfg.output
+    os.makedirs(out.save_dir, exist_ok=True)
+    axes = sim.static.mode.active_axes
+    for comp, arr in sim.fields().items():
+        base = os.path.join(out.save_dir, f"{comp}_t{step:06d}")
+        if "dat" in out.formats:
+            dump_dat(arr, base + ".dat", step=step)
+        if "txt" in out.formats:
+            dump_txt(arr, base + ".txt")
+        if "bmp" in out.formats:
+            dump_bmp(arr, base + ".bmp", axes)
+
+
+def write_materials(sim):
+    """One-time material dump (reference --save-materials)."""
+    from fdtd3d_tpu import materials as mats
+    out = sim.cfg.output
+    os.makedirs(out.save_dir, exist_ok=True)
+    mode = sim.static.mode
+    mat = sim.cfg.materials
+    for comp in mode.e_components:
+        eps = mats.scalar_or_grid(comp, sim.static.grid_shape,
+                                  mode.active_axes, mat.eps,
+                                  mat.eps_sphere, mat.eps_file)
+        arr = np.broadcast_to(np.asarray(eps, dtype=np.float64),
+                              sim.static.grid_shape)
+        dump_dat(arr, os.path.join(out.save_dir, f"eps_{comp}.dat"))

@@ -1,0 +1,181 @@
+"""Near-to-far-field (NTFF) transform.
+
+Reference parity: the ntffN/ntffL far-field routines inside Source/Scheme
+(SURVEY.md §2 NTFF row) — surface equivalence over a closed virtual box:
+
+  N(r^) = integral of  J_s exp(+jk r'.r^) dS',   J_s =  n^ x H
+  L(r^) = integral of  M_s exp(+jk r'.r^) dS',   M_s = -n^ x E
+  E_theta ~ -(L_phi + eta0 N_theta),  E_phi ~ +(L_theta - eta0 N_phi)
+
+Implemented frequency-domain: a running DFT of the tangential fields on the
+six faces of the virtual box accumulates during the run (sampled between
+scan chunks, on device, cheap: faces are 2D). ``far_field`` then evaluates
+the radiation integrals at requested angles on host. E samples use phase
+exp(-j w t dt), H samples exp(-j w (t+1/2) dt) (leapfrog staggering).
+
+Geometry notes: the Yee staggering is ignored at the half-cell level when
+sampling face fields (values are taken at the face's cell index) — a
+second-order approximation, same class as the reference's interpolation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from fdtd3d_tpu import physics
+from fdtd3d_tpu.layout import component_axis
+
+AXES = (0, 1, 2)
+
+
+class NtffCollector:
+    """Accumulates the running DFT of tangential E/H on a closed box."""
+
+    def __init__(self, sim, frequency: float,
+                 box: Tuple[Tuple[int, int, int], Tuple[int, int, int]]
+                 = None, margin: int = 2):
+        if sim.static.mode.name != "3D":
+            raise ValueError("NTFF requires the 3D scheme")
+        self.sim = sim
+        self.omega = 2.0 * math.pi * frequency
+        self.dt = sim.static.dt
+        self.dx = sim.static.dx
+        shape = sim.static.grid_shape
+        if box is None:
+            pml = sim.cfg.pml.size
+            lo = tuple(pml[a] + margin for a in AXES)
+            hi = tuple(shape[a] - 1 - pml[a] - margin for a in AXES)
+        else:
+            lo, hi = box
+        for a in AXES:
+            # H-plane centering reads index lo-1; a box touching the wall
+            # would silently wrap to the far side of the grid.
+            if lo[a] < 1 or hi[a] > shape[a] - 1 or hi[a] <= lo[a]:
+                raise ValueError(
+                    f"NTFF box [{lo[a]}, {hi[a]}] invalid on axis {a} "
+                    f"(need 1 <= lo < hi <= {shape[a] - 1})")
+        self.lo, self.hi = lo, hi
+        # accumulators: {(axis, side, comp): complex 2D array}
+        self.acc: Dict[Tuple[int, int, str], np.ndarray] = {}
+        self.n_samples = 0
+
+    def _face_slice(self, axis: int, side: int, at: int = None):
+        idx = (self.lo[axis] if side == 0 else self.hi[axis]) \
+            if at is None else at
+        sl = [slice(self.lo[a], self.hi[a] + 1) for a in AXES]
+        sl[axis] = idx
+        return tuple(sl)
+
+    def sample(self):
+        """Accumulate one DFT sample at the sim's current step.
+
+        Tangential H lives a half cell off the face plane (Yee staggering):
+        averaging the two adjacent H planes centers it on the face —
+        without this, opposing faces pick up opposite phase errors and the
+        pattern loses its symmetry.
+        """
+        t = self.sim.t
+        ph_e = np.exp(-1j * self.omega * t * self.dt)
+        ph_h = np.exp(-1j * self.omega * (t + 0.5) * self.dt)
+        state = self.sim.state
+
+        def face(comp, axis, side, at=None):
+            # Slice ON DEVICE, transfer only the 2D face (device-getting
+            # full volumes would move O(N^3) per sample instead of O(N^2)).
+            group = state["E" if comp[0] == "E" else "H"]
+            plane = group[comp][self._face_slice(axis, side, at)]
+            return np.asarray(plane)
+
+        for axis in AXES:
+            tang = [c for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz")
+                    if component_axis(c) != axis
+                    and c in self.sim.static.mode.components]
+            for side in (0, 1):
+                idx = self.lo[axis] if side == 0 else self.hi[axis]
+                for c in tang:
+                    if c[0] == "E":
+                        contrib = face(c, axis, side) \
+                            .astype(np.complex128) * ph_e
+                    else:
+                        a0 = face(c, axis, side, idx)
+                        a1 = face(c, axis, side, idx - 1)
+                        contrib = 0.5 * (a0 + a1).astype(np.complex128) \
+                            * ph_h
+                    key = (axis, side, c)
+                    if key in self.acc:
+                        self.acc[key] += contrib
+                    else:
+                        self.acc[key] = contrib
+        self.n_samples += 1
+
+    # -- post-processing ---------------------------------------------------
+
+    def _face_points(self, axis: int, side: int):
+        """(x, y, z) cell coordinates of the face sample points."""
+        coords = [np.arange(self.lo[a], self.hi[a] + 1, dtype=np.float64)
+                  for a in AXES]
+        coords[axis] = np.array(
+            [float(self.lo[axis] if side == 0 else self.hi[axis])])
+        g = np.meshgrid(*coords, indexing="ij")
+        return [np.squeeze(gg, axis=axis) for gg in g]
+
+    @staticmethod
+    def _levi(i, j, k):
+        return (i - j) * (j - k) * (k - i) // 2  # +1/-1/0
+
+    def far_field(self, theta_deg: float, phi_deg: float):
+        """Complex (E_theta, E_phi) pattern amplitudes at one direction.
+
+        Each component's phase uses its OWN staggered in-plane position
+        (layout.YEE_OFFSETS): ignoring the half-cell offsets biases every
+        face by e^{+-jk dx/2} with a direction-independent sign, which
+        breaks the +-axis parity of the computed pattern (verified against
+        a mirror-symmetric near field).
+        """
+        from fdtd3d_tpu.layout import YEE_OFFSETS
+        if self.n_samples == 0:
+            raise RuntimeError("no samples collected")
+        th, ph = math.radians(theta_deg), math.radians(phi_deg)
+        rhat = np.array([math.sin(th) * math.cos(ph),
+                         math.sin(th) * math.sin(ph), math.cos(th)])
+        theta_hat = np.array([math.cos(th) * math.cos(ph),
+                              math.cos(th) * math.sin(ph), -math.sin(th)])
+        phi_hat = np.array([-math.sin(ph), math.cos(ph), 0.0])
+        k = self.omega / physics.C0
+        scale = self.dt * self.dx ** 2 / self.n_samples  # dS' and DFT norm
+
+        N = np.zeros(3, dtype=np.complex128)
+        L = np.zeros(3, dtype=np.complex128)
+        for (axis, side, comp), acc in self.acc.items():
+            sigma = -1.0 if side == 0 else 1.0
+            ca = component_axis(comp)
+            j3 = 3 - axis - ca           # the third axis: cross target
+            sign = sigma * self._levi(axis, ca, j3)
+            pts = self._face_points(axis, side)
+            off = YEE_OFFSETS[comp]
+            # normal coordinate is already centered at the face index (E
+            # tangential has 0 normal offset; H was plane-averaged).
+            proj = sum(rhat[b] * (pts[b] + (off[b] if b != axis else 0.0))
+                       for b in AXES)
+            total = np.sum(acc * np.exp(1j * k * self.dx * proj)) * scale
+            if comp[0] == "H":           # N += (n x H) term
+                N[j3] += sign * total
+            else:                        # L += (-n x E) term
+                L[j3] -= sign * total
+        n_th, n_ph = N @ theta_hat, N @ phi_hat
+        l_th, l_ph = L @ theta_hat, L @ phi_hat
+        e_theta = -(l_ph + physics.ETA0 * n_th)
+        e_phi = +(l_th - physics.ETA0 * n_ph)
+        return e_theta, e_phi
+
+    def directivity_pattern(self, thetas, phis) -> np.ndarray:
+        """|E|^2 pattern (unnormalized) over angle grids."""
+        out = np.zeros((len(thetas), len(phis)))
+        for i, th in enumerate(thetas):
+            for j, ph in enumerate(phis):
+                et, ep = self.far_field(th, ph)
+                out[i, j] = abs(et) ** 2 + abs(ep) ** 2
+        return out

@@ -1,0 +1,101 @@
+"""Dump/load + checkpoint/resume tests (reference File/ + DAT-resume parity).
+
+Golden rule from SURVEY.md §4: DAT roundtrips must be bit-exact, and a
+checkpoint-restore-resume run must reproduce the uninterrupted run exactly
+(deterministic functional core, same platform, same op order).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import io
+from fdtd3d_tpu.config import (PmlConfig, PointSourceConfig, SimConfig,
+                               TfsfConfig)
+from fdtd3d_tpu.sim import Simulation
+
+
+def test_dat_roundtrip_bit_exact(tmp_path):
+    rng = np.random.default_rng(0)
+    for dtype in (np.float32, np.float64, np.complex64):
+        arr = rng.standard_normal((5, 7, 3)).astype(dtype)
+        if np.issubdtype(dtype, np.complexfloating):
+            arr = arr + 1j * rng.standard_normal((5, 7, 3)).astype(dtype)
+        p = str(tmp_path / f"a_{np.dtype(dtype).name}.dat")
+        io.dump_dat(arr, p, step=42)
+        back = io.load_dat(p)
+        assert back.dtype == arr.dtype
+        assert np.array_equal(back, arr)  # bit-exact
+
+
+def test_txt_roundtrip(tmp_path):
+    arr = np.arange(24, dtype=np.float64).reshape(2, 3, 4) * np.pi
+    p = str(tmp_path / "a.txt")
+    io.dump_txt(arr, p)
+    back = io.load_txt(p, arr.shape)
+    np.testing.assert_allclose(back, arr, rtol=1e-9)
+
+
+def test_bmp_writes_valid_image(tmp_path):
+    arr = np.zeros((32, 48, 1))
+    arr[10:20, 5:40, 0] = 1.0
+    arr[25:, :, 0] = -0.5
+    p = str(tmp_path / "cut.bmp")
+    io.dump_bmp(arr, p, active_axes=(0, 1))
+    w, h = io.load_bmp_size(p)
+    assert (w, h) == (32, 48)
+    with open(p, "rb") as f:
+        assert f.read(2) == b"BM"
+
+
+def test_checkpoint_resume_bit_exact(tmp_path):
+    n = 24
+    def mk():
+        return Simulation(SimConfig(
+            scheme="2D_TMz", size=(n, n, 1), time_steps=0, dx=1e-3,
+            courant_factor=0.5, wavelength=10e-3,
+            pml=PmlConfig(size=(4, 4, 0)),
+            point_source=PointSourceConfig(enabled=True, component="Ez",
+                                           position=(n // 2, n // 2, 0))))
+    ckpt = str(tmp_path / "ck.npz")
+    a = mk()
+    a.advance(20)
+    a.checkpoint(ckpt)
+    a.advance(20)
+
+    b = mk()
+    b.restore(ckpt)
+    assert b.t == 20
+    b.advance(20)
+    for comp, ref in a.fields().items():
+        got = b.fields()[comp]
+        assert np.array_equal(got, ref), f"{comp} diverged after resume"
+
+
+def test_checkpoint_restore_rejects_wrong_scheme(tmp_path):
+    ckpt = str(tmp_path / "ck.npz")
+    a = Simulation(SimConfig(scheme="1D_EzHy", size=(16, 1, 1)))
+    a.checkpoint(ckpt)
+    b = Simulation(SimConfig(scheme="3D", size=(8, 8, 8)))
+    with pytest.raises(ValueError, match="scheme"):
+        b.restore(ckpt)
+
+
+def test_cli_dumps_and_checkpoints(tmp_path):
+    from fdtd3d_tpu.cli import main
+    save = str(tmp_path / "out")
+    rc = main(["--2d", "TMz", "--sizex", "24", "--sizey", "24",
+               "--sizez", "1", "--time-steps", "20", "--point-source", "Ez",
+               "--save-res", "10", "--save-dir", save,
+               "--save-formats", "dat,bmp", "--checkpoint-every", "20",
+               "--save-materials", "--log-level", "0"])
+    assert rc == 0
+    files = sorted(os.listdir(save))
+    assert "Ez_t000010.dat" in files
+    assert "Ez_t000020.bmp" in files
+    assert "ckpt_t000020.npz" in files
+    assert "eps_Ez.dat" in files
+    arr = io.load_dat(os.path.join(save, "Ez_t000020.dat"))
+    assert arr.shape == (24, 24, 1)
+    assert np.isfinite(arr).all() and np.abs(arr).max() > 0
