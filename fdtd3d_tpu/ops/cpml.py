@@ -69,6 +69,39 @@ def axis_profiles(n: int, npml: int, dx: float, dt: float, pml_cfg,
     }
 
 
+def build_slab_coeffs(full_coeffs: Dict[str, np.ndarray], static,
+                      slabs: Dict[int, int]) -> Dict[str, np.ndarray]:
+    """Slab-compacted CPML profiles for psi's boundary-plane storage.
+
+    For each slab axis a (solver.slab_axes, m planes per side): gather the
+    already-built full-length b/c/ik profiles (``full_coeffs`` from
+    build_cpml_coeffs — gathering instead of rebuilding keeps the two
+    representations from ever drifting) at every shard's first/last m
+    positions, concatenated shard by shard -> 1D arrays of length
+    2*m*topology[a] whose per-shard slice under sharding is exactly that
+    shard's (lo ++ hi) slab profile. Interior shards get the identity
+    profile (b=c=0, ik=1), keeping their psi slabs exactly zero — one SPMD
+    program for every rank, like the reference's sigma grids being zero
+    outside the PML.
+    """
+    out: Dict[str, np.ndarray] = {}
+    shape = static.grid_shape
+    for a, m in slabs.items():
+        name = "xyz"[a]
+        n = shape[a]
+        p = static.topology[a]
+        local_n = n // p
+        idx = np.concatenate([
+            np.concatenate([i * local_n + np.arange(m),
+                            (i + 1) * local_n - m + np.arange(m)])
+            for i in range(p)])
+        for tag in ("e", "h"):
+            for prof in ("b", "c", "ik"):
+                out[f"pml_slab_{prof}{tag}_{name}"] = \
+                    full_coeffs[f"pml_{prof}{tag}_{name}"][idx]
+    return out
+
+
 def build_cpml_coeffs(cfg, static, dtype) -> Dict[str, np.ndarray]:
     """All per-axis CPML profile arrays, keyed for the coeffs pytree.
 

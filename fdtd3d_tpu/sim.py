@@ -10,6 +10,7 @@ the same chunk runner is wrapped in shard_map over the device mesh.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Callable, Dict, List, Optional
 
@@ -43,11 +44,13 @@ class Simulation:
     def __init__(self, cfg: SimConfig, devices: Optional[List] = None):
         self.cfg = cfg
         self.static: StaticSetup = build_static(cfg)
-        coeffs_np = build_coeffs(self.static)
-        state0 = init_state(self.static)
-
+        # Topology must be known BEFORE coeffs/state: the CPML psi slab
+        # layout (solver.slab_axes) is per-shard.
         topo = self._resolve_topology(devices)
         self.topology = topo
+        self.static = dataclasses.replace(self.static, topology=topo)
+        coeffs_np = build_coeffs(self.static)
+        state0 = init_state(self.static)
         self.mesh = None
         mesh_axes = mesh_shape = None
         if any(p > 1 for p in topo):
@@ -175,7 +178,9 @@ class Simulation:
                                 self.state)
         io.save_checkpoint(state_np, path, extra={
             "t": self.t, "scheme": self.cfg.scheme,
-            "size": list(self.cfg.size)})
+            "size": list(self.cfg.size),
+            # psi slab layout depends on the decomposition (solver.slab_axes)
+            "topology": list(self.topology)})
         return self
 
     def restore(self, path: str):
@@ -190,6 +195,12 @@ class Simulation:
             raise ValueError(
                 f"checkpoint grid size {tuple(extra['size'])} != "
                 f"config size {tuple(self.cfg.size)}")
+        if "topology" in extra and tuple(extra["topology"]) != self.topology:
+            raise ValueError(
+                f"checkpoint was written with decomposition topology "
+                f"{tuple(extra['topology'])} but this run uses "
+                f"{self.topology}; the CPML psi slab layout is "
+                f"per-topology — resume on the same topology")
         want = jax.tree.structure(self.state)
         got = jax.tree.structure(loaded)
         if want != got:

@@ -60,6 +60,35 @@ class StaticSetup:
     use_drude: bool
     field_dtype: Any
     real_dtype: Any
+    # Decomposition topology (px, py, pz). Simulation rewrites this after
+    # resolving the mesh; it controls the psi slab layout below.
+    topology: Tuple[int, int, int] = (1, 1, 1)
+
+
+def slab_axes(static: StaticSetup) -> Dict[int, int]:
+    """axis -> npml for PML axes using compact slab psi storage.
+
+    CPML psi memory is identically zero outside the two npml-thick absorbing
+    slabs of its own axis (ops/cpml.py forces c=0 there), so storing the
+    full-domain array — as v0 did, mirroring the reference's full-size sigma
+    grids — wastes ~(1 - 2*npml/n) of its HBM traffic every step. Instead
+    psi keeps only the 2*npml boundary planes per shard (lo slab ++ hi
+    slab); interior shards hold all-zero slabs so the SAME shard_map step
+    works for every rank. Falls back to full storage when a shard is too
+    thin to hold two disjoint slabs.
+    """
+    out: Dict[int, int] = {}
+    for a in static.pml_axes:
+        npml = static.cfg.pml.size[a]
+        # One extra plane: the h-staggered (offset 0.5) hi-side profile is
+        # nonzero at index n-1-npml, one plane inside of the npml-thick
+        # slab (ops/cpml.py d_hi), so exact parity with full storage needs
+        # npml+1 planes per side.
+        m = npml + 1
+        local_n = static.grid_shape[a] // static.topology[a]
+        if npml > 0 and local_n > 2 * m:
+            out[a] = m
+    return out
 
 
 def build_static(cfg: SimConfig) -> StaticSetup:
@@ -130,7 +159,9 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
                                / (1.0 + sm))
 
     if static.pml_axes:
-        out.update(cpml.build_cpml_coeffs(cfg, static, rd))
+        full = cpml.build_cpml_coeffs(cfg, static, rd)
+        out.update(full)
+        out.update(cpml.build_slab_coeffs(full, static, slab_axes(static)))
 
     if static.tfsf_setup is not None:
         ae, be, ah, bh = tfsf.line_loss_profiles(
@@ -143,7 +174,16 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
 def init_state(static: StaticSetup) -> Dict[str, Any]:
     shape, fd = static.grid_shape, static.field_dtype
     mode = static.mode
+    slabs = slab_axes(static)
     zeros = lambda: jnp.zeros(shape, dtype=fd)  # noqa: E731
+
+    def psi_zeros(a: int) -> jnp.ndarray:
+        """psi_{c,a} storage: slab-compacted along its own axis a."""
+        s = list(shape)
+        if a in slabs:
+            s[a] = 2 * slabs[a] * static.topology[a]
+        return jnp.zeros(tuple(s), dtype=fd)
+
     state: Dict[str, Any] = {
         "E": {c: zeros() for c in mode.e_components},
         "H": {c: zeros() for c in mode.h_components},
@@ -153,11 +193,11 @@ def init_state(static: StaticSetup) -> Dict[str, Any]:
     for c in mode.e_components:
         for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
             if a in static.pml_axes:
-                psi_e[f"{c}_{AXES[a]}"] = zeros()
+                psi_e[f"{c}_{AXES[a]}"] = psi_zeros(a)
     for c in mode.h_components:
         for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
             if a in static.pml_axes:
-                psi_h[f"{c}_{AXES[a]}"] = zeros()
+                psi_h[f"{c}_{AXES[a]}"] = psi_zeros(a)
     if psi_e:
         state["psi_E"] = psi_e
         state["psi_H"] = psi_h
@@ -187,6 +227,48 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     inv_dx = 1.0 / static.dx
     setup = static.tfsf_setup
     ps = cfg.point_source
+    slabs = slab_axes(static)
+
+    def _slab_delta(a, tag, s, dfa, psi, coeffs, m):
+        """Slab-psi CPML correction: -> (new compact psi, lo delta, hi delta).
+
+        The full-domain family update runs the PURE interior curl (term =
+        dfa, no PML logic at all — one fused memory-bound pass); the exact
+        CPML term differs from it only inside the two npml slabs of axis a,
+        by  s * ((ik - 1) * dfa + psi).  Those deltas are added back onto
+        the thin slab regions with in-place slice-adds. Deltas of different
+        axes commute, so overlap corners compose correctly.
+
+        Local shapes are trace-time static, so this is shard_map-safe; on
+        interior shards the slab profiles are identically (b=0, c=0, ik=1)
+        and both deltas are exactly zero.
+        """
+        ax = AXES[a]
+        nloc = dfa.shape[a]
+        cut = lambda f, lo, hi: jax.lax.slice_in_dim(f, lo, hi, axis=a)  # noqa: E731
+        b = _bcast1d(coeffs[f"pml_slab_b{tag}_{ax}"], a)
+        cc = _bcast1d(coeffs[f"pml_slab_c{tag}_{ax}"], a)
+        ik = _bcast1d(coeffs[f"pml_slab_ik{tag}_{ax}"], a)
+        d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
+        p_lo = cut(b, 0, m) * cut(psi, 0, m) + cut(cc, 0, m) * d_lo
+        p_hi = cut(b, m, 2 * m) * cut(psi, m, 2 * m) + cut(cc, m, 2 * m) * d_hi
+        dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
+        dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+        return jnp.concatenate([p_lo, p_hi], axis=a), dl, dh
+
+    def _pad_slab(dl, dh, a, nloc, m):
+        """Zero-pad the two slab deltas back to the full local extent.
+
+        jnp.pad (constant 0) fuses into its elementwise consumer under XLA,
+        so adding the padded deltas onto the accumulator costs no extra
+        full-array materialization — unlike dynamic-update-slice patches,
+        which compile to full copies here.
+        """
+        pad_lo = [(0, 0)] * 3
+        pad_hi = [(0, 0)] * 3
+        pad_lo[a] = (0, nloc - m)
+        pad_hi[a] = (nloc - m, 0)
+        return jnp.pad(dl, pad_lo) + jnp.pad(dh, pad_hi)
 
     def _half_update(field: str, state, coeffs, new_psi):
         """One family update (field='E' or 'H'). Returns new component dict."""
@@ -203,7 +285,18 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                 if d not in src:
                     continue
                 dfa = diff(src[d], a) * inv_dx
-                if a in static.pml_axes:
+                if a in slabs:
+                    key = f"{c}_{AXES[a]}"
+                    psi, dl, dh = _slab_delta(a, tag, s, dfa,
+                                              state[psi_key][key], coeffs,
+                                              slabs[a])
+                    new_psi[psi_key][key] = psi
+                    # The delta is an acc-level correction (it carries the
+                    # curl sign s already): fold it in before ca/cb.
+                    acc_fix = _pad_slab(dl, dh, a, dfa.shape[a], slabs[a])
+                    acc = acc_fix if acc is None else acc + acc_fix
+                    term = dfa
+                elif a in static.pml_axes:
                     ax = AXES[a]
                     b = _bcast1d(coeffs[f"pml_b{tag}_{ax}"], a)
                     cc = _bcast1d(coeffs[f"pml_c{tag}_{ax}"], a)
@@ -216,7 +309,8 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                     term = dfa
                 acc = s * term if acc is None else acc + s * term
             if acc is None:
-                acc = jnp.zeros(static.grid_shape, static.field_dtype)
+                # zeros in the LOCAL shape (shard_map-safe), not grid_shape.
+                acc = jnp.zeros(state[field][c].shape, static.field_dtype)
             if setup is not None:
                 corr = tfsf.corrections_for(field, c, setup, coeffs,
                                             state["inc"], mode.active_axes,
