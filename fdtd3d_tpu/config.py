@@ -176,7 +176,10 @@ class SimConfig:
         default_factory=ParallelConfig)
     output: OutputConfig = dataclasses.field(default_factory=OutputConfig)
 
-    use_pallas: bool = False       # fused Pallas kernels for the 3D hot path
+    # Fused Pallas kernels for the 3D hot path (ops/pallas3d.py):
+    # None = auto (use on TPU when the config is eligible), True = force
+    # (interpreter mode off-TPU — slow, test-only), False = always jnp.
+    use_pallas: Optional[bool] = None
 
     # ---- derived ----
     @property

@@ -1,0 +1,646 @@
+"""Fused Pallas TPU kernels for the 3D leapfrog hot path.
+
+Reference parity: this is the TPU twin of the reference's CUDA
+``InternalScheme`` kernels (SURVEY.md §2 CudaGrid/InternalScheme rows,
+§3.3) — one fused device kernel per field family per step instead of the
+XLA op-graph the pure-jnp path compiles to.
+
+Why it exists (measured on v5e, 256^3 + 10-cell CPML, f32): XLA's compiled
+step moves ~743 bytes/cell/step of HBM traffic vs ~72 ideal — the CPML
+slab deltas and curl intermediates each materialize full arrays. The fused
+kernel computes each family update in ONE pass over tiles resident in
+VMEM: curl + material update + CPML psi recursion + PEC walls, reading
+each field once and writing each output once.
+
+Design:
+
+* Grid over x-slabs of ``tile`` planes; blocks span full (y, z) extent.
+* The one-plane x halo (backward diff for E, forward for H) is fetched as
+  a SEPARATE single-plane block of the same HBM array via an index map
+  (``i*T - 1`` clamped / ``(i+1)*T`` clamped); the global-edge ghost is
+  zeroed in-kernel (the PEC ghost value, matching ops/stencil.py).
+* y/z-axis CPML psi slabs are block-aligned along x, so they stream
+  through the same grid; their recursions + curl-accumulator deltas run
+  in-kernel on VMEM data. 1D profile coefficients are embedded as
+  compile-time constants (they are pure functions of the config).
+* x-axis CPML psi (compact along the grid axis — NOT block-aligned) is
+  corrected by a thin jnp post-pass on the 2(npml+1) boundary planes
+  (`x_slab_post`), exactly the solver.py slab-delta algebra restricted to
+  the slabs. TFSF face corrections and point sources are jnp patches on
+  single planes/cells (`tfsf_patch`, `point_source_patch`).
+* PEC walls are applied in-kernel from broadcasted-iota index masks.
+
+Eligibility (everything else falls back to the identical-semantics jnp
+path in solver.py): 3D scheme, real float32, no Drude, unsharded. The
+kernels run in interpreter mode on CPU so the same code path is testable
+without a TPU (tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fdtd3d_tpu import physics
+from fdtd3d_tpu.layout import CURL_TERMS, component_axis
+from fdtd3d_tpu.ops import tfsf as tfsf_mod
+from fdtd3d_tpu.ops.sources import waveform
+
+AXES = "xyz"
+
+
+def eligible(static, mesh_axes=None) -> bool:
+    """True when the fused kernels cover this configuration."""
+    if static.mode.name != "3D":
+        return False
+    if mesh_axes and any(v is not None for v in mesh_axes.values()):
+        return False
+    if static.topology != (1, 1, 1):
+        return False
+    if static.field_dtype != np.float32:
+        return False
+    if static.use_drude:
+        return False
+    return True
+
+
+def _pick_tile(shape: Tuple[int, int, int]) -> int:
+    """Largest divisor of Nx <= 16 keeping a field block under ~2 MiB."""
+    n1, n2, n3 = shape
+    budget = 2 << 20
+    for t in (16, 8, 4, 2, 1):
+        if n1 % t == 0 and t * n2 * n3 * 4 <= budget:
+            return t
+    for t in (8, 4, 2, 1):
+        if n1 % t == 0:
+            return t
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# kernel builder (one per family)
+# ---------------------------------------------------------------------------
+
+# Term classification for CPML handling per (component, derivative axis):
+#   "plain" — no PML on this axis: acc += s * dfa
+#   "slab"  — in-kernel compact psi (axis 1 or 2)
+#   "full"  — in-kernel full-length psi (axis 1 or 2, thin-grid fallback)
+#   "post"  — axis 0: pure curl in-kernel, psi delta patched by x_slab_post
+
+
+def _classify(static, slabs: Dict[int, int], axis: int) -> str:
+    if axis not in static.pml_axes:
+        return "plain"
+    if axis == 0:
+        return "post"
+    return "slab" if axis in slabs else "full"
+
+
+def _profile_inputs(np_coeffs, tag: str, axis: int, slab: bool):
+    """(name, 3D-broadcastable numpy array) per CPML profile of one axis.
+
+    Pallas kernels cannot capture array constants, so the 1D b/c/1-over-
+    kappa profiles stream as (tiny) full-block inputs instead.
+    """
+    ax = AXES[axis]
+    key = f"pml_slab_{{}}{tag}_{ax}" if slab else f"pml_{{}}{tag}_{ax}"
+    out = []
+    for p in ("b", "c", "ik"):
+        v = np.asarray(np_coeffs[key.format(p)], np.float32)
+        shape = [1, 1, 1]
+        shape[axis] = v.shape[0]
+        out.append((f"pf_{p}_{ax}", v.reshape(shape)))
+    return out
+
+
+def make_family_kernel(static, np_coeffs, family: str, tile: int,
+                       slabs: Dict[int, int], interpret: bool):
+    """Build the fused pallas update for one family ('E' or 'H').
+
+    Returns step_family(fields_in: dict, src: dict, psi: dict,
+                        array_coeffs: dict) -> (new_fields, new_psi_inkernel)
+    where psi contains only the in-kernel (y/z-axis) psi arrays.
+    """
+    mode = static.mode
+    n1, n2, n3 = static.grid_shape
+    T = tile
+    ntiles = n1 // T
+    inv_dx = np.float32(1.0 / static.dx)
+    upd = mode.e_components if family == "E" else mode.h_components
+    tag = "e" if family == "E" else "h"
+    backward = family == "E"
+
+    # ---- static layout of kernel operands ------------------------------
+    src_names = list(mode.h_components if family == "E"
+                     else mode.e_components)
+    # halo planes needed for the axis-0 derivative: which source comps
+    halo_names = []
+    for c in upd:
+        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+            d = ("H" if family == "E" else "E") + AXES[d_axis]
+            if a == 0 and d in src_names and d not in halo_names:
+                halo_names.append(d)
+    # in-kernel psi terms: (comp, axis, src, sign, kind)
+    terms: Dict[str, List[Tuple[int, str, int, str]]] = {}
+    psi_names: List[str] = []
+    for c in upd:
+        terms[c] = []
+        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+            d = ("H" if family == "E" else "E") + AXES[d_axis]
+            if d not in src_names:
+                continue
+            kind = _classify(static, slabs, a)
+            terms[c].append((a, d, s, kind))
+            if kind in ("slab", "full"):
+                psi_names.append(f"{c}_{AXES[a]}")
+
+    # material coefficient layout: scalar -> embedded; array -> streamed
+    pairs = (("ca", "cb") if family == "E" else ("da", "db"))
+    coeff_is_array = {}
+    for c in upd:
+        for p in pairs:
+            coeff_is_array[f"{p}_{c}"] = (
+                np.ndim(np_coeffs[f"{p}_{c}"]) == 3)
+    array_coeff_names = [k for k, v in coeff_is_array.items() if v]
+
+    # CPML profile arrays stream as tiny full-block inputs (a pallas
+    # kernel cannot capture array constants), one (b, c, ik) triple per
+    # distinct in-kernel psi axis.
+    profile_inputs: List[Tuple[str, np.ndarray]] = []
+    seen_axes = set()
+    for c in upd:
+        for (a, d, s, kind) in terms[c]:
+            if kind in ("slab", "full") and a not in seen_axes:
+                seen_axes.add(a)
+                profile_inputs.extend(
+                    _profile_inputs(np_coeffs, tag, a, kind == "slab"))
+    profile_names = [nm for nm, _ in profile_inputs]
+
+    fdt = jnp.float32
+
+    # ---- the kernel ----------------------------------------------------
+    def kernel(*refs):
+        idx = {}
+        pos = 0
+        for name in upd:
+            idx[f"in_{name}"] = refs[pos]; pos += 1
+        for name in src_names:
+            idx[f"src_{name}"] = refs[pos]; pos += 1
+        for name in halo_names:
+            idx[f"halo_{name}"] = refs[pos]; pos += 1
+        for name in psi_names:
+            idx[f"psi_{name}"] = refs[pos]; pos += 1
+        for name in profile_names:
+            idx[name] = refs[pos]; pos += 1
+        for name in array_coeff_names:
+            idx[f"coef_{name}"] = refs[pos]; pos += 1
+        for name in upd:
+            idx[f"out_{name}"] = refs[pos]; pos += 1
+        for name in psi_names:
+            idx[f"pso_{name}"] = refs[pos]; pos += 1
+
+        i = pl.program_id(0)
+
+        src_vals = {name: idx[f"src_{name}"][:] for name in src_names}
+
+        def diff(name: str, axis: int) -> jnp.ndarray:
+            f = src_vals[name]
+            if axis == 0:
+                h = idx[f"halo_{name}"][:]
+                if backward:
+                    ghost = jnp.where(i > 0, h, jnp.zeros_like(h))
+                    sh = jnp.concatenate([ghost, f[:-1]], axis=0)
+                    return (f - sh) * inv_dx
+                ghost = jnp.where(i < ntiles - 1, h, jnp.zeros_like(h))
+                sh = jnp.concatenate([f[1:], ghost], axis=0)
+                return (sh - f) * inv_dx
+            zero = jnp.zeros_like(
+                jax.lax.slice_in_dim(f, 0, 1, axis=axis))
+            if backward:
+                body = jax.lax.slice_in_dim(f, 0, f.shape[axis] - 1,
+                                            axis=axis)
+                sh = jnp.concatenate([zero, body], axis=axis)
+                return (f - sh) * inv_dx
+            body = jax.lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
+            sh = jnp.concatenate([body, zero], axis=axis)
+            return (sh - f) * inv_dx
+
+        # global-x index mask helpers for PEC walls
+        gx = (i * T + jax.lax.broadcasted_iota(jnp.int32, (T, 1, 1), 0))
+
+        def wall_mask(axis: int) -> jnp.ndarray:
+            if axis == 0:
+                return ((gx != 0) & (gx != n1 - 1)).astype(fdt)
+            n = (n1, n2, n3)[axis]
+            shape = [1, 1, 1]
+            shape[axis] = n
+            ga = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis)
+            return ((ga != 0) & (ga != n - 1)).astype(fdt)
+
+        for c in upd:
+            acc = None
+            for (a, d, s, kind) in terms[c]:
+                dfa = diff(d, a)
+                if kind in ("slab", "full"):
+                    key = f"{c}_{AXES[a]}"
+                    psi = idx[f"psi_{key}"][:]
+                    ax = AXES[a]
+                    b = idx[f"pf_b_{ax}"][:]
+                    cc = idx[f"pf_c_{ax}"][:]
+                    ik = idx[f"pf_ik_{ax}"][:]
+                    if kind == "slab":
+                        m = slabs[a]
+                        nloc = dfa.shape[a]
+                        cut = functools.partial(jax.lax.slice_in_dim,
+                                                axis=a)
+                        d_lo = cut(dfa, 0, m)
+                        d_hi = cut(dfa, nloc - m, nloc)
+                        p_lo = (cut(b, 0, m) * cut(psi, 0, m)
+                                + cut(cc, 0, m) * d_lo)
+                        p_hi = (cut(b, m, 2 * m) * cut(psi, m, 2 * m)
+                                + cut(cc, m, 2 * m) * d_hi)
+                        idx[f"pso_{key}"][:] = jnp.concatenate(
+                            [p_lo, p_hi], axis=a)
+                        dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
+                        dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+                        mid_shape = list(dfa.shape)
+                        mid_shape[a] = nloc - 2 * m
+                        delta = jnp.concatenate(
+                            [dl, jnp.zeros(mid_shape, fdt), dh], axis=a)
+                        term = s * dfa + delta
+                    else:
+                        p_new = b * psi + cc * dfa
+                        idx[f"pso_{key}"][:] = p_new
+                        term = s * (ik * dfa + p_new)
+                else:
+                    term = s * dfa
+                acc = term if acc is None else acc + term
+
+            old = idx[f"in_{c}"][:]
+            coefs = []
+            for p in pairs:
+                k = f"{p}_{c}"
+                if coeff_is_array[k]:
+                    coefs.append(idx[f"coef_{k}"][:])
+                else:
+                    coefs.append(fdt(float(np_coeffs[k])))
+            if family == "E":
+                new = coefs[0] * old + coefs[1] * acc
+                for a in range(3):
+                    if a != component_axis(c):
+                        new = new * wall_mask(a)
+            else:
+                new = coefs[0] * old - coefs[1] * acc
+            idx[f"out_{c}"][:] = new.astype(fdt)
+
+    # ---- specs ---------------------------------------------------------
+    def field_spec():
+        return pl.BlockSpec((T, n2, n3), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def halo_spec():
+        if backward:
+            return pl.BlockSpec(
+                (1, n2, n3), lambda i: (jnp.maximum(i * T - 1, 0), 0, 0),
+                memory_space=pltpu.VMEM)
+        return pl.BlockSpec(
+            (1, n2, n3),
+            lambda i: (jnp.minimum((i + 1) * T, n1 - 1), 0, 0),
+            memory_space=pltpu.VMEM)
+
+    def psi_spec(name: str):
+        a = AXES.index(name[-1])
+        shape = [T, n2, n3]
+        if a in slabs:
+            shape[a] = 2 * slabs[a]
+        return pl.BlockSpec(tuple(shape), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def profile_spec(arr: np.ndarray):
+        shape = arr.shape
+        return pl.BlockSpec(shape, lambda i: (0, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    in_specs = ([field_spec() for _ in upd]
+                + [field_spec() for _ in src_names]
+                + [halo_spec() for _ in halo_names]
+                + [psi_spec(nm) for nm in psi_names]
+                + [profile_spec(arr) for _, arr in profile_inputs]
+                + [field_spec() for _ in array_coeff_names])
+    out_specs = ([field_spec() for _ in upd]
+                 + [psi_spec(nm) for nm in psi_names])
+
+    def psi_shape(name: str):
+        a = AXES.index(name[-1])
+        shape = [n1, n2, n3]
+        if a in slabs:
+            shape[a] = 2 * slabs[a]
+        return tuple(shape)
+
+    out_shape = ([jax.ShapeDtypeStruct((n1, n2, n3), np.float32)
+                  for _ in upd]
+                 + [jax.ShapeDtypeStruct(psi_shape(nm), np.float32)
+                    for nm in psi_names])
+
+    # donate the updated family's buffers and psi into the outputs
+    n_upd = len(upd)
+    aliases = {j: j for j in range(n_upd)}
+    psi_in_start = n_upd + len(src_names) + len(halo_names)
+    for j in range(len(psi_names)):
+        aliases[psi_in_start + j] = n_upd + j
+    profile_consts = [jnp.asarray(arr) for _, arr in profile_inputs]
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(ntiles,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )
+
+    def run(fields: Dict[str, jnp.ndarray], src: Dict[str, jnp.ndarray],
+            psi: Dict[str, jnp.ndarray],
+            array_coeffs: Dict[str, jnp.ndarray]):
+        args = ([fields[c] for c in upd]
+                + [src[c] for c in src_names]
+                + [src[c] for c in halo_names]
+                + [psi[nm] for nm in psi_names]
+                + profile_consts
+                + [array_coeffs[k] for k in array_coeff_names])
+        outs = call(*args)
+        new_fields = {c: outs[j] for j, c in enumerate(upd)}
+        new_psi = {nm: outs[n_upd + j] for j, nm in enumerate(psi_names)}
+        return new_fields, new_psi
+
+    return run, psi_names, array_coeff_names
+
+
+# ---------------------------------------------------------------------------
+# jnp post-passes (thin patches on kernel output)
+# ---------------------------------------------------------------------------
+
+
+def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
+                src: Dict[str, jnp.ndarray], psi_x: Dict[str, jnp.ndarray],
+                coeffs, slabs: Dict[int, int]):
+    """Apply the axis-0 CPML psi recursion + delta onto the kernel output.
+
+    The kernel computed plain s*dfa for axis-0 curl terms; the exact CPML
+    term differs only on the two x slabs by s*((ik-1)*dfa + psi'). Patch
+    those planes (solver.py's _slab_delta restricted to axis 0).
+    """
+    mode = static.mode
+    upd = mode.e_components if family == "E" else mode.h_components
+    tag = "e" if family == "E" else "h"
+    inv_dx = 1.0 / static.dx
+    n1 = static.grid_shape[0]
+    m = slabs[0]
+    b = coeffs[f"pml_slab_b{tag}_x"]
+    cc = coeffs[f"pml_slab_c{tag}_x"]
+    ik = coeffs[f"pml_slab_ik{tag}_x"]
+
+    def r3(v, lo, hi):
+        return v[lo:hi].reshape(-1, 1, 1)
+
+    new_fields = dict(fields)
+    new_psi = dict(psi_x)
+    for c in upd:
+        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+            if a != 0:
+                continue
+            d = ("H" if family == "E" else "E") + AXES[d_axis]
+            if d not in src:
+                continue
+            f = src[d]
+            if family == "E":  # backward diff, planes [0,m) and [n1-m,n1)
+                d_lo = (f[:m] - jnp.pad(f[:m - 1], ((1, 0), (0, 0), (0, 0)))
+                        ) * inv_dx
+                d_hi = (f[n1 - m:] - f[n1 - m - 1:-1]) * inv_dx
+            else:              # forward diff
+                d_lo = (f[1:m + 1] - f[:m]) * inv_dx
+                d_hi = (jnp.pad(f[n1 - m + 1:], ((0, 1), (0, 0), (0, 0)))
+                        - f[n1 - m:]) * inv_dx
+            key = f"{c}_x"
+            psi = psi_x[key]
+            p_lo = r3(b, 0, m) * psi[:m] + r3(cc, 0, m) * d_lo
+            p_hi = r3(b, m, 2 * m) * psi[m:] + r3(cc, m, 2 * m) * d_hi
+            new_psi[key] = jnp.concatenate([p_lo, p_hi], axis=0)
+            dl = s * ((r3(ik, 0, m) - 1.0) * d_lo + p_lo)
+            dh = s * ((r3(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+            cb = coeffs[("cb_" if family == "E" else "db_") + c]
+            sign = 1.0 if family == "E" else -1.0
+            if jnp.ndim(cb) == 3:
+                cb_lo, cb_hi = cb[:m], cb[n1 - m:]
+            else:
+                cb_lo = cb_hi = cb
+            if family == "E":
+                # respect PEC walls (kernel already zeroed the field there)
+                wx = coeffs["wall_x"]
+                dl = dl * r3(wx, 0, m)
+                dh = dh * r3(wx, n1 - m, n1)
+                ca_ax = component_axis(c)
+                for a2 in (1, 2):
+                    if a2 != ca_ax:
+                        w = coeffs[f"wall_{AXES[a2]}"]
+                        shape = [1, 1, 1]
+                        shape[a2] = w.shape[0]
+                        dl = dl * w.reshape(shape)
+                        dh = dh * w.reshape(shape)
+            arr = new_fields[c]
+            arr = arr.at[:m].add(sign * cb_lo * dl)
+            arr = arr.at[n1 - m:].add(sign * cb_hi * dh)
+            new_fields[c] = arr
+    return new_fields, new_psi
+
+
+def plane_corrections(field: str, comp: str, setup, coeffs, inc,
+                      active_axes, dx: float):
+    """TFSF corrections as (axis, plane, broadcastable term) patches.
+
+    Same math as ops/tfsf.corrections_for but WITHOUT the full-size onehot
+    gate — the plane index is returned for an .at[plane].add patch.
+    """
+    gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
+    out = []
+    for corr in setup.corrections:
+        if corr.field != field or corr.comp != comp:
+            continue
+        off = tfsf_mod.YEE_OFFSETS[corr.src]
+        zeta = setup.zeta0 + setup.khat[corr.axis] * (
+            corr.pos_a - setup.origin[corr.axis])
+        zeta = jnp.asarray(zeta, dtype=inc["Einc"].dtype)
+        for b in range(3):
+            if b == corr.axis or b not in active_axes:
+                continue
+            pb = gs[b].astype(inc["Einc"].dtype) + off[b]
+            shape = [1, 1, 1]
+            shape[b] = pb.shape[0]
+            zeta = zeta + setup.khat[b] * (
+                pb - setup.origin[b]).reshape(shape)
+        if corr.src[0] == "E":
+            val = tfsf_mod._interp_line(inc["Einc"], zeta)
+            pol = setup.ehat[component_axis(corr.src)]
+        else:
+            val = tfsf_mod._interp_line(inc["Hinc"], zeta - 0.5)
+            pol = setup.hhat[component_axis(corr.src)]
+        if abs(pol) < 1e-14:
+            continue
+        gate = None
+        m_off = tfsf_mod.YEE_OFFSETS[corr.mask_comp]
+        for b in range(3):
+            if b == corr.axis or b not in active_axes:
+                continue
+            hi_b = setup.hi[b] - 1 if m_off[b] == 0.5 else setup.hi[b]
+            ind = (gs[b] >= setup.lo[b]) & (gs[b] <= hi_b)
+            shape_b = [1, 1, 1]
+            shape_b[b] = ind.shape[0]
+            ind = ind.reshape(shape_b).astype(val.dtype)
+            gate = ind if gate is None else gate * ind
+        term = (corr.sign * pol / dx) * val
+        if gate is not None:
+            term = term * gate
+        out.append((corr.axis, corr.plane, term))
+    return out
+
+
+def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
+               coeffs, inc) -> Dict[str, jnp.ndarray]:
+    """Add the TFSF face corrections onto the kernel output planes."""
+    setup = static.tfsf_setup
+    mode = static.mode
+    upd = mode.e_components if family == "E" else mode.h_components
+    out = dict(fields)
+    for c in upd:
+        patches = plane_corrections(family, c, setup, coeffs, inc,
+                                    mode.active_axes, static.dx)
+        if not patches:
+            continue
+        cb = coeffs[("cb_" if family == "E" else "db_") + c]
+        sign = 1.0 if family == "E" else -1.0
+        arr = out[c]
+        for (axis, plane, term) in patches:
+            if plane < 0 or plane >= static.grid_shape[axis]:
+                continue
+            sl = [slice(None)] * 3
+            sl[axis] = plane
+            scale = cb[tuple(sl)] if jnp.ndim(cb) == 3 else cb
+            t2 = jnp.squeeze(term, axis=axis)
+            if family == "E":
+                # PEC wall zeroing must survive the patch
+                ca_ax = component_axis(c)
+                for a2 in mode.active_axes:
+                    if a2 != ca_ax and a2 != axis:
+                        w = coeffs[f"wall_{AXES[a2]}"]
+                        shp = [1, 1, 1]
+                        shp[a2] = w.shape[0]
+                        t2 = t2 * jnp.squeeze(
+                            w.reshape(shp), axis=axis)
+            arr = arr.at[tuple(sl)].add(
+                (sign * scale * t2).astype(arr.dtype))
+        out[c] = arr
+    return out
+
+
+def point_source_patch(static, fields, coeffs, t):
+    """Soft point source as a single-cell .at[].add patch."""
+    ps = static.cfg.point_source
+    c = ps.component
+    if c not in fields:
+        return fields
+    pos = tuple(ps.position)
+    cb = coeffs[f"cb_{c}"]
+    scale = cb[pos] if jnp.ndim(cb) == 3 else cb
+    wf = waveform(ps.waveform,
+                  (t.astype(static.real_dtype) + 0.5) * static.dt,
+                  static.omega, static.dt)
+    arr = fields[c]
+    return dict(fields, **{c: arr.at[pos].add(
+        (ps.amplitude * scale * wf).astype(arr.dtype))})
+
+
+# ---------------------------------------------------------------------------
+# the fused step
+# ---------------------------------------------------------------------------
+
+
+def make_pallas_step(static):
+    """Full leapfrog step via fused kernels. Same signature/state layout as
+    solver.make_step's jnp step; returns None if the config is ineligible."""
+    from fdtd3d_tpu import solver as solver_mod
+
+    if not eligible(static):
+        return None
+    slabs = solver_mod.slab_axes(static)
+    np_coeffs = solver_mod.build_coeffs(static)
+    tile = _pick_tile(static.grid_shape)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+
+    run_e, psi_e_names, _ = make_family_kernel(
+        static, np_coeffs, "E", tile, slabs, interpret)
+    run_h, psi_h_names, _ = make_family_kernel(
+        static, np_coeffs, "H", tile, slabs, interpret)
+    array_coeff_names = [k for k, v in np_coeffs.items()
+                         if np.ndim(v) == 3]
+    setup = static.tfsf_setup
+    x_active = 0 in static.pml_axes
+    x_slab = 0 in slabs
+    if x_active and not x_slab:
+        # thin-grid full-length x psi: not covered by the fused path
+        return None
+
+    def step(state, coeffs):
+        t = state["t"]
+        new_state = dict(state)
+        arr_coeffs = {k: coeffs[k] for k in array_coeff_names}
+
+        if setup is not None:
+            new_state["inc"] = tfsf_mod.advance_einc(
+                state["inc"], coeffs, t, static.dt, static.omega, setup)
+
+        psi_e_in = {k: state["psi_E"][k] for k in psi_e_names} \
+            if psi_e_names else {}
+        new_E, psi_e_out = run_e(state["E"], state["H"], psi_e_in,
+                                 arr_coeffs)
+        psi_E = dict(state.get("psi_E", {}), **psi_e_out)
+        if x_active:
+            px = {k: v for k, v in psi_E.items() if k.endswith("_x")}
+            new_E, px_new = x_slab_post(static, "E", new_E,
+                                        state["H"], px, coeffs, slabs)
+            psi_E.update(px_new)
+        if setup is not None:
+            new_E = tfsf_patch(static, "E", new_E, coeffs,
+                               new_state["inc"])
+        if static.cfg.point_source.enabled:
+            new_E = point_source_patch(static, new_E, coeffs, t)
+        new_state["E"] = new_E
+
+        if setup is not None:
+            new_state["inc"] = tfsf_mod.advance_hinc(
+                new_state["inc"], coeffs, setup)
+
+        psi_h_in = {k: state["psi_H"][k] for k in psi_h_names} \
+            if psi_h_names else {}
+        new_H, psi_h_out = run_h(state["H"], new_E, psi_h_in, arr_coeffs)
+        psi_H = dict(state.get("psi_H", {}), **psi_h_out)
+        if x_active:
+            px = {k: v for k, v in psi_H.items() if k.endswith("_x")}
+            new_H, px_new = x_slab_post(static, "H", new_H, new_E, px,
+                                        coeffs, slabs)
+            psi_H.update(px_new)
+        new_state["H"] = new_H
+
+        if psi_E:
+            new_state["psi_E"] = psi_E
+            new_state["psi_H"] = psi_H
+        new_state["t"] = t + 1
+        return new_state
+
+    return step

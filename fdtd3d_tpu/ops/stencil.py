@@ -13,6 +13,13 @@ plane. The SAME closures serve the single-chip path and the shard_map path —
 there is no separate "parallel kernel" the way the reference has
 ``#ifdef PARALLEL_GRID`` twins.
 
+Performance note: the shifted operand is built with constant-zero
+``jnp.pad`` of a slice — NOT ``jnp.concatenate`` — because XLA fuses a
+zero pad into its elementwise consumer, while a concatenate materializes a
+full extra copy of the field per difference (12 differences per 3D step:
+measured 1.9x whole-step slowdown on v5e). On a sharded axis the received
+halo plane is added onto the zero pad plane (also fusable).
+
 Sign/time conventions (leapfrog):
   E-update uses BACKWARD differences of H:  (H[i] - H[i-1]) / d
   H-update uses FORWARD  differences of E:  (E[i+1] - E[i]) / d
@@ -20,7 +27,7 @@ Sign/time conventions (leapfrog):
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +54,21 @@ def _neighbor_plane(plane: jnp.ndarray, axis_name: Optional[str],
     return lax.ppermute(plane, axis_name, perm)
 
 
+def _pad_plane(arr: jnp.ndarray, axis: int, lo: bool) -> jnp.ndarray:
+    """Zero-pad one plane onto the lo (or hi) side of `arr` along `axis`."""
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (1, 0) if lo else (0, 1)
+    return jnp.pad(arr, pad)
+
+
+def _pad_to_extent(plane: jnp.ndarray, n: int, axis: int,
+                   at_lo: bool) -> jnp.ndarray:
+    """Zero-pad a 1-plane array to extent n along `axis` (plane at an end)."""
+    pad = [(0, 0)] * plane.ndim
+    pad[axis] = (0, n - 1) if at_lo else (n - 1, 0)
+    return jnp.pad(plane, pad)
+
+
 def make_diff_ops(
     mesh_axes: Optional[MeshAxes] = None,
     mesh_shape: Optional[Dict[str, int]] = None,
@@ -67,30 +89,36 @@ def make_diff_ops(
         return name, mesh_shape.get(name, 1) if name else 1
 
     def diff_b(f: jnp.ndarray, axis: int) -> jnp.ndarray:
-        if f.shape[axis] == 1:
-            name, n = _shards(axis)
-            if n <= 1:
-                return jnp.zeros_like(f)
-        name, n = _shards(axis)
-        last = lax.slice_in_dim(f, f.shape[axis] - 1, f.shape[axis],
-                                axis=axis)
-        ghost = _neighbor_plane(last, name, n, downstream=True)
-        shifted = jnp.concatenate(
-            [ghost, lax.slice_in_dim(f, 0, f.shape[axis] - 1, axis=axis)],
-            axis=axis)
+        name, n_sh = _shards(axis)
+        n = f.shape[axis]
+        if n == 1 and n_sh <= 1:
+            return jnp.zeros_like(f)
+        if n == 1:
+            # Fully sharded-out axis: the local diff is f - left-neighbor.
+            ghost = _neighbor_plane(f, name, n_sh, downstream=True)
+            return f - ghost
+        shifted = _pad_plane(lax.slice_in_dim(f, 0, n - 1, axis=axis),
+                             axis, lo=True)
+        if name is not None and n_sh > 1:
+            last = lax.slice_in_dim(f, n - 1, n, axis=axis)
+            ghost = _neighbor_plane(last, name, n_sh, downstream=True)
+            shifted = shifted + _pad_to_extent(ghost, n, axis, at_lo=True)
         return f - shifted
 
     def diff_f(f: jnp.ndarray, axis: int) -> jnp.ndarray:
-        if f.shape[axis] == 1:
-            name, n = _shards(axis)
-            if n <= 1:
-                return jnp.zeros_like(f)
-        name, n = _shards(axis)
-        first = lax.slice_in_dim(f, 0, 1, axis=axis)
-        ghost = _neighbor_plane(first, name, n, downstream=False)
-        shifted = jnp.concatenate(
-            [lax.slice_in_dim(f, 1, f.shape[axis], axis=axis), ghost],
-            axis=axis)
+        name, n_sh = _shards(axis)
+        n = f.shape[axis]
+        if n == 1 and n_sh <= 1:
+            return jnp.zeros_like(f)
+        if n == 1:
+            ghost = _neighbor_plane(f, name, n_sh, downstream=False)
+            return ghost - f
+        shifted = _pad_plane(lax.slice_in_dim(f, 1, n, axis=axis),
+                             axis, lo=False)
+        if name is not None and n_sh > 1:
+            first = lax.slice_in_dim(f, 0, 1, axis=axis)
+            ghost = _neighbor_plane(first, name, n_sh, downstream=False)
+            shifted = shifted + _pad_to_extent(ghost, n, axis, at_lo=False)
         return shifted - f
 
     return diff_b, diff_f

@@ -220,8 +220,32 @@ def _bcast1d(arr: jnp.ndarray, axis: int) -> jnp.ndarray:
     return arr.reshape(shape)
 
 
+def _want_pallas(static: StaticSetup, mesh_axes) -> bool:
+    flag = static.cfg.use_pallas
+    if flag is False:
+        return False
+    if flag is None:
+        # auto: only on real TPU (interpret mode on CPU is test-only slow);
+        # "axon" is the tunneled-TPU platform in this environment.
+        import jax as _jax
+        if _jax.default_backend() not in ("tpu", "axon"):
+            return False
+    from fdtd3d_tpu.ops import pallas3d
+    return pallas3d.eligible(static, mesh_axes)
+
+
 def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
-    """Build the pure leapfrog step. mesh_axes/mesh_shape: see stencil.py."""
+    """Build the pure leapfrog step. mesh_axes/mesh_shape: see stencil.py.
+
+    Dispatches to the fused Pallas kernels (ops/pallas3d.py) when the
+    configuration is eligible and use_pallas is not False; otherwise the
+    pure-jnp step below (identical semantics) is built.
+    """
+    if _want_pallas(static, mesh_axes):
+        from fdtd3d_tpu.ops import pallas3d
+        fused = pallas3d.make_pallas_step(static)
+        if fused is not None:
+            return fused
     mode, cfg = static.mode, static.cfg
     diff_b, diff_f = make_diff_ops(mesh_axes, mesh_shape)
     inv_dx = 1.0 / static.dx

@@ -1,0 +1,99 @@
+"""Fused Pallas kernels (ops/pallas3d.py) vs the jnp step: exact parity.
+
+The pallas path must be bit-compatible (up to f32 roundoff from operation
+reordering) with the reference jnp step across every feature it claims:
+vacuum curl, CPML slabs, material arrays, TFSF patches, point sources,
+PEC walls. Runs in interpreter mode on the CPU test backend.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fdtd3d_tpu import solver
+from fdtd3d_tpu.config import (MaterialsConfig, PmlConfig,
+                               PointSourceConfig, SimConfig, SphereConfig,
+                               TfsfConfig)
+from fdtd3d_tpu.ops import pallas3d
+
+BASE = dict(scheme="3D", size=(16, 16, 16), time_steps=3, dx=1e-3,
+            courant_factor=0.5, wavelength=8e-3, dtype="float32")
+
+
+def _random_state(static):
+    state = solver.init_state(static)
+    key = jax.random.PRNGKey(0)
+    for grp in ("E", "H"):
+        for c in state[grp]:
+            key, k2 = jax.random.split(key)
+            state[grp][c] = 0.01 * jax.random.normal(
+                k2, state[grp][c].shape, jnp.float32)
+    return state
+
+
+def _compare(cfg, steps=3, tol=2e-6):
+    static = solver.build_static(cfg)
+    coeffs = jax.tree.map(jnp.asarray, solver.build_coeffs(static))
+    state = _random_state(static)
+    jnp_cfg = dataclasses.replace(cfg, use_pallas=False)
+    jstep = solver.make_step(dataclasses.replace(static, cfg=jnp_cfg))
+    pstep = pallas3d.make_pallas_step(static)
+    assert pstep is not None, "config unexpectedly ineligible"
+    s_j = s_p = state
+    for _ in range(steps):
+        s_j = jstep(s_j, coeffs)
+        s_p = pstep(s_p, coeffs)
+    for grp in ("E", "H", "psi_E", "psi_H"):
+        if grp not in s_j:
+            assert grp not in s_p or not s_p[grp]
+            continue
+        for c in s_j[grp]:
+            diff = float(jnp.max(jnp.abs(s_j[grp][c] - s_p[grp][c])))
+            ref = max(float(jnp.max(jnp.abs(s_j[grp][c]))), 1e-12)
+            assert diff / ref < tol, f"{grp}/{c}: rel {diff / ref:.2e}"
+
+
+def test_vacuum_parity():
+    _compare(SimConfig(**BASE))
+
+
+def test_cpml_parity():
+    _compare(SimConfig(**BASE, pml=PmlConfig(size=(3, 3, 3))))
+
+
+def test_material_array_parity():
+    _compare(SimConfig(**BASE, materials=MaterialsConfig(
+        eps=2.0, eps_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                         radius=4, value=6.0))))
+
+
+def test_tfsf_parity():
+    _compare(SimConfig(**BASE, pml=PmlConfig(size=(3, 3, 3)),
+                       tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                                       angle_teta=30.0, angle_phi=15.0,
+                                       angle_psi=40.0)))
+
+
+def test_point_source_parity():
+    _compare(SimConfig(**BASE, point_source=PointSourceConfig(
+        enabled=True, component="Ez", position=(8, 8, 8), amplitude=2.0)))
+
+
+def test_uneven_tile_parity():
+    # Nx with a small prime factor exercises non-power-of-two tiling.
+    cfg = dict(BASE)
+    cfg["size"] = (12, 16, 16)
+    _compare(SimConfig(**cfg), steps=2)
+
+
+@pytest.mark.parametrize("reason,cfg", [
+    ("2d-mode", dict(BASE, scheme="2D_TMz")),
+    ("f64", dict(BASE, dtype="float64")),
+    ("drude", dict(BASE, materials=MaterialsConfig(
+        use_drude=True, omega_p=1e11, gamma=1e10))),
+])
+def test_ineligible_falls_back(reason, cfg):
+    static = solver.build_static(SimConfig(**cfg))
+    assert pallas3d.make_pallas_step(static) is None, reason
