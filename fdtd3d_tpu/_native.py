@@ -1,0 +1,133 @@
+"""ctypes bridge to the native C++ I/O backend (native/fdtd3d_io.cpp).
+
+The reference's file subsystem is C++ (Source/File + EasyBMP); ours is
+too — this module loads ``libfdtd3d_io.so``, building it on first use
+with the in-image toolchain if needed. Every entry point returns None
+gracefully when the native library is unavailable (no compiler, build
+failure), and fdtd3d_tpu.io falls back to pure Python with identical
+file formats.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libfdtd3d_io.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.f3d_write_raw.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                      ctypes.c_uint64]
+        lib.f3d_write_raw.restype = ctypes.c_int
+        lib.f3d_read_raw.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                     ctypes.c_uint64]
+        lib.f3d_read_raw.restype = ctypes.c_int
+        lib.f3d_dump_txt_f64.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int]
+        lib.f3d_dump_txt_f64.restype = ctypes.c_int
+        lib.f3d_load_txt_f64.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+        lib.f3d_load_txt_f64.restype = ctypes.c_longlong
+        lib.f3d_encode_bmp.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.c_int]
+        lib.f3d_encode_bmp.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def write_raw(path: str, arr: np.ndarray) -> bool:
+    lib = load()
+    if lib is None:
+        return False
+    arr = np.ascontiguousarray(arr)
+    rc = lib.f3d_write_raw(path.encode(), arr.ctypes.data,
+                           arr.nbytes)
+    return rc == 0
+
+
+def read_raw(path: str, shape, dtype) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    out = np.empty(shape, dtype=dtype)
+    rc = lib.f3d_read_raw(path.encode(), out.ctypes.data, out.nbytes)
+    return out if rc == 0 else None
+
+
+def dump_txt(path: str, arr: np.ndarray) -> bool:
+    lib = load()
+    if lib is None:
+        return False
+    is_complex = int(np.iscomplexobj(arr))
+    data = np.ascontiguousarray(
+        arr, dtype=np.complex128 if is_complex else np.float64)
+    view = data.view(np.float64) if is_complex else data
+    shape = (ctypes.c_uint64 * arr.ndim)(*arr.shape)
+    rc = lib.f3d_dump_txt_f64(
+        path.encode(), view.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)),
+        shape, arr.ndim, is_complex)
+    return rc == 0
+
+
+def load_txt(path: str, shape, dtype) -> Optional[np.ndarray]:
+    lib = load()
+    if lib is None:
+        return None
+    is_complex = int(np.issubdtype(np.dtype(dtype), np.complexfloating))
+    total = int(np.prod(shape))
+    buf = np.zeros(total * (2 if is_complex else 1), dtype=np.float64)
+    got = lib.f3d_load_txt_f64(
+        path.encode(), buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        total, len(shape), is_complex)
+    if got != total:
+        return None
+    if is_complex:
+        return buf.view(np.complex128).reshape(shape).astype(dtype)
+    return buf.reshape(shape).astype(dtype)
+
+
+def encode_bmp(path: str, rgb: np.ndarray) -> bool:
+    lib = load()
+    if lib is None:
+        return False
+    rgb = np.ascontiguousarray(rgb, dtype=np.uint8)
+    h, w, _ = rgb.shape
+    rc = lib.f3d_encode_bmp(path.encode(),
+                            rgb.ctypes.data_as(ctypes.c_char_p), h, w)
+    return rc == 0

