@@ -1,0 +1,12 @@
+#!/bin/bash
+cd /root/repo
+for i in $(seq 1 40); do
+  if timeout 120 python -c "import jax; print(jax.devices())" >/tmp/tpu_probe.log 2>&1; then
+    echo "TPU back at attempt $i: $(date)" >> /tmp/tpu_probe.log
+    timeout 500 python bench.py >> /tmp/tpu_probe.log 2>&1
+    exit 0
+  fi
+  sleep 60
+done
+echo "TPU never came back" >> /tmp/tpu_probe.log
+exit 1

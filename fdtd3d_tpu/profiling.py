@@ -1,0 +1,105 @@
+"""Tracing/profiling + runtime guards.
+
+Reference parity (SURVEY.md §5.1, §5.2): the reference instruments
+wall-clock compute-vs-share time per rank with its ``Clock`` class (it
+exists to feed DYNAMIC_GRID rebalancing, which is a deliberate non-goal
+on homogeneous SPMD chips) and leans on ASSERT macros for correctness.
+Here:
+
+* ``StepClock`` — per-chunk wall timings + throughput; attached to a
+  Simulation when ``OutputConfig.profile`` is set (advance() then blocks
+  per chunk to take honest timings).
+* ``trace()`` — context manager around ``jax.profiler.trace`` producing
+  a TensorBoard/XProf trace with the compute/collective breakdown (the
+  modern equivalent of the reference's compute-vs-share printout).
+* ``assert_finite`` / ``finite_check`` — NaN/Inf tripwires over the
+  whole state pytree (the functional stand-in for the reference's
+  ASSERT; races are structurally absent in JAX).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class ChunkRecord:
+    steps: int
+    seconds: float
+    cells: float
+
+    @property
+    def mcells_per_s(self) -> float:
+        return self.cells * self.steps / self.seconds / 1e6
+
+
+class StepClock:
+    """Wall-clock per advance() chunk (the reference Clock's successor)."""
+
+    def __init__(self):
+        self.records: List[ChunkRecord] = []
+
+    def record(self, steps: int, seconds: float, cells: float):
+        self.records.append(ChunkRecord(steps, seconds, cells))
+
+    @property
+    def total_steps(self) -> int:
+        return sum(r.steps for r in self.records)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(r.seconds for r in self.records)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.records:
+            return {"steps": 0, "seconds": 0.0, "mcells_per_s": 0.0,
+                    "best_mcells_per_s": 0.0}
+        return {
+            "steps": self.total_steps,
+            "seconds": self.total_seconds,
+            "mcells_per_s": (sum(r.cells * r.steps for r in self.records)
+                             / self.total_seconds / 1e6),
+            "best_mcells_per_s": max(r.mcells_per_s for r in self.records),
+        }
+
+    def report(self) -> str:
+        s = self.summary()
+        return (f"{s['steps']} steps in {s['seconds']:.3f}s — "
+                f"{s['mcells_per_s']:.1f} Mcells/s "
+                f"(best chunk {s['best_mcells_per_s']:.1f})")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """jax.profiler trace around a block: XProf shows the per-step HLO
+    timeline incl. the ppermute halo collectives vs stencil compute."""
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def finite_check(state) -> Dict[str, bool]:
+    """{path: all_finite} over every array leaf of the state pytree."""
+    out = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in flat:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.inexact):
+            name = jax.tree_util.keystr(path)
+            out[name] = bool(jnp.isfinite(leaf).all())
+    return out
+
+
+def assert_finite(state, context: str = ""):
+    """Raise FloatingPointError naming the offending components."""
+    bad = [k for k, ok in finite_check(state).items() if not ok]
+    if bad:
+        where = f" at {context}" if context else ""
+        raise FloatingPointError(
+            f"non-finite field values{where}: {', '.join(sorted(bad))} "
+            f"(check the Courant factor / Drude stability bound)")
