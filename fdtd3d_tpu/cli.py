@@ -121,6 +121,11 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--norms-every", type=int, default=0,
                    help="print field norms every N steps")
     g.add_argument("--log-level", type=int, default=1)
+    g.add_argument("--profile", action="store_true",
+                   help="time every compute chunk (StepClock) and print a "
+                        "throughput summary at the end")
+    g.add_argument("--check-finite", action="store_true",
+                   help="NaN/Inf tripwire over the state after each chunk")
 
     g = p.add_argument_group("command files")
     g.add_argument("--cmd-from-file", metavar="FILE", default=None,
@@ -225,7 +230,8 @@ def args_to_config(args) -> SimConfig:
             formats=tuple(args.save_formats.split(",")),
             save_materials=args.save_materials,
             checkpoint_every=args.checkpoint_every,
-            norms_every=args.norms_every, log_level=args.log_level),
+            norms_every=args.norms_every, log_level=args.log_level,
+            profile=args.profile, check_finite=args.check_finite),
     )
     return cfg
 
@@ -315,6 +321,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     for a in sim.static.mode.active_axes:
         cells *= cfg.grid_shape[a]
     mcps = cells * cfg.time_steps / dt_wall / 1e6
+    if sim.clock is not None:
+        print(f"profile: {sim.clock.report()}")
     if args.log_level >= 1:
         print(f"done: {cfg.time_steps} steps in {dt_wall:.2f}s "
               f"({mcps:.1f} Mcells/s)")

@@ -151,6 +151,15 @@ class OutputConfig:
     checkpoint_every: int = 0      # orbax/npz full-state checkpoint cadence
     norms_every: int = 0           # print L2/Linf norms every N steps
     log_level: int = 1
+    # Attach a profiling.StepClock to the Simulation: every advance()
+    # chunk is timed (with a device sync, so honest but intrusive) and
+    # aggregated in sim.clock (reference Clock compute-share timing,
+    # SURVEY.md §5.1).
+    profile: bool = False
+    # NaN/Inf tripwire over the whole state pytree after every advance()
+    # chunk (profiling.assert_finite; reference ASSERT posture, §5.2).
+    # Independent of log_level so it can guard production runs.
+    check_finite: bool = False
 
 
 @dataclasses.dataclass

@@ -6,15 +6,19 @@ exists to feed DYNAMIC_GRID rebalancing, which is a deliberate non-goal
 on homogeneous SPMD chips) and leans on ASSERT macros for correctness.
 Here:
 
-* ``StepClock`` — per-chunk wall timings + throughput; attached to a
-  Simulation when ``OutputConfig.profile`` is set (advance() then blocks
-  per chunk to take honest timings).
+* ``StepClock`` — per-chunk wall timings + throughput. Wiring:
+  ``Simulation.__init__`` attaches one as ``sim.clock`` when
+  ``OutputConfig.profile`` is set, and ``Simulation.advance`` then
+  brackets every chunk with a device sync to take honest timings
+  (tests/test_profiling.py).
 * ``trace()`` — context manager around ``jax.profiler.trace`` producing
   a TensorBoard/XProf trace with the compute/collective breakdown (the
   modern equivalent of the reference's compute-vs-share printout).
 * ``assert_finite`` / ``finite_check`` — NaN/Inf tripwires over the
   whole state pytree (the functional stand-in for the reference's
-  ASSERT; races are structurally absent in JAX).
+  ASSERT; races are structurally absent in JAX). Wiring:
+  ``Simulation.advance`` calls ``assert_finite`` after every chunk when
+  ``OutputConfig.check_finite`` is set.
 """
 
 from __future__ import annotations

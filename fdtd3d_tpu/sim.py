@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -23,6 +24,7 @@ try:  # jax >= 0.5 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from fdtd3d_tpu import profiling
 from fdtd3d_tpu.config import SimConfig
 from fdtd3d_tpu.parallel import mesh as pmesh
 from fdtd3d_tpu.solver import (StaticSetup, build_coeffs, build_static,
@@ -70,6 +72,11 @@ class Simulation:
 
         self._runner = make_chunk_runner(self.static, mesh_axes, mesh_shape)
         self._compiled: Dict[int, Callable] = {}
+        # Diagnostics (profiling.py): per-chunk wall clock + finite guard.
+        self.clock = profiling.StepClock() if cfg.output.profile else None
+        self._check_finite = cfg.output.check_finite
+        self._cells = float(np.prod([cfg.grid_shape[a]
+                                     for a in self.static.mode.active_axes]))
 
     def _resolve_topology(self, devices):
         pc = self.cfg.parallel
@@ -101,14 +108,35 @@ class Simulation:
                                        in_specs=(self._state_specs,
                                                  self._coeff_specs),
                                        out_specs=self._state_specs)
-            self._compiled[n] = jax.jit(fn, donate_argnums=0)
+            jitted = jax.jit(fn, donate_argnums=0)
+            if self.clock is not None:
+                # Profiled runs must time steps, not compilation: compile
+                # ahead of time so the clocked call below is execute-only.
+                jitted = jitted.lower(self.state, self.coeffs).compile()
+            self._compiled[n] = jitted
         return self._compiled[n]
 
     def advance(self, n_steps: int):
-        """Advance n_steps inside one compiled scan."""
+        """Advance n_steps inside one compiled scan.
+
+        With OutputConfig.profile the chunk is timed sync-to-sync into
+        self.clock; with OutputConfig.check_finite the whole state pytree
+        is NaN/Inf-guarded after the chunk (raises FloatingPointError).
+        """
         if n_steps <= 0:
             return self
-        self.state = self._chunk_fn(n_steps)(self.state, self.coeffs)
+        fn = self._chunk_fn(n_steps)
+        if self.clock is not None:
+            self.block_until_ready()
+            t0 = time.perf_counter()
+            self.state = fn(self.state, self.coeffs)
+            self.block_until_ready()
+            self.clock.record(n_steps, time.perf_counter() - t0,
+                              self._cells)
+        else:
+            self.state = fn(self.state, self.coeffs)
+        if self._check_finite:
+            profiling.assert_finite(self.state, context=f"t={self.t}")
         return self
 
     def run(self, time_steps: Optional[int] = None,
