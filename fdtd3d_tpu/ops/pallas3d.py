@@ -3,35 +3,55 @@
 Reference parity: this is the TPU twin of the reference's CUDA
 ``InternalScheme`` kernels (SURVEY.md §2 CudaGrid/InternalScheme rows,
 §3.3) — one fused device kernel per field family per step instead of the
-XLA op-graph the pure-jnp path compiles to.
+XLA op-graph the pure-jnp path compiles to. Like the reference's hybrid
+MPI+CUDA mode (SURVEY.md §2.9 item 6), the fused kernels compose with the
+domain decomposition: the SAME kernels run inside ``shard_map``, with the
+y/z ghost planes fetched by ``lax.ppermute`` outside the kernel and
+streamed in as thin extra blocks.
 
 Why it exists (measured on v5e, 256^3 + 10-cell CPML, f32): XLA's compiled
 step moves ~743 bytes/cell/step of HBM traffic vs ~72 ideal — the CPML
 slab deltas and curl intermediates each materialize full arrays. The fused
 kernel computes each family update in ONE pass over tiles resident in
-VMEM: curl + material update + CPML psi recursion + PEC walls, reading
-each field once and writing each output once.
+VMEM: curl + material update + CPML psi recursion + Drude ADE current +
+PEC walls, reading each field once and writing each output once.
 
 Design:
 
-* Grid over x-slabs of ``tile`` planes; blocks span full (y, z) extent.
+* Grid over x-slabs of ``tile`` planes; blocks span the full LOCAL (y, z)
+  extent. The x axis is never sharded on the mesh (eligibility), so tiling
+  along x needs no cross-device traffic.
 * The one-plane x halo (backward diff for E, forward for H) is fetched as
   a SEPARATE single-plane block of the same HBM array via an index map
   (``i*T - 1`` clamped / ``(i+1)*T`` clamped); the global-edge ghost is
   zeroed in-kernel (the PEC ghost value, matching ops/stencil.py).
+* On a sharded y/z axis the one-plane halo comes from the neighbor shard:
+  the step function ppermutes the boundary plane per source component
+  (exactly ``ParallelGrid::share()``'s ghost exchange, SURVEY.md §3.2) and
+  the kernel reads it as a (T, 1, nz)/(T, ny, 1) block instead of the
+  zero plane. At the global mesh edge ppermute delivers zeros — again the
+  PEC ghost.
+* CPML profile (b, c, 1/kappa) vectors, PEC wall masks, and 3D material
+  coefficient grids stream as kernel inputs taken from the coeffs pytree,
+  so under shard_map every rank reads its OWN slice (interior ranks see
+  identity profiles and all-ones walls — one SPMD program, like the
+  reference's sigma grids being zero outside the PML).
 * y/z-axis CPML psi slabs are block-aligned along x, so they stream
   through the same grid; their recursions + curl-accumulator deltas run
-  in-kernel on VMEM data. 1D profile coefficients are embedded as
-  compile-time constants (they are pure functions of the config).
+  in-kernel on VMEM data.
 * x-axis CPML psi (compact along the grid axis — NOT block-aligned) is
   corrected by a thin jnp post-pass on the 2(npml+1) boundary planes
   (`x_slab_post`), exactly the solver.py slab-delta algebra restricted to
   the slabs. TFSF face corrections and point sources are jnp patches on
-  single planes/cells (`tfsf_patch`, `point_source_patch`).
-* PEC walls are applied in-kernel from broadcasted-iota index masks.
+  single planes/cells (`tfsf_patch`, `point_source_patch`); on a sharded
+  axis the patch index is ownership-gated per shard.
+* The Drude ADE current recursion (J' = kj J + bj E; E -= cb J') runs
+  in-kernel on the same VMEM-resident data — two extra FMAs per E
+  component (reference: dispersive update with prev-prev layers,
+  SURVEY.md §2 InternalScheme row).
 
 Eligibility (everything else falls back to the identical-semantics jnp
-path in solver.py): 3D scheme, real float32, no Drude, unsharded. The
+path in solver.py): 3D scheme, real float32, x axis unsharded. The
 kernels run in interpreter mode on CPU so the same code path is testable
 without a TPU (tests/test_pallas.py).
 """
@@ -44,6 +64,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -56,16 +77,19 @@ AXES = "xyz"
 
 
 def eligible(static, mesh_axes=None) -> bool:
-    """True when the fused kernels cover this configuration."""
+    """True when the fused kernels cover this configuration.
+
+    The x (tiling) axis must stay unsharded; y/z may shard — their halos
+    ride ppermute outside the kernel. Drude and sharded meshes are
+    in-scope; complex fields and non-3D modes fall back to jnp.
+    """
     if static.mode.name != "3D":
-        return False
-    if mesh_axes and any(v is not None for v in mesh_axes.values()):
-        return False
-    if static.topology != (1, 1, 1):
         return False
     if static.field_dtype != np.float32:
         return False
-    if static.use_drude:
+    if static.topology[0] != 1:
+        return False
+    if mesh_axes and mesh_axes.get(0):
         return False
     return True
 
@@ -77,15 +101,13 @@ _VMEM_LIMIT = 100 << 20
 _VMEM_BUDGET = 64 << 20
 
 
-def _pick_tile(shape: Tuple[int, int, int],
-               block_bytes_at) -> int:
-    """Largest divisor T of Nx whose double-buffered VMEM use fits budget.
+def _pick_tile(n1: int, block_bytes_at) -> int:
+    """Largest divisor T of n1 whose double-buffered VMEM use fits budget.
 
     ``block_bytes_at(t)`` returns the summed bytes of every kernel operand
     block (inputs + outputs) at x-tile size t; Mosaic double-buffers each
     block for grid pipelining, hence the factor 2.
     """
-    n1 = shape[0]
     for t in (32, 16, 8, 4, 2, 1):
         if n1 % t == 0 and 2 * block_bytes_at(t) <= _VMEM_BUDGET:
             return t
@@ -114,49 +136,37 @@ def _classify(static, slabs: Dict[int, int], axis: int) -> str:
     return "slab" if axis in slabs else "full"
 
 
-def _profile_inputs(np_coeffs, tag: str, axis: int, slab: bool):
-    """(name, 3D-broadcastable numpy array) per CPML profile of one axis.
-
-    Pallas kernels cannot capture array constants, so the 1D b/c/1-over-
-    kappa profiles stream as (tiny) full-block inputs instead.
-    """
-    ax = AXES[axis]
-    key = f"pml_slab_{{}}{tag}_{ax}" if slab else f"pml_{{}}{tag}_{ax}"
-    out = []
-    for p in ("b", "c", "ik"):
-        v = np.asarray(np_coeffs[key.format(p)], np.float32)
-        shape = [1, 1, 1]
-        shape[axis] = v.shape[0]
-        out.append((f"pf_{p}_{ax}", v.reshape(shape)))
-    return out
-
-
-def make_family_kernel(static, np_coeffs, family: str, tile: int,
-                       slabs: Dict[int, int], interpret: bool):
+def make_family_kernel(static, np_coeffs, family: str, local_shape,
+                       tile: Optional[int], slabs: Dict[int, int],
+                       sharded_axes: Tuple[int, ...], interpret: bool):
     """Build the fused pallas update for one family ('E' or 'H').
 
-    Returns step_family(fields_in: dict, src: dict, psi: dict,
-                        array_coeffs: dict) -> (new_fields, new_psi_inkernel)
-    where psi contains only the in-kernel (y/z-axis) psi arrays.
+    ``local_shape`` is the per-shard extent (globals with y/z divided by
+    the topology); ``sharded_axes`` lists which of axes 1/2 have >1 shards
+    (their halos arrive as ghost-plane inputs).
+
+    Returns (run, psi_names, ghost_pairs) where
+    run(fields_in, src, psi, coeffs, ghosts) ->
+        (new_fields, new_psi_inkernel[, new_J])
+    with psi holding only the in-kernel (y/z-axis) psi arrays and ghosts
+    keyed by (src_comp, axis).
     """
     mode = static.mode
-    n1, n2, n3 = static.grid_shape
+    n1, n2, n3 = local_shape
     inv_dx = np.float32(1.0 / static.dx)
     upd = mode.e_components if family == "E" else mode.h_components
     tag = "e" if family == "E" else "h"
     backward = family == "E"
+    drude = family == "E" and static.use_drude
 
     # ---- static layout of kernel operands ------------------------------
     src_names = list(mode.h_components if family == "E"
                      else mode.e_components)
-    # halo planes needed for the axis-0 derivative: which source comps
-    halo_names = []
-    for c in upd:
-        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
-            d = ("H" if family == "E" else "E") + AXES[d_axis]
-            if a == 0 and d in src_names and d not in halo_names:
-                halo_names.append(d)
-    # in-kernel psi terms: (comp, axis, src, sign, kind)
+    # halo planes for the axis-0 derivative (same-array index-map blocks)
+    halo_names: List[str] = []
+    # neighbor ghost planes for sharded y/z derivatives: (src_comp, axis)
+    ghost_pairs: List[Tuple[str, int]] = []
+    # in-kernel psi terms: comp -> [(axis, src, sign, kind)]
     terms: Dict[str, List[Tuple[int, str, int, str]]] = {}
     psi_names: List[str] = []
     for c in upd:
@@ -165,6 +175,10 @@ def make_family_kernel(static, np_coeffs, family: str, tile: int,
             d = ("H" if family == "E" else "E") + AXES[d_axis]
             if d not in src_names:
                 continue
+            if a == 0 and d not in halo_names:
+                halo_names.append(d)
+            if a in sharded_axes and (d, a) not in ghost_pairs:
+                ghost_pairs.append((d, a))
             kind = _classify(static, slabs, a)
             terms[c].append((a, d, s, kind))
             if kind in ("slab", "full"):
@@ -172,44 +186,66 @@ def make_family_kernel(static, np_coeffs, family: str, tile: int,
 
     # material coefficient layout: scalar -> embedded; array -> streamed
     pairs = (("ca", "cb") if family == "E" else ("da", "db"))
-    coeff_is_array = {}
-    for c in upd:
-        for p in pairs:
-            coeff_is_array[f"{p}_{c}"] = (
-                np.ndim(np_coeffs[f"{p}_{c}"]) == 3)
+    coeff_keys = [f"{p}_{c}" for c in upd for p in pairs]
+    if drude:
+        coeff_keys += [f"{p}_{c}" for c in upd for p in ("kj", "bj")]
+    coeff_is_array = {k: np.ndim(np_coeffs[k]) == 3 for k in coeff_keys}
     array_coeff_names = [k for k, v in coeff_is_array.items() if v]
 
-    # CPML profile arrays stream as tiny full-block inputs (a pallas
-    # kernel cannot capture array constants), one (b, c, ik) triple per
-    # distinct in-kernel psi axis.
-    profile_inputs: List[Tuple[str, np.ndarray]] = []
+    # CPML profile vectors: one (b, c, ik) triple per distinct in-kernel
+    # psi axis, streamed from the coeffs pytree (key, axis, slab?).
+    profile_srcs: List[Tuple[str, str, int, bool]] = []  # (ref, key, axis)
     seen_axes = set()
     for c in upd:
         for (a, d, s, kind) in terms[c]:
             if kind in ("slab", "full") and a not in seen_axes:
                 seen_axes.add(a)
-                profile_inputs.extend(
-                    _profile_inputs(np_coeffs, tag, a, kind == "slab"))
-    profile_names = [nm for nm, _ in profile_inputs]
+                ax = AXES[a]
+                for p in ("b", "c", "ik"):
+                    key = (f"pml_slab_{p}{tag}_{ax}" if kind == "slab"
+                           else f"pml_{p}{tag}_{ax}")
+                    profile_srcs.append((f"pf_{p}_{ax}", key, a,
+                                         kind == "slab"))
+
+    def _prof_len(a: int, slab: bool) -> int:
+        return 2 * slabs[a] if slab else local_shape[a]
+
+    # PEC wall masks (E family only): 1D arrays from coeffs, one per axis.
+    wall_axes = [a for a in range(3)] if family == "E" else []
+
+    def _ghost_shape(a: int) -> Tuple[int, int, int]:
+        s = [n1, n2, n3]
+        s[a] = 1
+        return tuple(s)
+
+    def _psi_shape(name: str) -> Tuple[int, int, int]:
+        a = AXES.index(name[-1])
+        s = [n1, n2, n3]
+        if a in slabs:
+            s[a] = 2 * slabs[a]
+        return tuple(s)
 
     def _block_bytes(t: int) -> int:
         """Summed operand-block bytes at x-tile size t (see _pick_tile)."""
         plane = n2 * n3 * 4
         n_full = len(upd) + len(src_names) + len(upd)  # in + src + out
         n_full += len(array_coeff_names)
+        if drude:
+            n_full += 2 * len(upd)  # J in + J out
         total = n_full * t * plane + len(halo_names) * plane
+        for (_, a) in ghost_pairs:
+            gs = _ghost_shape(a)
+            total += t * gs[1] * gs[2] * 4
         for nm in psi_names:  # psi in + psi out
-            a = AXES.index(nm[-1])
-            shape = [t, n2, n3]
-            if a in slabs:
-                shape[a] = 2 * slabs[a]
-            total += 2 * shape[0] * shape[1] * shape[2] * 4
-        for _, arr in profile_inputs:
-            total += arr.size * 4
+            s = _psi_shape(nm)
+            total += 2 * t * s[1] * s[2] * 4
+        for (_, _, a, slab) in profile_srcs:
+            total += _prof_len(a, slab) * 4
+        for a in wall_axes:
+            total += (t if a == 0 else local_shape[a]) * 4
         return total
 
-    T = tile if tile is not None else _pick_tile(static.grid_shape,
-                                                 _block_bytes)
+    T = tile if tile is not None else _pick_tile(n1, _block_bytes)
     ntiles = n1 // T
 
     fdt = jnp.float32
@@ -220,18 +256,28 @@ def make_family_kernel(static, np_coeffs, family: str, tile: int,
         pos = 0
         for name in upd:
             idx[f"in_{name}"] = refs[pos]; pos += 1
+        if drude:
+            for name in upd:
+                idx[f"jin_{name}"] = refs[pos]; pos += 1
         for name in src_names:
             idx[f"src_{name}"] = refs[pos]; pos += 1
         for name in halo_names:
             idx[f"halo_{name}"] = refs[pos]; pos += 1
+        for (d, a) in ghost_pairs:
+            idx[f"gh_{d}_{a}"] = refs[pos]; pos += 1
         for name in psi_names:
             idx[f"psi_{name}"] = refs[pos]; pos += 1
-        for name in profile_names:
-            idx[name] = refs[pos]; pos += 1
+        for (ref, _, _, _) in profile_srcs:
+            idx[ref] = refs[pos]; pos += 1
+        for a in wall_axes:
+            idx[f"wl_{AXES[a]}"] = refs[pos]; pos += 1
         for name in array_coeff_names:
             idx[f"coef_{name}"] = refs[pos]; pos += 1
         for name in upd:
             idx[f"out_{name}"] = refs[pos]; pos += 1
+        if drude:
+            for name in upd:
+                idx[f"jout_{name}"] = refs[pos]; pos += 1
         for name in psi_names:
             idx[f"pso_{name}"] = refs[pos]; pos += 1
 
@@ -250,28 +296,31 @@ def make_family_kernel(static, np_coeffs, family: str, tile: int,
                 ghost = jnp.where(i < ntiles - 1, h, jnp.zeros_like(h))
                 sh = jnp.concatenate([f[1:], ghost], axis=0)
                 return (sh - f) * inv_dx
-            zero = jnp.zeros_like(
-                jax.lax.slice_in_dim(f, 0, 1, axis=axis))
-            if backward:
-                body = jax.lax.slice_in_dim(f, 0, f.shape[axis] - 1,
+            if axis in sharded_axes:
+                # neighbor plane (zeros at the global mesh edge = PEC ghost)
+                gh = idx[f"gh_{name}_{axis}"][:]
+                if backward:
+                    body = lax.slice_in_dim(f, 0, f.shape[axis] - 1,
                                             axis=axis)
+                    sh = jnp.concatenate([gh, body], axis=axis)
+                    return (f - sh) * inv_dx
+                body = lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
+                sh = jnp.concatenate([body, gh], axis=axis)
+                return (sh - f) * inv_dx
+            zero = jnp.zeros_like(
+                lax.slice_in_dim(f, 0, 1, axis=axis))
+            if backward:
+                body = lax.slice_in_dim(f, 0, f.shape[axis] - 1, axis=axis)
                 sh = jnp.concatenate([zero, body], axis=axis)
                 return (f - sh) * inv_dx
-            body = jax.lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
+            body = lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
             sh = jnp.concatenate([body, zero], axis=axis)
             return (sh - f) * inv_dx
 
-        # global-x index mask helpers for PEC walls
-        gx = (i * T + jax.lax.broadcasted_iota(jnp.int32, (T, 1, 1), 0))
-
-        def wall_mask(axis: int) -> jnp.ndarray:
-            if axis == 0:
-                return ((gx != 0) & (gx != n1 - 1)).astype(fdt)
-            n = (n1, n2, n3)[axis]
-            shape = [1, 1, 1]
-            shape[axis] = n
-            ga = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis)
-            return ((ga != 0) & (ga != n - 1)).astype(fdt)
+        def coef(key: str):
+            if coeff_is_array[key]:
+                return idx[f"coef_{key}"][:]
+            return fdt(float(np_coeffs[key]))
 
         for c in upd:
             acc = None
@@ -287,8 +336,7 @@ def make_family_kernel(static, np_coeffs, family: str, tile: int,
                     if kind == "slab":
                         m = slabs[a]
                         nloc = dfa.shape[a]
-                        cut = functools.partial(jax.lax.slice_in_dim,
-                                                axis=a)
+                        cut = functools.partial(lax.slice_in_dim, axis=a)
                         d_lo = cut(dfa, 0, m)
                         d_hi = cut(dfa, nloc - m, nloc)
                         p_lo = (cut(b, 0, m) * cut(psi, 0, m)
@@ -313,20 +361,18 @@ def make_family_kernel(static, np_coeffs, family: str, tile: int,
                 acc = term if acc is None else acc + term
 
             old = idx[f"in_{c}"][:]
-            coefs = []
-            for p in pairs:
-                k = f"{p}_{c}"
-                if coeff_is_array[k]:
-                    coefs.append(idx[f"coef_{k}"][:])
-                else:
-                    coefs.append(fdt(float(np_coeffs[k])))
             if family == "E":
-                new = coefs[0] * old + coefs[1] * acc
+                if drude:
+                    j_new = (coef(f"kj_{c}") * idx[f"jin_{c}"][:]
+                             + coef(f"bj_{c}") * old)
+                    idx[f"jout_{c}"][:] = j_new.astype(fdt)
+                    acc = acc - j_new
+                new = coef(f"ca_{c}") * old + coef(f"cb_{c}") * acc
                 for a in range(3):
                     if a != component_axis(c):
-                        new = new * wall_mask(a)
+                        new = new * idx[f"wl_{AXES[a]}"][:]
             else:
-                new = coefs[0] * old - coefs[1] * acc
+                new = coef(f"da_{c}") * old - coef(f"db_{c}") * acc
             idx[f"out_{c}"][:] = new.astype(fdt)
 
     # ---- specs ---------------------------------------------------------
@@ -344,47 +390,62 @@ def make_family_kernel(static, np_coeffs, family: str, tile: int,
             lambda i: (jnp.minimum((i + 1) * T, n1 - 1), 0, 0),
             memory_space=pltpu.VMEM)
 
+    def ghost_spec(a: int):
+        gs = _ghost_shape(a)
+        return pl.BlockSpec((T, gs[1], gs[2]), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
     def psi_spec(name: str):
-        a = AXES.index(name[-1])
-        shape = [T, n2, n3]
-        if a in slabs:
-            shape[a] = 2 * slabs[a]
-        return pl.BlockSpec(tuple(shape), lambda i: (i, 0, 0),
+        s = _psi_shape(name)
+        return pl.BlockSpec((T, s[1], s[2]), lambda i: (i, 0, 0),
                             memory_space=pltpu.VMEM)
 
-    def profile_spec(arr: np.ndarray):
-        shape = arr.shape
-        return pl.BlockSpec(shape, lambda i: (0, 0, 0),
+    def vec_spec(a: int, length: int):
+        """1D profile/wall broadcast block along axis a."""
+        s = [1, 1, 1]
+        s[a] = length
+        if a == 0:
+            return pl.BlockSpec((T, 1, 1), lambda i: (i, 0, 0),
+                                memory_space=pltpu.VMEM)
+        return pl.BlockSpec(tuple(s), lambda i: (0, 0, 0),
                             memory_space=pltpu.VMEM)
 
-    in_specs = ([field_spec() for _ in upd]
-                + [field_spec() for _ in src_names]
-                + [halo_spec() for _ in halo_names]
-                + [psi_spec(nm) for nm in psi_names]
-                + [profile_spec(arr) for _, arr in profile_inputs]
-                + [field_spec() for _ in array_coeff_names])
-    out_specs = ([field_spec() for _ in upd]
-                 + [psi_spec(nm) for nm in psi_names])
+    in_specs = [field_spec() for _ in upd]
+    if drude:
+        in_specs += [field_spec() for _ in upd]
+    in_specs += [field_spec() for _ in src_names]
+    in_specs += [halo_spec() for _ in halo_names]
+    in_specs += [ghost_spec(a) for (_, a) in ghost_pairs]
+    in_specs += [psi_spec(nm) for nm in psi_names]
+    in_specs += [vec_spec(a, _prof_len(a, slab))
+                 for (_, _, a, slab) in profile_srcs]
+    in_specs += [vec_spec(a, local_shape[a]) for a in wall_axes]
+    in_specs += [field_spec() for _ in array_coeff_names]
 
-    def psi_shape(name: str):
-        a = AXES.index(name[-1])
-        shape = [n1, n2, n3]
-        if a in slabs:
-            shape[a] = 2 * slabs[a]
-        return tuple(shape)
+    out_specs = [field_spec() for _ in upd]
+    if drude:
+        out_specs += [field_spec() for _ in upd]
+    out_specs += [psi_spec(nm) for nm in psi_names]
 
-    out_shape = ([jax.ShapeDtypeStruct((n1, n2, n3), np.float32)
-                  for _ in upd]
-                 + [jax.ShapeDtypeStruct(psi_shape(nm), np.float32)
-                    for nm in psi_names])
+    out_shape = [jax.ShapeDtypeStruct((n1, n2, n3), np.float32)
+                 for _ in upd]
+    if drude:
+        out_shape += [jax.ShapeDtypeStruct((n1, n2, n3), np.float32)
+                      for _ in upd]
+    out_shape += [jax.ShapeDtypeStruct(_psi_shape(nm), np.float32)
+                  for nm in psi_names]
 
-    # donate the updated family's buffers and psi into the outputs
+    # donate the updated family's buffers (+J, +psi) into the outputs
     n_upd = len(upd)
     aliases = {j: j for j in range(n_upd)}
-    psi_in_start = n_upd + len(src_names) + len(halo_names)
+    if drude:
+        for j in range(n_upd):
+            aliases[n_upd + j] = n_upd + j
+    psi_in_start = ((2 if drude else 1) * n_upd + len(src_names)
+                    + len(halo_names) + len(ghost_pairs))
+    psi_out_start = (2 if drude else 1) * n_upd
     for j in range(len(psi_names)):
-        aliases[psi_in_start + j] = n_upd + j
-    profile_consts = [jnp.asarray(arr) for _, arr in profile_inputs]
+        aliases[psi_in_start + j] = psi_out_start + j
 
     call = pl.pallas_call(
         kernel,
@@ -398,21 +459,66 @@ def make_family_kernel(static, np_coeffs, family: str, tile: int,
         interpret=interpret,
     )
 
+    def _vec3(v: jnp.ndarray, a: int) -> jnp.ndarray:
+        s = [1, 1, 1]
+        s[a] = v.shape[0]
+        return v.astype(fdt).reshape(s)
+
     def run(fields: Dict[str, jnp.ndarray], src: Dict[str, jnp.ndarray],
-            psi: Dict[str, jnp.ndarray],
-            array_coeffs: Dict[str, jnp.ndarray]):
-        args = ([fields[c] for c in upd]
-                + [src[c] for c in src_names]
-                + [src[c] for c in halo_names]
-                + [psi[nm] for nm in psi_names]
-                + profile_consts
-                + [array_coeffs[k] for k in array_coeff_names])
+            psi: Dict[str, jnp.ndarray], coeffs: Dict[str, jnp.ndarray],
+            ghosts: Dict[Tuple[str, int], jnp.ndarray], J=None):
+        args = [fields[c] for c in upd]
+        if drude:
+            args += [J[c] for c in upd]
+        args += [src[c] for c in src_names]
+        args += [src[c] for c in halo_names]
+        args += [ghosts[(d, a)] for (d, a) in ghost_pairs]
+        args += [psi[nm] for nm in psi_names]
+        args += [_vec3(coeffs[key], a) for (_, key, a, _) in profile_srcs]
+        args += [_vec3(coeffs[f"wall_{AXES[a]}"], a) for a in wall_axes]
+        args += [coeffs[k] for k in array_coeff_names]
         outs = call(*args)
         new_fields = {c: outs[j] for j, c in enumerate(upd)}
-        new_psi = {nm: outs[n_upd + j] for j, nm in enumerate(psi_names)}
-        return new_fields, new_psi
+        k = n_upd
+        new_j = None
+        if drude:
+            new_j = {c: outs[k + j] for j, c in enumerate(upd)}
+            k += n_upd
+        new_psi = {nm: outs[k + j] for j, nm in enumerate(psi_names)}
+        return new_fields, new_psi, new_j
 
-    return run, psi_names, array_coeff_names
+    return run, psi_names, ghost_pairs
+
+
+# ---------------------------------------------------------------------------
+# halo exchange for the sharded case (outside the kernel)
+# ---------------------------------------------------------------------------
+
+
+def gather_ghosts(src: Dict[str, jnp.ndarray],
+                  ghost_pairs: List[Tuple[str, int]],
+                  mesh_axes, mesh_shape, backward: bool):
+    """ppermute the one-plane y/z halos the kernel needs.
+
+    backward=True (E family): each shard receives the LAST plane of its
+    lower neighbor; False (H family): the FIRST plane of its upper
+    neighbor. Non-periodic, so edge shards receive zeros (PEC ghost) —
+    identical to ops/stencil.py's _neighbor_plane convention.
+    """
+    out = {}
+    for (d, a) in ghost_pairs:
+        name = mesh_axes[a]
+        n_sh = mesh_shape[name]
+        f = src[d]
+        n = f.shape[a]
+        if backward:
+            plane = lax.slice_in_dim(f, n - 1, n, axis=a)
+            perm = [(i, i + 1) for i in range(n_sh - 1)]
+        else:
+            plane = lax.slice_in_dim(f, 0, 1, axis=a)
+            perm = [(i + 1, i) for i in range(n_sh - 1)]
+        out[(d, a)] = lax.ppermute(plane, name, perm)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -427,7 +533,8 @@ def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
 
     The kernel computed plain s*dfa for axis-0 curl terms; the exact CPML
     term differs only on the two x slabs by s*((ik-1)*dfa + psi'). Patch
-    those planes (solver.py's _slab_delta restricted to axis 0).
+    those planes (solver.py's _slab_delta restricted to axis 0). The x
+    axis is never sharded (eligibility), so the slices are local.
     """
     mode = static.mode
     upd = mode.e_components if family == "E" else mode.h_components
@@ -498,7 +605,9 @@ def plane_corrections(field: str, comp: str, setup, coeffs, inc,
     """TFSF corrections as (axis, plane, broadcastable term) patches.
 
     Same math as ops/tfsf.corrections_for but WITHOUT the full-size onehot
-    gate — the plane index is returned for an .at[plane].add patch.
+    gate — the plane index is returned for an .at[plane].add patch. All
+    coordinates come from the gx/gy/gz global-index arrays, so the terms
+    are correct per shard.
     """
     gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
     out = []
@@ -543,6 +652,51 @@ def plane_corrections(field: str, comp: str, setup, coeffs, inc,
     return out
 
 
+def _local_index(static, coeffs, axis: int, pos: int):
+    """(local index, ownership mask) of global cell `pos` along `axis`.
+
+    The local index is pos - first-owned-global-index (gx/gy/gz hold each
+    shard's global coordinates), clamped into range so every rank runs the
+    same program; `own` is None on an unsharded axis (always owner) and a
+    traced bool on a sharded one.
+    """
+    if static.topology[axis] <= 1:
+        return pos, None
+    g = coeffs[f"g{AXES[axis]}"]
+    n_loc = static.grid_shape[axis] // static.topology[axis]
+    loc = pos - g[0]
+    own = (loc >= 0) & (loc < n_loc)
+    return jnp.clip(loc, 0, n_loc - 1), own
+
+
+def _plane_add(static, arr, axis: int, plane: int, val, coeffs):
+    """arr[..., plane, ...] += val, ownership-gated on a sharded axis.
+
+    Unsharded axis: static index (XLA folds to an in-place slice update).
+    Sharded axis: the add is zeroed on non-owner shards.
+    """
+    if plane < 0 or plane >= static.grid_shape[axis]:
+        return arr
+    loc, own = _local_index(static, coeffs, axis, plane)
+    sl: List[Any] = [slice(None)] * 3
+    sl[axis] = loc
+    if own is not None:
+        val = jnp.where(own, val, 0.0).astype(arr.dtype)
+    return arr.at[tuple(sl)].add(val)
+
+
+def _plane_coef(static, cb, axis: int, plane: int, coeffs):
+    """cb sliced at a (possibly sharded-axis) plane; scalar cb passes through."""
+    if jnp.ndim(cb) != 3:
+        return cb
+    loc, own = _local_index(static, coeffs, axis, plane)
+    if own is None:
+        sl = [slice(None)] * 3
+        sl[axis] = loc
+        return cb[tuple(sl)]
+    return lax.dynamic_index_in_dim(cb, loc, axis, keepdims=False)
+
+
 def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
                coeffs, inc) -> Dict[str, jnp.ndarray]:
     """Add the TFSF face corrections onto the kernel output planes."""
@@ -561,9 +715,7 @@ def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
         for (axis, plane, term) in patches:
             if plane < 0 or plane >= static.grid_shape[axis]:
                 continue
-            sl = [slice(None)] * 3
-            sl[axis] = plane
-            scale = cb[tuple(sl)] if jnp.ndim(cb) == 3 else cb
+            scale = _plane_coef(static, cb, axis, plane, coeffs)
             t2 = jnp.squeeze(term, axis=axis)
             if family == "E":
                 # PEC wall zeroing must survive the patch
@@ -575,27 +727,43 @@ def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
                         shp[a2] = w.shape[0]
                         t2 = t2 * jnp.squeeze(
                             w.reshape(shp), axis=axis)
-            arr = arr.at[tuple(sl)].add(
-                (sign * scale * t2).astype(arr.dtype))
+            arr = _plane_add(static, arr, axis, plane,
+                             (sign * scale * t2).astype(arr.dtype), coeffs)
         out[c] = arr
     return out
 
 
 def point_source_patch(static, fields, coeffs, t):
-    """Soft point source as a single-cell .at[].add patch."""
+    """Soft point source as a single-cell add, ownership-gated per shard."""
     ps = static.cfg.point_source
     c = ps.component
     if c not in fields:
         return fields
-    pos = tuple(ps.position)
-    cb = coeffs[f"cb_{c}"]
-    scale = cb[pos] if jnp.ndim(cb) == 3 else cb
     wf = waveform(ps.waveform,
                   (t.astype(static.real_dtype) + 0.5) * static.dt,
                   static.omega, static.dt)
     arr = fields[c]
-    return dict(fields, **{c: arr.at[pos].add(
-        (ps.amplitude * scale * wf).astype(arr.dtype))})
+    cb = coeffs[f"cb_{c}"]
+    if all(p <= 1 for p in static.topology):
+        pos = tuple(ps.position)
+        scale = cb[pos] if jnp.ndim(cb) == 3 else cb
+        return dict(fields, **{c: arr.at[pos].add(
+            (ps.amplitude * scale * wf).astype(arr.dtype))})
+    idxs = []
+    own = None
+    for a in range(3):
+        loc, o = _local_index(static, coeffs, a, ps.position[a])
+        idxs.append(loc)
+        if o is not None:
+            own = o if own is None else own & o
+    scale = cb
+    if jnp.ndim(cb) == 3:
+        scale = cb[tuple(idxs)]
+    val = ps.amplitude * scale * wf
+    if own is not None:
+        val = jnp.where(own, val, 0.0)
+    return dict(fields, **{c: arr.at[tuple(idxs)].add(
+        val.astype(arr.dtype))})
 
 
 # ---------------------------------------------------------------------------
@@ -603,24 +771,32 @@ def point_source_patch(static, fields, coeffs, t):
 # ---------------------------------------------------------------------------
 
 
-def make_pallas_step(static):
+def make_pallas_step(static, mesh_axes=None, mesh_shape=None):
     """Full leapfrog step via fused kernels. Same signature/state layout as
     solver.make_step's jnp step; returns None if the config is ineligible."""
     from fdtd3d_tpu import solver as solver_mod
 
-    if not eligible(static):
+    if not eligible(static, mesh_axes):
         return None
+    topo = static.topology
+    local_shape = tuple(static.grid_shape[a] // topo[a] for a in range(3))
+    if any(topo[a] > 1 and not (mesh_axes or {}).get(a) for a in (1, 2)):
+        return None  # sharded axis without a mesh axis name to permute on
+    sharded_axes = tuple(a for a in (1, 2)
+                         if topo[a] > 1 and (mesh_axes or {}).get(a))
+    mesh_axes = mesh_axes or {}
+    mesh_shape = mesh_shape or {}
     slabs = solver_mod.slab_axes(static)
     np_coeffs = solver_mod.build_coeffs(static)
     tile = None  # per-family auto pick (VMEM-budgeted, _pick_tile)
     interpret = jax.default_backend() not in ("tpu", "axon")
 
-    run_e, psi_e_names, _ = make_family_kernel(
-        static, np_coeffs, "E", tile, slabs, interpret)
-    run_h, psi_h_names, _ = make_family_kernel(
-        static, np_coeffs, "H", tile, slabs, interpret)
-    array_coeff_names = [k for k, v in np_coeffs.items()
-                         if np.ndim(v) == 3]
+    run_e, psi_e_names, ghosts_e = make_family_kernel(
+        static, np_coeffs, "E", local_shape, tile, slabs, sharded_axes,
+        interpret)
+    run_h, psi_h_names, ghosts_h = make_family_kernel(
+        static, np_coeffs, "H", local_shape, tile, slabs, sharded_axes,
+        interpret)
     setup = static.tfsf_setup
     x_active = 0 in static.pml_axes
     x_slab = 0 in slabs
@@ -631,16 +807,21 @@ def make_pallas_step(static):
     def step(state, coeffs):
         t = state["t"]
         new_state = dict(state)
-        arr_coeffs = {k: coeffs[k] for k in array_coeff_names}
 
         if setup is not None:
             new_state["inc"] = tfsf_mod.advance_einc(
                 state["inc"], coeffs, t, static.dt, static.omega, setup)
 
+        # E family ------------------------------------------------------
         psi_e_in = {k: state["psi_E"][k] for k in psi_e_names} \
             if psi_e_names else {}
-        new_E, psi_e_out = run_e(state["E"], state["H"], psi_e_in,
-                                 arr_coeffs)
+        gh_e = gather_ghosts(state["H"], ghosts_e, mesh_axes, mesh_shape,
+                             backward=True)
+        new_E, psi_e_out, new_J = run_e(state["E"], state["H"], psi_e_in,
+                                        coeffs, gh_e,
+                                        J=state.get("J"))
+        if new_J is not None:
+            new_state["J"] = new_J
         psi_E = dict(state.get("psi_E", {}), **psi_e_out)
         if x_active:
             px = {k: v for k, v in psi_E.items() if k.endswith("_x")}
@@ -658,15 +839,23 @@ def make_pallas_step(static):
             new_state["inc"] = tfsf_mod.advance_hinc(
                 new_state["inc"], coeffs, setup)
 
+        # H family ------------------------------------------------------
         psi_h_in = {k: state["psi_H"][k] for k in psi_h_names} \
             if psi_h_names else {}
-        new_H, psi_h_out = run_h(state["H"], new_E, psi_h_in, arr_coeffs)
+        gh_h = gather_ghosts(new_E, ghosts_h, mesh_axes, mesh_shape,
+                             backward=False)
+        new_H, psi_h_out, _ = run_h(state["H"], new_E, psi_h_in, coeffs,
+                                    gh_h)
         psi_H = dict(state.get("psi_H", {}), **psi_h_out)
         if x_active:
             px = {k: v for k, v in psi_H.items() if k.endswith("_x")}
             new_H, px_new = x_slab_post(static, "H", new_H, new_E, px,
                                         coeffs, slabs)
             psi_H.update(px_new)
+        if setup is not None:
+            # H-side consistency corrections (sampling Einc at t^{n+1})
+            new_H = tfsf_patch(static, "H", new_H, coeffs,
+                               new_state["inc"])
         new_state["H"] = new_H
 
         if psi_E:

@@ -243,7 +243,7 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     """
     if _want_pallas(static, mesh_axes):
         from fdtd3d_tpu.ops import pallas3d
-        fused = pallas3d.make_pallas_step(static)
+        fused = pallas3d.make_pallas_step(static, mesh_axes, mesh_shape)
         if fused is not None:
             return fused
     mode, cfg = static.mode, static.cfg

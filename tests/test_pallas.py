@@ -76,6 +76,27 @@ def test_tfsf_parity():
                                        angle_psi=40.0)))
 
 
+def test_tfsf_driven_parity():
+    """Zero initial fields, source-driven: catches corrections the random-
+    field 3-step parity masks (round-1 regression: the H-family TFSF
+    patches were missing entirely from the fused path)."""
+    from fdtd3d_tpu.sim import Simulation
+    import numpy as np
+
+    def cfgs(use_pallas):
+        return SimConfig(**BASE, use_pallas=use_pallas,
+                         pml=PmlConfig(size=(3, 3, 3)),
+                         tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                                         angle_teta=30.0, angle_phi=40.0,
+                                         angle_psi=15.0))
+    ref = Simulation(cfgs(False)); ref.run(30)
+    got = Simulation(cfgs(True)); got.run(30)
+    for c, r in ref.fields().items():
+        scale = np.abs(r).max() + 1e-30
+        err = np.abs(got.fields()[c] - r).max() / scale
+        assert err < 2e-6, f"{c}: rel {err:.2e}"
+
+
 def test_point_source_parity():
     _compare(SimConfig(**BASE, point_source=PointSourceConfig(
         enabled=True, component="Ez", position=(8, 8, 8), amplitude=2.0)))
@@ -88,12 +109,32 @@ def test_uneven_tile_parity():
     _compare(SimConfig(**cfg), steps=2)
 
 
+def test_drude_uniform_parity():
+    # scalar kj/bj embedded as kernel constants
+    _compare(SimConfig(**BASE, materials=MaterialsConfig(
+        use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10)))
+
+
+def test_drude_sphere_parity():
+    # 3D kj/bj coefficient grids streamed through the kernel, plus CPML
+    _compare(SimConfig(**BASE, pml=PmlConfig(size=(3, 3, 3)),
+                       materials=MaterialsConfig(
+                           use_drude=True, eps_inf=1.5, omega_p=1e11,
+                           gamma=1e10,
+                           drude_sphere=SphereConfig(
+                               enabled=True, center=(8, 8, 8), radius=4))))
+
+
 @pytest.mark.parametrize("reason,cfg", [
     ("2d-mode", dict(BASE, scheme="2D_TMz")),
     ("f64", dict(BASE, dtype="float64")),
-    ("drude", dict(BASE, materials=MaterialsConfig(
-        use_drude=True, omega_p=1e11, gamma=1e10))),
 ])
 def test_ineligible_falls_back(reason, cfg):
     static = solver.build_static(SimConfig(**cfg))
     assert pallas3d.make_pallas_step(static) is None, reason
+
+
+def test_x_sharded_falls_back():
+    static = solver.build_static(SimConfig(**BASE))
+    static = dataclasses.replace(static, topology=(2, 1, 1))
+    assert pallas3d.make_pallas_step(static, {0: "x"}, {"x": 2}) is None
