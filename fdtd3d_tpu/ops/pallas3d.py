@@ -744,11 +744,6 @@ def point_source_patch(static, fields, coeffs, t):
                   static.omega, static.dt)
     arr = fields[c]
     cb = coeffs[f"cb_{c}"]
-    if all(p <= 1 for p in static.topology):
-        pos = tuple(ps.position)
-        scale = cb[pos] if jnp.ndim(cb) == 3 else cb
-        return dict(fields, **{c: arr.at[pos].add(
-            (ps.amplitude * scale * wf).astype(arr.dtype))})
     idxs = []
     own = None
     for a in range(3):

@@ -1,0 +1,98 @@
+"""Acceptance suite: black-box replay of every Examples/*.txt config.
+
+The reference's acceptance tests are black-box runs of the full binary on
+small .txt configs with correctness asserted on printed error norms
+(SURVEY.md §4). Here: every example command file is replayed through the
+real CLI entry (cmd-file parsing included); 3D BASELINE-scale configs are
+shrunk by override flags (CLI flags override the file, reference
+behavior); the final printed field norms must match golden values
+recorded from a validated run. A norm drift beyond ~0.5% means the
+physics changed.
+
+The two BASELINE multi-chip configs (sphere3D_mie, drude3D_nanoantenna)
+use --topology auto, so on the 8-device test mesh this suite also
+exercises the sharded path end-to-end from the CLI.
+"""
+
+import contextlib
+import glob
+import io
+import os
+import re
+
+import pytest
+
+from fdtd3d_tpu import cli
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "Examples")
+
+_SHRINK_3D = ["--same-size", "32", "--time-steps", "60", "--pml-size", "4",
+              "--tfsf-margin", "3", "--norms-every", "60"]
+
+# file -> (override argv, golden final norms). Goldens recorded on the
+# 8-device CPU mesh, f32; tolerance covers platform/fusion reorderings.
+CASES = {
+    "vacuum1D_ezhy.txt": ([], {"Ez": 9.9848e-01, "Hy": 2.6546e-03}),
+    "drude1D_metal.txt": ([], {"Ez": 1.0683e+00, "Hy": 5.3137e-03}),
+    "vacuum2D_tmz.txt": ([], {"Ez": 6.0252e-02, "Hx": 6.5954e-05,
+                              "Hy": 6.5954e-05}),
+    "vacuum3D_tfsf.txt": (
+        ["--same-size", "32", "--time-steps", "60", "--pml-size", "5",
+         "--tfsf-margin", "4", "--norms-every", "60"],
+        {"Ex": 3.2531e-01, "Hy": 8.3379e-04}),
+    "sphere3D_mie.txt": (
+        _SHRINK_3D + ["--eps-sphere-center-x", "16",
+                      "--eps-sphere-center-y", "16",
+                      "--eps-sphere-center-z", "16",
+                      "--eps-sphere-radius", "6"],
+        {"Ex": 4.4693e-02, "Ey": 6.1280e-03, "Ez": 7.6921e-03,
+         "Hy": 1.2000e-04}),
+    "drude3D_nanoantenna.txt": (
+        _SHRINK_3D + ["--drude-sphere-center-x", "16",
+                      "--drude-sphere-center-y", "16",
+                      "--drude-sphere-center-z", "16",
+                      "--drude-sphere-radius", "6"],
+        {"Ex": 4.4692e-02, "Ey": 9.9613e-03, "Ez": 1.3982e-02,
+         "Hy": 1.2808e-04}),
+}
+
+RTOL = 5e-3
+
+
+def _run_cli(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(argv)
+    return rc, buf.getvalue()
+
+
+def test_every_example_has_a_case():
+    files = {os.path.basename(p)
+             for p in glob.glob(os.path.join(EXAMPLES_DIR, "*.txt"))}
+    assert files == set(CASES), (
+        "every Examples/*.txt must be replayed by this suite")
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_example_replay_golden_norms(name):
+    overrides, golden = CASES[name]
+    rc, out = _run_cli(
+        ["--cmd-from-file", os.path.join(EXAMPLES_DIR, name)] + overrides)
+    assert rc == 0, f"{name}: CLI exited {rc}\n{out}"
+    norm_lines = [ln for ln in out.splitlines() if ln.startswith("[t=")]
+    assert norm_lines, f"{name}: no norms printed\n{out}"
+    norms = dict(re.findall(r"(\w+)=([\d.e+-]+)", norm_lines[-1]))
+    for comp, want in golden.items():
+        got = float(norms[comp])
+        assert got == pytest.approx(want, rel=RTOL), (
+            f"{name}: {comp} = {got:.6e}, golden {want:.6e}")
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_example_parses_and_validates_at_full_scale(name):
+    """The unshrunk config (BASELINE scale) must parse and validate."""
+    argv = cli.read_cmd_file(os.path.join(EXAMPLES_DIR, name))
+    args = cli.build_parser().parse_args(argv)
+    cfg = cli.args_to_config(args)
+    cfg.validate()
+    assert cfg.time_steps > 0
