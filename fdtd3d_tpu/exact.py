@@ -65,20 +65,78 @@ def cavity_mode_tmz(size: Tuple[int, int], m: int, n: int,
     return shape, discrete_omega((kx, ky, 0.0), dx, dt)
 
 
-def cavity_mode_3d(size: Tuple[int, int, int], mnp: Tuple[int, int, int],
-                   dx: float, dt: float):
-    """3D PEC-cavity TM-like eigenmode with E = Ez only (p=0 along z).
+def cavity_mode(size: Tuple[int, int, int], mnp: Tuple[int, int, int],
+                dx: float, dt: float,
+                cvec: Tuple[float, float, float] = (0.37, -0.61, 0.83),
+                avec: Tuple[float, float, float] = None):
+    """PEC-cavity eigenmode of the DISCRETE Yee operator, any dimension.
 
-    With k = (m pi/(Nx-1), n pi/(Ny-1), 0), Ez = sin(kx i) sin(ky j)
-    (constant along z) solves the discrete equations with Hz = 0 — the
-    z-invariant TMz mode embedded in 3D; exact in the 3D update too.
+    Works for every scheme mode: an inactive axis (size 1, m = 0) simply
+    contributes no trig factor. Returns ({comp: staggered E-grid array},
+    omega_discrete); identically-zero components are omitted.
+
+    Construction: with k_a = m_a pi/(N_a - 1) (rad/cell) the staggered
+    trig product
+        Ex(i+1/2, j, k) = Ax cos(kx(i+1/2)) sin(ky j) sin(kz k)   (cyc.)
+    turns the discrete curl/div into the continuum ones with the EXACT
+    substitution K_a = 2 sin(k_a/2)/dx. An amplitude vector A with
+    K . A = 0 (discrete divergence-free) makes E0 a discrete curl-curl
+    eigenvector with eigenvalue c^2 |K|^2, so with H = 0 at init it
+    evolves as cavity_expectation — machine precision in f64. Tangential
+    E vanishes on all PEC walls because sin(k_a g) is zero at g = 0 and
+    g = N_a - 1.
+
+    ``avec``: explicit amplitude vector (validated K . A ~ 0) — use it to
+    select a scheme's components (e.g. (0,0,1) for TMz, K x e_z for TEz).
+    Default: A = K x cvec (generic full-vector mode).
     """
-    nx, ny, nz = size
-    m, n, p = mnp
-    if p != 0:
-        raise NotImplementedError("only z-invariant (p=0) modes")
-    shape2d, omega = cavity_mode_tmz((nx, ny), m, n, dx, dt)
-    return np.repeat(shape2d[:, :, None], nz, axis=2), omega
+    k = [mnp[a] * math.pi / (size[a] - 1) if size[a] > 1 else 0.0
+         for a in range(3)]
+    bigk = np.array([2.0 * math.sin(k[a] / 2.0) / dx for a in range(3)])
+    if avec is not None:
+        amp = np.asarray(avec, dtype=np.float64)
+        if abs(float(bigk @ amp)) > 1e-9 * np.linalg.norm(bigk):
+            raise ValueError("avec is not discrete-divergence-free")
+    else:
+        amp = np.cross(bigk, np.asarray(cvec, dtype=np.float64))
+    scale = np.max(np.abs(amp))
+    if scale == 0.0:
+        raise ValueError(f"degenerate mode/amplitude combination {mnp}")
+    amp = amp / scale
+
+    def axis_fn(a: int, half: bool):
+        g = np.arange(size[a], dtype=np.float64) + (0.5 if half else 0.0)
+        v = np.cos(k[a] * g) if half else np.sin(k[a] * g)
+        sh = [1, 1, 1]
+        sh[a] = size[a]
+        return v.reshape(sh)
+
+    out = {}
+    for a, comp in enumerate(("Ex", "Ey", "Ez")):
+        # a sin factor of a k=0 ACTIVE transverse axis zeroes the whole
+        # component (inactive axes contribute no factor at all)
+        if abs(amp[a]) < 1e-14 or any(
+                k[b] == 0.0 and size[b] > 1 for b in range(3) if b != a):
+            continue
+        f = amp[a]
+        for b in range(3):
+            if size[b] > 1:
+                f = f * axis_fn(b, half=(b == a))
+        f = np.broadcast_to(np.asarray(f), size).copy()
+        if k[a] != 0.0:
+            # The outermost own-axis half-plane (position N_a - 1/2) lies
+            # OUTSIDE the PEC box. Zeroed, it stays exactly zero: every
+            # term of its update reads other beyond-wall planes that are
+            # also zero, so the whole-array evolution is machine-exact.
+            sl = [slice(None)] * 3
+            sl[a] = size[a] - 1
+            f[tuple(sl)] = 0.0
+        out[comp] = f
+    return out, discrete_omega(tuple(k), dx, dt)
+
+
+# Backward-compatible name for the 3D case.
+cavity_mode_3d = cavity_mode
 
 
 def cavity_expectation(mode_shape: np.ndarray, omega: float, dt: float,

@@ -610,6 +610,8 @@ def plane_corrections(field: str, comp: str, setup, coeffs, inc,
     are correct per shard.
     """
     gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
+    # zeta is a REAL line coordinate (see tfsf.corrections_for)
+    rdt = jnp.real(inc["Einc"]).dtype
     out = []
     for corr in setup.corrections:
         if corr.field != field or corr.comp != comp:
@@ -617,11 +619,11 @@ def plane_corrections(field: str, comp: str, setup, coeffs, inc,
         off = tfsf_mod.YEE_OFFSETS[corr.src]
         zeta = setup.zeta0 + setup.khat[corr.axis] * (
             corr.pos_a - setup.origin[corr.axis])
-        zeta = jnp.asarray(zeta, dtype=inc["Einc"].dtype)
+        zeta = jnp.asarray(zeta, dtype=rdt)
         for b in range(3):
             if b == corr.axis or b not in active_axes:
                 continue
-            pb = gs[b].astype(inc["Einc"].dtype) + off[b]
+            pb = gs[b].astype(rdt) + off[b]
             shape = [1, 1, 1]
             shape[b] = pb.shape[0]
             zeta = zeta + setup.khat[b] * (

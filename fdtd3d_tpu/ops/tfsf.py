@@ -185,9 +185,10 @@ def advance_einc(inc: Dict[str, jnp.ndarray], coeffs, t, dt, omega,
     einc, hinc = inc["Einc"], inc["Hinc"]
     dh = hinc - jnp.concatenate([jnp.zeros_like(hinc[:1]), hinc[:-1]])
     einc = coeffs["inc_ae"] * einc - coeffs["inc_be"] * dh
-    src = setup.amplitude * waveform(setup.waveform,
-                                     (t.astype(einc.dtype) + 1.0) * dt,
-                                     omega, dt)
+    # waveform time is REAL even in complex_fields mode
+    src = setup.amplitude * waveform(
+        setup.waveform, (t.astype(jnp.real(einc).dtype) + 1.0) * dt,
+        omega, dt)
     einc = einc.at[0].set(src.astype(einc.dtype))
     return dict(inc, Einc=einc)
 
@@ -217,6 +218,9 @@ def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
     everything derived from the sharded coordinate arrays gx/gy/gz.
     """
     gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
+    # zeta is a REAL line coordinate even when the fields are complex
+    # (complex_fields mode): interpolation clips/floors it.
+    rdt = jnp.real(inc["Einc"]).dtype
     total = None
     for corr in setup.corrections:
         if corr.field != field or corr.comp != comp:
@@ -225,11 +229,11 @@ def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
         off = YEE_OFFSETS[corr.src]
         zeta = setup.zeta0 + setup.khat[corr.axis] * (
             corr.pos_a - setup.origin[corr.axis])
-        zeta = jnp.asarray(zeta, dtype=inc["Einc"].dtype)
+        zeta = jnp.asarray(zeta, dtype=rdt)
         for b in range(3):
             if b == corr.axis or b not in active_axes:
                 continue
-            pb = gs[b].astype(inc["Einc"].dtype) + off[b]
+            pb = gs[b].astype(rdt) + off[b]
             shape = [1, 1, 1]
             shape[b] = pb.shape[0]
             zeta = zeta + setup.khat[b] * (

@@ -96,6 +96,11 @@ def mesh_axis_map(topology: Tuple[int, int, int]) -> Dict[int, Optional[str]]:
     return {a: (AXES[a] if topology[a] > 1 else None) for a in range(3)}
 
 
+def mesh_shape_map(topology: Tuple[int, int, int]) -> Dict[str, int]:
+    """mesh axis name -> shard count, sharded axes only (shard_map shape)."""
+    return {AXES[a]: topology[a] for a in range(3) if topology[a] > 1}
+
+
 def _axis_suffix(key: str) -> Optional[str]:
     if key in ("gx", "gy", "gz"):
         return key[1]

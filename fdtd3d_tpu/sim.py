@@ -58,8 +58,7 @@ class Simulation:
         if any(p > 1 for p in topo):
             self.mesh = pmesh.build_mesh(topo, devices)
             mesh_axes = pmesh.mesh_axis_map(topo)
-            mesh_shape = {pmesh.AXES[a]: topo[a] for a in range(3)
-                          if topo[a] > 1}
+            mesh_shape = pmesh.mesh_shape_map(topo)
             self._coeff_specs = pmesh.coeff_specs(coeffs_np, topo)
             self._state_specs = pmesh.state_specs(state0, topo)
             self.coeffs = pmesh.shard_tree(coeffs_np, self._coeff_specs,
@@ -71,6 +70,8 @@ class Simulation:
             self.state = state0
 
         self._runner = make_chunk_runner(self.static, mesh_axes, mesh_shape)
+        # "pallas" when the fused kernels are engaged, else "jnp"
+        self.step_kind: str = getattr(self._runner, "kind", "jnp")
         self._compiled: Dict[int, Callable] = {}
         # Diagnostics (profiling.py): per-chunk wall clock + finite guard.
         self.clock = profiling.StepClock() if cfg.output.profile else None

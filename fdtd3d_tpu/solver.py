@@ -91,8 +91,47 @@ def slab_axes(static: StaticSetup) -> Dict[int, int]:
     return out
 
 
+# True once probed OK; the probe's Exception when the backend failed it.
+_complex_backend_ok: Any = None
+
+
+def _ensure_complex_backend():
+    """Fail fast if the active backend cannot do complex arithmetic.
+
+    Complex-field mode is fully supported on CPU; some experimental TPU
+    backends (the tunneled 'axon' platform here) create complex arrays
+    but raise UNIMPLEMENTED on the first complex op — surface that as a
+    clear config error instead of a mid-run backend crash.
+    """
+    global _complex_backend_ok
+    if _complex_backend_ok is None:
+        try:
+            # Mirror the real workload: a jitted complex scan plus a
+            # device->host transfer (some backends only fail lazily there).
+            x = jnp.ones((4, 4), jnp.complex64)
+
+            def body(c, _):
+                return c * (0.99 + 0.01j) + c.conj() * 0.001j, None
+
+            y, _ = jax.jit(
+                lambda v: jax.lax.scan(body, v, None, length=3))(x)
+            np.asarray(y)
+            _complex_backend_ok = True
+        except Exception as exc:
+            _complex_backend_ok = exc
+    if _complex_backend_ok is not True:
+        raise ValueError(
+            f"complex_fields requested but the {jax.default_backend()!r} "
+            f"backend does not implement complex arithmetic; run on CPU "
+            f"(JAX_PLATFORMS=cpu) or a TPU backend with complex support"
+        ) from (_complex_backend_ok
+                if isinstance(_complex_backend_ok, Exception) else None)
+
+
 def build_static(cfg: SimConfig) -> StaticSetup:
     cfg.validate()
+    if cfg.complex_fields:
+        _ensure_complex_backend()
     if cfg.dtype == "float64" and not jax.config.jax_enable_x64:
         # The reference computes in C++ double; honor float64 requests
         # instead of letting jax silently truncate to f32.
@@ -245,6 +284,7 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         from fdtd3d_tpu.ops import pallas3d
         fused = pallas3d.make_pallas_step(static, mesh_axes, mesh_shape)
         if fused is not None:
+            fused.kind = "pallas"
             return fused
     mode, cfg = static.mode, static.cfg
     diff_b, diff_f = make_diff_ops(mesh_axes, mesh_shape)
@@ -417,4 +457,5 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         out, _ = jax.lax.scan(body, state, None, length=n)
         return out
 
+    run_chunk.kind = getattr(step, "kind", "jnp")
     return run_chunk

@@ -34,6 +34,7 @@ def test_cavity_mode_2d_exact_evolution_f64():
 
 
 def test_cavity_mode_3d_exact_evolution_f64():
+    """z-invariant (p=0) mode: only Ez active; Hz/Ex stay exactly zero."""
     n, nz, steps = 21, 8, 200
     cfg = SimConfig(scheme="3D", size=(n, n, nz), time_steps=steps,
                     dx=1e-3, courant_factor=0.5, wavelength=10e-3,
@@ -41,14 +42,39 @@ def test_cavity_mode_3d_exact_evolution_f64():
     sim = Simulation(cfg)
     mode, omega = exact.cavity_mode_3d((n, n, nz), (2, 1, 0), cfg.dx,
                                        cfg.dt)
-    sim.set_field("Ez", mode)
+    assert set(mode) == {"Ez"}
+    sim.set_field("Ez", mode["Ez"])
     sim.run()
-    expected = exact.cavity_expectation(mode, omega, cfg.dt, steps)
+    expected = exact.cavity_expectation(mode["Ez"], omega, cfg.dt, steps)
     err = np.max(np.abs(sim.field("Ez") - expected))
     assert err < 1e-10, f"3D cavity mode drifted: {err:.2e}"
     # inactive-in-this-mode components stayed exactly zero
     assert np.abs(sim.field("Hz")).max() == 0.0
     assert np.abs(sim.field("Ex")).max() == 0.0
+
+
+def test_cavity_mode_3d_full_vector_exact_evolution_f64():
+    """All of (m, n, p) nonzero: every E component carries the mode and
+    must track the discrete-dispersion evolution to machine precision —
+    the strongest whole-solver oracle (all six components, all three
+    curl-term axes)."""
+    nx, ny, nz, steps = 17, 21, 13, 150
+    cfg = SimConfig(scheme="3D", size=(nx, ny, nz), time_steps=steps,
+                    dx=1e-3, courant_factor=0.5, wavelength=10e-3,
+                    dtype="float64")
+    sim = Simulation(cfg)
+    mode, omega = exact.cavity_mode_3d((nx, ny, nz), (2, 3, 1), cfg.dx,
+                                       cfg.dt)
+    assert set(mode) == {"Ex", "Ey", "Ez"}
+    for comp, shape in mode.items():
+        sim.set_field(comp, shape)
+    sim.run()
+    for comp, shape in mode.items():
+        expected = exact.cavity_expectation(shape, omega, cfg.dt, steps)
+        err = np.max(np.abs(sim.field(comp) - expected))
+        assert err < 1e-10, f"{comp} drifted: {err:.2e}"
+    for comp in ("Hx", "Hy", "Hz"):
+        assert np.abs(sim.field(comp)).max() > 0.0
 
 
 def test_discrete_dispersion_matches_tfsf_steady_state():

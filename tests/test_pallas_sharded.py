@@ -55,12 +55,7 @@ def test_sharded_pallas_matches_unsharded_jnp(topo, reference_fields):
     assert sim.mesh is not None, "sharded path not engaged"
     # the fused step must actually be in play for this topology (eligible
     # AND the builder did not hit a post-eligibility jnp bailout)
-    from fdtd3d_tpu.ops import pallas3d
-    from fdtd3d_tpu.parallel import mesh as pmesh
-    ma = pmesh.mesh_axis_map(topo)
-    ms = {pmesh.AXES[a]: topo[a] for a in range(3) if topo[a] > 1}
-    assert pallas3d.make_pallas_step(sim.static, ma, ms) is not None, \
-        "pallas path not engaged"
+    assert sim.step_kind == "pallas", "pallas path not engaged"
     sim.run()
     got = sim.fields()
     for comp, ref in reference_fields.items():
