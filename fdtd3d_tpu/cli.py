@@ -18,9 +18,9 @@ import time
 from typing import List, Optional
 
 from fdtd3d_tpu import diag
-from fdtd3d_tpu.config import (MaterialsConfig, OutputConfig, ParallelConfig,
-                               PmlConfig, PointSourceConfig, SimConfig,
-                               SphereConfig, TfsfConfig)
+from fdtd3d_tpu.config import (MaterialsConfig, NtffConfig, OutputConfig,
+                               ParallelConfig, PmlConfig, PointSourceConfig,
+                               SimConfig, SphereConfig, TfsfConfig)
 from fdtd3d_tpu.layout import SCHEME_MODES
 
 
@@ -101,6 +101,21 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--drude-sphere-center-y", type=float, default=0.0)
     g.add_argument("--drude-sphere-center-z", type=float, default=0.0)
     g.add_argument("--drude-sphere-radius", type=float, default=0.0)
+
+    g = p.add_argument_group("near-to-far-field (NTFF)")
+    g.add_argument("--ntff", action="store_true",
+                   help="accumulate the NTFF running DFT during the run "
+                        "and write the far-field pattern at the end")
+    g.add_argument("--ntff-frequency", type=float, default=None,
+                   help="DFT frequency, Hz (default: source frequency)")
+    g.add_argument("--ntff-every", type=int, default=None,
+                   help="sample every N steps (default ~16/period)")
+    g.add_argument("--ntff-start", type=int, default=None,
+                   help="first sampling step (default: half the run)")
+    g.add_argument("--ntff-margin", type=int, default=2,
+                   help="box margin inward from the PML inner face, cells")
+    g.add_argument("--ntff-theta-steps", type=int, default=19)
+    g.add_argument("--ntff-phi-steps", type=int, default=24)
 
     g = p.add_argument_group("parallel decomposition")
     g.add_argument("--topology", choices=["none", "auto", "manual"],
@@ -232,6 +247,11 @@ def args_to_config(args) -> SimConfig:
             checkpoint_every=args.checkpoint_every,
             norms_every=args.norms_every, log_level=args.log_level,
             profile=args.profile, check_finite=args.check_finite),
+        ntff=NtffConfig(
+            enabled=args.ntff, frequency=args.ntff_frequency,
+            every=args.ntff_every, start=args.ntff_start,
+            margin=args.ntff_margin, theta_steps=args.ntff_theta_steps,
+            phi_steps=args.ntff_phi_steps),
     )
     return cfg
 
@@ -255,6 +275,30 @@ def save_cmd_file(args, path: str):
             lines.append(f"{opt} {val}")
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
+
+
+def write_ntff_pattern(col, cfg) -> str:
+    """Write the far-field |E|^2 pattern over the angle grid to save_dir.
+
+    Format: '# theta_deg phi_deg directivity' rows (TXT, reference-dump
+    style); directivity is normalized to the pattern peak.
+    """
+    import os
+    import numpy as np
+    thetas = np.linspace(0.0, 180.0, cfg.ntff.theta_steps)
+    phis = np.arange(cfg.ntff.phi_steps) * (360.0 / cfg.ntff.phi_steps)
+    pattern = col.directivity_pattern(thetas, phis)
+    peak = pattern.max()
+    if peak > 0:
+        pattern = pattern / peak
+    os.makedirs(cfg.output.save_dir, exist_ok=True)
+    path = os.path.join(cfg.output.save_dir, "ntff_pattern.txt")
+    with open(path, "w") as f:
+        f.write("# theta_deg phi_deg directivity(normalized)\n")
+        for i, th in enumerate(thetas):
+            for j, ph in enumerate(phis):
+                f.write(f"{th:.3f} {ph:.3f} {pattern[i, j]:.9e}\n")
+    return path
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -284,17 +328,38 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"steps={cfg.time_steps} dt={cfg.dt:.3e}s "
               f"topology={sim.topology} devices={jax.device_count()}")
 
+    # NTFF: resolve cadence defaults and build the collector (reference
+    # --ntff-* surface; running DFT sampled between compute chunks).
+    ntff_col = None
+    ntff_every = ntff_start = 0
+    if cfg.ntff.enabled:
+        from fdtd3d_tpu import physics
+        from fdtd3d_tpu.ntff import NtffCollector
+        freq = cfg.ntff.frequency or physics.C0 / cfg.wavelength
+        period_steps = 1.0 / (freq * cfg.dt)
+        ntff_every = cfg.ntff.every or max(1, round(period_steps / 16.0))
+        ntff_start = (cfg.ntff.start if cfg.ntff.start is not None
+                      else cfg.time_steps // 2)
+        # align up to the sampling grid: the loop only lands on multiples
+        # of ntff_every, so an unaligned start would never sample
+        ntff_start = -(-ntff_start // ntff_every) * ntff_every
+        ntff_col = NtffCollector(sim, frequency=freq,
+                                 margin=cfg.ntff.margin)
+
     t0 = time.time()
     # gcd, not min: with cadences 10 and 3, chunking by 3 would never land
     # on a multiple of 10 and those dumps would silently be skipped.
     import math
     interval = 0
     for v in (cfg.output.save_res, cfg.output.norms_every,
-              cfg.output.checkpoint_every):
+              cfg.output.checkpoint_every, ntff_every):
         if v:
             interval = math.gcd(interval, v)
 
     def on_interval(s):
+        if ntff_col is not None and s.t >= ntff_start and \
+                s.t % ntff_every == 0:
+            ntff_col.sample()
         if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
             norms = diag.field_norms(s)
             txt = " ".join(f"{k}={v:.4e}" for k, v in sorted(norms.items()))
@@ -316,6 +381,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             on_interval=on_interval if interval else None,
             interval=interval)
     sim.block_until_ready()
+    if ntff_col is not None:
+        if ntff_col.n_samples > 0:
+            path = write_ntff_pattern(ntff_col, cfg)
+            if args.log_level >= 1:
+                print(f"ntff: {ntff_col.n_samples} samples -> {path}")
+        else:
+            print(f"ntff: WARNING: no samples collected (first sample at "
+                  f"step {ntff_start}, every {ntff_every}, run ends at "
+                  f"{cfg.time_steps}) — no pattern written")
     dt_wall = time.time() - t0
     cells = 1.0
     for a in sim.static.mode.active_axes:

@@ -130,14 +130,44 @@ class ParallelConfig:
     topology: "none" | "auto" | explicit (px,py,pz) via manual_topology.
     Auto picks the factorization of n_devices over the ACTIVE axes minimizing
     total halo surface (the reference's optimal-node-grid heuristic).
-    halo: ghost width in cells (reference ``--buffer-size``); the stencil
-    radius is 1, wider halos are accepted and validated but exchange width 1.
+
+    Deliberate non-feature: the reference's configurable ghost width
+    (``--buffer-size``: exchange k planes, then step k times without
+    communicating, recomputing the overlap) is an MPI-latency lever. On
+    the TPU torus the one-plane ``ppermute`` per axis per half-step rides
+    ICI at ~us latency and XLA overlaps it with the interior compute, so
+    redundant-compute halos would pay FLOPs + memory for a latency that
+    is not the bottleneck; the knob is omitted rather than accepted and
+    ignored.
     """
 
     topology: str = "none"
     manual_topology: Optional[Tuple[int, int, int]] = None
     n_devices: Optional[int] = None  # default: all visible devices
-    halo: int = 1
+
+
+@dataclasses.dataclass
+class NtffConfig:
+    """Near-to-far-field transform (reference --ntff-* flags, SURVEY §2).
+
+    A running DFT of the tangential fields on a closed virtual box
+    accumulates during the run (fdtd3d_tpu.ntff.NtffCollector); the
+    far-field directivity pattern is written at the end.
+
+    frequency: DFT frequency in Hz; None = the source frequency
+    (C0/wavelength). every: sampling cadence in steps; None = auto
+    (~16 samples per period). start: first sampling step; None = auto
+    (after half the run, once the CW state is established). margin:
+    box distance in cells inward from the PML inner face.
+    """
+
+    enabled: bool = False
+    frequency: Optional[float] = None
+    every: Optional[int] = None
+    start: Optional[int] = None
+    margin: int = 2
+    theta_steps: int = 19          # pattern grid: theta in [0, 180]
+    phi_steps: int = 24            # phi in [0, 360)
 
 
 @dataclasses.dataclass
@@ -184,6 +214,7 @@ class SimConfig:
     parallel: ParallelConfig = dataclasses.field(
         default_factory=ParallelConfig)
     output: OutputConfig = dataclasses.field(default_factory=OutputConfig)
+    ntff: NtffConfig = dataclasses.field(default_factory=NtffConfig)
 
     # Fused Pallas kernels for the 3D hot path (ops/pallas3d.py):
     # None = auto (use on TPU when the config is eligible), True = force
@@ -254,4 +285,12 @@ class SimConfig:
                 f"(active: {mode.e_components})")
         if self.complex_fields and self.dtype == "bfloat16":
             raise ValueError("complex_fields requires float32/float64")
+        if self.ntff.enabled:
+            if mode.name != "3D":
+                raise ValueError("NTFF requires the 3D scheme")
+            if self.ntff.theta_steps < 2 or self.ntff.phi_steps < 1:
+                raise ValueError(
+                    "NTFF needs theta_steps >= 2 and phi_steps >= 1")
+            if self.ntff.every is not None and self.ntff.every < 1:
+                raise ValueError("ntff.every must be >= 1")
         return self

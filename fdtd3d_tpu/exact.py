@@ -95,7 +95,8 @@ def cavity_mode(size: Tuple[int, int, int], mnp: Tuple[int, int, int],
     bigk = np.array([2.0 * math.sin(k[a] / 2.0) / dx for a in range(3)])
     if avec is not None:
         amp = np.asarray(avec, dtype=np.float64)
-        if abs(float(bigk @ amp)) > 1e-9 * np.linalg.norm(bigk):
+        if abs(float(bigk @ amp)) > 1e-9 * (
+                np.linalg.norm(bigk) * np.linalg.norm(amp) + 1e-300):
             raise ValueError("avec is not discrete-divergence-free")
     else:
         amp = np.cross(bigk, np.asarray(cvec, dtype=np.float64))

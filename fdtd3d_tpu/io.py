@@ -186,6 +186,44 @@ def load_bmp_size(path: str) -> Tuple[int, int]:
     return struct.unpack_from("<ii", head, 18)
 
 
+def load_bmp(path: str) -> np.ndarray:
+    """Decode a 24-bit uncompressed BMP -> uint8 (H, W, 3) RGB.
+
+    The loader half of the reference's BMPLoader (SURVEY.md §2 File I/O
+    row). Handles the standard bottom-up row order (positive height) and
+    top-down (negative height) variants; anything else (palettized, RLE)
+    is out of scope — the reference vendors EasyBMP for those, we only
+    need the interchange subset our own dumper and common tools write.
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:2] != b"BM":
+        raise ValueError(f"{path}: not a BMP file")
+    (offset,) = struct.unpack_from("<I", data, 10)
+    w, h = struct.unpack_from("<ii", data, 18)
+    (bpp,) = struct.unpack_from("<H", data, 28)
+    (compression,) = struct.unpack_from("<I", data, 30)
+    if bpp != 24 or compression != 0:
+        raise ValueError(
+            f"{path}: only 24-bit uncompressed BMP supported "
+            f"(got {bpp}bpp, compression {compression})")
+    top_down = h < 0
+    h = abs(h)
+    row = w * 3
+    stride = row + (4 - row % 4) % 4
+    out = np.empty((h, w, 3), dtype=np.uint8)
+    for y in range(h):
+        src = offset + y * stride
+        line = np.frombuffer(data, np.uint8, row, src).reshape(w, 3)
+        out[y if top_down else h - 1 - y] = line[:, ::-1]  # BGR -> RGB
+    return out
+
+
+def load_bmp_gray(path: str) -> np.ndarray:
+    """BMP -> float64 (H, W) luminance in [0, 1] (material-init input)."""
+    return load_bmp(path).mean(axis=2) / 255.0
+
+
 # ---------------------------------------------------------------------------
 # checkpoints (full solver state pytree)
 # ---------------------------------------------------------------------------
@@ -246,16 +284,43 @@ def write_outputs(sim, step: int):
 
 
 def write_materials(sim):
-    """One-time material dump (reference --save-materials)."""
+    """One-time dump of EVERY material grid (reference --save-materials).
+
+    eps at each E component's staggered positions, mu at each H
+    component's, uniform sigma_e/sigma_m, and the Drude omega_p/gamma
+    grids when dispersion is on — in every configured dump format.
+    """
     from fdtd3d_tpu import materials as mats
     out = sim.cfg.output
     os.makedirs(out.save_dir, exist_ok=True)
     mode = sim.static.mode
     mat = sim.cfg.materials
+    shape = sim.static.grid_shape
+
+    grids: Dict[str, np.ndarray] = {}
     for comp in mode.e_components:
-        eps = mats.scalar_or_grid(comp, sim.static.grid_shape,
-                                  mode.active_axes, mat.eps,
-                                  mat.eps_sphere, mat.eps_file)
-        arr = np.broadcast_to(np.asarray(eps, dtype=np.float64),
-                              sim.static.grid_shape)
-        dump_dat(arr, os.path.join(out.save_dir, f"eps_{comp}.dat"))
+        grids[f"eps_{comp}"] = mats.scalar_or_grid(
+            comp, shape, mode.active_axes, mat.eps, mat.eps_sphere,
+            mat.eps_file)
+        if mat.use_drude:
+            wp, gamma, _ = mats.drude_params(comp, shape,
+                                             mode.active_axes, mat)
+            grids[f"omega_p_{comp}"] = wp
+            grids[f"gamma_{comp}"] = gamma
+    for comp in mode.h_components:
+        grids[f"mu_{comp}"] = mats.scalar_or_grid(
+            comp, shape, mode.active_axes, mat.mu, mat.mu_sphere,
+            mat.mu_file)
+    grids["sigma_e"] = mat.sigma_e
+    grids["sigma_m"] = mat.sigma_m
+
+    axes = mode.active_axes
+    for name, val in grids.items():
+        arr = np.broadcast_to(np.asarray(val, dtype=np.float64), shape)
+        base = os.path.join(out.save_dir, name)
+        if "dat" in out.formats:
+            dump_dat(arr, base + ".dat")
+        if "txt" in out.formats:
+            dump_txt(arr, base + ".txt")
+        if "bmp" in out.formats:
+            dump_bmp(arr, base + ".bmp", axes)

@@ -44,7 +44,37 @@ def _sphere_mask(comp, shape, active_axes, sphere):
     return d2 <= sphere.radius ** 2
 
 
-def _load_file(path: str, shape) -> np.ndarray:
+def _load_bmp_grid(path: str, shape, active_axes, base: float) -> np.ndarray:
+    """Material grid from a BMP image (reference BMPLoader init path).
+
+    Luminance maps linearly: black -> 1.0 (vacuum), white -> ``base``
+    (the configured background value). The image spans the first two
+    active axes — columns = first axis, rows = second (the same layout
+    dump_bmp writes) — and is broadcast along the third.
+    """
+    from fdtd3d_tpu import io
+    axes = [a for a in range(3) if a in active_axes]
+    if len(axes) < 2:
+        raise ValueError(
+            "BMP material init needs a scheme with >= 2 active axes")
+    a, b = axes[0], axes[1]
+    lum = io.load_bmp_gray(path)
+    if lum.shape != (shape[b], shape[a]):
+        raise ValueError(
+            f"{path}: image is {lum.shape[1]}x{lum.shape[0]} (WxH) but the "
+            f"grid needs {shape[a]}x{shape[b]}")
+    vals = 1.0 + (float(base) - 1.0) * lum.T      # (na, nb)
+    shp = [1, 1, 1]
+    shp[a], shp[b] = shape[a], shape[b]
+    grid = np.empty(shape, dtype=np.float64)
+    grid[:] = vals.reshape(shp)                   # broadcast along 3rd axis
+    return grid
+
+
+def _load_file(path: str, shape, active_axes=(0, 1, 2),
+               base: float = 1.0) -> np.ndarray:
+    if path.endswith(".bmp"):
+        return _load_bmp_grid(path, shape, active_axes, base)
     arr = np.load(path) if path.endswith(".npy") else np.fromfile(
         path, dtype=np.float64).reshape(shape)
     return np.broadcast_to(arr, shape).astype(np.float64)
@@ -54,7 +84,7 @@ def scalar_or_grid(comp: str, shape, active_axes, base: float,
                    sphere, file_path: Optional[str]) -> Material:
     """Evaluate one material channel at ``comp``'s staggered positions."""
     if file_path:
-        return _load_file(file_path, shape)
+        return _load_file(file_path, shape, active_axes, base)
     if sphere is not None and sphere.enabled and sphere.radius > 0:
         grid = np.full(shape, base, dtype=np.float64)
         grid[_sphere_mask(comp, shape, active_axes, sphere)] = sphere.value

@@ -147,3 +147,37 @@ def test_ntff_dipole_pattern():
     r10 = p10 / p90.mean()
     assert 0.35 < r45 < 0.75, f"D(45)/D(90) = {r45:.3f}"
     assert r10 < 0.15, f"D(10)/D(90) = {r10:.3f}"
+
+
+def test_ntff_cli_black_box(tmp_path):
+    """--ntff end-to-end from the CLI: pattern file written, sin^2(theta)
+    shape (theta=0/180 nulls, equatorial peak, phi symmetry)."""
+    import contextlib
+    import io as _io
+
+    from fdtd3d_tpu import cli
+
+    n = 40
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main([
+            "--3d", "--same-size", str(n), "--time-steps", "260",
+            "--courant-factor", "0.5", "--wavelength", "12e-3",
+            "--use-pml", "--pml-size", "7",
+            "--point-source", "Ez",
+            "--ntff", "--ntff-margin", "3",
+            "--ntff-theta-steps", "7", "--ntff-phi-steps", "8",
+            "--save-dir", str(tmp_path)])
+    assert rc == 0, buf.getvalue()
+    path = tmp_path / "ntff_pattern.txt"
+    assert path.exists(), buf.getvalue()
+    rows = np.loadtxt(path)
+    thetas = np.unique(rows[:, 0])
+    pattern = {th: rows[rows[:, 0] == th][:, 2] for th in thetas}
+    eq = pattern[90.0]
+    assert eq.mean() > 0.5, "equatorial lobe missing"
+    assert eq.max() / eq.min() < 1.3, "phi asymmetry"
+    assert pattern[0.0].max() < 0.15, "theta=0 null missing"
+    assert pattern[180.0].max() < 0.15, "theta=180 null missing"
+    assert pattern[30.0].mean() < pattern[60.0].mean() < eq.mean(), \
+        "pattern not monotone toward the equator"
