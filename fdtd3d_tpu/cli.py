@@ -12,6 +12,7 @@ replacement for the reference's compile-time CMake matrix + runtime
 from __future__ import annotations
 
 import argparse
+import json
 import shlex
 import sys
 import time
@@ -135,6 +136,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--load-checkpoint", metavar="PATH", default=None)
     g.add_argument("--norms-every", type=int, default=0,
                    help="print field norms every N steps")
+    g.add_argument("--metrics-every", type=int, default=0,
+                   help="append a structured metrics record (energy, "
+                        "norms, divergence residual) to "
+                        "save_dir/metrics.jsonl every N steps")
     g.add_argument("--log-level", type=int, default=1)
     g.add_argument("--profile", action="store_true",
                    help="time every compute chunk (StepClock) and print a "
@@ -245,7 +250,8 @@ def args_to_config(args) -> SimConfig:
             formats=tuple(args.save_formats.split(",")),
             save_materials=args.save_materials,
             checkpoint_every=args.checkpoint_every,
-            norms_every=args.norms_every, log_level=args.log_level,
+            norms_every=args.norms_every, metrics_every=args.metrics_every,
+            log_level=args.log_level,
             profile=args.profile, check_finite=args.check_finite),
         ntff=NtffConfig(
             enabled=args.ntff, frequency=args.ntff_frequency,
@@ -352,7 +358,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     import math
     interval = 0
     for v in (cfg.output.save_res, cfg.output.norms_every,
-              cfg.output.checkpoint_every, ntff_every):
+              cfg.output.checkpoint_every, cfg.output.metrics_every,
+              ntff_every):
         if v:
             interval = math.gcd(interval, v)
 
@@ -364,6 +371,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             norms = diag.field_norms(s)
             txt = " ".join(f"{k}={v:.4e}" for k, v in sorted(norms.items()))
             print(f"[t={s.t}] {txt}")
+        if cfg.output.metrics_every and \
+                s.t % cfg.output.metrics_every == 0:
+            import os
+            os.makedirs(cfg.output.save_dir, exist_ok=True)
+            rec = diag.metrics(s)
+            with open(os.path.join(cfg.output.save_dir,
+                                   "metrics.jsonl"), "a") as f:
+                f.write(json.dumps(rec) + "\n")
         if cfg.output.save_res and s.t % cfg.output.save_res == 0:
             io.write_outputs(s, s.t)
         if cfg.output.checkpoint_every and \

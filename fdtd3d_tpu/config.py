@@ -180,6 +180,10 @@ class OutputConfig:
     save_materials: bool = False
     checkpoint_every: int = 0      # orbax/npz full-state checkpoint cadence
     norms_every: int = 0           # print L2/Linf norms every N steps
+    # structured per-interval metrics (energy, norms, divergence
+    # residual — diag.metrics) appended to save_dir/metrics.jsonl
+    # (SURVEY §5.5 observability)
+    metrics_every: int = 0
     log_level: int = 1
     # Attach a profiling.StepClock to the Simulation: every advance()
     # chunk is timed (with a device sync, so honest but intrusive) and

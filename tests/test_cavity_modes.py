@@ -11,7 +11,7 @@ give every mode (SURVEY.md §4).
 import numpy as np
 import pytest
 
-from fdtd3d_tpu import exact
+from fdtd3d_tpu import diag, exact
 from fdtd3d_tpu.config import SimConfig
 from fdtd3d_tpu.layout import SCHEME_MODES, component_axis
 from fdtd3d_tpu.sim import Simulation
@@ -57,8 +57,9 @@ def test_cavity_mode_exact_evolution(name):
     sim.run()
     for comp, shape in shapes.items():
         expected = exact.cavity_expectation(shape, omega, cfg.dt, STEPS)
-        err = np.max(np.abs(sim.field(comp) - expected))
+        norms = diag.error_norms(sim.field(comp), expected)
         scale = np.max(np.abs(expected))
-        assert err < 1e-10 * max(scale, 1.0), f"{name}/{comp}: {err:.2e}"
+        assert norms["linf"] < 1e-10 * max(scale, 1.0), \
+            f"{name}/{comp}: {norms['linf']:.2e} (rel_l2 {norms['rel_l2']:.2e})"
     # H fields must actually be in motion (the mode is not static)
     assert max(np.abs(sim.field(c)).max() for c in mode.h_components) > 0.0

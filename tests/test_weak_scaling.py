@@ -1,0 +1,21 @@
+"""Weak-scaling harness smoke on the 8-device virtual CPU mesh."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from weak_scaling import run_point  # noqa: E402
+
+
+def test_weak_scaling_points_run():
+    r1 = run_point(1, tile=16, steps=4)
+    r8 = run_point(8, tile=16, steps=4)
+    assert r1["n_devices"] == 1 and r8["n_devices"] == 8
+    assert r8["global_size"] != r1["global_size"], "workload must grow"
+    assert r8["mcells_per_s"] > 0 and r1["mcells_per_s"] > 0
+    # per-device local volume is constant (weak scaling)
+    import numpy as np
+    v1 = np.prod(r1["global_size"]) / r1["n_devices"]
+    v8 = np.prod(r8["global_size"]) / r8["n_devices"]
+    assert v1 == v8

@@ -1,0 +1,89 @@
+"""Weak-scaling harness: constant per-device workload, growing mesh.
+
+The BASELINE.md headline metric is weak-scaling efficiency 8 -> 256 chips
+(1024^3-per-scaling-unit 3D Yee + CPML). This harness runs the same
+per-device tile on 1, 2, 4, ... n_devices meshes (topology chosen by the
+same min-halo-surface heuristic production uses) and reports Mcells/s and
+efficiency vs the single-device run:
+
+    python tools/weak_scaling.py --tile 256 --steps 10
+    python tools/weak_scaling.py --tile 16 --steps 4 --max-devices 8  # CPU smoke
+
+On a real pod, run it as-is (devices = all visible chips). In this repo's
+environment only one tunneled chip exists, so the multi-device rows are
+exercised on the virtual CPU mesh (JAX_PLATFORMS=cpu +
+--xla_force_host_platform_device_count) — a correctness/overhead smoke,
+not a bandwidth measurement. Emits one JSON line per mesh size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run_point(n_devices: int, tile: int, steps: int, use_pallas=None):
+    """One weak-scaling point: per-device tile^3, n_devices-device mesh."""
+    import jax
+    import numpy as np
+
+    from fdtd3d_tpu.config import ParallelConfig, PmlConfig, SimConfig
+    from fdtd3d_tpu.parallel.mesh import choose_topology
+    from fdtd3d_tpu.sim import Simulation
+
+    # grow the global grid so every device holds ~tile^3 cells
+    probe = choose_topology(n_devices, (tile * n_devices,) * 3, (0, 1, 2))
+    size = tuple(tile * p for p in probe)
+    cfg = SimConfig(
+        scheme="3D", size=size, time_steps=steps, dx=1e-3,
+        courant_factor=0.5, wavelength=32e-3, use_pallas=use_pallas,
+        pml=PmlConfig(size=(min(10, tile // 4),) * 3),
+        parallel=ParallelConfig(topology="auto", n_devices=n_devices),
+    )
+    sim = Simulation(cfg, devices=jax.devices()[:n_devices])
+    sim.advance(steps)           # compile + warm up
+    sim.block_until_ready()
+    t0 = time.perf_counter()
+    sim.advance(steps)
+    sim.block_until_ready()
+    dt = time.perf_counter() - t0
+    for comp, v in sim.fields().items():
+        assert np.isfinite(v).all(), f"{comp} not finite"
+    cells = float(np.prod(size))
+    return {
+        "n_devices": n_devices,
+        "topology": list(sim.topology),
+        "global_size": list(size),
+        "step_kind": sim.step_kind,
+        "mcells_per_s": cells * steps / dt / 1e6,
+        "mcells_per_s_per_device": cells * steps / dt / 1e6 / n_devices,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tile", type=int, default=256,
+                    help="per-device cells per axis")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--max-devices", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+    n_avail = args.max_devices or jax.device_count()
+    sizes = []
+    n = 1
+    while n <= n_avail:
+        sizes.append(n)
+        n *= 2
+    base = None
+    for n_devices in sizes:
+        rec = run_point(n_devices, args.tile, args.steps)
+        if base is None:
+            base = rec["mcells_per_s_per_device"]
+        rec["efficiency_vs_1"] = rec["mcells_per_s_per_device"] / base
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
