@@ -234,7 +234,14 @@ def _flatten(prefix: str, tree, out: Dict[str, np.ndarray]):
         for k, v in tree.items():
             _flatten(f"{prefix}/{k}" if prefix else k, v, out)
     else:
-        out[prefix] = np.asarray(tree)
+        arr = np.asarray(tree)
+        if arr.dtype.kind == "V":
+            # non-native dtypes (bfloat16) hit .npz as raw void bytes and
+            # cannot be cast back on load; store widened to f32 instead —
+            # bf16 -> f32 is exact, and restore()'s .astype(old.dtype)
+            # returns the identical bf16 bits.
+            arr = arr.astype(np.float32)
+        out[prefix] = arr
 
 
 def save_checkpoint(state, path: str, extra: Optional[Dict] = None):

@@ -85,7 +85,7 @@ def eligible(static, mesh_axes=None) -> bool:
     """
     if static.mode.name != "3D":
         return False
-    if static.field_dtype != np.float32:
+    if static.field_dtype not in (np.float32, jnp.bfloat16):
         return False
     if static.topology[0] != 1:
         return False
@@ -225,17 +225,21 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
             s[a] = 2 * slabs[a]
         return tuple(s)
 
+    # field storage may be bf16 (2 B); psi/J/coeffs/profiles stay f32
+    fbytes = np.dtype(static.field_dtype).itemsize
+
     def _block_bytes(t: int) -> int:
         """Summed operand-block bytes at x-tile size t (see _pick_tile)."""
-        plane = n2 * n3 * 4
-        n_full = len(upd) + len(src_names) + len(upd)  # in + src + out
-        n_full += len(array_coeff_names)
+        plane = n2 * n3
+        n_field = len(upd) + len(src_names) + len(upd)  # in + src + out
+        total = n_field * t * plane * fbytes
+        total += len(array_coeff_names) * t * plane * 4
         if drude:
-            n_full += 2 * len(upd)  # J in + J out
-        total = n_full * t * plane + len(halo_names) * plane
+            total += 2 * len(upd) * t * plane * 4       # J in + J out
+        total += len(halo_names) * plane * fbytes
         for (_, a) in ghost_pairs:
             gs = _ghost_shape(a)
-            total += t * gs[1] * gs[2] * 4
+            total += t * gs[1] * gs[2] * fbytes
         for nm in psi_names:  # psi in + psi out
             s = _psi_shape(nm)
             total += 2 * t * s[1] * s[2] * 4
@@ -248,7 +252,8 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
     T = tile if tile is not None else _pick_tile(n1, _block_bytes)
     ntiles = n1 // T
 
-    fdt = jnp.float32
+    fdt = jnp.float32                 # in-kernel compute dtype
+    fst = static.field_dtype          # field STORAGE dtype (f32 or bf16)
 
     # ---- the kernel ----------------------------------------------------
     def kernel(*refs):
@@ -283,12 +288,14 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
 
         i = pl.program_id(0)
 
-        src_vals = {name: idx[f"src_{name}"][:] for name in src_names}
+        # loads cast to the f32 compute dtype (no-op for f32 storage)
+        src_vals = {name: idx[f"src_{name}"][:].astype(fdt)
+                    for name in src_names}
 
         def diff(name: str, axis: int) -> jnp.ndarray:
             f = src_vals[name]
             if axis == 0:
-                h = idx[f"halo_{name}"][:]
+                h = idx[f"halo_{name}"][:].astype(fdt)
                 if backward:
                     ghost = jnp.where(i > 0, h, jnp.zeros_like(h))
                     sh = jnp.concatenate([ghost, f[:-1]], axis=0)
@@ -298,7 +305,7 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
                 return (sh - f) * inv_dx
             if axis in sharded_axes:
                 # neighbor plane (zeros at the global mesh edge = PEC ghost)
-                gh = idx[f"gh_{name}_{axis}"][:]
+                gh = idx[f"gh_{name}_{axis}"][:].astype(fdt)
                 if backward:
                     body = lax.slice_in_dim(f, 0, f.shape[axis] - 1,
                                             axis=axis)
@@ -360,7 +367,7 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
                     term = s * dfa
                 acc = term if acc is None else acc + term
 
-            old = idx[f"in_{c}"][:]
+            old = idx[f"in_{c}"][:].astype(fdt)
             if family == "E":
                 if drude:
                     j_new = (coef(f"kj_{c}") * idx[f"jin_{c}"][:]
@@ -373,7 +380,7 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
                         new = new * idx[f"wl_{AXES[a]}"][:]
             else:
                 new = coef(f"da_{c}") * old - coef(f"db_{c}") * acc
-            idx[f"out_{c}"][:] = new.astype(fdt)
+            idx[f"out_{c}"][:] = new.astype(fst)
 
     # ---- specs ---------------------------------------------------------
     def field_spec():
@@ -427,7 +434,7 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
         out_specs += [field_spec() for _ in upd]
     out_specs += [psi_spec(nm) for nm in psi_names]
 
-    out_shape = [jax.ShapeDtypeStruct((n1, n2, n3), np.float32)
+    out_shape = [jax.ShapeDtypeStruct((n1, n2, n3), static.field_dtype)
                  for _ in upd]
     if drude:
         out_shape += [jax.ShapeDtypeStruct((n1, n2, n3), np.float32)
@@ -558,7 +565,7 @@ def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
             d = ("H" if family == "E" else "E") + AXES[d_axis]
             if d not in src:
                 continue
-            f = src[d]
+            f = src[d].astype(static.compute_dtype)
             if family == "E":  # backward diff, planes [0,m) and [n1-m,n1)
                 d_lo = (f[:m] - jnp.pad(f[:m - 1], ((1, 0), (0, 0), (0, 0)))
                         ) * inv_dx
@@ -594,8 +601,9 @@ def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
                         dl = dl * w.reshape(shape)
                         dh = dh * w.reshape(shape)
             arr = new_fields[c]
-            arr = arr.at[:m].add(sign * cb_lo * dl)
-            arr = arr.at[n1 - m:].add(sign * cb_hi * dh)
+            arr = arr.at[:m].add((sign * cb_lo * dl).astype(arr.dtype))
+            arr = arr.at[n1 - m:].add(
+                (sign * cb_hi * dh).astype(arr.dtype))
             new_fields[c] = arr
     return new_fields, new_psi
 

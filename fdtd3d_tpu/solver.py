@@ -60,6 +60,19 @@ class StaticSetup:
     use_drude: bool
     field_dtype: Any
     real_dtype: Any
+
+    @property
+    def aux_dtype(self):
+        """dtype of the recursion state (psi, J, inc): f32 when fields
+        are bf16 storage, else the field dtype."""
+        return np.float32 if self.field_dtype == jnp.bfloat16 \
+            else self.field_dtype
+
+    @property
+    def compute_dtype(self):
+        """dtype the update arithmetic runs in."""
+        return np.float32 if self.field_dtype == jnp.bfloat16 \
+            else self.field_dtype
     # Decomposition topology (px, py, pz). Simulation rewrites this after
     # resolving the mesh; it controls the psi slab layout below.
     topology: Tuple[int, int, int] = (1, 1, 1)
@@ -137,8 +150,13 @@ def build_static(cfg: SimConfig) -> StaticSetup:
         # instead of letting jax silently truncate to f32.
         jax.config.update("jax_enable_x64", True)
     mode = cfg.mode
+    # bfloat16 is a STORAGE dtype only (fields in HBM): coefficients,
+    # CPML psi, Drude J, the incident line, and all arithmetic stay f32
+    # (mixed precision) — bf16 accumulation of the leapfrog recursions
+    # loses the wave within tens of steps, while bf16 storage alone
+    # halves the HBM traffic that bounds FDTD throughput.
     real = {"float32": np.float32, "float64": np.float64,
-            "bfloat16": jnp.bfloat16}[cfg.dtype]
+            "bfloat16": np.float32}[cfg.dtype]
     field = cfg.np_dtype()
     pml_axes = tuple(a for a in mode.active_axes if cfg.pml.size[a] > 0)
     st = StaticSetup(
@@ -212,6 +230,7 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
 
 def init_state(static: StaticSetup) -> Dict[str, Any]:
     shape, fd = static.grid_shape, static.field_dtype
+    aux = static.aux_dtype
     mode = static.mode
     slabs = slab_axes(static)
     zeros = lambda: jnp.zeros(shape, dtype=fd)  # noqa: E731
@@ -221,7 +240,7 @@ def init_state(static: StaticSetup) -> Dict[str, Any]:
         s = list(shape)
         if a in slabs:
             s[a] = 2 * slabs[a] * static.topology[a]
-        return jnp.zeros(tuple(s), dtype=fd)
+        return jnp.zeros(tuple(s), dtype=aux)
 
     state: Dict[str, Any] = {
         "E": {c: zeros() for c in mode.e_components},
@@ -241,11 +260,12 @@ def init_state(static: StaticSetup) -> Dict[str, Any]:
         state["psi_E"] = psi_e
         state["psi_H"] = psi_h
     if static.use_drude:
-        state["J"] = {c: zeros() for c in mode.e_components}
+        state["J"] = {c: jnp.zeros(shape, dtype=aux)
+                      for c in mode.e_components}
     if static.tfsf_setup is not None:
         n = static.tfsf_setup.n_inc
-        state["inc"] = {"Einc": jnp.zeros(n, dtype=fd),
-                        "Hinc": jnp.zeros(n, dtype=fd)}
+        state["inc"] = {"Einc": jnp.zeros(n, dtype=aux),
+                        "Hinc": jnp.zeros(n, dtype=aux)}
     return state
 
 
@@ -338,6 +358,11 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         """One family update (field='E' or 'H'). Returns new component dict."""
         upd_comps = mode.e_components if field == "E" else mode.h_components
         src = state["H"] if field == "E" else state["E"]
+        if static.field_dtype != static.compute_dtype:
+            # bf16 storage: difference/psi arithmetic runs in f32 (the
+            # convert fuses into the consumers, no extra HBM pass)
+            src = {k: v.astype(static.compute_dtype)
+                   for k, v in src.items()}
         tag = "e" if field == "E" else "h"
         diff = diff_b if field == "E" else diff_f
         psi_key = "psi_E" if field == "E" else "psi_H"
@@ -374,7 +399,8 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                 acc = s * term if acc is None else acc + s * term
             if acc is None:
                 # zeros in the LOCAL shape (shard_map-safe), not grid_shape.
-                acc = jnp.zeros(state[field][c].shape, static.field_dtype)
+                acc = jnp.zeros(state[field][c].shape,
+                                static.compute_dtype)
             if setup is not None:
                 corr = tfsf.corrections_for(field, c, setup, coeffs,
                                             state["inc"], mode.active_axes,

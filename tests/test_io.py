@@ -173,3 +173,26 @@ def test_save_materials_dumps_every_grid(tmp_path):
             assert (tmp_path / (name + ext)).exists(), name + ext
     wp = io.load_dat(str(tmp_path / "omega_p_Ez.dat"))
     assert wp.max() == 1e11 and wp.min() == 0.0
+
+
+def test_bfloat16_checkpoint_resume(tmp_path):
+    """bf16 runs must checkpoint/resume bit-exactly (fields are stored
+    widened to f32 in the .npz; bf16 -> f32 -> bf16 is the identity)."""
+    cfg = SimConfig(scheme="3D", size=(12, 12, 12), time_steps=20,
+                    dtype="bfloat16", pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(enabled=True,
+                                                   component="Ez",
+                                                   position=(6, 6, 6)))
+    sim = Simulation(cfg)
+    sim.run(10)
+    path = str(tmp_path / "ck.npz")
+    sim.checkpoint(path)
+    sim.run(10)
+    resumed = Simulation(cfg)
+    resumed.restore(path)
+    assert resumed.state["E"]["Ez"].dtype == __import__("jax").numpy.bfloat16
+    resumed.run(10)
+    for comp, a in sim.fields().items():
+        b = resumed.fields()[comp]
+        assert np.array_equal(np.asarray(a, np.float32),
+                              np.asarray(b, np.float32)), comp
