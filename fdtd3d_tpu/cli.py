@@ -124,6 +124,12 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--manual-topology", metavar="PXxPYxPZ", default=None,
                    help="e.g. 2x2x2 (reference --manual-topology)")
     g.add_argument("--num-devices", type=int, default=None)
+    # multi-process runtime (the reference's mpirun surface): one process
+    # per host; the device mesh then spans every process's chips.
+    g.add_argument("--coordinator-address", default=None,
+                   metavar="HOST:PORT")
+    g.add_argument("--num-processes", type=int, default=None)
+    g.add_argument("--process-id", type=int, default=None)
 
     g = p.add_argument_group("output")
     g.add_argument("--save-res", type=int, default=0,
@@ -318,6 +324,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.save_cmd_to_file:
         save_cmd_file(args, args.save_cmd_to_file)
 
+    if args.coordinator_address or args.num_processes or \
+            args.process_id is not None:
+        # must happen before any backend-initializing jax call
+        from fdtd3d_tpu.parallel import distributed
+        distributed.initialize(coordinator=args.coordinator_address,
+                               num_processes=args.num_processes,
+                               process_id=args.process_id)
+
     cfg = args_to_config(args)
     from fdtd3d_tpu import io
     from fdtd3d_tpu.sim import Simulation  # deferred: jax init is slow
@@ -339,6 +353,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ntff_col = None
     ntff_every = ntff_start = 0
     if cfg.ntff.enabled:
+        import jax
+        if jax.process_count() > 1:
+            raise SystemExit(
+                "--ntff is single-process only: face sampling slices "
+                "host-addressable arrays; run NTFF post-processing on a "
+                "single process")
         from fdtd3d_tpu import physics
         from fdtd3d_tpu.ntff import NtffCollector
         freq = cfg.ntff.frequency or physics.C0 / cfg.wavelength
@@ -368,17 +388,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 s.t % ntff_every == 0:
             ntff_col.sample()
         if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
-            norms = diag.field_norms(s)
-            txt = " ".join(f"{k}={v:.4e}" for k, v in sorted(norms.items()))
-            print(f"[t={s.t}] {txt}")
+            import jax
+            norms = diag.field_norms(s)   # collective: ALL ranks
+            if jax.process_index() == 0:
+                txt = " ".join(f"{k}={v:.4e}"
+                               for k, v in sorted(norms.items()))
+                print(f"[t={s.t}] {txt}")
         if cfg.output.metrics_every and \
                 s.t % cfg.output.metrics_every == 0:
-            import os
-            os.makedirs(cfg.output.save_dir, exist_ok=True)
-            rec = diag.metrics(s)
-            with open(os.path.join(cfg.output.save_dir,
-                                   "metrics.jsonl"), "a") as f:
-                f.write(json.dumps(rec) + "\n")
+            import jax
+            rec = diag.metrics(s)   # collective gathers: ALL ranks
+            if jax.process_index() == 0:
+                import os
+                os.makedirs(cfg.output.save_dir, exist_ok=True)
+                with open(os.path.join(cfg.output.save_dir,
+                                       "metrics.jsonl"), "a") as f:
+                    f.write(json.dumps(rec) + "\n")
         if cfg.output.save_res and s.t % cfg.output.save_res == 0:
             io.write_outputs(s, s.t)
         if cfg.output.checkpoint_every and \

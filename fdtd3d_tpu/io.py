@@ -276,11 +276,19 @@ def load_checkpoint(path: str) -> Tuple[Dict, Dict]:
 
 
 def write_outputs(sim, step: int):
-    """Dump every stored field component in each configured format."""
+    """Dump every stored field component in each configured format.
+
+    Multi-process: the gather below is COLLECTIVE (all ranks must call
+    it), the file writes happen on rank 0 only.
+    """
+    import jax
     out = sim.cfg.output
+    fields = sim.fields()            # collective allgather
+    if jax.process_index() != 0:
+        return
     os.makedirs(out.save_dir, exist_ok=True)
     axes = sim.static.mode.active_axes
-    for comp, arr in sim.fields().items():
+    for comp, arr in fields.items():
         base = os.path.join(out.save_dir, f"{comp}_t{step:06d}")
         if "dat" in out.formats:
             dump_dat(arr, base + ".dat", step=step)
@@ -297,6 +305,9 @@ def write_materials(sim):
     component's, uniform sigma_e/sigma_m, and the Drude omega_p/gamma
     grids when dispersion is on — in every configured dump format.
     """
+    import jax
+    if jax.process_index() != 0:     # host-side only: rank 0 writes
+        return
     from fdtd3d_tpu import materials as mats
     out = sim.cfg.output
     os.makedirs(out.save_dir, exist_ok=True)

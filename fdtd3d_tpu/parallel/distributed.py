@@ -1,0 +1,75 @@
+"""Multi-process (multi-host) runtime: the reference's MPI-launch analog.
+
+Reference parity: fdtd3d runs as `mpirun -n N ./fdtd3d ...` — one process
+per rank, ranks meeting over MPI (SURVEY.md §2.9, §5.8). The TPU-native
+equivalent is one process per host, meeting through JAX's distributed
+runtime: collectives ride ICI inside a slice and DCN across slices, with
+the SAME solver code — the device mesh simply spans all processes'
+devices.
+
+Usage (per process):
+
+    from fdtd3d_tpu.parallel import distributed
+    distributed.initialize(coordinator="host0:9955",
+                           num_processes=4, process_id=rank)
+    sim = Simulation(cfg)          # mesh spans the global device set
+    sim.run()
+
+or from the CLI: --coordinator-address host0:9955 --num-processes 4
+--process-id $RANK (each falling back to the standard JAX env vars /
+TPU pod auto-detection when omitted).
+
+Tested end-to-end with real multi-process runs on the CPU backend
+(tests/test_distributed.py), the same oversubscribed-single-host pattern
+the reference uses for its MPI unit tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the distributed runtime (no-op when already initialized).
+
+    With all arguments None on TPU pods, JAX auto-detects the topology
+    from the TPU environment. Must run BEFORE any other jax call that
+    initializes the backend.
+    """
+    if is_initialized():
+        return
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def is_initialized() -> bool:
+    # NB: must not touch jax.process_count()/jax.devices() here — those
+    # initialize the XLA backend, after which joining is impossible.
+    try:
+        return jax._src.distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def gather_to_host(arr) -> "np.ndarray":
+    """Global numpy value of a (possibly multi-host sharded) jax array.
+
+    Single-process: a plain device_get. Multi-process: an allgather of
+    the addressable shards over the distributed runtime, so EVERY process
+    returns the full global array (the reference's gather-for-dump).
+    """
+    import numpy as np
+    if jax.process_count() <= 1:
+        return np.asarray(jax.device_get(arr))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

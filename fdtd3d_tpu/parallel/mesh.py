@@ -142,6 +142,41 @@ def state_specs(state: Dict, topology) -> Dict:
 
 
 def shard_tree(tree, specs, mesh: Mesh):
-    """device_put every leaf with its NamedSharding."""
-    return jax.tree.map(
-        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)), tree, specs)
+    """Shard a host pytree: each device receives ONLY its own slice.
+
+    Built on make_array_from_callback rather than a whole-array
+    device_put, so (a) no full-size staging allocation happens on any
+    single device, and (b) the same call works when ``mesh`` spans
+    multiple processes — each process materializes just its addressable
+    shards (the reference's per-rank grid fill, SURVEY.md §3.1 initGrids
+    under MPI).
+    """
+    return jax.tree.map(lambda v, s: shard_leaf(v, s, mesh), tree, specs)
+
+
+def shard_leaf(v, spec: P, mesh: Mesh):
+    """One host array -> sharded jax array (each device gets its slice)."""
+    v = np.asarray(v)
+    return jax.make_array_from_callback(
+        v.shape, NamedSharding(mesh, spec), lambda idx: v[idx])
+
+
+def sharded_zeros(shape_tree, specs, mesh: Mesh):
+    """Zeros pytree created ALREADY sharded (from eval_shape structs).
+
+    Allocating zeros unsharded and resharding would momentarily need the
+    full array on one device — at 1024^3 that alone overflows a chip.
+    """
+    def mk(sd, s):
+        sharding = NamedSharding(mesh, s)
+
+        def cb(idx):
+            local = tuple(
+                (sl.stop if sl.stop is not None else n)
+                - (sl.start if sl.start is not None else 0)
+                for sl, n in zip(idx, sd.shape)) if sd.shape else ()
+            return np.zeros(local, dtype=sd.dtype)
+
+        return jax.make_array_from_callback(sd.shape, sharding, cb)
+
+    return jax.tree.map(mk, shape_tree, specs)

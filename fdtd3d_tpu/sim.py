@@ -52,22 +52,26 @@ class Simulation:
         self.topology = topo
         self.static = dataclasses.replace(self.static, topology=topo)
         coeffs_np = build_coeffs(self.static)
-        state0 = init_state(self.static)
         self.mesh = None
         mesh_axes = mesh_shape = None
         if any(p > 1 for p in topo):
             self.mesh = pmesh.build_mesh(topo, devices)
             mesh_axes = pmesh.mesh_axis_map(topo)
             mesh_shape = pmesh.mesh_shape_map(topo)
+            # Allocate the state ALREADY sharded (zeros per shard): a
+            # full-size staging array on one device would overflow at
+            # 1024^3 scale, and in multi-process runs no process even
+            # holds the global array.
+            state_shapes = jax.eval_shape(lambda: init_state(self.static))
             self._coeff_specs = pmesh.coeff_specs(coeffs_np, topo)
-            self._state_specs = pmesh.state_specs(state0, topo)
+            self._state_specs = pmesh.state_specs(state_shapes, topo)
             self.coeffs = pmesh.shard_tree(coeffs_np, self._coeff_specs,
                                            self.mesh)
-            self.state = pmesh.shard_tree(state0, self._state_specs,
-                                          self.mesh)
+            self.state = pmesh.sharded_zeros(state_shapes,
+                                             self._state_specs, self.mesh)
         else:
             self.coeffs = jax.tree.map(jnp.asarray, coeffs_np)
-            self.state = state0
+            self.state = init_state(self.static)
 
         self._runner = make_chunk_runner(self.static, mesh_axes, mesh_shape)
         # "pallas" when the fused kernels are engaged, else "jnp"
@@ -168,15 +172,21 @@ class Simulation:
         return int(jax.device_get(self.state["t"]))
 
     def field(self, comp: str) -> np.ndarray:
-        """Gather one field component to host as a global numpy array."""
+        """Gather one field component to host as a global numpy array.
+
+        Works in multi-process runs too (allgather over the distributed
+        runtime — every process gets the global array).
+        """
+        from fdtd3d_tpu.parallel import distributed as pdist
         group = "E" if comp[0] == "E" else "H"
-        return np.asarray(jax.device_get(self.state[group][comp]))
+        return pdist.gather_to_host(self.state[group][comp])
 
     def fields(self) -> Dict[str, np.ndarray]:
+        from fdtd3d_tpu.parallel import distributed as pdist
         out = {}
         for g in ("E", "H"):
             for c, v in self.state[g].items():
-                out[c] = np.asarray(jax.device_get(v))
+                out[c] = pdist.gather_to_host(v)
         return out
 
     def block_until_ready(self):
@@ -189,22 +199,29 @@ class Simulation:
         if comp not in self.state[group]:
             raise KeyError(f"{comp} not active in scheme {self.cfg.scheme}")
         old = self.state[group][comp]
-        arr = jnp.asarray(np.broadcast_to(value, old.shape),
-                          dtype=old.dtype)
+        vnp = np.asarray(np.broadcast_to(value, old.shape),
+                         dtype=old.dtype)
         if self.mesh is not None:
-            spec = self._state_specs[group][comp]
-            arr = jax.device_put(
-                arr, jax.sharding.NamedSharding(self.mesh, spec))
+            arr = pmesh.shard_leaf(vnp, self._state_specs[group][comp],
+                                   self.mesh)
+        else:
+            arr = jnp.asarray(vnp)
         self.state[group][comp] = arr
         return self
 
     # -- checkpoint/resume (reference DAT save->load workflow, SURVEY §5.4)
 
     def checkpoint(self, path: str):
-        """Bit-exact snapshot of the full solver state pytree."""
+        """Bit-exact snapshot of the full solver state pytree.
+
+        Multi-process: the gather is collective (all ranks call it);
+        rank 0 writes the file.
+        """
         from fdtd3d_tpu import io
-        state_np = jax.tree.map(lambda v: np.asarray(jax.device_get(v)),
-                                self.state)
+        from fdtd3d_tpu.parallel import distributed as pdist
+        state_np = jax.tree.map(pdist.gather_to_host, self.state)
+        if jax.process_index() != 0:
+            return self
         io.save_checkpoint(state_np, path, extra={
             "t": self.t, "scheme": self.cfg.scheme,
             "size": list(self.cfg.size),

@@ -70,9 +70,9 @@ class StaticSetup:
 
     @property
     def compute_dtype(self):
-        """dtype the update arithmetic runs in."""
-        return np.float32 if self.field_dtype == jnp.bfloat16 \
-            else self.field_dtype
+        """dtype the update arithmetic runs in (the recursion state must
+        be stored in the same precision the arithmetic uses)."""
+        return self.aux_dtype
     # Decomposition topology (px, py, pz). Simulation rewrites this after
     # resolving the mesh; it controls the psi slab layout below.
     topology: Tuple[int, int, int] = (1, 1, 1)
