@@ -153,6 +153,12 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--check-finite", action="store_true",
                    help="NaN/Inf tripwire over the state after each chunk")
 
+    g = p.add_argument_group("planning")
+    g.add_argument("--dry-run", action="store_true",
+                   help="print the per-chip memory/communication plan "
+                        "(no device needed) and exit — size pod-scale "
+                        "configs on a laptop")
+
     g = p.add_argument_group("command files")
     g.add_argument("--cmd-from-file", metavar="FILE", default=None,
                    help="read flags from a .txt command file (reference "
@@ -323,6 +329,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         args = parser.parse_args(file_argv + argv)
     if args.save_cmd_to_file:
         save_cmd_file(args, args.save_cmd_to_file)
+
+    if args.dry_run:
+        from fdtd3d_tpu import plan as plan_mod
+        cfg = args_to_config(args)
+        p_ = plan_mod.plan(cfg, n_devices=args.num_devices or 1)
+        print(f"dry run: scheme={cfg.scheme} global={cfg.grid_shape} "
+              f"steps={cfg.time_steps} dtype={cfg.dtype}")
+        print(p_.report())
+        return 0
 
     if args.coordinator_address or args.num_processes or \
             args.process_id is not None:

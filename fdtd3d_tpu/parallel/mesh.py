@@ -81,6 +81,38 @@ def choose_topology(n_devices: int, grid_shape: Tuple[int, int, int],
     return best
 
 
+def resolve_topology(parallel_cfg, grid_shape: Tuple[int, int, int],
+                     active_axes: Tuple[int, ...],
+                     n_devices: Optional[int] = None
+                     ) -> Tuple[int, int, int]:
+    """(px, py, pz) from a ParallelConfig — THE topology authority.
+
+    Shared by Simulation and the dry-run planner so both resolve (and
+    reject) configurations identically: manual topologies must name only
+    active axes and divide the grid; "auto" needs a device count.
+    """
+    if parallel_cfg.topology == "none":
+        return (1, 1, 1)
+    if parallel_cfg.topology == "manual":
+        if parallel_cfg.manual_topology is None:
+            raise ValueError("manual topology requires manual_topology")
+        topo = tuple(parallel_cfg.manual_topology)
+        for a in range(3):
+            if topo[a] > 1 and a not in active_axes:
+                raise ValueError(f"cannot shard inactive axis {a}")
+            if grid_shape[a] % topo[a] != 0:
+                raise ValueError(
+                    f"axis {a} ({grid_shape[a]} cells) not divisible "
+                    f"by topology {topo[a]}")
+        return topo
+    if parallel_cfg.topology == "auto":
+        n = parallel_cfg.n_devices or n_devices
+        if not n:
+            raise ValueError("auto topology needs a device count")
+        return choose_topology(n, grid_shape, active_axes)
+    raise ValueError(f"unknown topology {parallel_cfg.topology!r}")
+
+
 def build_mesh(topology: Tuple[int, int, int], devices=None) -> Mesh:
     """Mesh with axis names x/y/z from an (px, py, pz) topology."""
     n = int(np.prod(topology))

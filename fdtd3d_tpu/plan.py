@@ -1,0 +1,148 @@
+"""Memory / communication planner: size a run before touching a device.
+
+Reference analog: the reference's users size MPI+CUDA runs by hand from
+grid counts; here ``plan(cfg)`` computes, per chip, the HBM bytes of
+every state and coefficient array (fields, slab-compacted CPML psi,
+Drude J, incident line, material grids) and the per-step halo-exchange
+traffic of the chosen decomposition — exactly the arrays
+``solver.init_state``/``build_coeffs`` would allocate, derived from the
+same layout logic (slab_axes, scalar-vs-grid materials), without
+allocating anything. Drives the CLI ``--dry-run`` flag, so pod-scale
+configs (1024^3 on 64 chips) can be validated on a laptop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import numpy as np
+
+from fdtd3d_tpu import solver
+from fdtd3d_tpu.layout import CURL_TERMS, component_axis
+from fdtd3d_tpu.parallel.mesh import resolve_topology
+
+AXES = "xyz"
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    topology: Tuple[int, int, int]
+    local_shape: Tuple[int, int, int]
+    fields_bytes: int          # E + H
+    psi_bytes: int             # CPML recursion state (slab-compacted)
+    drude_bytes: int           # J currents
+    inc_bytes: int             # TFSF incident line (Einc + Hinc)
+    coeff_bytes: int           # material arrays (3D grids only count
+    #                            when spatially varying)
+    halo_bytes_per_step: int   # ppermute traffic per chip per full step
+    n_chips: int
+
+    @property
+    def hbm_per_chip(self) -> int:
+        return (self.fields_bytes + self.psi_bytes + self.drude_bytes
+                + self.inc_bytes + self.coeff_bytes)
+
+    def report(self) -> str:
+        gib = 1 << 30
+        mib = 1 << 20
+        lines = [
+            f"topology {self.topology} ({self.n_chips} chip"
+            f"{'s' if self.n_chips != 1 else ''}), local grid "
+            f"{self.local_shape}",
+            f"  fields (E+H):        {self.fields_bytes / gib:8.3f} GiB",
+            f"  CPML psi (slabs):    {self.psi_bytes / gib:8.3f} GiB",
+            f"  Drude J:             {self.drude_bytes / gib:8.3f} GiB",
+            f"  TFSF incident line:  {self.inc_bytes / mib:8.3f} MiB",
+            f"  material coeffs:     {self.coeff_bytes / gib:8.3f} GiB",
+            f"  TOTAL per chip:      {self.hbm_per_chip / gib:8.3f} GiB",
+            f"  halo exchange:       {self.halo_bytes_per_step / mib:8.3f}"
+            f" MiB/chip/step",
+        ]
+        return "\n".join(lines)
+
+
+def _coeff_grid_counts(static) -> Tuple[int, int]:
+    """(grids per E comp, grids per H comp) — mirrors build_coeffs'
+    scalar-vs-grid decisions (materials.scalar_or_grid / drude_params /
+    merge_drude_eps), asserted equal to the real allocation by
+    tests/test_plan.py so the two cannot drift silently."""
+    mat = static.cfg.materials
+
+    def sphere_on(s):
+        return s is not None and s.enabled and s.radius > 0
+
+    eps_grid = bool(mat.eps_file) or sphere_on(mat.eps_sphere)
+    bj_grids = 0
+    if static.use_drude:
+        wp_grid = sphere_on(mat.drude_sphere)
+        if wp_grid:
+            eps_grid = True        # merge_drude_eps broadcasts to a grid
+            bj_grids = 1           # bj carries wp^2; kj (gamma) is scalar
+        elif mat.omega_p > 0:
+            eps_grid = False       # uniform plasma: eps collapses to
+            #                        eps_inf, discarding any eps grid
+    per_e = 2 * eps_grid + bj_grids              # ca, cb (+bj)
+    per_h = 2 * (bool(mat.mu_file) or sphere_on(mat.mu_sphere))
+    return per_e, per_h
+
+
+def _halo_planes(mode, a: int) -> int:
+    """Planes exchanged across sharded axis `a` per full step: one per
+    curl term whose derivative crosses it (ops/stencil.py ppermutes per
+    difference), counted from the mode's actual components."""
+    n = 0
+    for fam, (upd, srcs) in (("E", (mode.e_components, mode.h_components)),
+                             ("H", (mode.h_components, mode.e_components))):
+        for c in upd:
+            for (ax, d_axis, s) in CURL_TERMS[component_axis(c)]:
+                d = ("H" if fam == "E" else "E") + AXES[d_axis]
+                if ax == a and d in srcs:
+                    n += 1
+    return n
+
+
+def plan(cfg, n_devices: int = 1) -> Plan:
+    """Compute the per-chip memory/comm plan WITHOUT any device work."""
+    static = solver.build_static(cfg)
+    mode = static.mode
+    topo = resolve_topology(cfg.parallel, static.grid_shape,
+                            mode.active_axes, n_devices=n_devices)
+    static = dataclasses.replace(static, topology=topo)
+    local = tuple(static.grid_shape[a] // topo[a] for a in range(3))
+    cells = int(np.prod(local))
+    fb = np.dtype(static.field_dtype).itemsize
+    ab = np.dtype(static.aux_dtype).itemsize
+    rb = np.dtype(static.real_dtype).itemsize
+
+    fields = len(mode.components) * cells * fb
+
+    slabs = solver.slab_axes(static)
+    psi = 0
+    for comps in (mode.e_components, mode.h_components):
+        for c in comps:
+            for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+                if a in static.pml_axes:
+                    shape = list(local)
+                    if a in slabs:
+                        shape[a] = 2 * slabs[a]
+                    psi += int(np.prod(shape)) * ab
+
+    drude = len(mode.e_components) * cells * ab if static.use_drude else 0
+    inc = 2 * static.tfsf_setup.n_inc * ab if static.tfsf_setup else 0
+
+    per_e, per_h = _coeff_grid_counts(static)
+    coeff = (len(mode.e_components) * per_e
+             + len(mode.h_components) * per_h) * cells * rb
+
+    # halo traffic: ops/stencil.py ppermutes one plane per curl term
+    # crossing a sharded axis; each plane is sent AND received.
+    halo = 0
+    for a in range(3):
+        if topo[a] > 1:
+            plane = cells // local[a] * fb
+            halo += 2 * _halo_planes(mode, a) * plane
+    return Plan(topology=topo, local_shape=local, fields_bytes=fields,
+                psi_bytes=psi, drude_bytes=drude, inc_bytes=inc,
+                coeff_bytes=coeff, halo_bytes_per_step=halo,
+                n_chips=int(np.prod(topo)))

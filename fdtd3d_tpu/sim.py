@@ -84,24 +84,10 @@ class Simulation:
                                      for a in self.static.mode.active_axes]))
 
     def _resolve_topology(self, devices):
-        pc = self.cfg.parallel
-        if pc.topology == "none":
-            return (1, 1, 1)
-        if pc.topology == "manual":
-            if pc.manual_topology is None:
-                raise ValueError("manual topology requires manual_topology")
-            topo = tuple(pc.manual_topology)
-            for a in range(3):
-                if topo[a] > 1 and a not in self.static.mode.active_axes:
-                    raise ValueError(f"cannot shard inactive axis {a}")
-                if self.static.grid_shape[a] % topo[a] != 0:
-                    raise ValueError(f"axis {a} not divisible by {topo[a]}")
-            return topo
-        if pc.topology == "auto":
-            n = pc.n_devices or len(devices or jax.devices())
-            return pmesh.choose_topology(n, self.static.grid_shape,
-                                         self.static.mode.active_axes)
-        raise ValueError(f"unknown topology {pc.topology!r}")
+        return pmesh.resolve_topology(
+            self.cfg.parallel, self.static.grid_shape,
+            self.static.mode.active_axes,
+            n_devices=len(devices or jax.devices()))
 
     # -- stepping ----------------------------------------------------------
 

@@ -1,0 +1,145 @@
+"""Memory/communication planner (plan.py + CLI --dry-run) and the DAT
+viewer tool."""
+
+import contextlib
+import io as _io
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import plan as plan_mod
+from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
+                               SimConfig, SphereConfig, TfsfConfig)
+
+
+def _sphere(r=5):
+    return SphereConfig(enabled=True, center=(16, 16, 16), radius=r)
+
+
+MATERIAL_CASES = {
+    "vacuum": MaterialsConfig(),
+    "eps-sphere": MaterialsConfig(eps=2.0, eps_sphere=_sphere()),
+    "mu-sphere": MaterialsConfig(mu_sphere=_sphere()),
+    "drude-sphere": MaterialsConfig(
+        use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+        drude_sphere=_sphere()),
+    # uniform plasma DISCARDS the eps grid (merge_drude_eps) — the
+    # planner must predict zero material grids here
+    "uniform-drude-plus-eps-sphere": MaterialsConfig(
+        use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+        eps=2.0, eps_sphere=_sphere()),
+}
+
+
+@pytest.mark.parametrize("name", sorted(MATERIAL_CASES))
+def test_plan_matches_actual_allocation(name):
+    """Planner bytes must EQUAL what init_state/build_coeffs allocate —
+    including the coefficient grids, whose scalar-vs-grid rules the
+    planner mirrors (it must not drift from build_coeffs)."""
+    import jax
+
+    from fdtd3d_tpu import solver
+    cfg = SimConfig(scheme="3D", size=(32, 32, 32), time_steps=1,
+                    pml=PmlConfig(size=(5, 5, 5)),
+                    tfsf=TfsfConfig(enabled=True, margin=(3, 3, 3)),
+                    materials=MATERIAL_CASES[name])
+    p = plan_mod.plan(cfg)
+    static = solver.build_static(cfg)
+    shapes = jax.eval_shape(lambda: solver.init_state(static))
+
+    def nbytes(tree):
+        return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                   for l in jax.tree.leaves(tree))
+
+    assert p.fields_bytes == nbytes(shapes["E"]) + nbytes(shapes["H"])
+    assert p.psi_bytes == nbytes(shapes["psi_E"]) + nbytes(shapes["psi_H"])
+    if static.use_drude:
+        assert p.drude_bytes == nbytes(shapes["J"])
+    assert p.inc_bytes == nbytes(shapes["inc"])
+    coeffs = solver.build_coeffs(static)
+    actual_grids = sum(v.size * v.dtype.itemsize
+                       for v in coeffs.values()
+                       if getattr(v, "ndim", 0) == 3)
+    assert p.coeff_bytes == actual_grids, name
+
+
+def test_plan_halo_count_per_mode():
+    """2D TMz sharded along x exchanges 2 planes/step, 3D exchanges 4."""
+    cfg2 = SimConfig(scheme="2D_TMz", size=(32, 32, 1), time_steps=1,
+                     parallel=ParallelConfig(topology="manual",
+                                             manual_topology=(2, 1, 1)))
+    p2 = plan_mod.plan(cfg2)
+    plane2 = 32 * 1 * 4              # y*z cells of one x-plane, f32
+    assert p2.halo_bytes_per_step == 2 * 2 * plane2
+
+    cfg3 = SimConfig(scheme="3D", size=(16, 16, 16), time_steps=1,
+                     parallel=ParallelConfig(topology="manual",
+                                             manual_topology=(2, 1, 1)))
+    p3 = plan_mod.plan(cfg3)
+    plane3 = 16 * 16 * 4
+    assert p3.halo_bytes_per_step == 2 * 4 * plane3
+
+
+def test_plan_rejects_what_simulation_rejects():
+    """The dry run must fail exactly where the real run fails."""
+    cfg = SimConfig(scheme="3D", size=(30, 30, 30),
+                    parallel=ParallelConfig(topology="manual"))
+    with pytest.raises(ValueError, match="manual topology requires"):
+        plan_mod.plan(cfg)
+    cfg2 = SimConfig(scheme="3D", size=(30, 30, 30),
+                     parallel=ParallelConfig(topology="manual",
+                                             manual_topology=(4, 1, 1)))
+    with pytest.raises(ValueError, match="not divisible"):
+        plan_mod.plan(cfg2)
+
+
+def test_plan_1024_cubed_on_64_chips_fits_v5p():
+    """The BASELINE config #5 plan: 1024^3 Drude on 64 chips must show a
+    per-chip footprint comfortably under v5p's 95 GiB HBM."""
+    cfg = SimConfig(scheme="3D", size=(1024, 1024, 1024), time_steps=1,
+                    pml=PmlConfig(size=(10, 10, 10)),
+                    materials=MaterialsConfig(use_drude=True, eps_inf=4.0,
+                                              omega_p=1e12, gamma=5e10,
+                                              drude_sphere=SphereConfig(
+                                                  enabled=True,
+                                                  center=(512,) * 3,
+                                                  radius=96)),
+                    parallel=ParallelConfig(topology="auto",
+                                            n_devices=64))
+    p = plan_mod.plan(cfg, n_devices=64)
+    assert p.n_chips == 64
+    assert np.prod(p.local_shape) * 64 == 1024 ** 3
+    gib = p.hbm_per_chip / (1 << 30)
+    assert gib < 16.0, f"per-chip plan {gib:.1f} GiB too large"
+    assert p.halo_bytes_per_step > 0
+    assert "TOTAL per chip" in p.report()
+
+
+def test_cli_dry_run():
+    from fdtd3d_tpu import cli
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(["--3d", "--same-size", "1024", "--use-pml",
+                       "--pml-size", "10", "--topology", "auto",
+                       "--num-devices", "64", "--dry-run"])
+    assert rc == 0
+    out = buf.getvalue()
+    assert "TOTAL per chip" in out and "halo exchange" in out
+
+
+def test_view_tool(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import view
+    from fdtd3d_tpu import io
+    arr = np.linspace(-1, 1, 4 * 5 * 6).reshape(4, 5, 6)
+    p = str(tmp_path / "Ez_t000001.dat")
+    io.dump_dat(arr, p)
+    msg = view.view(p, "z", None)
+    assert "shape (4, 5, 6)" in msg
+    bmp = str(tmp_path / "Ez_t000001_z3.bmp")
+    assert os.path.exists(bmp)
+    w, h = io.load_bmp_size(bmp)
+    assert (w, h) == (4, 5)

@@ -1,0 +1,57 @@
+"""Result viewer: convert DAT dumps to colormapped BMP cuts + stats.
+
+Reference analog: the reference's Tools/ result-viewer scripts over its
+BMP/DAT dumps (SURVEY.md §2 Docs/Tools row). Works on the .dat files
+written by --save-res / --save-materials:
+
+    python tools/view.py out/Ez_t000100.dat            # stats + BMP cut
+    python tools/view.py out/*.dat --axis z --index 16
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from fdtd3d_tpu import io  # noqa: E402
+
+
+def view(path: str, axis: str, index: int | None) -> str:
+    arr = io.load_dat(path)
+    a = "xyz".index(axis)
+    arr3 = arr.reshape(arr.shape + (1,) * (3 - arr.ndim))
+    idx = arr3.shape[a] // 2 if index is None else index
+    sl = [slice(None)] * 3
+    sl[a] = idx
+    cut = np.asarray(arr3[tuple(sl)])
+    out = os.path.splitext(path)[0] + f"_{axis}{idx}.bmp"
+    axes = [b for b in range(3) if b != a]
+    # rebuild a rank-3 array with the cut in place for dump_bmp
+    shape3 = [1, 1, 1]
+    shape3[axes[0]], shape3[axes[1]] = cut.shape[0], cut.shape[1]
+    io.dump_bmp(cut.reshape(shape3), out, active_axes=tuple(axes))
+    stats = (f"{os.path.basename(path)}: shape {arr.shape} "
+             f"min {arr.min():.4e} max {arr.max():.4e} "
+             f"rms {np.sqrt(np.mean(np.abs(arr) ** 2)):.4e} -> {out}")
+    return stats
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+")
+    ap.add_argument("--axis", choices=["x", "y", "z"], default="z",
+                    help="cut normal (default z)")
+    ap.add_argument("--index", type=int, default=None,
+                    help="cut plane index (default: center)")
+    args = ap.parse_args()
+    for path in args.paths:
+        print(view(path, args.axis, args.index))
+
+
+if __name__ == "__main__":
+    main()
