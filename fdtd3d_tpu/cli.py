@@ -139,6 +139,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma list of dat,txt,bmp")
     g.add_argument("--save-materials", action="store_true")
     g.add_argument("--checkpoint-every", type=int, default=0)
+    g.add_argument("--checkpoint-backend", choices=["npz", "orbax"],
+                   default="npz",
+                   help="npz: rank-0 single file; orbax: sharding-aware "
+                        "per-host shard writes (large/multi-host runs)")
     g.add_argument("--load-checkpoint", metavar="PATH", default=None)
     g.add_argument("--norms-every", type=int, default=0,
                    help="print field norms every N steps")
@@ -262,6 +266,7 @@ def args_to_config(args) -> SimConfig:
             formats=tuple(args.save_formats.split(",")),
             save_materials=args.save_materials,
             checkpoint_every=args.checkpoint_every,
+            checkpoint_backend=args.checkpoint_backend,
             norms_every=args.norms_every, metrics_every=args.metrics_every,
             log_level=args.log_level,
             profile=args.profile, check_finite=args.check_finite),
@@ -425,8 +430,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 s.t % cfg.output.checkpoint_every == 0:
             import os
             os.makedirs(cfg.output.save_dir, exist_ok=True)
+            ext = ".npz" if cfg.output.checkpoint_backend == "npz" else ""
             s.checkpoint(os.path.join(cfg.output.save_dir,
-                                      f"ckpt_t{s.t:06d}.npz"))
+                                      f"ckpt_t{s.t:06d}{ext}"),
+                         backend=cfg.output.checkpoint_backend)
 
     # After a checkpoint restore, run only the REMAINING steps so the
     # resumed run ends at the same t as the uninterrupted one.

@@ -178,7 +178,10 @@ class OutputConfig:
     save_dir: str = "out"
     formats: Tuple[str, ...] = ("dat",)   # subset of {"dat","txt","bmp"}
     save_materials: bool = False
-    checkpoint_every: int = 0      # orbax/npz full-state checkpoint cadence
+    checkpoint_every: int = 0      # full-state checkpoint cadence
+    # "npz": rank-0 gathers and writes one file; "orbax": sharding-aware,
+    # every host writes its own shards (large/multi-host runs)
+    checkpoint_backend: str = "npz"
     norms_every: int = 0           # print L2/Linf norms every N steps
     # structured per-interval metrics (energy, norms, divergence
     # residual — diag.metrics) appended to save_dir/metrics.jsonl
@@ -269,6 +272,10 @@ class SimConfig:
                     raise ValueError(f"PML too thick on axis {a}")
         if self.dtype not in ("float32", "float64", "bfloat16"):
             raise ValueError(f"bad dtype {self.dtype}")
+        if self.output.checkpoint_backend not in ("npz", "orbax"):
+            raise ValueError(
+                f"bad checkpoint backend "
+                f"{self.output.checkpoint_backend!r} (npz | orbax)")
         if self.materials.use_drude and self.materials.omega_p > 0:
             # Drude dispersion w^2 = (wp^2 + c^2 k^2)/eps_inf tightens the
             # leapfrog stability limit: ((wp dt/2)^2 + cf^2)/eps_inf <= 1

@@ -270,6 +270,67 @@ def load_checkpoint(path: str) -> Tuple[Dict, Dict]:
     return state, extra
 
 
+def _import_orbax():
+    try:
+        import orbax.checkpoint as ocp
+        return ocp
+    except ImportError as exc:  # pragma: no cover
+        raise ImportError(
+            "the 'orbax' checkpoint backend needs the orbax-checkpoint "
+            "package (pip install orbax-checkpoint), or use the default "
+            "npz backend") from exc
+
+
+def save_checkpoint_orbax(state, path: str, extra: Optional[Dict] = None):
+    """Sharding-aware checkpoint: every host writes ITS OWN shards.
+
+    The TPU-native alternative to the .npz snapshot for large/multi-host
+    runs — no rank-0 gather of the global state (at 1024^3 the npz path
+    stages ~30 GB on one host). `path` becomes a directory; metadata
+    rides a REQUIRED .meta.json sidecar written by rank 0 (restore
+    refuses a checkpoint separated from it).
+    """
+    import jax
+    ocp = _import_orbax()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ck:
+        ck.save(path, state, force=True)
+        ck.wait_until_finished()
+    if jax.process_index() == 0:
+        with open(path + ".meta.json", "w") as f:
+            json.dump(extra or {}, f)
+
+
+def read_orbax_meta(path: str) -> Dict:
+    """Metadata of an orbax checkpoint — validate BEFORE restoring."""
+    meta_path = os.path.abspath(path) + ".meta.json"
+    if not os.path.exists(meta_path):
+        raise ValueError(
+            f"{path}: missing {os.path.basename(meta_path)} sidecar — "
+            f"the metadata guards (scheme/size/topology) cannot be "
+            f"checked; keep the sidecar next to the checkpoint directory")
+    with open(meta_path) as f:
+        return json.load(f)
+
+
+def load_checkpoint_orbax(path: str, target) -> Dict:
+    """State pytree restored WITH target's shardings.
+
+    `target` is the live state pytree (or abstract equivalents): shapes,
+    dtypes and shardings to restore into — each host reads only its own
+    shards. Call read_orbax_meta first and validate.
+    """
+    import jax
+    ocp = _import_orbax()
+    path = os.path.abspath(path)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=getattr(x, "sharding",
+                                                        None)), target)
+    with ocp.StandardCheckpointer() as ck:
+        return ck.restore(path, abstract)
+
+
 # ---------------------------------------------------------------------------
 # periodic output hook (Scheme's dump cadence, SURVEY.md §3.1)
 # ---------------------------------------------------------------------------

@@ -197,28 +197,14 @@ class Simulation:
 
     # -- checkpoint/resume (reference DAT save->load workflow, SURVEY §5.4)
 
-    def checkpoint(self, path: str):
-        """Bit-exact snapshot of the full solver state pytree.
+    def _ckpt_meta(self):
+        return {"t": self.t, "scheme": self.cfg.scheme,
+                "size": list(self.cfg.size),
+                # psi slab layout depends on the decomposition
+                # (solver.slab_axes)
+                "topology": list(self.topology)}
 
-        Multi-process: the gather is collective (all ranks call it);
-        rank 0 writes the file.
-        """
-        from fdtd3d_tpu import io
-        from fdtd3d_tpu.parallel import distributed as pdist
-        state_np = jax.tree.map(pdist.gather_to_host, self.state)
-        if jax.process_index() != 0:
-            return self
-        io.save_checkpoint(state_np, path, extra={
-            "t": self.t, "scheme": self.cfg.scheme,
-            "size": list(self.cfg.size),
-            # psi slab layout depends on the decomposition (solver.slab_axes)
-            "topology": list(self.topology)})
-        return self
-
-    def restore(self, path: str):
-        """Load a checkpoint produced by .checkpoint() into this sim."""
-        from fdtd3d_tpu import io
-        loaded, extra = io.load_checkpoint(path)
+    def _check_ckpt_meta(self, extra):
         if extra.get("scheme") not in (None, self.cfg.scheme):
             raise ValueError(
                 f"checkpoint scheme {extra.get('scheme')!r} != "
@@ -233,6 +219,47 @@ class Simulation:
                 f"{tuple(extra['topology'])} but this run uses "
                 f"{self.topology}; the CPML psi slab layout is "
                 f"per-topology — resume on the same topology")
+
+    def checkpoint(self, path: str, backend: str = "npz"):
+        """Bit-exact snapshot of the full solver state pytree.
+
+        backend="npz": gather to host (collective — all ranks call it),
+        rank 0 writes one file. backend="orbax": sharding-aware — every
+        host writes its own shards, no global gather (use for large /
+        multi-host runs); `path` becomes a directory.
+        """
+        from fdtd3d_tpu import io
+        if backend == "orbax":
+            io.save_checkpoint_orbax(self.state, path,
+                                     extra=self._ckpt_meta())
+            return self
+        if backend != "npz":
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
+        from fdtd3d_tpu.parallel import distributed as pdist
+        state_np = jax.tree.map(pdist.gather_to_host, self.state)
+        if jax.process_index() != 0:
+            return self
+        io.save_checkpoint(state_np, path, extra=self._ckpt_meta())
+        return self
+
+    def restore(self, path: str):
+        """Load a checkpoint produced by .checkpoint() into this sim.
+
+        The backend is detected from the path: an orbax checkpoint is a
+        directory (restored shard-by-shard into this sim's shardings), an
+        .npz is a host-side file.
+        """
+        import os
+
+        from fdtd3d_tpu import io
+        if os.path.isdir(path):
+            # validate metadata BEFORE the restore so mismatches surface
+            # as the friendly guards, not orbax shape errors
+            self._check_ckpt_meta(io.read_orbax_meta(path))
+            self.state = io.load_checkpoint_orbax(path, self.state)
+            return self
+        loaded, extra = io.load_checkpoint(path)
+        self._check_ckpt_meta(extra)
         want = jax.tree.structure(self.state)
         got = jax.tree.structure(loaded)
         if want != got:
