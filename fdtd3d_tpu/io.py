@@ -297,8 +297,12 @@ def save_checkpoint_orbax(state, path: str, extra: Optional[Dict] = None):
         ck.save(path, state, force=True)
         ck.wait_until_finished()
     if jax.process_index() == 0:
-        with open(path + ".meta.json", "w") as f:
+        # atomic publish: a preemption between checkpoint completion and
+        # the sidecar write must not strand (or half-write) the metadata
+        tmp = path + ".meta.json.tmp"
+        with open(tmp, "w") as f:
             json.dump(extra or {}, f)
+        os.replace(tmp, path + ".meta.json")
 
 
 def read_orbax_meta(path: str) -> Dict:
@@ -310,7 +314,12 @@ def read_orbax_meta(path: str) -> Dict:
             f"the metadata guards (scheme/size/topology) cannot be "
             f"checked; keep the sidecar next to the checkpoint directory")
     with open(meta_path) as f:
-        return json.load(f)
+        try:
+            return json.load(f)
+        except json.JSONDecodeError as exc:
+            raise ValueError(
+                f"{path}: corrupt metadata sidecar "
+                f"({os.path.basename(meta_path)}): {exc}") from exc
 
 
 def load_checkpoint_orbax(path: str, target) -> Dict:

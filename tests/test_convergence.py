@@ -1,0 +1,66 @@
+"""Second-order convergence to the CONTINUUM solution under refinement.
+
+The discrete-eigenmode oracles (test_cavity_modes.py) prove the solver
+implements its own discretization exactly; this suite proves that
+discretization converges to Maxwell at the expected 2nd order — the
+reference's sinusoidal convergence-norm tests (SURVEY.md §4).
+
+Probe: a PEC-cavity eigenmode at FIXED physical size and mode numbers,
+resolved at 16/32/64 cells per side, evolved ~5 periods. The sin-product
+mode shape is exact at every resolution, so the entire error against the
+CONTINUUM evolution is the dispersion phase drift (w_d - w_cont) * T =
+O(dx^2). The error is measured as its envelope over one full period
+(a single snapshot samples an arbitrary phase of the drift) and is
+asserted BOTH to fall at 2nd order and to match the analytic envelope
+2|sin(drift/2)| — the sim must reproduce the Yee dispersion
+quantitatively, not just shrink.
+"""
+
+import math
+
+import numpy as np
+
+from fdtd3d_tpu import exact, physics
+from fdtd3d_tpu.config import SimConfig
+from fdtd3d_tpu.sim import Simulation
+
+L = 16e-3          # physical cavity side
+M, N = 2, 3        # mode numbers
+
+
+def _cavity_drift(res: int):
+    """(measured error envelope, analytic envelope prediction)."""
+    dx = L / res
+    n = res + 1                 # walls at 0 and n-1 -> interior length L
+    cfg = SimConfig(scheme="2D_TMz", size=(n, n, 1), time_steps=0,
+                    dx=dx, courant_factor=0.5, wavelength=10e-3,
+                    dtype="float64")
+    sim = Simulation(cfg)
+    shape, omega_d = exact.cavity_mode_tmz((n, n), M, N, dx, cfg.dt)
+    sim.set_field("Ez", shape[:, :, None])
+    omega_c = physics.C0 * math.pi / L * math.hypot(M, N)
+    period = 2.0 * math.pi / omega_c
+    total = int(round(5.0 * period / cfg.dt))
+    p_steps = int(round(period / cfg.dt))
+    sim.advance(total - p_steps)
+    err = 0.0
+    for _ in range(p_steps):
+        sim.advance(1)
+        t = sim.t
+        expected = shape * (math.cos(omega_c * (t - 0.5) * cfg.dt)
+                            / math.cos(omega_c * 0.5 * cfg.dt))
+        err = max(err, float(np.max(
+            np.abs(sim.field("Ez")[:, :, 0] - expected))))
+    drift = (omega_d - omega_c) * total * cfg.dt
+    return err, abs(2.0 * math.sin(drift / 2.0))
+
+
+def test_cavity_dispersion_drift_second_order():
+    measured, predicted = zip(*[_cavity_drift(r) for r in (16, 32, 64)])
+    orders = [math.log2(measured[i] / measured[i + 1]) for i in range(2)]
+    for i, o in enumerate(orders):
+        assert 1.8 < o < 2.3, f"step {i}: order {o:.2f} ({measured})"
+    # and quantitatively the drift the Yee dispersion relation predicts
+    for res, m, p in zip((16, 32, 64), measured, predicted):
+        assert abs(m - p) < 0.25 * p, (
+            f"res {res}: measured {m:.4f} vs predicted {p:.4f}")

@@ -202,6 +202,7 @@ def test_orbax_checkpoint_resume_sharded_bit_exact(tmp_path):
     """Sharding-aware (orbax) checkpoint on a real mesh: every device's
     shards written without a global gather; resume reproduces the
     uninterrupted run bit-for-bit."""
+    pytest.importorskip("orbax.checkpoint")
     from fdtd3d_tpu.config import ParallelConfig
 
     n = 16
@@ -231,6 +232,7 @@ def test_orbax_checkpoint_resume_sharded_bit_exact(tmp_path):
 
 
 def test_orbax_checkpoint_rejects_topology_mismatch(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
     from fdtd3d_tpu.config import ParallelConfig
 
     cfg = SimConfig(scheme="3D", size=(16, 16, 16),
