@@ -96,3 +96,22 @@ def test_example_parses_and_validates_at_full_scale(name):
     cfg = cli.args_to_config(args)
     cfg.validate()
     assert cfg.time_steps > 0
+
+
+def test_save_cmd_to_file_roundtrip(tmp_path):
+    """--save-cmd-to-file re-emits flags that reproduce the same config
+    when replayed with --cmd-from-file (reference Settings parity)."""
+    out = str(tmp_path / "cmd.txt")
+    argv = ["--3d", "--same-size", "48", "--time-steps", "123",
+            "--courant-factor", "0.4", "--wavelength", "15e-3",
+            "--use-pml", "--pml-size", "6",
+            "--use-tfsf", "--tfsf-margin", "4", "--angle-teta", "30",
+            "--use-drude", "--eps-inf", "2.0", "--omega-p", "1e11",
+            "--drude-sphere-radius", "5"]
+    parser = cli.build_parser()
+    args = parser.parse_args(argv)
+    cli.save_cmd_file(args, out)
+    cfg_direct = cli.args_to_config(parser.parse_args(argv))
+    replay = cli.read_cmd_file(out)
+    cfg_replayed = cli.args_to_config(parser.parse_args(replay))
+    assert cfg_direct == cfg_replayed
