@@ -102,6 +102,16 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--drude-sphere-center-y", type=float, default=0.0)
     g.add_argument("--drude-sphere-center-z", type=float, default=0.0)
     g.add_argument("--drude-sphere-radius", type=float, default=0.0)
+    # magnetic Drude (reference metamaterial mode: OmegaPM/GammaM)
+    g.add_argument("--use-drude-m", action="store_true",
+                   help="dispersive mu(w) via an ADE magnetic current")
+    g.add_argument("--mu-inf", type=float, default=1.0)
+    g.add_argument("--omega-pm", type=float, default=0.0, help="rad/s")
+    g.add_argument("--gamma-m", type=float, default=0.0, help="rad/s")
+    g.add_argument("--drude-m-sphere-center-x", type=float, default=0.0)
+    g.add_argument("--drude-m-sphere-center-y", type=float, default=0.0)
+    g.add_argument("--drude-m-sphere-center-z", type=float, default=0.0)
+    g.add_argument("--drude-m-sphere-radius", type=float, default=0.0)
 
     g = p.add_argument_group("near-to-far-field (NTFF)")
     g.add_argument("--ntff", action="store_true",
@@ -256,6 +266,15 @@ def args_to_config(args) -> SimConfig:
                         args.drude_sphere_center_y,
                         args.drude_sphere_center_z),
                 radius=args.drude_sphere_radius),
+            use_drude_m=args.use_drude_m,
+            mu_inf=args.mu_inf, omega_pm=args.omega_pm,
+            gamma_m=args.gamma_m,
+            drude_m_sphere=SphereConfig(
+                enabled=args.drude_m_sphere_radius > 0,
+                center=(args.drude_m_sphere_center_x,
+                        args.drude_m_sphere_center_y,
+                        args.drude_m_sphere_center_z),
+                radius=args.drude_m_sphere_radius),
             eps_file=args.load_eps_from_file,
             mu_file=args.load_mu_from_file),
         parallel=ParallelConfig(

@@ -112,12 +112,22 @@ class MaterialsConfig:
     sigma_m: float = 0.0           # magnetic loss
     eps_sphere: SphereConfig = dataclasses.field(default_factory=SphereConfig)
     mu_sphere: SphereConfig = dataclasses.field(default_factory=SphereConfig)
-    # Drude
+    # Drude (electric)
     use_drude: bool = False
     eps_inf: float = 1.0
     omega_p: float = 0.0           # rad/s (0 -> no plasma response)
     gamma: float = 0.0             # collision rate, rad/s
     drude_sphere: SphereConfig = dataclasses.field(default_factory=SphereConfig)
+    # Drude (magnetic) — the reference's metamaterial mode pairs the
+    # OmegaPE/GammaE grids with OmegaPM/GammaM ones so both eps(w) and
+    # mu(w) disperse (double-negative media): mu(w) = mu_inf -
+    # wpm^2/(w^2 + i gm w), realized as an ADE magnetic current K.
+    use_drude_m: bool = False
+    mu_inf: float = 1.0
+    omega_pm: float = 0.0
+    gamma_m: float = 0.0
+    drude_m_sphere: SphereConfig = dataclasses.field(
+        default_factory=SphereConfig)
     # load-from-file (path to .npy with shape (Nx,Ny,Nz) or broadcastable)
     eps_file: Optional[str] = None
     mu_file: Optional[str] = None
@@ -276,18 +286,23 @@ class SimConfig:
             raise ValueError(
                 f"bad checkpoint backend "
                 f"{self.output.checkpoint_backend!r} (npz | orbax)")
-        if self.materials.use_drude and self.materials.omega_p > 0:
-            # Drude dispersion w^2 = (wp^2 + c^2 k^2)/eps_inf tightens the
-            # leapfrog stability limit: ((wp dt/2)^2 + cf^2)/eps_inf <= 1
-            # (cf is the fraction of the vacuum Courant limit). Violations
-            # blow up to NaN; the vacuum cf <= 1 case is checked above.
-            margin = ((self.materials.omega_p * self.dt / 2.0) ** 2
-                      + self.courant_factor ** 2) / self.materials.eps_inf
-            if margin > 1.0:
-                raise ValueError(
-                    f"unstable Drude configuration: ((omega_p*dt/2)^2 + "
-                    f"courant_factor^2)/eps_inf = {margin:.3f} > 1; reduce "
-                    f"courant_factor or omega_p")
+        for use, wp, base, tag in (
+                (self.materials.use_drude, self.materials.omega_p,
+                 self.materials.eps_inf, "eps_inf"),
+                (self.materials.use_drude_m, self.materials.omega_pm,
+                 self.materials.mu_inf, "mu_inf")):
+            if use and wp > 0:
+                # Drude dispersion w^2 = (wp^2 + c^2 k^2)/base tightens
+                # the leapfrog stability limit:
+                # ((wp dt/2)^2 + cf^2)/base <= 1 (cf is the fraction of
+                # the vacuum Courant limit). Violations blow up to NaN.
+                margin = ((wp * self.dt / 2.0) ** 2
+                          + self.courant_factor ** 2) / base
+                if margin > 1.0:
+                    raise ValueError(
+                        f"unstable Drude configuration: ((wp*dt/2)^2 + "
+                        f"courant_factor^2)/{tag} = {margin:.3f} > 1; "
+                        f"reduce courant_factor or the plasma frequency")
         if self.point_source.enabled and \
                 self.point_source.component not in mode.e_components:
             raise ValueError(

@@ -399,6 +399,11 @@ def write_materials(sim):
         grids[f"mu_{comp}"] = mats.scalar_or_grid(
             comp, shape, mode.active_axes, mat.mu, mat.mu_sphere,
             mat.mu_file)
+        if mat.use_drude_m:
+            wpm, gm, _ = mats.drude_params(comp, shape, mode.active_axes,
+                                           mat, magnetic=True)
+            grids[f"omega_pm_{comp}"] = wpm
+            grids[f"gamma_m_{comp}"] = gm
     grids["sigma_e"] = mat.sigma_e
     grids["sigma_m"] = mat.sigma_m
 

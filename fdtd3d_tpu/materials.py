@@ -92,18 +92,23 @@ def scalar_or_grid(comp: str, shape, active_axes, base: float,
     return float(base)
 
 
-def drude_params(comp: str, shape, active_axes, mat) -> tuple:
+def drude_params(comp: str, shape, active_axes, mat,
+                 magnetic: bool = False) -> tuple:
     """(omega_p, gamma, region_is_uniform) at comp positions.
 
-    When ``drude_sphere`` is enabled the plasma is confined to the sphere
-    (omega_p = 0 outside); otherwise the whole domain is Drude.
+    When the (electric or magnetic) drude sphere is enabled the plasma is
+    confined to it (omega_p = 0 outside); otherwise the whole domain is
+    dispersive. ``magnetic=True`` selects the OmegaPM/GammaM analog
+    (reference metamaterial mode).
     """
-    if mat.drude_sphere.enabled and mat.drude_sphere.radius > 0:
+    sphere = mat.drude_m_sphere if magnetic else mat.drude_sphere
+    wp0 = mat.omega_pm if magnetic else mat.omega_p
+    g = mat.gamma_m if magnetic else mat.gamma
+    if sphere.enabled and sphere.radius > 0:
         wp = np.zeros(shape, dtype=np.float64)
-        wp[_sphere_mask(comp, shape, active_axes, mat.drude_sphere)] = \
-            mat.omega_p
-        return wp, float(mat.gamma), False
-    return float(mat.omega_p), float(mat.gamma), True
+        wp[_sphere_mask(comp, shape, active_axes, sphere)] = wp0
+        return wp, float(g), False
+    return float(wp0), float(g), True
 
 
 def merge_drude_eps(eps: Material, omega_p, eps_inf: float) -> Material:

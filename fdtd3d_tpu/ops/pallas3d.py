@@ -157,7 +157,10 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
     upd = mode.e_components if family == "E" else mode.h_components
     tag = "e" if family == "E" else "h"
     backward = family == "E"
-    drude = family == "E" and static.use_drude
+    # ADE dispersion: electric Drude (J) on the E family, magnetic Drude
+    # (K, metamaterial mode) on the H family — same recursion, dual sign
+    drude = static.use_drude if family == "E" else static.use_drude_m
+    ade = ("kj", "bj") if family == "E" else ("km", "bm")
 
     # ---- static layout of kernel operands ------------------------------
     src_names = list(mode.h_components if family == "E"
@@ -188,7 +191,7 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
     pairs = (("ca", "cb") if family == "E" else ("da", "db"))
     coeff_keys = [f"{p}_{c}" for c in upd for p in pairs]
     if drude:
-        coeff_keys += [f"{p}_{c}" for c in upd for p in ("kj", "bj")]
+        coeff_keys += [f"{p}_{c}" for c in upd for p in ade]
     coeff_is_array = {k: np.ndim(np_coeffs[k]) == 3 for k in coeff_keys}
     array_coeff_names = [k for k, v in coeff_is_array.items() if v]
 
@@ -368,12 +371,13 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
                 acc = term if acc is None else acc + term
 
             old = idx[f"in_{c}"][:].astype(fdt)
+            if drude:
+                ade_new = (coef(f"{ade[0]}_{c}") * idx[f"jin_{c}"][:]
+                           + coef(f"{ade[1]}_{c}") * old)
+                idx[f"jout_{c}"][:] = ade_new.astype(fdt)
+                # J is driven by +curl (subtract); K opposes -curl (add)
+                acc = acc - ade_new if family == "E" else acc + ade_new
             if family == "E":
-                if drude:
-                    j_new = (coef(f"kj_{c}") * idx[f"jin_{c}"][:]
-                             + coef(f"bj_{c}") * old)
-                    idx[f"jout_{c}"][:] = j_new.astype(fdt)
-                    acc = acc - j_new
                 new = coef(f"ca_{c}") * old + coef(f"cb_{c}") * acc
                 for a in range(3):
                     if a != component_axis(c):
@@ -849,8 +853,11 @@ def make_pallas_step(static, mesh_axes=None, mesh_shape=None):
             if psi_h_names else {}
         gh_h = gather_ghosts(new_E, ghosts_h, mesh_axes, mesh_shape,
                              backward=False)
-        new_H, psi_h_out, _ = run_h(state["H"], new_E, psi_h_in, coeffs,
-                                    gh_h)
+        new_H, psi_h_out, new_K = run_h(state["H"], new_E, psi_h_in,
+                                        coeffs, gh_h,
+                                        J=state.get("K"))
+        if new_K is not None:
+            new_state["K"] = new_K
         psi_H = dict(state.get("psi_H", {}), **psi_h_out)
         if x_active:
             px = {k: v for k, v in psi_H.items() if k.endswith("_x")}

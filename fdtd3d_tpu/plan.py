@@ -72,18 +72,21 @@ def _coeff_grid_counts(static) -> Tuple[int, int]:
     def sphere_on(s):
         return s is not None and s.enabled and s.radius > 0
 
-    eps_grid = bool(mat.eps_file) or sphere_on(mat.eps_sphere)
-    bj_grids = 0
-    if static.use_drude:
-        wp_grid = sphere_on(mat.drude_sphere)
-        if wp_grid:
-            eps_grid = True        # merge_drude_eps broadcasts to a grid
-            bj_grids = 1           # bj carries wp^2; kj (gamma) is scalar
-        elif mat.omega_p > 0:
-            eps_grid = False       # uniform plasma: eps collapses to
-            #                        eps_inf, discarding any eps grid
-    per_e = 2 * eps_grid + bj_grids              # ca, cb (+bj)
-    per_h = 2 * (bool(mat.mu_file) or sphere_on(mat.mu_sphere))
+    def side(base_grid, use, wp_sphere, wp0):
+        drive_grids = 0
+        if use:
+            if sphere_on(wp_sphere):
+                base_grid = True   # merge_drude_eps broadcasts to a grid
+                drive_grids = 1    # bj/bm carries wp^2; kj/km is scalar
+            elif wp0 > 0:
+                base_grid = False  # uniform plasma: collapses to the
+                #                    _inf value, discarding any grid
+        return 2 * base_grid + drive_grids
+
+    per_e = side(bool(mat.eps_file) or sphere_on(mat.eps_sphere),
+                 static.use_drude, mat.drude_sphere, mat.omega_p)
+    per_h = side(bool(mat.mu_file) or sphere_on(mat.mu_sphere),
+                 static.use_drude_m, mat.drude_m_sphere, mat.omega_pm)
     return per_e, per_h
 
 
@@ -129,6 +132,8 @@ def plan(cfg, n_devices: int = 1) -> Plan:
                     psi += int(np.prod(shape)) * ab
 
     drude = len(mode.e_components) * cells * ab if static.use_drude else 0
+    if static.use_drude_m:
+        drude += len(mode.h_components) * cells * ab   # K currents
     inc = 2 * static.tfsf_setup.n_inc * ab if static.tfsf_setup else 0
 
     per_e, per_h = _coeff_grid_counts(static)

@@ -60,6 +60,7 @@ class StaticSetup:
     use_drude: bool
     field_dtype: Any
     real_dtype: Any
+    use_drude_m: bool = False        # magnetic Drude (metamaterial mode)
 
     @property
     def aux_dtype(self):
@@ -163,7 +164,7 @@ def build_static(cfg: SimConfig) -> StaticSetup:
         cfg=cfg, mode=mode, grid_shape=cfg.grid_shape, dt=cfg.dt,
         dx=cfg.dx, omega=cfg.omega, pml_axes=pml_axes, tfsf_setup=None,
         use_drude=cfg.materials.use_drude, field_dtype=field,
-        real_dtype=real)
+        real_dtype=real, use_drude_m=cfg.materials.use_drude_m)
     if cfg.tfsf.enabled:
         st = dataclasses.replace(st, tfsf_setup=tfsf.build_setup(cfg, st))
     return st
@@ -210,6 +211,15 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
     for c in mode.h_components:
         mu = materials.scalar_or_grid(c, shape, mode.active_axes, mat.mu,
                                       mat.mu_sphere, mat.mu_file)
+        if static.use_drude_m:
+            wpm, gm, _ = materials.drude_params(c, shape,
+                                                mode.active_axes, mat,
+                                                magnetic=True)
+            mu = materials.merge_drude_eps(mu, wpm, mat.mu_inf)
+            out[f"km_{c}"] = _cast((1.0 - gm * dt / 2.0)
+                                   / (1.0 + gm * dt / 2.0))
+            out[f"bm_{c}"] = _cast(physics.MU0 * np.square(wpm) * dt
+                                   / (1.0 + gm * dt / 2.0))
         sm = mat.sigma_m * dt / (2.0 * physics.MU0 * np.asarray(mu))
         out[f"da_{c}"] = _cast((1.0 - sm) / (1.0 + sm))
         out[f"db_{c}"] = _cast(dt / (physics.MU0 * np.asarray(mu))
@@ -262,6 +272,9 @@ def init_state(static: StaticSetup) -> Dict[str, Any]:
     if static.use_drude:
         state["J"] = {c: jnp.zeros(shape, dtype=aux)
                       for c in mode.e_components}
+    if static.use_drude_m:
+        state["K"] = {c: jnp.zeros(shape, dtype=aux)
+                      for c in mode.h_components}
     if static.tfsf_setup is not None:
         n = static.tfsf_setup.n_inc
         state["inc"] = {"Einc": jnp.zeros(n, dtype=aux),
@@ -425,13 +438,13 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         # 2. E family
         acc_e = _half_update("E", state, coeffs, new_psi)
         new_E = {}
+        new_J: Dict[str, Any] = {}
         for c in mode.e_components:
             acc = acc_e[c]
             if static.use_drude:
                 j_new = coeffs[f"kj_{c}"] * state["J"][c] \
                     + coeffs[f"bj_{c}"] * state["E"][c]
-                new_state.setdefault("J", {})
-                new_state["J"] = dict(new_state.get("J", {}), **{c: j_new})
+                new_J[c] = j_new
                 acc = acc - j_new
             if ps.enabled and ps.component == c:
                 mask = point_mask(coeffs["gx"], coeffs["gy"], coeffs["gz"],
@@ -447,6 +460,8 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                     e = e * _bcast1d(coeffs[f"wall_{AXES[a]}"], a)
             new_E[c] = e.astype(static.field_dtype)
         new_state["E"] = new_E
+        if static.use_drude:
+            new_state["J"] = new_J
         state = dict(state, E=new_E)
 
         # 3. incident line H advance (Hinc -> t^{n+3/2})
@@ -455,14 +470,23 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                                                  setup)
             state = dict(state, inc=new_state["inc"])
 
-        # 4. H family
+        # 4. H family (dual of step 2: mu0 mu dH/dt = -curl E - K)
         acc_h = _half_update("H", state, coeffs, new_psi)
         new_H = {}
+        new_K: Dict[str, Any] = {}
         for c in mode.h_components:
+            acc = acc_h[c]
+            if static.use_drude_m:
+                k_new = coeffs[f"km_{c}"] * state["K"][c] \
+                    + coeffs[f"bm_{c}"] * state["H"][c]
+                new_K[c] = k_new
+                acc = acc + k_new
             h = coeffs[f"da_{c}"] * state["H"][c] \
-                - coeffs[f"db_{c}"] * acc_h[c]
+                - coeffs[f"db_{c}"] * acc
             new_H[c] = h.astype(static.field_dtype)
         new_state["H"] = new_H
+        if static.use_drude_m:
+            new_state["K"] = new_K
 
         if new_psi["psi_E"]:
             new_state["psi_E"] = new_psi["psi_E"]

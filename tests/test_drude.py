@@ -12,8 +12,9 @@ import math
 import numpy as np
 
 from fdtd3d_tpu import physics
-from fdtd3d_tpu.config import (MaterialsConfig, PmlConfig, SimConfig,
-                               SphereConfig, TfsfConfig)
+from fdtd3d_tpu.config import (MaterialsConfig, PmlConfig,
+                               PointSourceConfig, SimConfig, SphereConfig,
+                               TfsfConfig)
 from fdtd3d_tpu.sim import Simulation
 
 
@@ -87,3 +88,129 @@ def test_drude_transparent_above_plasma_frequency():
     # Deep inside the weak plasma the CW amplitude stays near 1.
     inside = np.abs(ez[120:145]).max()
     assert 0.8 < inside < 1.3, f"transmission wrong: {inside:.3f}"
+
+
+def _halfspace_cfg(wavelength, n, *, electric=False, magnetic=False,
+                   wp_ratio=1.2, steps=1600, slab_hi=None):
+    """TFSF plane wave onto a dispersive region starting at x=100.
+
+    slab_hi: end of the dispersive region (default: the domain edge —
+    fine for evanescent single-negative media). For PROPAGATING
+    (double-negative) media the region must end before the CPML: a PML
+    backed by a negative-index medium is a known instability.
+    """
+    omega = 2 * math.pi * physics.C0 / wavelength
+    wp = wp_ratio * omega
+    if slab_hi is None:
+        sphere = SphereConfig(enabled=True, center=(n, 0.0, 0.0),
+                              radius=n - 100.0)
+    else:
+        c = (100.0 + slab_hi) / 2.0
+        sphere = SphereConfig(enabled=True, center=(c, 0.0, 0.0),
+                              radius=(slab_hi - 100.0) / 2.0)
+    return SimConfig(
+        scheme="1D_EzHy", size=(n, 1, 1), time_steps=steps, dx=1e-3,
+        courant_factor=0.5, wavelength=wavelength,
+        pml=PmlConfig(size=(10, 0, 0)),
+        tfsf=TfsfConfig(enabled=True, margin=(8, 0, 0),
+                        angle_teta=90.0, angle_phi=0.0, angle_psi=180.0),
+        materials=MaterialsConfig(
+            use_drude=electric, eps_inf=1.0, omega_p=wp if electric else 0.0,
+            gamma=0.0, drude_sphere=sphere,
+            use_drude_m=magnetic, mu_inf=1.0,
+            omega_pm=wp if magnetic else 0.0, gamma_m=0.0,
+            drude_m_sphere=sphere),
+    )
+
+
+def test_magnetic_drude_mirror_below_plasma_frequency():
+    """mu(w) < 0 single-negative half-space: reflective + evanescent —
+    the magnetic dual of the electric Drude mirror above."""
+    n, wavelength = 160, 15e-3
+    sim = Simulation(_halfspace_cfg(wavelength, n, magnetic=True,
+                                    wp_ratio=3.0))
+    sim.run()
+    front_max, inside_max = 0.0, 0.0
+    for _ in range(6):
+        sim.advance(7)
+        ez = sim.field("Ez")[:, 0, 0]
+        front_max = max(front_max, np.abs(ez[40:95]).max())
+        inside_max = max(inside_max, np.abs(ez[112:118]).max())
+    assert front_max > 1.5, f"no standing wave, max {front_max:.2f}"
+    omega = 2 * math.pi * physics.C0 / wavelength
+    k0 = omega / physics.C0 * 1e-3
+    expected_bound = 2.0 * math.exp(-k0 * math.sqrt(8.0) * 12)
+    assert inside_max < 3.0 * expected_bound + 0.02, (
+        f"not evanescent: {inside_max:.3f}")
+
+
+def _swr_probe(cells_per_wl, *, electric=False, magnetic=False,
+               wp_ratio=1.2):
+    """CW point source onto a dispersive slab; geometry fixed in physical
+    wavelengths. Returns (reflection coefficient from the standing-wave
+    ratio in front, transmitted envelope inside / incident).
+
+    Point source, not TFSF (a penetrable slab crossing the TFSF exit
+    face injects a spurious difference wave); SWR makes the measurement
+    source-amplitude-free.
+    """
+    wavelength = 15e-3
+    wl = cells_per_wl
+    dx = wavelength / wl
+    n = int(11 * wl)
+    s_lo, s_hi = 4 * wl, 6.5 * wl
+    omega = 2 * math.pi * physics.C0 / wavelength
+    wp = wp_ratio * omega
+    sphere = SphereConfig(enabled=True,
+                          center=((s_lo + s_hi) / 2.0, 0.0, 0.0),
+                          radius=(s_hi - s_lo) / 2.0)
+    cfg = SimConfig(
+        scheme="1D_EzHy", size=(n, 1, 1), time_steps=int(160 * wl), dx=dx,
+        courant_factor=0.5, wavelength=wavelength,
+        pml=PmlConfig(size=(wl, 0, 0)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(2 * wl, 0, 0)),
+        materials=MaterialsConfig(
+            use_drude=electric, eps_inf=1.0,
+            omega_p=wp if electric else 0.0, gamma=0.0,
+            drude_sphere=sphere,
+            use_drude_m=magnetic, mu_inf=1.0,
+            omega_pm=wp if magnetic else 0.0, gamma_m=0.0,
+            drude_m_sphere=sphere))
+    sim = Simulation(cfg)
+    sim.run()
+    env = np.zeros(n)
+    stride = max(1, round(wl / 0.5 / 8))    # ~8 samples per period
+    for _ in range(10):
+        sim.advance(stride)
+        env = np.maximum(env, np.abs(sim.field("Ez")[:, 0, 0]))
+    front = env[int(2.6 * wl):int(3.8 * wl)]
+    swr = front.max() / max(front.min(), 1e-12)
+    refl = (swr - 1.0) / (swr + 1.0)
+    inside = env[int(4.4 * wl):int(6.1 * wl)].max() / front.max()
+    return refl, inside
+
+
+def test_double_negative_medium_is_matched_and_transparent():
+    """THE metamaterial oracle: with identical electric and magnetic
+    plasma, eps(w) = mu(w) = -0.44 at the drive frequency, the impedance
+    sqrt(mu/eps) = eta0 is MATCHED — the slab reflects ~nothing and the
+    wave propagates inside (negative index), in stark contrast to the
+    single-negative mirror. The residual reflection is the half-cell
+    staggered-interface effect, first-order in dx — asserted to shrink
+    with resolution. Gets the coupled J/K update signs right or fails."""
+    r15, in15 = _swr_probe(15, electric=True, magnetic=True)
+    r30, in30 = _swr_probe(30, electric=True, magnetic=True)
+    assert r30 < 0.15, f"matched DNG slab reflected: R ~ {r30:.2f}"
+    assert in30 > 0.8, f"wave did not propagate inside: {in30:.2f}"
+    assert r30 < 0.75 * r15, (
+        f"interface reflection not shrinking with dx: {r15:.3f} -> {r30:.3f}")
+
+
+def test_single_negative_blocks_where_double_negative_passes():
+    """Same plasma electric-only: eps < 0, mu = 1 -> mirror + evanescent.
+    The contrast against the DNG case pins the physics, not just
+    stability."""
+    refl, inside = _swr_probe(15, electric=True)
+    assert refl > 0.8, f"single-negative slab should reflect: {refl:.2f}"
+    assert inside < 0.25, f"single-negative slab should block: {inside:.2f}"

@@ -158,7 +158,10 @@ def test_save_materials_dumps_every_grid(tmp_path):
         materials=MaterialsConfig(
             eps=2.0, use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
             drude_sphere=SphereConfig(enabled=True, center=(4, 4, 4),
-                                      radius=2)),
+                                      radius=2),
+            use_drude_m=True, mu_inf=1.5, omega_pm=1e11, gamma_m=1e10,
+            drude_m_sphere=SphereConfig(enabled=True, center=(4, 4, 4),
+                                        radius=2)),
         output=OutputConfig(save_materials=True, save_dir=str(tmp_path),
                             formats=("dat", "txt", "bmp")))
     sim = Simulation(cfg)
@@ -167,6 +170,8 @@ def test_save_materials_dumps_every_grid(tmp_path):
              + [f"omega_p_{c}" for c in ("Ex", "Ey", "Ez")]
              + [f"gamma_{c}" for c in ("Ex", "Ey", "Ez")]
              + [f"mu_{c}" for c in ("Hx", "Hy", "Hz")]
+             + [f"omega_pm_{c}" for c in ("Hx", "Hy", "Hz")]
+             + [f"gamma_m_{c}" for c in ("Hx", "Hy", "Hz")]
              + ["sigma_e", "sigma_m"])
     for name in names:
         for ext in (".dat", ".txt", ".bmp"):

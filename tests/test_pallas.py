@@ -201,3 +201,17 @@ def test_bfloat16_tracks_f32_within_storage_rounding():
         b = np.asarray(b16.field(comp), np.float32)
         rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
         assert rel < 5e-2, f"{comp}: rel {rel:.2e}"
+
+
+def test_magnetic_drude_parity():
+    # metamaterial mode: K recursion runs in the H-family kernel
+    _compare(SimConfig(**BASE, pml=PmlConfig(size=(3, 3, 3)),
+                       materials=MaterialsConfig(
+                           use_drude=True, eps_inf=1.5, omega_p=1e11,
+                           gamma=1e10,
+                           drude_sphere=SphereConfig(
+                               enabled=True, center=(8, 8, 8), radius=4),
+                           use_drude_m=True, mu_inf=1.5, omega_pm=1e11,
+                           gamma_m=1e10,
+                           drude_m_sphere=SphereConfig(
+                               enabled=True, center=(8, 8, 8), radius=4))))

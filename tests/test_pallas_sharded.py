@@ -33,7 +33,11 @@ def _cfg(parallel=None, use_pallas=None):
         materials=MaterialsConfig(
             eps=1.0, use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
             drude_sphere=SphereConfig(enabled=True,
-                                      center=(8.0, 8.0, 8.0), radius=3.0)),
+                                      center=(8.0, 8.0, 8.0), radius=3.0),
+            use_drude_m=True, mu_inf=1.5, omega_pm=1e11, gamma_m=1e10,
+            drude_m_sphere=SphereConfig(enabled=True,
+                                       center=(8.0, 8.0, 8.0),
+                                       radius=3.0)),
         point_source=PointSourceConfig(enabled=True, component="Ez",
                                        position=(5, 9, 7)),
         parallel=parallel or ParallelConfig(),

@@ -30,6 +30,12 @@ MATERIAL_CASES = {
     "uniform-drude-plus-eps-sphere": MaterialsConfig(
         use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
         eps=2.0, eps_sphere=_sphere()),
+    # metamaterial mode: K currents + magnetic coefficient grids
+    "double-drude-spheres": MaterialsConfig(
+        use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+        drude_sphere=_sphere(),
+        use_drude_m=True, mu_inf=1.5, omega_pm=1e11, gamma_m=1e10,
+        drude_m_sphere=_sphere()),
 }
 
 
@@ -55,8 +61,10 @@ def test_plan_matches_actual_allocation(name):
 
     assert p.fields_bytes == nbytes(shapes["E"]) + nbytes(shapes["H"])
     assert p.psi_bytes == nbytes(shapes["psi_E"]) + nbytes(shapes["psi_H"])
-    if static.use_drude:
-        assert p.drude_bytes == nbytes(shapes["J"])
+    if static.use_drude or static.use_drude_m:
+        want = (nbytes(shapes["J"]) if static.use_drude else 0) + \
+            (nbytes(shapes["K"]) if static.use_drude_m else 0)
+        assert p.drude_bytes == want
     assert p.inc_bytes == nbytes(shapes["inc"])
     coeffs = solver.build_coeffs(static)
     actual_grids = sum(v.size * v.dtype.itemsize
