@@ -22,7 +22,7 @@ Source/Grid/ParallelGrid     fdtd3d_tpu.parallel (mesh + ppermute halo)
 Source/Grid/CudaGrid         XLA TPU backend (nothing to write)
 Source/Layout/YeeGridLayout  fdtd3d_tpu.layout
 Source/Scheme/InternalScheme fdtd3d_tpu.solver + fdtd3d_tpu.ops
-Source/Scheme/Scheme         fdtd3d_tpu.solver.Simulation
+Source/Scheme/Scheme         fdtd3d_tpu.sim.Simulation
 Source/File                  fdtd3d_tpu.io
 Source/Physics               fdtd3d_tpu.physics
 NTFF (in Source/Scheme)      fdtd3d_tpu.ntff
