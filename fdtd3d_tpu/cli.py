@@ -166,6 +166,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "throughput summary at the end")
     g.add_argument("--check-finite", action="store_true",
                    help="NaN/Inf tripwire over the state after each chunk")
+    g.add_argument("--trace", metavar="DIR", default=None,
+                   help="write a jax.profiler (XProf/TensorBoard) trace "
+                        "of the run to DIR: per-step HLO timeline incl. "
+                        "halo collectives vs stencil compute")
 
     g = p.add_argument_group("planning")
     g.add_argument("--dry-run", action="store_true",
@@ -458,10 +462,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     # resumed run ends at the same t as the uninterrupted one.
     remaining = max(0, cfg.time_steps - sim.t) if args.load_checkpoint \
         else cfg.time_steps
-    sim.run(time_steps=remaining,
-            on_interval=on_interval if interval else None,
-            interval=interval)
-    sim.block_until_ready()
+    import contextlib
+
+    from fdtd3d_tpu import profiling
+    tracer = profiling.trace(args.trace) if args.trace \
+        else contextlib.nullcontext()
+    with tracer:
+        sim.run(time_steps=remaining,
+                on_interval=on_interval if interval else None,
+                interval=interval)
+        sim.block_until_ready()
     if ntff_col is not None:
         if ntff_col.n_samples > 0:
             path = write_ntff_pattern(ntff_col, cfg)

@@ -181,3 +181,34 @@ def test_ntff_cli_black_box(tmp_path):
     assert pattern[180.0].max() < 0.15, "theta=180 null missing"
     assert pattern[30.0].mean() < pattern[60.0].mean() < eq.mean(), \
         "pattern not monotone toward the equator"
+
+
+def test_ntff_sharded_matches_unsharded():
+    """NTFF face sampling on a sharded sim (single process): the lazy
+    global-index slicing must gather the right planes; pattern equals
+    the unsharded run's."""
+    from fdtd3d_tpu.config import ParallelConfig, PmlConfig
+    from fdtd3d_tpu.ntff import NtffCollector
+
+    n = 32
+
+    def run(parallel):
+        cfg = SimConfig(
+            scheme="3D", size=(n, n, n), time_steps=0, dx=1e-3,
+            courant_factor=0.5, wavelength=12e-3,
+            pml=PmlConfig(size=(6, 6, 6)),
+            point_source=PointSourceConfig(enabled=True, component="Ez",
+                                           position=(n // 2,) * 3),
+            parallel=parallel)
+        sim = Simulation(cfg)
+        sim.advance(120)
+        col = NtffCollector(sim, frequency=physics.C0 / cfg.wavelength,
+                            box=((9, 9, 9), (n - 9,) * 3))
+        for _ in range(24):
+            sim.advance(2)
+            col.sample()
+        return col.directivity_pattern([45.0, 90.0], [0.0, 90.0])
+
+    ref = run(ParallelConfig())
+    shd = run(ParallelConfig(topology="manual", manual_topology=(2, 2, 2)))
+    assert np.allclose(shd, ref, rtol=1e-4), f"{shd} vs {ref}"

@@ -62,3 +62,25 @@ def test_cli_profile_flag(capsys, tmp_path):
     assert rc == 0
     outp = capsys.readouterr().out
     assert "profile:" in outp
+
+
+def test_cli_trace_writes_profile(tmp_path):
+    """--trace produces a jax.profiler (XProf) trace directory."""
+    import contextlib
+    import io as _io
+    import os
+
+    from fdtd3d_tpu import cli
+
+    trace_dir = str(tmp_path / "trace")
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(["--2d", "TMz", "--sizex", "16", "--sizey", "16",
+                       "--sizez", "1", "--time-steps", "10",
+                       "--point-source", "Ez", "--trace", trace_dir,
+                       "--log-level", "0"])
+    assert rc == 0
+    found = []
+    for root, _, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found, "no trace files written"
