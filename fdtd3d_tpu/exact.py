@@ -17,7 +17,7 @@ that are exact eigenfunctions/solutions of the discrete operator itself:
 from __future__ import annotations
 
 import math
-from typing import Dict, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 

@@ -21,7 +21,7 @@ second-order approximation, same class as the reference's interpolation.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 

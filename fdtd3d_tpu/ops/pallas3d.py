@@ -68,7 +68,6 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from fdtd3d_tpu import physics
 from fdtd3d_tpu.layout import CURL_TERMS, component_axis
 from fdtd3d_tpu.ops import tfsf as tfsf_mod
 from fdtd3d_tpu.ops.sources import waveform

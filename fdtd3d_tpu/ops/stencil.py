@@ -29,7 +29,6 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

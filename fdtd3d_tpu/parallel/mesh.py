@@ -23,7 +23,6 @@ else (incident line, scalars) is replicated.
 
 from __future__ import annotations
 
-import itertools
 from typing import Dict, Optional, Tuple
 
 import jax

@@ -14,7 +14,7 @@ configs (1024^3 on 64 chips) can be validated on a laptop.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Tuple
 
 import numpy as np
 

@@ -25,8 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import time
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import jax
 import jax.numpy as jnp

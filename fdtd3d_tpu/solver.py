@@ -28,7 +28,6 @@ The 13 scheme modes share this one kernel: inactive axes are singleton dims
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -37,7 +36,7 @@ import numpy as np
 
 from fdtd3d_tpu import materials, physics
 from fdtd3d_tpu.config import SimConfig
-from fdtd3d_tpu.layout import (CURL_TERMS, component_axis, get_mode)
+from fdtd3d_tpu.layout import CURL_TERMS, component_axis
 from fdtd3d_tpu.ops import cpml, tfsf
 from fdtd3d_tpu.ops.sources import point_mask, waveform
 from fdtd3d_tpu.ops.stencil import make_diff_ops
