@@ -36,6 +36,7 @@ CASES = {
     "drude1D_metal.txt": ([], {"Ez": 1.0683e+00, "Hy": 5.3137e-03}),
     "vacuum2D_tmz.txt": ([], {"Ez": 6.0252e-02, "Hx": 6.5954e-05,
                               "Hy": 6.5954e-05}),
+    "metamaterial1D_dng.txt": ([], {"Ez": 2.2762e-01, "Hy": 6.0649e-04}),
     "vacuum3D_tfsf.txt": (
         ["--same-size", "32", "--time-steps", "60", "--pml-size", "5",
          "--tfsf-margin", "4", "--norms-every", "60"],
