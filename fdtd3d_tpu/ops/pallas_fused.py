@@ -1,0 +1,505 @@
+"""Single-pass fused E+H Pallas kernel (experimental subset).
+
+The two-pass kernels (ops/pallas3d.py) move ~18 field volumes per step
+(72 B/cell f32); fusing both family updates into ONE pass cuts that to
+12 (48 B/cell) — see docs/PERFORMANCE.md. The mechanics: each x-tile
+computes new_E for its T planes PLUS one redundant extra plane
+((i+1)·T, recomputed by the next tile), so the tile's H update — whose
+forward x-difference needs new_E one plane ahead — never waits on a
+neighbor tile.
+
+Scope (everything else falls back to the two-pass kernels): 3D, real
+f32/bf16 storage, UNSHARDED, CPML only on y/z axes (slab psi in-kernel),
+Drude J/K allowed, NO TFSF and NO point source. The excluded features
+are exactly the jnp post-passes that modify E after the kernel — the H
+update would then need curl-of-patch corrections (the round-3 work item
+in docs/PERFORMANCE.md); this subset needs no post-pass at all.
+
+The extra plane needs one-plane "forward halos" of everything the E
+update reads there: old E, psi_E, J, and any 3D E-side coefficient
+grids — fetched as single-plane blocks of the same HBM arrays via
+clamped index maps (the pattern the two-pass kernels already use for
+the x halo).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fdtd3d_tpu.layout import CURL_TERMS, component_axis
+from fdtd3d_tpu.ops.pallas3d import _VMEM_LIMIT, _pick_tile
+
+AXES = "xyz"
+
+
+def eligible(static, mesh_axes=None) -> bool:
+    if static.mode.name != "3D":
+        return False
+    if static.field_dtype not in (np.float32, jnp.bfloat16):
+        return False
+    if static.topology != (1, 1, 1):
+        return False
+    if mesh_axes and any(v is not None for v in mesh_axes.values()):
+        return False
+    if static.tfsf_setup is not None or static.cfg.point_source.enabled:
+        return False
+    if 0 in static.pml_axes:
+        return False
+    return True
+
+
+def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
+    """One-pallas-call leapfrog step, or None if out of scope."""
+    from fdtd3d_tpu import solver as solver_mod
+
+    if not eligible(static, mesh_axes):
+        return None
+    slabs = solver_mod.slab_axes(static)
+    # y/z PML must be slab-compacted (thin grids fall back)
+    for a in static.pml_axes:
+        if a not in slabs:
+            return None
+    np_coeffs = solver_mod.build_coeffs(static)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+
+    mode = static.mode
+    n1, n2, n3 = static.grid_shape
+    inv_dx = np.float32(1.0 / static.dx)
+    fdt = jnp.float32
+    fst = static.field_dtype
+    fbytes = np.dtype(fst).itemsize
+    e_comps = list(mode.e_components)
+    h_comps = list(mode.h_components)
+    drude_e = static.use_drude
+    drude_m = static.use_drude_m
+
+    # psi terms per family comp: (axis, src, sign) for slab y/z axes
+    def terms_of(c: str, fam: str):
+        out = []
+        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+            d = ("H" if fam == "E" else "E") + AXES[d_axis]
+            out.append((a, d, s))
+        return out
+
+    psi_e_names = [f"{c}_{AXES[a]}" for c in e_comps
+                   for (a, d, s) in terms_of(c, "E") if a in slabs]
+    psi_h_names = [f"{c}_{AXES[a]}" for c in h_comps
+                   for (a, d, s) in terms_of(c, "H") if a in slabs]
+
+    pairs_e = ["ca", "cb"] + (["kj", "bj"] if drude_e else [])
+    pairs_h = ["da", "db"] + (["km", "bm"] if drude_m else [])
+    coeff_is_array = {}
+    for c in e_comps:
+        for p in pairs_e:
+            coeff_is_array[f"{p}_{c}"] = np.ndim(np_coeffs[f"{p}_{c}"]) == 3
+    for c in h_comps:
+        for p in pairs_h:
+            coeff_is_array[f"{p}_{c}"] = np.ndim(np_coeffs[f"{p}_{c}"]) == 3
+    arr_e = [k for k, v in coeff_is_array.items()
+             if v and k.split("_")[0] in pairs_e]
+    arr_h = [k for k, v in coeff_is_array.items()
+             if v and k.split("_")[0] in pairs_h]
+
+    # CPML profile vectors per family tag and slab axis
+    prof_specs: List[Tuple[str, str, int]] = []   # (ref, coeffs key, axis)
+    for tag in ("e", "h"):
+        for a in sorted(slabs):
+            for p in ("b", "c", "ik"):
+                prof_specs.append((f"pf_{p}{tag}_{AXES[a]}",
+                                   f"pml_slab_{p}{tag}_{AXES[a]}", a))
+
+    def _psi_shape(name):
+        a = AXES.index(name[-1])
+        s = [n1, n2, n3]
+        s[a] = 2 * slabs[a]
+        return tuple(s)
+
+    def _block_bytes(t: int) -> int:
+        plane = n2 * n3
+        total = 0
+        # E in (t) + extra (1) + E out (t)
+        total += len(e_comps) * (2 * t + 1) * plane * fbytes
+        # H in (t) + bwd halo + fwd halo + H out (t)
+        total += len(h_comps) * (2 * t + 2) * plane * fbytes
+        # psi_E in (t) + extra (1) + out (t); psi_H in/out (t)
+        for nm in psi_e_names:
+            s = _psi_shape(nm)
+            total += (2 * t + 1) * s[1] * s[2] * 4
+        for nm in psi_h_names:
+            s = _psi_shape(nm)
+            total += 2 * t * s[1] * s[2] * 4
+        if drude_e:   # J in (t) + extra + out (t)
+            total += len(e_comps) * (2 * t + 1) * plane * 4
+        if drude_m:   # K in/out
+            total += len(h_comps) * 2 * t * plane * 4
+        total += len(arr_e) * (t + 1) * plane * 4
+        total += len(arr_h) * t * plane * 4
+        for (_, key, a) in prof_specs:
+            total += 2 * slabs[a] * 4
+        total += n2 * 4 + n3 * 4 + t * 4     # walls
+        return total
+
+    T = _pick_tile(n1, _block_bytes)
+    ntiles = n1 // T
+
+    # ---- operand order --------------------------------------------------
+    # E in | E extra | H in | H bhalo | H fhalo | psiE in | psiE extra |
+    # psiH in | J in | J extra | K in | profiles | walls(x,y,z) |
+    # arrE | arrE extra | arrH
+    # outs: E | H | psiE | psiH | J | K
+
+    def kernel(*refs):
+        idx = {}
+        pos = 0
+
+        def take(names, prefix):
+            nonlocal pos
+            for nm in names:
+                idx[f"{prefix}{nm}"] = refs[pos]
+                pos += 1
+
+        take(e_comps, "ein_")
+        take(e_comps, "eex_")
+        take(h_comps, "hin_")
+        take(h_comps, "hbh_")
+        take(h_comps, "hfh_")
+        take(psi_e_names, "pe_")
+        take(psi_e_names, "pex_")
+        take(psi_h_names, "ph_")
+        if drude_e:
+            take(e_comps, "jin_")
+            take(e_comps, "jex_")
+        if drude_m:
+            take(h_comps, "kin_")
+        take([r for (r, _, _) in prof_specs], "")
+        take(["wall_y", "wall_z"], "")
+        take(arr_e, "ce_")
+        take(arr_e, "cex_")
+        take(arr_h, "ch_")
+        take(e_comps, "eout_")
+        take(h_comps, "hout_")
+        take(psi_e_names, "peo_")
+        take(psi_h_names, "pho_")
+        if drude_e:
+            take(e_comps, "jout_")
+        if drude_m:
+            take(h_comps, "kout_")
+
+        i = pl.program_id(0)
+
+        def cat0(a, b):
+            return jnp.concatenate([a, b], axis=0)
+
+        # extended (T+1 plane) loads for the E update
+        e_old = {c: cat0(idx[f"ein_{c}"][:], idx[f"eex_{c}"][:]).astype(fdt)
+                 for c in e_comps}
+        h_old = {c: idx[f"hin_{c}"][:].astype(fdt) for c in h_comps}
+        h_ext = {c: cat0(h_old[c], idx[f"hfh_{c}"][:].astype(fdt))
+                 for c in h_comps}
+
+        def coef_e(key, ext):
+            if coeff_is_array[key]:
+                v = idx[f"ce_{key}"][:]
+                if ext:
+                    v = cat0(v, idx[f"cex_{key}"][:])
+                return v.astype(fdt)
+            return fdt(float(np_coeffs[key]))
+
+        def coef_h(key):
+            if coeff_is_array[key]:
+                return idx[f"ch_{key}"][:].astype(fdt)
+            return fdt(float(np_coeffs[key]))
+
+        def yz_diff(f, axis, backward):
+            zero = jnp.zeros_like(lax.slice_in_dim(f, 0, 1, axis=axis))
+            if backward:
+                body = lax.slice_in_dim(f, 0, f.shape[axis] - 1, axis=axis)
+                return (f - jnp.concatenate([zero, body], axis=axis)) \
+                    * inv_dx
+            body = lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
+            return (jnp.concatenate([body, zero], axis=axis) - f) * inv_dx
+
+        def slab_term(dfa, psi, tag, a, s, out_ref, owned):
+            """CPML slab psi recursion + term for derivative axis a.
+
+            dfa/psi span `owned+?` planes along x; psi written to out_ref
+            for the owned T planes only when out_ref is not None.
+            """
+            m = slabs[a]
+            b = idx[f"pf_b{tag}_{AXES[a]}"][:]
+            cc = idx[f"pf_c{tag}_{AXES[a]}"][:]
+            ik = idx[f"pf_ik{tag}_{AXES[a]}"][:]
+            cut = lambda f, lo, hi: lax.slice_in_dim(f, lo, hi, axis=a)  # noqa: E731
+            nloc = dfa.shape[a]
+            d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
+            p_lo = cut(b, 0, m) * cut(psi, 0, m) + cut(cc, 0, m) * d_lo
+            p_hi = (cut(b, m, 2 * m) * cut(psi, m, 2 * m)
+                    + cut(cc, m, 2 * m) * d_hi)
+            if out_ref is not None:
+                out_ref[:] = jnp.concatenate(
+                    [p_lo, p_hi], axis=a)[:owned].astype(fdt)
+            dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
+            dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+            mid = list(dfa.shape)
+            mid[a] = nloc - 2 * m
+            delta = jnp.concatenate([dl, jnp.zeros(mid, fdt), dh], axis=a)
+            return s * dfa + delta
+
+        # global x indices of the extended range, for the PEC x wall
+        gx = (i * T + lax.broadcasted_iota(jnp.int32, (T + 1, 1, 1), 0))
+        wall_x_ext = ((gx != 0) & (gx != n1 - 1)).astype(fdt)
+
+        # ---- E update over T+1 planes --------------------------------
+        new_e = {}
+        for c in e_comps:
+            acc = None
+            for (a, d, s) in terms_of(c, "E"):
+                if d not in h_comps:
+                    continue
+                if a == 0:
+                    # backward diff over the extended range: needs
+                    # H[iT-1 .. iT+T] = bhalo ++ tile ++ fhalo
+                    bh = idx[f"hbh_{d}"][:].astype(fdt)
+                    ghost = jnp.where(i > 0, bh, jnp.zeros_like(bh))
+                    full = cat0(ghost, h_ext[d])         # T+2 planes
+                    dfa = (full[1:] - full[:-1]) * inv_dx  # T+1
+                    term = s * dfa                        # no x-PML here
+                else:
+                    dfa = yz_diff(h_ext[d], a, backward=True)
+                    if a in slabs:
+                        key = f"{c}_{AXES[a]}"
+                        psi = cat0(idx[f"pe_{key}"][:],
+                                   idx[f"pex_{key}"][:]).astype(fdt)
+                        term = slab_term(dfa, psi, "e", a, s,
+                                         idx[f"peo_{key}"], T)
+                    else:
+                        term = s * dfa
+                acc = term if acc is None else acc + term
+            old = e_old[c]
+            if drude_e:
+                j_old = cat0(idx[f"jin_{c}"][:],
+                             idx[f"jex_{c}"][:]).astype(fdt)
+                j_new = (coef_e(f"kj_{c}", True) * j_old
+                         + coef_e(f"bj_{c}", True) * old)
+                idx[f"jout_{c}"][:] = j_new[:T].astype(fdt)
+                acc = acc - j_new
+            e = coef_e(f"ca_{c}", True) * old \
+                + coef_e(f"cb_{c}", True) * acc
+            ca_ax = component_axis(c)
+            if ca_ax != 0:
+                e = e * wall_x_ext
+            for a2 in (1, 2):
+                if a2 != ca_ax:
+                    e = e * idx[f"wall_{AXES[a2]}"][:].astype(fdt)
+            new_e[c] = e
+            idx[f"eout_{c}"][:] = e[:T].astype(fst)
+
+        # ---- H update over the owned T planes ------------------------
+        for c in h_comps:
+            acc = None
+            for (a, d, s) in terms_of(c, "H"):
+                if d not in e_comps:
+                    continue
+                if a == 0:
+                    # forward diff: new_e has T+1 planes; at the global
+                    # edge the shifted plane is the PEC zero ghost
+                    f = new_e[d][:T]
+                    nxt = new_e[d][1:T + 1]
+                    edge = jnp.where(
+                        (i * T + lax.broadcasted_iota(
+                            jnp.int32, (T, 1, 1), 0)) < n1 - 1,
+                        nxt, jnp.zeros_like(nxt))
+                    dfa = (edge - f) * inv_dx
+                    term = s * dfa
+                else:
+                    dfa = yz_diff(new_e[d][:T], a, backward=False)
+                    if a in slabs:
+                        key = f"{c}_{AXES[a]}"
+                        psi = idx[f"ph_{key}"][:].astype(fdt)
+                        term = slab_term(dfa, psi, "h", a, s,
+                                         idx[f"pho_{key}"], T)
+                    else:
+                        term = s * dfa
+                acc = term if acc is None else acc + term
+            old = h_old[c]
+            if drude_m:
+                k_new = (coef_h(f"km_{c}") * idx[f"kin_{c}"][:].astype(fdt)
+                         + coef_h(f"bm_{c}") * old)
+                idx[f"kout_{c}"][:] = k_new.astype(fdt)
+                acc = acc + k_new
+            h = coef_h(f"da_{c}") * old - coef_h(f"db_{c}") * acc
+            idx[f"hout_{c}"][:] = h.astype(fst)
+
+    # ---- specs ---------------------------------------------------------
+    def tile_spec(last2=(n2, n3)):
+        return pl.BlockSpec((T, last2[0], last2[1]), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def fwd_halo_spec(last2=(n2, n3)):
+        return pl.BlockSpec(
+            (1, last2[0], last2[1]),
+            lambda i: (jnp.minimum((i + 1) * T, n1 - 1), 0, 0),
+            memory_space=pltpu.VMEM)
+
+    def bwd_halo_spec():
+        return pl.BlockSpec(
+            (1, n2, n3), lambda i: (jnp.maximum(i * T - 1, 0), 0, 0),
+            memory_space=pltpu.VMEM)
+
+    def psi_last2(nm):
+        s = _psi_shape(nm)
+        return (s[1], s[2])
+
+    def vec_spec(a, length):
+        if a == 0:
+            return pl.BlockSpec((T, 1, 1), lambda i: (i, 0, 0),
+                                memory_space=pltpu.VMEM)
+        s = [1, 1, 1]
+        s[a] = length
+        return pl.BlockSpec(tuple(s), lambda i: (0, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    in_specs = (
+        [tile_spec() for _ in e_comps]
+        + [fwd_halo_spec() for _ in e_comps]
+        + [tile_spec() for _ in h_comps]
+        + [bwd_halo_spec() for _ in h_comps]
+        + [fwd_halo_spec() for _ in h_comps]
+        + [tile_spec(psi_last2(nm)) for nm in psi_e_names]
+        + [fwd_halo_spec(psi_last2(nm)) for nm in psi_e_names]
+        + [tile_spec(psi_last2(nm)) for nm in psi_h_names])
+    if drude_e:
+        in_specs += ([tile_spec() for _ in e_comps]
+                     + [fwd_halo_spec() for _ in e_comps])
+    if drude_m:
+        in_specs += [tile_spec() for _ in h_comps]
+    in_specs += [vec_spec(a, 2 * slabs[a]) for (_, _, a) in prof_specs]
+    in_specs += [vec_spec(1, n2), vec_spec(2, n3)]
+    in_specs += [tile_spec() for _ in arr_e]
+    in_specs += [fwd_halo_spec() for _ in arr_e]
+    in_specs += [tile_spec() for _ in arr_h]
+
+    out_specs = ([tile_spec() for _ in e_comps]
+                 + [tile_spec() for _ in h_comps]
+                 + [tile_spec(psi_last2(nm)) for nm in psi_e_names]
+                 + [tile_spec(psi_last2(nm)) for nm in psi_h_names])
+    out_shape = ([jax.ShapeDtypeStruct((n1, n2, n3), fst)
+                  for _ in e_comps + h_comps]
+                 + [jax.ShapeDtypeStruct(_psi_shape(nm), np.float32)
+                    for nm in psi_e_names + psi_h_names])
+    if drude_e:
+        out_specs += [tile_spec() for _ in e_comps]
+        out_shape += [jax.ShapeDtypeStruct((n1, n2, n3), np.float32)
+                      for _ in e_comps]
+    if drude_m:
+        out_specs += [tile_spec() for _ in h_comps]
+        out_shape += [jax.ShapeDtypeStruct((n1, n2, n3), np.float32)
+                      for _ in h_comps]
+
+    # Input/output aliasing. SAFETY RULE: an aliased (donated) array may
+    # only be read at its OWN tile's planes or FORWARD of them (a later
+    # tile's region, still unwritten under the sequential grid order).
+    # E/psi_E/J extra planes are forward reads -> safe to alias. H is
+    # read BACKWARD (the bwd halo plane belongs to the previous tile,
+    # which would already have overwritten it) -> H is NOT aliased.
+    ne, nh = len(e_comps), len(h_comps)
+    npe, nph = len(psi_e_names), len(psi_h_names)
+    pos_in = {}
+    p = 0
+    pos_in["E"] = p; p += ne          # E in
+    p += ne                           # E extra
+    pos_in["H"] = p; p += nh
+    p += 2 * nh                       # halos
+    pos_in["psiE"] = p; p += npe
+    p += npe                          # psi extra
+    pos_in["psiH"] = p; p += nph
+    if drude_e:
+        pos_in["J"] = p; p += ne
+        p += ne
+    if drude_m:
+        pos_in["K"] = p; p += nh
+    aliases = {}
+    for j in range(ne):
+        aliases[pos_in["E"] + j] = j
+    for j in range(npe):
+        aliases[pos_in["psiE"] + j] = ne + nh + j
+    for j in range(nph):
+        aliases[pos_in["psiH"] + j] = ne + nh + npe + j
+    out_p = ne + nh + npe + nph
+    if drude_e:
+        for j in range(ne):
+            aliases[pos_in["J"] + j] = out_p + j
+        out_p += ne
+    if drude_m:
+        for j in range(nh):
+            aliases[pos_in["K"] + j] = out_p + j
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(ntiles,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        input_output_aliases=aliases,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )
+
+    def _vec3(v, a):
+        s = [1, 1, 1]
+        s[a] = v.shape[0]
+        return v.astype(fdt).reshape(s)
+
+    def step(state, coeffs):
+        args = [state["E"][c] for c in e_comps]
+        args += [state["E"][c] for c in e_comps]       # extra (same array)
+        args += [state["H"][c] for c in h_comps]
+        args += [state["H"][c] for c in h_comps]       # bwd halo
+        args += [state["H"][c] for c in h_comps]       # fwd halo
+        args += [state["psi_E"][nm] for nm in psi_e_names]
+        args += [state["psi_E"][nm] for nm in psi_e_names]
+        args += [state["psi_H"][nm] for nm in psi_h_names]
+        if drude_e:
+            args += [state["J"][c] for c in e_comps]
+            args += [state["J"][c] for c in e_comps]
+        if drude_m:
+            args += [state["K"][c] for c in h_comps]
+        args += [_vec3(coeffs[key], a) for (_, key, a) in prof_specs]
+        args += [_vec3(coeffs["wall_y"], 1), _vec3(coeffs["wall_z"], 2)]
+        args += [coeffs[k] for k in arr_e]
+        args += [coeffs[k] for k in arr_e]
+        args += [coeffs[k] for k in arr_h]
+        outs = call(*args)
+        p = 0
+        new_state = dict(state)
+        new_state["E"] = {c: outs[p + j] for j, c in enumerate(e_comps)}
+        p += ne
+        new_state["H"] = {c: outs[p + j] for j, c in enumerate(h_comps)}
+        p += nh
+        if psi_e_names or psi_h_names:
+            new_state["psi_E"] = {nm: outs[p + j]
+                                  for j, nm in enumerate(psi_e_names)}
+            p += npe
+            new_state["psi_H"] = {nm: outs[p + j]
+                                  for j, nm in enumerate(psi_h_names)}
+            p += nph
+        if drude_e:
+            new_state["J"] = {c: outs[p + j]
+                              for j, c in enumerate(e_comps)}
+            p += ne
+        if drude_m:
+            new_state["K"] = {c: outs[p + j]
+                              for j, c in enumerate(h_comps)}
+            p += nh
+        new_state["t"] = state["t"] + 1
+        return new_state
+
+    return step

@@ -1,0 +1,128 @@
+"""Single-pass fused E+H kernel (ops/pallas_fused.py) vs the jnp step.
+
+The fused kernel's scope is the no-post-pass subset (no TFSF/point
+source/x-PML, unsharded); within it, parity with the jnp step must hold
+at f32 roundoff, and out-of-scope configs must fall back to the two-pass
+kernels ("pallas") rather than silently degrade.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig, SphereConfig,
+                               TfsfConfig)
+from fdtd3d_tpu.sim import Simulation
+
+BASE = dict(scheme="3D", size=(16, 16, 16), time_steps=8, dx=1e-3,
+            courant_factor=0.4, wavelength=8e-3)
+
+
+def _run(use_pallas, **kw):
+    sim = Simulation(SimConfig(**BASE, use_pallas=use_pallas, **kw))
+    key = jax.random.PRNGKey(0)
+    for grp in ("E", "H"):
+        for c in list(sim.state[grp]):
+            key, k2 = jax.random.split(key)
+            sim.set_field(c, 0.01 * np.asarray(
+                jax.random.normal(k2, sim.state[grp][c].shape)))
+    sim.run()
+    return sim
+
+
+def _parity(tol=2e-6, **kw):
+    j = _run(False, **kw)
+    p = _run(True, **kw)
+    assert p.step_kind == "pallas_fused", p.step_kind
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < tol, f"{c}: rel {rel:.2e}"
+
+
+def test_fused_vacuum_parity():
+    _parity()
+
+
+def test_fused_yz_cpml_parity():
+    _parity(pml=PmlConfig(size=(0, 3, 3)))
+
+
+def test_fused_metamaterial_parity():
+    _parity(pml=PmlConfig(size=(0, 3, 3)),
+            materials=MaterialsConfig(
+                use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+                drude_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                          radius=3),
+                use_drude_m=True, mu_inf=1.5, omega_pm=1e11, gamma_m=1e10,
+                drude_m_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                            radius=3)))
+
+
+def test_fused_material_array_parity():
+    _parity(materials=MaterialsConfig(
+        eps=2.0, eps_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                         radius=4, value=6.0)))
+
+
+def test_fused_bf16_parity():
+    j = _run(False, dtype="bfloat16", pml=PmlConfig(size=(0, 3, 3)))
+    p = _run(True, dtype="bfloat16", pml=PmlConfig(size=(0, 3, 3)))
+    assert p.step_kind == "pallas_fused"
+    for c in ("Ez", "Hy"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-2, f"{c}: rel {rel:.2e}"
+
+
+def test_fused_uneven_tiles():
+    """Non-power-of-two x extent: exercises the clamped last-tile halo
+    index maps — and the fields must MATCH, not just run."""
+    cfg = dict(BASE)
+    cfg["size"] = (12, 16, 16)
+
+    def run(up):
+        sim = Simulation(SimConfig(**cfg, use_pallas=up,
+                                   pml=PmlConfig(size=(0, 3, 3))))
+        key = jax.random.PRNGKey(2)
+        for grp in ("E", "H"):
+            for c in list(sim.state[grp]):
+                key, k2 = jax.random.split(key)
+                sim.set_field(c, 0.01 * np.asarray(
+                    jax.random.normal(k2, sim.state[grp][c].shape)))
+        sim.run()
+        return sim
+    j = run(False)
+    p = run(True)
+    assert p.step_kind == "pallas_fused"
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-6, f"{c}: rel {rel:.2e}"
+
+
+@pytest.mark.parametrize("name,kw,expect", [
+    ("tfsf", dict(pml=PmlConfig(size=(0, 3, 3)),
+                  tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2))),
+     "pallas"),
+    ("point-source", dict(point_source=PointSourceConfig(
+        enabled=True, component="Ez", position=(8, 8, 8))), "pallas"),
+    ("x-pml", dict(pml=PmlConfig(size=(3, 3, 3))), "pallas"),
+])
+def test_out_of_scope_falls_back_to_two_pass(name, kw, expect):
+    sim = Simulation(SimConfig(**BASE, use_pallas=True, **kw))
+    assert sim.step_kind == expect, f"{name}: {sim.step_kind}"
+
+
+def test_sharded_falls_back_to_two_pass():
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(1, 2, 2))))
+    assert sim.step_kind == "pallas"
