@@ -18,6 +18,14 @@ slab-compacted CPML psi keeps the working set ~4.6 GB) and report
 Mcells/s/chip. Both the fused Pallas path and the pure-jnp XLA path are
 measured; the headline value is the faster (pallas_mcells / jnp_mcells
 are carried for the comparison table in BASELINE.md).
+
+Tunnel weather (VERDICT r2 items 1-3): the tunneled chip throttles ~20x
+between sessions, so one driver invocation is a lottery ticket. Two
+mitigations: (a) the 512^3 go/no-go is the measured 256^3 pallas
+throughput of THIS window (a direct timing, not the HBM probe, which
+reads -1.0 on healthy-but-readback-dominated windows); (b) the best
+session on record persists in BENCH_BEST.json (with its calibration)
+and is reported as best_known_* alongside the current window.
 """
 
 import json
@@ -28,7 +36,10 @@ import time
 
 RETRIES = 2
 BACKOFF_S = 20
-ATTEMPT_TIMEOUT_S = 900  # 512^3 Mosaic+XLA compiles are minutes-slow
+# Sized for BOTH stages on a healthy window: 256^3 two-path (stage 1)
+# plus 512^3 two-path (stage 2), each ~2 Mosaic+XLA compiles that are
+# minutes-slow cold; warm runs hit the persistent compile cache.
+ATTEMPT_TIMEOUT_S = 1500
 
 
 def measure(n: int, steps: int, use_pallas, repeats: int = 3) -> float:
@@ -100,6 +111,53 @@ def probe_hbm_gbps() -> float:
     return 2 * n * 4 / (best - rb) / 1e9  # read + write
 
 
+BEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_BEST.json")
+
+# Direct timing gate for the 512^3 run (VERDICT r2 weak item 2: the HBM
+# probe is calibration metadata, not a go/no-go — it reads -1.0 on
+# healthy-but-readback-dominated windows). 512^3 x 20 steps at this rate
+# is ~2 s per timed repeat; below it, a degraded tunnel risks eating the
+# attempt timeout for a number 256^3 already provides.
+GATE_MCELLS_512 = 1500.0
+
+
+def _load_best():
+    try:
+        with open(BEST_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _maybe_update_best(pallas_mc, jnp_mc, n, gbps, device_kind):
+    """Keep BENCH_BEST.json = the best session on record (+calibration)."""
+    best = _load_best()
+    cur = max(pallas_mc, jnp_mc)
+    try:
+        best_val = float(best.get("best_known_mcells", 0)) if best else 0.0
+    except (TypeError, ValueError):
+        best_val = 0.0  # malformed record: overwrite with a fresh one
+    if best is not None and cur <= best_val:
+        return best
+    new = {
+        "comment": (best or {}).get("comment", ""),
+        "best_known_mcells": round(cur, 1),
+        "n": n,
+        "path": "pallas" if pallas_mc >= jnp_mc else "jnp",
+        "jnp_mcells": round(jnp_mc, 1),
+        "hbm_probe_gbps": gbps,
+        "session": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "device_kind": device_kind,
+    }
+    try:
+        with open(BEST_PATH, "w") as f:
+            json.dump(new, f, indent=1)
+    except Exception:
+        pass
+    return new
+
+
 def run_measurement() -> None:
     """Child-process entry: measure both paths, print the one JSON line."""
     import jax
@@ -116,26 +174,35 @@ def run_measurement() -> None:
 
     platform = jax.default_backend()
     on_tpu = platform in ("tpu", "axon")
+    device_kind = jax.devices()[0].device_kind
     try:
         gbps = round(probe_hbm_gbps(), 1) if on_tpu else 0.0
     except Exception:
         gbps = -1.0
-    # The tunneled chip throttles ~20x between sessions (BASELINE.md).
-    # On a degraded tunnel a 512^3 two-path measurement can outlast the
-    # attempt timeout and record NOTHING — drop to 256^3 so the driver
-    # always gets a number, with the calibration making the context
-    # explicit. An UNKNOWN calibration (probe failed / unreliable) also
-    # takes the safe size: a modest number beats a timeout.
+    # Stage 1: 256^3 both paths — always completes, always yields a
+    # number (the tunneled chip throttles ~20x between sessions).
     if on_tpu:
-        n, steps = (512, 20) if gbps >= 50.0 else (256, 10)
+        n, steps = 256, 10
     else:
         n, steps = 64, 10
     jnp_mc = measure(n, steps, use_pallas=False)
     pallas_mc = measure(n, steps, use_pallas=True) if on_tpu else 0.0
+    # Stage 2: the 256^3 pallas timing itself is the 512^3 go/no-go —
+    # a direct measurement of THIS window's speed, unlike the HBM probe.
+    # A mid-stage failure (tunnel degrading, OOM) must not discard the
+    # stage-1 numbers already in hand.
+    if on_tpu and pallas_mc >= GATE_MCELLS_512:
+        try:
+            jnp_512 = measure(512, 20, use_pallas=False)
+            pallas_512 = measure(512, 20, use_pallas=True)
+            n, jnp_mc, pallas_mc = 512, jnp_512, pallas_512
+        except Exception:
+            pass  # report the completed 256^3 measurements
     mcells = max(jnp_mc, pallas_mc)
-    print(json.dumps({
-        "metric": f"Mcells/s/chip (3D Yee + CPML, {n}^3, "
-                  f"{jax.devices()[0].device_kind})",
+    best = _maybe_update_best(pallas_mc, jnp_mc, n, gbps, device_kind) \
+        if on_tpu else None
+    out = {
+        "metric": f"Mcells/s/chip (3D Yee + CPML, {n}^3, {device_kind})",
         "value": round(mcells, 1),
         "unit": "Mcells/s",
         "vs_baseline": round(mcells / 1e4, 4),
@@ -143,7 +210,13 @@ def run_measurement() -> None:
         "jnp_mcells": round(jnp_mc, 1),
         "hbm_probe_gbps": gbps,
         "platform": platform,
-    }), flush=True)
+    }
+    if best is not None:
+        out["best_known_mcells"] = best.get("best_known_mcells")
+        out["best_known_n"] = best.get("n")
+        out["best_known_hbm_probe_gbps"] = best.get("hbm_probe_gbps")
+        out["best_known_session"] = best.get("session")
+    print(json.dumps(out), flush=True)
 
 
 def main() -> None:

@@ -555,8 +555,13 @@ def gather_ghosts(src: Dict[str, jnp.ndarray],
 
 def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
                 src: Dict[str, jnp.ndarray], psi_x: Dict[str, jnp.ndarray],
-                coeffs, slabs: Dict[int, int]):
+                coeffs, slabs: Dict[int, int], collect=None):
     """Apply the axis-0 CPML psi recursion + delta onto the kernel output.
+
+    ``collect``, when a list, receives the APPLIED field deltas as thin
+    patches (comp, axis=0, start, delta_array) — the single-pass fused
+    kernel (ops/pallas_fused.py) consumes them to correct the H update
+    it computed from the pre-patch E.
 
     The kernel computed plain s*dfa for axis-0 curl terms; the exact CPML
     term differs only on the two x slabs by s*((ik-1)*dfa + psi'). Patch
@@ -625,10 +630,17 @@ def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
                         dl = dl * w.reshape(shape)
                         dh = dh * w.reshape(shape)
             arr = new_fields[c]
-            arr = arr.at[:m].add((sign * cb_lo * dl).astype(arr.dtype))
-            arr = arr.at[n1 - m:].add(
-                (sign * cb_hi * dh).astype(arr.dtype))
+            add_lo = (sign * cb_lo * dl).astype(arr.dtype)
+            add_hi = (sign * cb_hi * dh).astype(arr.dtype)
+            arr = arr.at[:m].add(add_lo)
+            arr = arr.at[n1 - m:].add(add_hi)
             new_fields[c] = arr
+            if collect is not None:
+                shape = arr.shape
+                collect.append((c, 0, 0, jnp.broadcast_to(
+                    add_lo, (m, shape[1], shape[2]))))
+                collect.append((c, 0, n1 - m, jnp.broadcast_to(
+                    add_hi, (m, shape[1], shape[2]))))
     return new_fields, new_psi
 
 
@@ -732,8 +744,14 @@ def _plane_coef(static, cb, axis: int, plane: int, coeffs):
 
 
 def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
-               coeffs, inc) -> Dict[str, jnp.ndarray]:
-    """Add the TFSF face corrections onto the kernel output planes."""
+               coeffs, inc, collect=None) -> Dict[str, jnp.ndarray]:
+    """Add the TFSF face corrections onto the kernel output planes.
+
+    ``collect`` (list or None): receives the applied deltas as
+    (comp, axis, plane, 3D one-plane array) patches — see x_slab_post.
+    Only valid on an unsharded topology (the fused E+H path's scope);
+    the two-pass path passes None.
+    """
     setup = static.tfsf_setup
     mode = static.mode
     upd = mode.e_components if family == "E" else mode.h_components
@@ -761,14 +779,23 @@ def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
                         shp[a2] = w.shape[0]
                         t2 = t2 * jnp.squeeze(
                             w.reshape(shp), axis=axis)
-            arr = _plane_add(static, arr, axis, plane,
-                             (sign * scale * t2).astype(arr.dtype), coeffs)
+            val = (sign * scale * t2).astype(arr.dtype)
+            arr = _plane_add(static, arr, axis, plane, val, coeffs)
+            if collect is not None:
+                pshape = list(arr.shape)
+                pshape[axis] = 1
+                collect.append((c, axis, plane, jnp.broadcast_to(
+                    jnp.expand_dims(val, axis), pshape)))
         out[c] = arr
     return out
 
 
-def point_source_patch(static, fields, coeffs, t):
-    """Soft point source as a single-cell add, ownership-gated per shard."""
+def point_source_patch(static, fields, coeffs, t, collect=None):
+    """Soft point source as a single-cell add, ownership-gated per shard.
+
+    ``collect`` (unsharded only): receives the applied delta as a
+    one-x-plane patch with a single nonzero cell.
+    """
     ps = static.cfg.point_source
     c = ps.component
     if c not in fields:
@@ -791,8 +818,12 @@ def point_source_patch(static, fields, coeffs, t):
     val = ps.amplitude * scale * wf
     if own is not None:
         val = jnp.where(own, val, 0.0)
-    return dict(fields, **{c: arr.at[tuple(idxs)].add(
-        val.astype(arr.dtype))})
+    val = val.astype(arr.dtype)
+    if collect is not None:
+        plane = jnp.zeros((1,) + arr.shape[1:], arr.dtype)
+        plane = plane.at[0, idxs[1], idxs[2]].add(val)
+        collect.append((c, 0, ps.position[0], plane))
+    return dict(fields, **{c: arr.at[tuple(idxs)].add(val)})
 
 
 # ---------------------------------------------------------------------------

@@ -1,4 +1,4 @@
-"""Single-pass fused E+H Pallas kernel (experimental subset).
+"""Single-pass fused E+H Pallas kernel.
 
 The two-pass kernels (ops/pallas3d.py) move ~18 field volumes per step
 (72 B/cell f32); fusing both family updates into ONE pass cuts that to
@@ -9,11 +9,15 @@ forward x-difference needs new_E one plane ahead — never waits on a
 neighbor tile.
 
 Scope (everything else falls back to the two-pass kernels): 3D, real
-f32/bf16 storage, UNSHARDED, CPML only on y/z axes (slab psi in-kernel),
-Drude J/K allowed, NO TFSF and NO point source. The excluded features
-are exactly the jnp post-passes that modify E after the kernel — the H
-update would then need curl-of-patch corrections (the round-3 work item
-in docs/PERFORMANCE.md); this subset needs no post-pass at all.
+f32/bf16 storage, UNSHARDED, slab-fitting CPML on any axes, Drude J/K,
+TFSF, point source. The post-kernel E modifications (x-slab CPML
+deltas, TFSF face corrections, point source) are thin plane patches;
+the kernel's H update — computed from the PRE-patch E — is corrected
+afterwards by the curl of those patches (``apply_patch_h_corrections``):
+every patch contributes forward differences along each curl axis, all
+plane-local, so the correction traffic is O(slab/tfsf planes), not a
+full pass. The H-side x-slab CPML post-pass then runs on the CORRECTED
+E (exact by construction), mirroring ops/pallas3d's two-pass ordering.
 
 The extra plane needs one-plane "forward halos" of everything the E
 update reads there: old E, psi_E, J, and any 3D E-side coefficient
@@ -48,11 +52,155 @@ def eligible(static, mesh_axes=None) -> bool:
         return False
     if mesh_axes and any(v is not None for v in mesh_axes.values()):
         return False
-    if static.tfsf_setup is not None or static.cfg.point_source.enabled:
-        return False
-    if 0 in static.pml_axes:
-        return False
     return True
+
+
+def _shift_lo(v: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """v shifted one plane toward lo along axis, zero-filled at hi."""
+    pad = [(0, 0)] * v.ndim
+    pad[axis] = (0, 1)
+    return jnp.pad(lax.slice_in_dim(v, 1, v.shape[axis], axis=axis),
+                   pad)
+
+
+def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
+                              slabs):
+    """Correct the kernel's H update for post-kernel E patches.
+
+    The kernel computed H from E' (pre-patch). The exact H uses
+    E = E' + sum(patches); since the update is linear, the fix is
+    dH_c = -db_c * sum_terms s * F_a(D_a(dE_d)/dx), applied at the
+    patches' planes only. F_a encodes the CPML handling the kernel used
+    for axis a:
+
+      * a == 0 ("post" axis): the kernel term was the plain curl, so
+        F = identity — the x-slab psi delta itself is added later by
+        x_slab_post over the corrected E.
+      * a in slabs (in-kernel slab psi): term = s*(ik*dfa + psi') with
+        psi' = b*psi + c*dfa, so F = (ik + c) at the patch planes, and
+        the stored psi' needs +c * D_a(dE)/dx at the slab overlap.
+      * else: plain curl, F = identity.
+
+    ``patches``: list of (e_comp, axis b, start, delta) with delta a 3D
+    array spanning `k` planes along b and full extents elsewhere.
+    Unsharded topology only (the fused path's scope).
+    """
+    mode = static.mode
+    inv_dx = 1.0 / static.dx
+    cdt = static.compute_dtype
+    out_H = dict(new_H)
+    out_psi = dict(psi_H)
+
+    def slab_f(a: int, lo: int, hi: int) -> jnp.ndarray:
+        """F = ik + c at ABSOLUTE planes [lo, hi) of axis a, from the
+        FULL-length "h" profiles (ik=1, c=0 outside the absorbing
+        region, so F is the identity exactly where the kernel used the
+        plain curl)."""
+        v = (coeffs[f"pml_ikh_{AXES[a]}"]
+             + coeffs[f"pml_ch_{AXES[a]}"])[lo:hi]
+        shape = [1, 1, 1]
+        shape[a] = hi - lo
+        return v.reshape(shape)
+
+    for c in mode.h_components:
+        h_arr = out_H[c]
+        db = coeffs[f"db_{c}"]
+        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+            d = "E" + AXES[d_axis]
+            if d not in mode.e_components:
+                continue
+            for (pc, b, start, delta) in patches:
+                if pc != d:
+                    continue
+                delta = delta.astype(cdt)
+                k = delta.shape[b]
+                n_a = static.grid_shape[a]
+                if a == b:
+                    # forward diff along the patch normal: k+1 planes
+                    # starting at start-1 (zero ghost beyond the patch)
+                    pad = [(0, 0)] * 3
+                    pad[a] = (1, 1)
+                    vpad = jnp.pad(delta, pad)
+                    w = (lax.slice_in_dim(vpad, 1, k + 2, axis=a)
+                         - lax.slice_in_dim(vpad, 0, k + 1, axis=a)) \
+                        * inv_dx
+                    pstart = start - 1
+                    lo_clip = max(0, -pstart)
+                    hi_clip = min(k + 1, n_a - pstart)
+                    if hi_clip <= lo_clip:
+                        continue
+                    w = lax.slice_in_dim(w, lo_clip, hi_clip, axis=a)
+                    pstart += lo_clip
+                    plen = hi_clip - lo_clip
+                else:
+                    # in-patch forward diff along a (zero ghost at the
+                    # global hi edge — the kernel's PEC convention)
+                    w = (_shift_lo(delta, a) - delta) * inv_dx
+                    pstart, plen = start, k
+
+                # position of the correction along the patch-extent axis
+                pa = a if a == b else b
+                sl = [slice(None)] * 3
+                sl[pa] = slice(pstart, pstart + plen)
+                sl = tuple(sl)
+
+                if a in slabs and a != 0:
+                    if a == b:
+                        dacc = s * slab_f(a, pstart, pstart + plen) * w
+                    else:
+                        dacc = s * slab_f(a, 0, n_a) * w
+                    # stored psi' correction at the slab overlap
+                    key = f"{c}_{AXES[a]}"
+                    m = slabs[a]
+                    ca_prof = coeffs[f"pml_slab_ch_{AXES[a]}"]
+                    psi_arr = out_psi[key]
+                    if a == b:
+                        # patch planes [pstart, pstart+plen) vs slabs
+                        # [0, m) and [n_a-m, n_a) -> compact [0,m)/[m,2m)
+                        for (s_lo, s_hi, c_off) in ((0, m, 0),
+                                                    (n_a - m, n_a, m)):
+                            o_lo = max(pstart, s_lo)
+                            o_hi = min(pstart + plen, s_hi)
+                            if o_hi <= o_lo:
+                                continue
+                            wsl = [slice(None)] * 3
+                            wsl[a] = slice(o_lo - pstart, o_hi - pstart)
+                            psl = [slice(None)] * 3
+                            psl[a] = slice(c_off + o_lo - s_lo,
+                                           c_off + o_hi - s_lo)
+                            cp = ca_prof[c_off + o_lo - s_lo:
+                                         c_off + o_hi - s_lo]
+                            shape = [1, 1, 1]
+                            shape[a] = o_hi - o_lo
+                            psi_arr = psi_arr.at[tuple(psl)].add(
+                                cp.reshape(shape) * w[tuple(wsl)])
+                    else:
+                        # w spans full a; slice its slab planes, add at
+                        # the patch's b-location in the compact array
+                        wsl_lo = [slice(None)] * 3
+                        wsl_lo[a] = slice(0, m)
+                        wsl_hi = [slice(None)] * 3
+                        wsl_hi[a] = slice(n_a - m, n_a)
+                        shape = [1, 1, 1]
+                        shape[a] = m
+                        add = jnp.concatenate(
+                            [ca_prof[:m].reshape(shape)
+                             * w[tuple(wsl_lo)],
+                             ca_prof[m:].reshape(shape)
+                             * w[tuple(wsl_hi)]], axis=a)
+                        bsl = [slice(None)] * 3
+                        bsl[b] = slice(pstart, pstart + plen)
+                        psi_arr = psi_arr.at[tuple(bsl)].add(add)
+                    out_psi[key] = psi_arr
+                else:
+                    # plain curl term (x "post" axis or no PML on a)
+                    dacc = s * w
+
+                db_sl = db[sl] if jnp.ndim(db) == 3 else db
+                h_arr = h_arr.at[sl].add(
+                    (-db_sl * dacc).astype(h_arr.dtype))
+        out_H[c] = h_arr
+    return out_H, out_psi
 
 
 def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
@@ -62,12 +210,15 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
     if not eligible(static, mesh_axes):
         return None
     slabs = solver_mod.slab_axes(static)
-    # y/z PML must be slab-compacted (thin grids fall back)
+    # every PML axis must be slab-compacted (thin grids fall back):
+    # y/z slabs run in-kernel, the x slab via the jnp post-pass
     for a in static.pml_axes:
         if a not in slabs:
             return None
     np_coeffs = solver_mod.build_coeffs(static)
     interpret = jax.default_backend() not in ("tpu", "axon")
+    setup = static.tfsf_setup
+    x_pml = 0 in static.pml_axes
 
     mode = static.mode
     n1, n2, n3 = static.grid_shape
@@ -88,10 +239,13 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
             out.append((a, d, s))
         return out
 
+    # in-kernel (y/z) psi only; axis-0 psi lives in the x_slab_post pass
     psi_e_names = [f"{c}_{AXES[a]}" for c in e_comps
-                   for (a, d, s) in terms_of(c, "E") if a in slabs]
+                   for (a, d, s) in terms_of(c, "E")
+                   if a in slabs and a != 0]
     psi_h_names = [f"{c}_{AXES[a]}" for c in h_comps
-                   for (a, d, s) in terms_of(c, "H") if a in slabs]
+                   for (a, d, s) in terms_of(c, "H")
+                   if a in slabs and a != 0]
 
     pairs_e = ["ca", "cb"] + (["kj", "bj"] if drude_e else [])
     pairs_h = ["da", "db"] + (["km", "bm"] if drude_m else [])
@@ -107,10 +261,12 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
     arr_h = [k for k, v in coeff_is_array.items()
              if v and k.split("_")[0] in pairs_h]
 
-    # CPML profile vectors per family tag and slab axis
+    # CPML profile vectors per family tag and in-kernel slab axis
     prof_specs: List[Tuple[str, str, int]] = []   # (ref, coeffs key, axis)
     for tag in ("e", "h"):
         for a in sorted(slabs):
+            if a == 0:
+                continue
             for p in ("b", "c", "ik"):
                 prof_specs.append((f"pf_{p}{tag}_{AXES[a]}",
                                    f"pml_slab_{p}{tag}_{AXES[a]}", a))
@@ -458,7 +614,15 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
         s[a] = v.shape[0]
         return v.astype(fdt).reshape(s)
 
+    from fdtd3d_tpu.ops import pallas3d
+    from fdtd3d_tpu.ops import tfsf as tfsf_mod
+
     def step(state, coeffs):
+        t = state["t"]
+        new_state = dict(state)
+        if setup is not None:
+            new_state["inc"] = tfsf_mod.advance_einc(
+                state["inc"], coeffs, t, static.dt, static.omega, setup)
         args = [state["E"][c] for c in e_comps]
         args += [state["E"][c] for c in e_comps]       # extra (same array)
         args += [state["H"][c] for c in h_comps]
@@ -479,17 +643,18 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
         args += [coeffs[k] for k in arr_h]
         outs = call(*args)
         p = 0
-        new_state = dict(state)
-        new_state["E"] = {c: outs[p + j] for j, c in enumerate(e_comps)}
+        new_E = {c: outs[p + j] for j, c in enumerate(e_comps)}
         p += ne
-        new_state["H"] = {c: outs[p + j] for j, c in enumerate(h_comps)}
+        new_H = {c: outs[p + j] for j, c in enumerate(h_comps)}
         p += nh
+        psi_E = dict(state.get("psi_E", {}))
+        psi_H = dict(state.get("psi_H", {}))
         if psi_e_names or psi_h_names:
-            new_state["psi_E"] = {nm: outs[p + j]
-                                  for j, nm in enumerate(psi_e_names)}
+            psi_E.update({nm: outs[p + j]
+                          for j, nm in enumerate(psi_e_names)})
             p += npe
-            new_state["psi_H"] = {nm: outs[p + j]
-                                  for j, nm in enumerate(psi_h_names)}
+            psi_H.update({nm: outs[p + j]
+                          for j, nm in enumerate(psi_h_names)})
             p += nph
         if drude_e:
             new_state["J"] = {c: outs[p + j]
@@ -499,7 +664,46 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
             new_state["K"] = {c: outs[p + j]
                               for j, c in enumerate(h_comps)}
             p += nh
-        new_state["t"] = state["t"] + 1
+
+        # ---- E post-passes, collecting the applied thin patches ------
+        patches: list = []
+        if x_pml:
+            px = {k: v for k, v in psi_E.items() if k.endswith("_x")}
+            new_E, px_new = pallas3d.x_slab_post(
+                static, "E", new_E, state["H"], px, coeffs, slabs,
+                collect=patches)
+            psi_E.update(px_new)
+        if setup is not None:
+            new_E = pallas3d.tfsf_patch(static, "E", new_E, coeffs,
+                                        new_state["inc"],
+                                        collect=patches)
+        if static.cfg.point_source.enabled:
+            new_E = pallas3d.point_source_patch(static, new_E, coeffs, t,
+                                                collect=patches)
+
+        # ---- H corrections: curl of the E patches --------------------
+        if patches:
+            new_H, psi_H = apply_patch_h_corrections(
+                static, new_H, psi_H, patches, coeffs, slabs)
+        if setup is not None:
+            new_state["inc"] = tfsf_mod.advance_hinc(
+                new_state["inc"], coeffs, setup)
+        if x_pml:
+            px = {k: v for k, v in psi_H.items() if k.endswith("_x")}
+            new_H, px_new = pallas3d.x_slab_post(
+                static, "H", new_H, new_E, px, coeffs, slabs)
+            psi_H.update(px_new)
+        if setup is not None:
+            # H-side consistency corrections (sampling Einc at t^{n+1})
+            new_H = pallas3d.tfsf_patch(static, "H", new_H, coeffs,
+                                        new_state["inc"])
+
+        new_state["E"] = new_E
+        new_state["H"] = new_H
+        if psi_E or psi_H:
+            new_state["psi_E"] = psi_E
+            new_state["psi_H"] = psi_H
+        new_state["t"] = t + 1
         return new_state
 
     step.diag = {"tile": {"EH": T},

@@ -119,9 +119,9 @@ def test_save_cmd_to_file_roundtrip(tmp_path):
 
 
 @pytest.mark.parametrize("argv,want_kind", [
-    # 3D + pallas forced (interpret mode on CPU) -> two-pass kernels
+    # 3D + pallas forced (interpret mode on CPU) -> single-pass kernel
     (["--3d", "--same-size", "16", "--time-steps", "2", "--use-pml",
-      "--pml-size", "2", "--use-pallas", "on"], "pallas"),
+      "--pml-size", "2", "--use-pallas", "on"], "pallas_fused"),
     # pallas off -> jnp, stated explicitly at startup
     (["--3d", "--same-size", "16", "--time-steps", "2",
       "--use-pallas", "off"], "jnp"),
@@ -138,7 +138,7 @@ def test_cli_prints_engaged_step_kind(argv, want_kind):
     assert kind_lines, f"no step_kind line printed\n{out}"
     assert kind_lines[0].split()[0] == f"step_kind={want_kind}", \
         kind_lines[0]
-    if want_kind == "pallas":
+    if want_kind.startswith("pallas"):
         assert "tile=" in kind_lines[0] and "vmem_block=" in kind_lines[0]
 
 
