@@ -125,6 +125,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="first sampling step (default: half the run)")
     g.add_argument("--ntff-margin", type=int, default=2,
                    help="box margin inward from the PML inner face, cells")
+    g.add_argument("--ntff-box-lo", metavar="X,Y,Z", default=None,
+                   help="explicit box lower corner (overrides margin)")
+    g.add_argument("--ntff-box-hi", metavar="X,Y,Z", default=None,
+                   help="explicit box upper corner (overrides margin)")
     g.add_argument("--ntff-theta-steps", type=int, default=19)
     g.add_argument("--ntff-phi-steps", type=int, default=24)
 
@@ -206,6 +210,20 @@ def read_cmd_file(path: str) -> List[str]:
             if line:
                 argv.extend(shlex.split(line))
     return argv
+
+
+def _parse_xyz(val):
+    """'X,Y,Z' -> (int, int, int), or None passthrough."""
+    if val is None:
+        return None
+    parts = [p for p in str(val).replace("x", ",").split(",") if p]
+    try:
+        triple = tuple(int(p) for p in parts)
+    except ValueError:
+        triple = ()
+    if len(triple) != 3:
+        raise SystemExit(f"expected X,Y,Z integer triple, got {val!r}")
+    return triple
 
 
 def _resolve_scheme(args) -> str:
@@ -307,7 +325,10 @@ def args_to_config(args) -> SimConfig:
         ntff=NtffConfig(
             enabled=args.ntff, frequency=args.ntff_frequency,
             every=args.ntff_every, start=args.ntff_start,
-            margin=args.ntff_margin, theta_steps=args.ntff_theta_steps,
+            margin=args.ntff_margin,
+            box_lo=_parse_xyz(args.ntff_box_lo),
+            box_hi=_parse_xyz(args.ntff_box_hi),
+            theta_steps=args.ntff_theta_steps,
             phi_steps=args.ntff_phi_steps),
         use_pallas={"auto": None, "on": True, "off": False}[args.use_pallas],
         require_pallas=args.require_pallas,
@@ -408,6 +429,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.dry_run:
         from fdtd3d_tpu import plan as plan_mod
         cfg = args_to_config(args)
+        if cfg.parallel.topology == "auto" and not args.num_devices:
+            # a pod-sizing flag that silently plans for 1 chip misleads
+            # (ADVICE r2) — auto needs the intended device count
+            raise SystemExit(
+                "--dry-run with --topology auto needs --num-devices N "
+                "(the plan depends on the chip count you are sizing for)")
         p_ = plan_mod.plan(cfg, n_devices=args.num_devices or 1)
         print(f"dry run: scheme={cfg.scheme} global={cfg.grid_shape} "
               f"steps={cfg.time_steps} dtype={cfg.dtype}")
@@ -454,15 +481,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ntff_col = None
     ntff_every = ntff_start = 0
     if cfg.ntff.enabled:
-        import jax
-        if jax.process_count() > 1:
-            raise SystemExit(
-                "--ntff is single-process only: face sampling slices "
-                "host-addressable arrays; run NTFF post-processing on a "
-                "single process")
+        # Multi-process-capable: sampling accumulates device-side and is
+        # collective (every rank runs on_interval); the pattern is
+        # evaluated from the allgathered accumulators on rank 0.
         from fdtd3d_tpu.ntff import NtffCollector
         freq, ntff_every, ntff_start = resolve_ntff_cadence(cfg)
-        ntff_col = NtffCollector(sim, frequency=freq,
+        box = None
+        if cfg.ntff.box_lo is not None or cfg.ntff.box_hi is not None:
+            if cfg.ntff.box_lo is None or cfg.ntff.box_hi is None:
+                raise SystemExit(
+                    "--ntff-box-lo and --ntff-box-hi must be given "
+                    "together")
+            box = (cfg.ntff.box_lo, cfg.ntff.box_hi)
+        ntff_col = NtffCollector(sim, frequency=freq, box=box,
                                  margin=cfg.ntff.margin)
 
     t0 = time.time()
@@ -480,13 +511,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         if ntff_col is not None and s.t >= ntff_start and \
                 s.t % ntff_every == 0:
             ntff_col.sample()
-        if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
-            import jax
-            norms = diag.field_norms(s)   # collective: ALL ranks
-            if jax.process_index() == 0:
-                txt = " ".join(f"{k}={v:.4e}"
-                               for k, v in sorted(norms.items()))
-                print(f"[t={s.t}] {txt}")
+        # metrics BEFORE norms: when both cadences land on one step,
+        # field_norms reuses the full metrics pass via diag's per-step
+        # cache instead of launching its own max reductions.
         if cfg.output.metrics_every and \
                 s.t % cfg.output.metrics_every == 0:
             import jax
@@ -497,6 +524,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 with open(os.path.join(cfg.output.save_dir,
                                        "metrics.jsonl"), "a") as f:
                     f.write(json.dumps(rec) + "\n")
+        if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
+            import jax
+            norms = diag.field_norms(s)   # collective: ALL ranks
+            if jax.process_index() == 0:
+                txt = " ".join(f"{k}={v:.4e}"
+                               for k, v in sorted(norms.items()))
+                print(f"[t={s.t}] {txt}")
         if cfg.output.save_res and s.t % cfg.output.save_res == 0:
             io.write_outputs(s, s.t)
         if cfg.output.checkpoint_every and \
@@ -524,9 +558,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         sim.block_until_ready()
     if ntff_col is not None:
         if ntff_col.n_samples > 0:
-            path = write_ntff_pattern(ntff_col, cfg)
-            if args.log_level >= 1:
-                print(f"ntff: {ntff_col.n_samples} samples -> {path}")
+            import jax
+            _ = ntff_col.acc  # collective gather: ALL ranks participate
+            if jax.process_index() == 0:
+                path = write_ntff_pattern(ntff_col, cfg)
+                if args.log_level >= 1:
+                    print(f"ntff: {ntff_col.n_samples} samples -> {path}")
         else:
             print(f"ntff: WARNING: no samples collected (first sample at "
                   f"step {ntff_start}, every {ntff_every}, run ends at "

@@ -176,6 +176,10 @@ class NtffConfig:
     every: Optional[int] = None
     start: Optional[int] = None
     margin: int = 2
+    # Explicit box override (global cell coords, inclusive): when set,
+    # wins over `margin` (the collector's `box=` argument).
+    box_lo: Optional[Tuple[int, int, int]] = None
+    box_hi: Optional[Tuple[int, int, int]] = None
     theta_steps: int = 19          # pattern grid: theta in [0, 180]
     phi_steps: int = 24            # phi in [0, 360)
 

@@ -2,14 +2,22 @@
 
 Reference parity: the printed L2/Linf error norms vs exact-solution
 callbacks and per-interval norm prints (SURVEY.md §2 "Exact solutions /
-callbacks", §5.5 metrics/observability). Norms are computed on GLOBAL
-arrays outside shard_map — XLA inserts the reduction collectives.
+callbacks", §5.5 metrics/observability).
+
+Everything per-interval is computed DEVICE-SIDE on the (possibly
+sharded) state arrays by one jitted function cached per Simulation —
+XLA inserts the reduction collectives, and the only host traffic per
+record is the dict of scalars (VERDICT r2 item 5: the previous
+implementation gathered full E components to host per interval, which
+is multi-GB at 512^3+). In multi-process runs every rank must call
+these functions (the reductions are collective).
 """
 
 from __future__ import annotations
 
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -18,38 +26,115 @@ from fdtd3d_tpu.layout import component_axis
 
 
 def _energy_weights(sim):
-    """eps/mu weights per component, built once and cached on the sim."""
+    """eps/mu weight arrays per component, device-resident and sharded
+    like their field, built once and cached on the sim."""
     cache = getattr(sim, "_energy_weights", None)
     if cache is not None:
         return cache
     cfg, mode = sim.cfg, sim.static.mode
     mat = cfg.materials
     cache = {}
-    for c in mode.e_components:
-        cache[c] = materials.scalar_or_grid(
-            c, sim.static.grid_shape, mode.active_axes, mat.eps,
-            mat.eps_sphere, mat.eps_file)
-    for c in mode.h_components:
-        cache[c] = materials.scalar_or_grid(
-            c, sim.static.grid_shape, mode.active_axes, mat.mu,
-            mat.mu_sphere, mat.mu_file)
+    for grp, comps, val, sph, fil in (
+            ("E", mode.e_components, mat.eps, mat.eps_sphere, mat.eps_file),
+            ("H", mode.h_components, mat.mu, mat.mu_sphere, mat.mu_file)):
+        for c in comps:
+            w = materials.scalar_or_grid(c, sim.static.grid_shape,
+                                         mode.active_axes, val, sph, fil)
+            if np.ndim(w) == 0:
+                cache[c] = float(w)
+            else:
+                w = np.asarray(w, np.float32)
+                field = sim.state[grp][c]
+                sharding = getattr(field, "sharding", None)
+                cache[c] = (jax.device_put(w, sharding)
+                            if sharding is not None else jnp.asarray(w))
     sim._energy_weights = cache
     return cache
 
 
+def _device_metrics(sim) -> Dict[str, jnp.ndarray]:
+    """One jitted pass computing every per-interval metric on device.
+
+    Cached per step: when --norms-every and --metrics-every land on the
+    same step (common — the run interval is the gcd of all cadences),
+    the volume pass runs once and both records derive from it.
+    """
+    cache = getattr(sim, "_metrics_cache", None)
+    t_now = sim.t
+    if cache is not None and cache[0] == t_now:
+        return cache[1]
+    fn = getattr(sim, "_metrics_device_fn", None)
+    if fn is None:
+        mode = sim.static.mode
+        cell = float(sim.cfg.dx ** mode.ndim)
+        inv_dx = 1.0 / sim.cfg.dx
+        weights = _energy_weights(sim)
+        e_comps = tuple(mode.e_components)
+        h_comps = tuple(mode.h_components)
+        active = tuple(mode.active_axes)
+        cdt = sim.static.compute_dtype
+
+        def compute(state):
+            out = {}
+            energy = jnp.zeros((), jnp.float32)
+            for grp, comps, c0 in (("E", e_comps, physics.EPS0),
+                                   ("H", h_comps, physics.MU0)):
+                for c in comps:
+                    v = state[grp][c]
+                    av = jnp.abs(v.astype(cdt) if v.dtype != cdt else v)
+                    out[f"max_{c}"] = jnp.max(av)
+                    energy = energy + (0.5 * c0 * cell) * jnp.sum(
+                        weights[c] * jnp.square(av)).astype(jnp.float32)
+            out["energy"] = energy
+            # Discrete divergence residual of E (charge-free health
+            # metric): the Yee update conserves the discrete divergence
+            # of D exactly in source-free uniform regions; growth flags
+            # a stencil/coefficient bug or an unaccounted source. The
+            # backward difference of each E component along its own
+            # axis lands on integer cells. PEC walls carry surface
+            # charge (nonzero div there is physics) — measured on
+            # interior cells only.
+            div = None
+            e_scale = jnp.zeros((), jnp.float32)
+            for c in e_comps:
+                a = component_axis(c)
+                out_max = out[f"max_{c}"]
+                e_scale = jnp.maximum(e_scale,
+                                      out_max.astype(jnp.float32))
+                if a not in active:
+                    continue
+                arr = state["E"][c].astype(cdt)
+                pad = [(0, 0)] * 3
+                pad[a] = (1, 0)
+                shifted = jnp.pad(
+                    jax.lax.slice_in_dim(arr, 0, arr.shape[a] - 1,
+                                         axis=a), pad)
+                d = (arr - shifted) * inv_dx
+                div = d if div is None else div + d
+            if div is None:
+                out["div_l2"] = jnp.zeros((), jnp.float32)
+                out["div_linf"] = jnp.zeros((), jnp.float32)
+            else:
+                sl = [slice(None)] * 3
+                for a in active:
+                    sl[a] = slice(1, -1)
+                interior = jnp.abs(div[tuple(sl)])
+                out["div_l2"] = jnp.sqrt(
+                    jnp.mean(jnp.square(interior))).astype(jnp.float32)
+                out["div_linf"] = jnp.max(interior).astype(jnp.float32)
+            out["e_scale"] = e_scale
+            return out
+
+        fn = jax.jit(compute)
+        sim._metrics_device_fn = fn
+    out = fn(sim.state)
+    sim._metrics_cache = (t_now, out)
+    return out
+
+
 def em_energy(sim) -> float:
-    """Total electromagnetic field energy, J."""
-    mode = sim.static.mode
-    cell = sim.cfg.dx ** mode.ndim
-    weights = _energy_weights(sim)
-    total = 0.0
-    for c in mode.e_components:
-        total += 0.5 * physics.EPS0 * float(jnp.sum(
-            jnp.asarray(weights[c]) * jnp.abs(sim.state["E"][c]) ** 2)) * cell
-    for c in mode.h_components:
-        total += 0.5 * physics.MU0 * float(jnp.sum(
-            jnp.asarray(weights[c]) * jnp.abs(sim.state["H"][c]) ** 2)) * cell
-    return total
+    """Total electromagnetic field energy, J. Device-side reduction."""
+    return float(jax.device_get(_device_metrics(sim)["energy"]))
 
 
 def error_norms(actual: np.ndarray, expected: np.ndarray) -> Dict[str, float]:
@@ -63,60 +148,49 @@ def error_norms(actual: np.ndarray, expected: np.ndarray) -> Dict[str, float]:
 
 
 def field_norms(sim) -> Dict[str, float]:
-    """max|comp| for every stored field component (cheap health metric)."""
-    out = {}
-    for g in ("E", "H"):
-        for c, v in sim.state[g].items():
-            out[c] = float(jnp.max(jnp.abs(v)))
-    return out
+    """max|comp| for every stored field component (cheap health metric).
+
+    Its own tiny jitted pass (max reductions only) — NOT the full
+    metrics computation; reuses the full pass's result when one was
+    already computed at this step.
+    """
+    cache = getattr(sim, "_metrics_cache", None)
+    if cache is not None and cache[0] == sim.t:
+        dm = jax.device_get(cache[1])
+        return {c: float(dm[f"max_{c}"])
+                for g in ("E", "H") for c in sim.state[g]}
+    fn = getattr(sim, "_norms_device_fn", None)
+    if fn is None:
+        comps = [(g, c) for g in ("E", "H") for c in sim.state[g]]
+
+        def compute(state):
+            return {c: jnp.max(jnp.abs(state[g][c])) for (g, c) in comps}
+
+        fn = jax.jit(compute)
+        sim._norms_device_fn = fn
+    return {c: float(v) for c, v in jax.device_get(fn(sim.state)).items()}
 
 
 def divergence_e(sim) -> Dict[str, float]:
-    """Discrete divergence residual of E (charge-free health metric).
-
-    The Yee update conserves the discrete divergence of D = eps*E exactly
-    in source-free regions (Gauss's law rides along with Ampere's); in
-    uniform-eps regions div E is proportional, and its growth flags a
-    stencil/coefficient bug or an unaccounted source. The backward
-    difference of each E component along its own axis lands on integer
-    cells. Returns absolute L2/Linf of the residual, plus the field scale
-    ("e_scale") the caller can normalize by. Source cells and material
-    interfaces are legitimately nonzero — interpret on uniform
-    source-free runs or track the trend.
-    """
-    mode = sim.static.mode
-    div = None
-    scale = 0.0
-    for c in mode.e_components:
-        a = component_axis(c)
-        arr = sim.field(c)
-        scale = max(scale, float(np.abs(arr).max()))
-        if a not in mode.active_axes:
-            continue
-        d = np.diff(arr, axis=a, prepend=0.0) / sim.cfg.dx
-        div = d if div is None else div + d
-    if div is None:
-        return {"div_l2": 0.0, "div_linf": 0.0, "e_scale": scale}
-    # PEC walls carry surface charge (div E != 0 AT the walls is physics,
-    # not a bug) — measure the residual on interior cells only.
-    sl = [slice(None)] * 3
-    for a in mode.active_axes:
-        sl[a] = slice(1, -1)
-    div = np.abs(div[tuple(sl)])  # magnitude: correct for complex fields
-    return {"div_l2": float(np.sqrt(np.mean(div ** 2))),
-            "div_linf": float(div.max()),
-            "e_scale": scale}
+    """Discrete divergence residual of E — see _device_metrics for the
+    physics note. Returns absolute L2/Linf of the interior residual plus
+    the field scale ("e_scale") the caller can normalize by."""
+    dm = jax.device_get(_device_metrics(sim))
+    return {"div_l2": float(dm["div_l2"]),
+            "div_linf": float(dm["div_linf"]),
+            "e_scale": float(dm["e_scale"])}
 
 
 def metrics(sim) -> Dict[str, float]:
     """Structured per-interval metrics record (SURVEY.md §5.5).
 
     One flat JSON-serializable dict: step, EM energy, per-component
-    max-norms, divergence residual. Consumed by the CLI's
-    --metrics-every JSONL writer and usable directly from the library.
+    max-norms, divergence residual — ONE device computation + ONE small
+    host transfer. Consumed by the CLI's --metrics-every JSONL writer
+    and usable directly from the library.
     """
-    out: Dict[str, float] = {"t": float(sim.t), "energy": em_energy(sim)}
-    for comp, v in field_norms(sim).items():
-        out[f"max_{comp}"] = v
-    out.update(divergence_e(sim))
+    dm = jax.device_get(_device_metrics(sim))
+    out: Dict[str, float] = {"t": float(sim.t)}
+    for k, v in dm.items():
+        out[k] = float(v)
     return out

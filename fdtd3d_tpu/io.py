@@ -211,6 +211,16 @@ def load_bmp(path: str) -> np.ndarray:
     h = abs(h)
     row = w * 3
     stride = row + (4 - row % 4) % 4
+    # Validate the header against the actual file size BEFORE indexing:
+    # a truncated/corrupt file should fail with a clear message, not an
+    # opaque frombuffer error (ADVICE r2).
+    if w <= 0 or h <= 0:
+        raise ValueError(f"{path}: bad BMP dimensions {w}x{h}")
+    if offset + (h - 1) * stride + row > len(data):
+        raise ValueError(
+            f"{path}: truncated BMP ({len(data)} bytes; header claims "
+            f"{w}x{h} 24-bit rows ending at byte "
+            f"{offset + (h - 1) * stride + row})")
     out = np.empty((h, w, 3), dtype=np.uint8)
     for y in range(h):
         src = offset + y * stride

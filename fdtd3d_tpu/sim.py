@@ -203,6 +203,7 @@ class Simulation:
         group = "E" if comp[0] == "E" else "H"
         if comp not in self.state[group]:
             raise KeyError(f"{comp} not active in scheme {self.cfg.scheme}")
+        self._metrics_cache = None  # diag cache keys on t, not contents
         old = self.state[group][comp]
         vnp = np.asarray(np.broadcast_to(value, old.shape),
                          dtype=old.dtype)
@@ -271,6 +272,7 @@ class Simulation:
         import os
 
         from fdtd3d_tpu import io
+        self._metrics_cache = None  # diag cache keys on t, not contents
         if os.path.isdir(path):
             # validate metadata BEFORE the restore so mismatches surface
             # as the friendly guards, not orbax shape errors

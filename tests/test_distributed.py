@@ -46,10 +46,22 @@ cfg = SimConfig(
     parallel=ParallelConfig(topology="auto"))
 sim = Simulation(cfg)
 assert sim.mesh is not None and sim.mesh.devices.size == 2 * nproc
-sim.run()
+# NTFF sampling + device-side metrics are COLLECTIVE (every rank calls
+# them) and must work in multi-process runs (VERDICT r2 item 5).
+from fdtd3d_tpu import diag
+from fdtd3d_tpu.ntff import NtffCollector
+from fdtd3d_tpu import physics
+col = NtffCollector(sim, frequency=physics.C0 / cfg.wavelength, margin=0)
+sim.run(on_interval=lambda s: col.sample(), interval=2)
+met = diag.metrics(sim)
+et, ep = col.far_field(90.0, 0.0)
 ez = sim.field("Ez")   # allgathered: full global array on every process
 import numpy as np
 np.save(os.path.join(outdir, f"ez_{pid}.npy"), np.asarray(ez))
+np.save(os.path.join(outdir, f"ntff_{pid}.npy"),
+        np.array([et, ep], dtype=np.complex128))
+with open(os.path.join(outdir, f"metrics_{pid}.json"), "w") as f:
+    json.dump(met, f)
 print("WORKER_OK", pid)
 """
 
@@ -103,8 +115,25 @@ def test_two_process_run_matches_single_process(tmp_path):
             use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
             drude_sphere=SphereConfig(enabled=True,
                                       center=(8.0, 8.0, 8.0), radius=3.0)))
+    from fdtd3d_tpu import diag, physics
+    from fdtd3d_tpu.ntff import NtffCollector
     ref = Simulation(cfg)
-    ref.run()
+    col = NtffCollector(ref, frequency=physics.C0 / cfg.wavelength,
+                        margin=0)
+    ref.run(on_interval=lambda s: col.sample(), interval=2)
     r = ref.field("Ez")
     scale = np.abs(r).max() + 1e-30
     assert np.abs(ez0 - r).max() < 1e-5 * scale
+
+    # multi-process NTFF + collective metrics match the unsharded run
+    nt0 = np.load(tmp_path / "ntff_0.npy")
+    nt1 = np.load(tmp_path / "ntff_1.npy")
+    assert np.allclose(nt0, nt1), "ranks disagree on the far field"
+    et, ep = col.far_field(90.0, 0.0)
+    ref_ff = np.array([et, ep])
+    ff_scale = np.abs(ref_ff).max() + 1e-30
+    assert np.abs(nt0 - ref_ff).max() < 1e-4 * ff_scale
+    met0 = json.loads((tmp_path / "metrics_0.json").read_text())
+    ref_met = diag.metrics(ref)
+    for k in ("energy", "max_Ez", "div_l2"):
+        assert met0[k] == pytest.approx(ref_met[k], rel=1e-4, abs=1e-30)

@@ -183,6 +183,34 @@ def test_ntff_cli_black_box(tmp_path):
         "pattern not monotone toward the equator"
 
 
+def test_ntff_cli_explicit_box_matches_margin(tmp_path):
+    """--ntff-box-lo/hi override the margin-derived box; an explicit box
+    equal to the margin default must reproduce the same pattern file."""
+    import contextlib
+    import io as _io
+
+    from fdtd3d_tpu import cli
+
+    n = 40
+    base = ["--3d", "--same-size", str(n), "--time-steps", "200",
+            "--courant-factor", "0.5", "--wavelength", "12e-3",
+            "--use-pml", "--pml-size", "7", "--point-source", "Ez",
+            "--ntff", "--ntff-theta-steps", "5", "--ntff-phi-steps", "6"]
+    outs = []
+    # margin 3 -> box lo = 7+3 = 10, hi = 40-1-7-3 = 29
+    for extra in (["--ntff-margin", "3"],
+                  ["--ntff-box-lo", "10,10,10",
+                   "--ntff-box-hi", "29,29,29"]):
+        d = tmp_path / extra[0].strip("-").replace("-", "_")
+        d.mkdir()
+        buf = _io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.main(base + extra + ["--save-dir", str(d)])
+        assert rc == 0, buf.getvalue()
+        outs.append(np.loadtxt(d / "ntff_pattern.txt"))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+
+
 def test_ntff_sharded_matches_unsharded():
     """NTFF face sampling on a sharded sim (single process): the lazy
     global-index slicing must gather the right planes; pattern equals

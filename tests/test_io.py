@@ -112,6 +112,19 @@ def test_bmp_roundtrip_decode(tmp_path):
     np.testing.assert_array_equal(got, rgb)
 
 
+def test_truncated_bmp_raises_clear_error(tmp_path):
+    """A corrupt/truncated BMP must fail with a ValueError naming the
+    file, not an opaque frombuffer error (ADVICE r2)."""
+    import pytest
+    rgb = np.zeros((8, 8, 3), dtype=np.uint8)
+    path = str(tmp_path / "trunc.bmp")
+    full = io._bmp_encode(rgb)
+    with open(path, "wb") as f:
+        f.write(full[:len(full) // 2])
+    with pytest.raises(ValueError, match="trunc.bmp.*truncated"):
+        io.load_bmp(path)
+
+
 def test_material_init_from_bmp(tmp_path):
     """eps loaded from a BMP image: black -> 1.0, white -> --eps."""
     from fdtd3d_tpu.config import MaterialsConfig, SimConfig

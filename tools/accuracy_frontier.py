@@ -1,0 +1,116 @@
+"""Accuracy/throughput frontier: dtype x (rel-err vs f64, Mcells/s).
+
+SURVEY.md "hard parts" item 1 / VERDICT r2 item 4: the reference solver
+is double-precision C++; TPU f64 is emulated and slow. This experiment
+quantifies what each storage/compute dtype actually costs in accuracy on
+BASELINE config #3 (3D vacuum TFSF + CPML) so the 1e-6-rel-err vs
+1e4-Mcells/s tension is a measured tradeoff, not a one-line risk note.
+
+Each dtype runs in a SUBPROCESS (jax_enable_x64 is process-global; an
+f64 run would silently upgrade literals in a later f32 run). The child
+writes final fields + timing to an .npz; the parent compares against
+the f64 reference and prints the frontier table (recorded in
+BASELINE.md).
+
+Usage: python tools/accuracy_frontier.py [--n 128] [--steps 1000]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, sys, time
+import numpy as np
+
+dtype, n, steps, out_path = sys.argv[1], int(sys.argv[2]), \
+    int(sys.argv[3]), sys.argv[4]
+
+import jax
+if dtype == "float64":
+    jax.config.update("jax_enable_x64", True)
+
+from fdtd3d_tpu.config import PmlConfig, SimConfig, TfsfConfig
+from fdtd3d_tpu.sim import Simulation
+
+cfg = SimConfig(
+    scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
+    courant_factor=0.5, wavelength=n * 1e-3 / 4.0, dtype=dtype,
+    pml=PmlConfig(size=(8, 8, 8)),
+    tfsf=TfsfConfig(enabled=True, margin=(6, 6, 6),
+                    angle_teta=30.0, angle_phi=40.0, angle_psi=15.0),
+)
+sim = Simulation(cfg)
+# warm-up chunk compiles; then time the full run fresh
+sim.advance(5)
+sim.block_until_ready()
+t0 = time.perf_counter()
+sim.advance(steps - 5)
+sim.block_until_ready()
+wall = time.perf_counter() - t0
+mcells = (n ** 3) * (steps - 5) / wall / 1e6
+fields = {c: np.asarray(sim.field(c), np.float64)
+          for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz")}
+np.savez(out_path, mcells=mcells, step_kind=sim.step_kind, **fields)
+print(json.dumps({"dtype": dtype, "mcells": round(mcells, 1),
+                  "step_kind": sim.step_kind}), flush=True)
+"""
+
+
+def run_child(dtype, n, steps, out_path):
+    env = dict(os.environ)
+    r = subprocess.run([sys.executable, "-c", CHILD, dtype, str(n),
+                       str(steps), out_path], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=3600)
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout).strip().splitlines()[-5:]
+        raise RuntimeError(f"{dtype} child failed: " + " | ".join(tail))
+    for line in r.stdout.splitlines():
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"{dtype}: no JSON line")
+
+
+def main():
+    import numpy as np
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=1000)
+    ap.add_argument("--dtypes", default="float64,float32,bfloat16")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="acc_frontier_")
+    results = {}
+    for dt in args.dtypes.split(","):
+        out = os.path.join(tmp, f"{dt}.npz")
+        info = run_child(dt, args.n, args.steps, out)
+        info["npz"] = out
+        results[dt] = info
+        print(f"ran {dt}: {info['mcells']} Mcells/s "
+              f"({info['step_kind']})", flush=True)
+
+    ref = np.load(results["float64"]["npz"])
+    comps = ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz")
+    escale = max(np.abs(ref[c]).max() for c in comps[:3])
+    hscale = max(np.abs(ref[c]).max() for c in comps[3:])
+    table = []
+    for dt, info in results.items():
+        got = np.load(info["npz"])
+        rel = max(
+            np.abs(got[c] - ref[c]).max()
+            / (escale if c[0] == "E" else hscale) for c in comps)
+        table.append({"dtype": dt, "rel_err_vs_f64": float(f"{rel:.3e}"),
+                      "mcells": info["mcells"],
+                      "step_kind": info["step_kind"]})
+    print(json.dumps({"n": args.n, "steps": args.steps,
+                      "frontier": table}, indent=1))
+
+
+if __name__ == "__main__":
+    main()
