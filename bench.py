@@ -37,9 +37,11 @@ import time
 RETRIES = 2
 BACKOFF_S = 20
 # Sized for BOTH stages on a healthy window: 256^3 two-path (stage 1)
-# plus 512^3 two-path (stage 2), each ~2 Mosaic+XLA compiles that are
-# minutes-slow cold; warm runs hit the persistent compile cache.
-ATTEMPT_TIMEOUT_S = 1500
+# plus 512^3 two-path (stage 2) plus a possible third 512^3 compile
+# (the raised-VMEM-budget attempt OOMs loudly, then recompiles at the
+# default budget) — up to ~5 Mosaic+XLA compiles that are minutes-slow
+# cold; warm runs hit the persistent compile cache.
+ATTEMPT_TIMEOUT_S = 2400
 
 
 def measure(n: int, steps: int, use_pallas, repeats: int = 3) -> float:
@@ -116,10 +118,15 @@ BEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 # Direct timing gate for the 512^3 run (VERDICT r2 weak item 2: the HBM
 # probe is calibration metadata, not a go/no-go — it reads -1.0 on
-# healthy-but-readback-dominated windows). 512^3 x 20 steps at this rate
-# is ~2 s per timed repeat; below it, a degraded tunnel risks eating the
-# attempt timeout for a number 256^3 already provides.
-GATE_MCELLS_512 = 1500.0
+# healthy-but-readback-dominated windows). The threshold is deliberately
+# LOW: at 256^3 x 10 steps the fixed per-call readback latency dominates
+# and underestimates the chip by up to ~4x (measured same-window:
+# 928 Mcells/s at 256^3 vs 3592 at 512^3, where overheads amortize) —
+# the gate only needs to exclude truly dead windows (<100 Mcells/s)
+# where a 512^3 attempt would eat the timeout. A wall-clock guard on
+# stage 1 backstops mid-session degradation.
+GATE_MCELLS_512 = 600.0
+STAGE1_BUDGET_S = 400.0
 
 
 def _load_best():
@@ -185,16 +192,37 @@ def run_measurement() -> None:
         n, steps = 256, 10
     else:
         n, steps = 64, 10
+    t_stage1 = time.time()
     jnp_mc = measure(n, steps, use_pallas=False)
     pallas_mc = measure(n, steps, use_pallas=True) if on_tpu else 0.0
+    stage1_s = time.time() - t_stage1
     # Stage 2: the 256^3 pallas timing itself is the 512^3 go/no-go —
     # a direct measurement of THIS window's speed, unlike the HBM probe.
     # A mid-stage failure (tunnel degrading, OOM) must not discard the
-    # stage-1 numbers already in hand.
-    if on_tpu and pallas_mc >= GATE_MCELLS_512:
+    # stage-1 numbers already in hand. The raised VMEM budget lets the
+    # two-pass kernels run T=4 at 512^3 (measured 18% faster than the
+    # default budget's T=2); Mosaic VMEM overflow is a loud compile
+    # error, caught here with a default-budget retry.
+    if on_tpu and pallas_mc >= GATE_MCELLS_512 and \
+            stage1_s < STAGE1_BUDGET_S:
         try:
             jnp_512 = measure(512, 20, use_pallas=False)
-            pallas_512 = measure(512, 20, use_pallas=True)
+            user_budget = os.environ.get("FDTD3D_VMEM_BUDGET_MB")
+            os.environ["FDTD3D_VMEM_BUDGET_MB"] = "86"
+            try:
+                pallas_512 = measure(512, 20, use_pallas=True)
+            except Exception:
+                # retry at the caller's own budget (or the default)
+                if user_budget is None:
+                    os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
+                else:
+                    os.environ["FDTD3D_VMEM_BUDGET_MB"] = user_budget
+                pallas_512 = measure(512, 20, use_pallas=True)
+            finally:
+                if user_budget is None:
+                    os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
+                else:
+                    os.environ["FDTD3D_VMEM_BUDGET_MB"] = user_budget
             n, jnp_mc, pallas_mc = 512, jnp_512, pallas_512
         except Exception:
             pass  # report the completed 256^3 measurements

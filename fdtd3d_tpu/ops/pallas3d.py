@@ -94,9 +94,27 @@ def eligible(static, mesh_axes=None) -> bool:
 
 # Mosaic's default scoped-VMEM limit is 16 MiB; v5e/v5p have 128 MiB of
 # physical VMEM. Raise the limit and budget the double-buffered working
-# set well under it (measured: 256^3 at T=8 needs ~38 MiB).
+# set well under it (measured: 256^3 at T=8 needs ~38 MiB). The default
+# budget is conservative because Mosaic's own scratch (kernel
+# temporaries) measured 40-47 MiB on top of the blocks and does not
+# model cleanly across sizes: 512^3 two-pass at T=4 (2x43 MiB blocks)
+# compiles and runs 18% faster than T=2, while 256^3 at T=16 (2x41 MiB)
+# overflows by 0.7 MiB. FDTD3D_VMEM_BUDGET_MB overrides for callers
+# prepared to catch the (loud, compile-time) OOM and retry — bench.py
+# does this for its 512^3 stage.
 _VMEM_LIMIT = 100 << 20
 _VMEM_BUDGET = 64 << 20
+
+
+def _vmem_budget() -> int:
+    import os
+    v = os.environ.get("FDTD3D_VMEM_BUDGET_MB")
+    if v:
+        try:
+            return int(v) << 20
+        except ValueError:
+            pass
+    return _VMEM_BUDGET
 
 
 def _pick_tile(n1: int, block_bytes_at) -> int:
@@ -106,8 +124,9 @@ def _pick_tile(n1: int, block_bytes_at) -> int:
     block (inputs + outputs) at x-tile size t; Mosaic double-buffers each
     block for grid pipelining, hence the factor 2.
     """
+    budget = _vmem_budget()
     for t in (32, 16, 8, 4, 2, 1):
-        if n1 % t == 0 and 2 * block_bytes_at(t) <= _VMEM_BUDGET:
+        if n1 % t == 0 and 2 * block_bytes_at(t) <= budget:
             return t
     for t in (8, 4, 2, 1):
         if n1 % t == 0:

@@ -314,7 +314,12 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     """
     if _want_pallas(static, mesh_axes):
         # single-pass E+H kernel where its (stricter) scope allows —
-        # ~2/3 the HBM traffic of the two-pass kernels.
+        # ~2/3 the HBM traffic of the two-pass kernels, but ONLY when
+        # the VMEM-budgeted x-tile stays large enough: every tile
+        # re-reads ~3 extra halo planes per input volume, so at small T
+        # the amplification eats the 48-vs-72 B/cell advantage
+        # (measured, same window: 256^3 T=8 fused 1.10x faster;
+        # 384^3 T=2 fused 0.92x; 512^3 T=1 fused 0.73x).
         # FDTD3D_NO_FUSED is a measurement escape hatch: it forces the
         # two-pass kernels so the fused advantage can be benchmarked on
         # configs where both are eligible (tools/measure_r3.py).
@@ -322,7 +327,7 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         from fdtd3d_tpu.ops import pallas_fused
         eh = None if _os.environ.get("FDTD3D_NO_FUSED") else \
             pallas_fused.make_fused_eh_step(static, mesh_axes, mesh_shape)
-        if eh is not None:
+        if eh is not None and eh.diag["tile"]["EH"] >= 4:
             eh.kind = "pallas_fused"
             return eh
         from fdtd3d_tpu.ops import pallas3d
@@ -330,6 +335,9 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         if fused is not None:
             fused.kind = "pallas"
             return fused
+        # (no eh fallback here: single-pass eligibility is a strict
+        # subset of two-pass eligibility, so eh is None whenever
+        # make_pallas_step returned None)
     mode, cfg = static.mode, static.cfg
     diff_b, diff_f = make_diff_ops(mesh_axes, mesh_shape)
     inv_dx = 1.0 / static.dx

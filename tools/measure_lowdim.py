@@ -1,0 +1,75 @@
+"""1D/2D jnp-path throughput on TPU (VERDICT r2 item 8).
+
+The fused Pallas kernels cover 3D only; 1D/2D run the pure-jnp XLA
+path. This measures whether XLA alone keeps those modes within ~1.5x of
+the HBM B/cell bound — if not, a low-dim kernel is a round-4 item.
+
+Workloads: 2D TMz 4096^2 + CPML (3 components -> ideal ~24 B/cell f32
++ slab psi), 1D Ez/Hy 1M cells (2 components -> ~16 B/cell). Prints one
+JSON line per case with the implied GB/s to compare against the
+same-session HBM probe.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(scheme, size, steps, pml, repeats=3):
+    import numpy as np
+
+    from fdtd3d_tpu.config import PmlConfig, SimConfig
+    from fdtd3d_tpu.sim import Simulation
+
+    cfg = SimConfig(
+        scheme=scheme, size=size, time_steps=steps, dx=1e-3,
+        courant_factor=0.5, wavelength=64e-3,
+        pml=PmlConfig(size=pml))
+    sim = Simulation(cfg)
+    comp = next(iter(sim.state["E"]))
+    cells = float(np.prod([size[a]
+                           for a in sim.static.mode.active_axes]))
+    sim.advance(steps)
+    float(sim.state["E"][comp].ravel()[0])
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        sim.advance(steps)
+        sim.block_until_ready()
+        float(sim.state["E"][comp].ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    n_comp = len(sim.state["E"]) + len(sim.state["H"])
+    mcells = cells * steps / best / 1e6
+    return {
+        "scheme": scheme, "cells": int(cells), "steps": steps,
+        "mcells": round(mcells, 1),
+        "ideal_bytes_per_cell": 8 * n_comp,  # read+write f32 per comp
+        "implied_gbps_at_ideal": round(mcells * 8 * n_comp / 1e3, 1),
+        "step_kind": sim.step_kind,
+    }
+
+
+def main():
+    from bench import probe_hbm_gbps
+
+    try:
+        gbps = round(probe_hbm_gbps(), 1)
+    except Exception:
+        gbps = -1.0
+    print(json.dumps({"hbm_probe_gbps": gbps}), flush=True)
+    for (scheme, size, steps, pml) in [
+            ("2D_TMz", (4096, 4096, 1), 50, (10, 10, 0)),
+            ("1D_EzHy", (1 << 20, 1, 1), 200, (16, 0, 0))]:
+        try:
+            print(json.dumps(measure(scheme, size, steps, pml)),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({"scheme": scheme, "error": str(e)[:300]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
