@@ -572,77 +572,95 @@ def gather_ghosts(src: Dict[str, jnp.ndarray],
 # ---------------------------------------------------------------------------
 
 
-def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
-                src: Dict[str, jnp.ndarray], psi_x: Dict[str, jnp.ndarray],
-                coeffs, slabs: Dict[int, int], collect=None):
-    """Apply the axis-0 CPML psi recursion + delta onto the kernel output.
+def slab_post(static, family: str, fields, src, psi_ax, coeffs,
+              slabs, axis: int, collect=None):
+    """Apply one axis's CPML psi recursion + delta onto kernel output.
 
-    ``collect``, when a list, receives the APPLIED field deltas as thin
-    patches (comp, axis=0, start, delta_array) — the single-pass fused
-    kernel (ops/pallas_fused.py) consumes them to correct the H update
-    it computed from the pre-patch E.
+    The kernel computed plain s*dfa for this axis's curl terms; the
+    exact CPML term differs only on the two slabs of `axis` by
+    s*((ik-1)*dfa + psi') (solver.py's _slab_delta restricted to one
+    axis). ``collect``, when a list, receives the APPLIED field deltas
+    as thin patches (comp, axis, start, delta_array) — the single-pass
+    fused kernel (ops/pallas_fused.py) consumes them to correct the H
+    update it computed from the pre-patch E.
 
-    The kernel computed plain s*dfa for axis-0 curl terms; the exact CPML
-    term differs only on the two x slabs by s*((ik-1)*dfa + psi'). Patch
-    those planes (solver.py's _slab_delta restricted to axis 0). All
-    slices are shard-local: under an x-sharded topology the slab profile
-    / wall / cb slices are per-shard (identity on interior shards, so
-    their deltas are exactly zero — and the one edge plane whose local
-    derivative lacks the true neighbor value only ever multiplies those
-    identity profiles).
+    All slices are shard-local: under a sharded topology the slab
+    profile / wall / cb slices are per-shard (identity on interior
+    shards, so their deltas are exactly zero — and the one edge plane
+    whose local derivative lacks the true neighbor value only ever
+    multiplies those identity profiles).
     """
     mode = static.mode
     upd = mode.e_components if family == "E" else mode.h_components
     tag = "e" if family == "E" else "h"
+    ax = AXES[axis]
     inv_dx = 1.0 / static.dx
-    n1 = static.grid_shape[0] // static.topology[0]
-    m = slabs[0]
-    b = coeffs[f"pml_slab_b{tag}_x"]
-    cc = coeffs[f"pml_slab_c{tag}_x"]
-    ik = coeffs[f"pml_slab_ik{tag}_x"]
+    n1 = static.grid_shape[axis] // static.topology[axis]
+    m = slabs[axis]
+    b = coeffs[f"pml_slab_b{tag}_{ax}"]
+    cc = coeffs[f"pml_slab_c{tag}_{ax}"]
+    ik = coeffs[f"pml_slab_ik{tag}_{ax}"]
 
     def r3(v, lo, hi):
-        return v[lo:hi].reshape(-1, 1, 1)
+        shape = [1, 1, 1]
+        shape[axis] = hi - lo
+        return v[lo:hi].reshape(shape)
+
+    def cut(f, lo, hi):
+        return lax.slice_in_dim(f, lo, hi, axis=axis)
+
+    def pad1(f, lo_side: bool):
+        pad = [(0, 0)] * 3
+        pad[axis] = (1, 0) if lo_side else (0, 1)
+        return jnp.pad(f, pad)
+
+    def slab_slice(lo, hi):
+        sl = [slice(None)] * 3
+        sl[axis] = slice(lo, hi)
+        return tuple(sl)
 
     new_fields = dict(fields)
-    new_psi = dict(psi_x)
+    new_psi = dict(psi_ax)
     for c in upd:
         for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
-            if a != 0:
+            if a != axis:
                 continue
             d = ("H" if family == "E" else "E") + AXES[d_axis]
             if d not in src:
                 continue
             f = src[d].astype(static.compute_dtype)
-            if family == "E":  # backward diff, planes [0,m) and [n1-m,n1)
-                d_lo = (f[:m] - jnp.pad(f[:m - 1], ((1, 0), (0, 0), (0, 0)))
-                        ) * inv_dx
-                d_hi = (f[n1 - m:] - f[n1 - m - 1:-1]) * inv_dx
+            if family == "E":  # backward diff, slabs [0,m) / [n1-m,n1)
+                d_lo = (cut(f, 0, m) - pad1(cut(f, 0, m - 1), True)) \
+                    * inv_dx
+                d_hi = (cut(f, n1 - m, n1)
+                        - cut(f, n1 - m - 1, n1 - 1)) * inv_dx
             else:              # forward diff
-                d_lo = (f[1:m + 1] - f[:m]) * inv_dx
-                d_hi = (jnp.pad(f[n1 - m + 1:], ((0, 1), (0, 0), (0, 0)))
-                        - f[n1 - m:]) * inv_dx
-            key = f"{c}_x"
-            psi = psi_x[key]
-            p_lo = r3(b, 0, m) * psi[:m] + r3(cc, 0, m) * d_lo
-            p_hi = r3(b, m, 2 * m) * psi[m:] + r3(cc, m, 2 * m) * d_hi
-            new_psi[key] = jnp.concatenate([p_lo, p_hi], axis=0)
+                d_lo = (cut(f, 1, m + 1) - cut(f, 0, m)) * inv_dx
+                d_hi = (pad1(cut(f, n1 - m + 1, n1), False)
+                        - cut(f, n1 - m, n1)) * inv_dx
+            key = f"{c}_{ax}"
+            psi = psi_ax[key]
+            p_lo = r3(b, 0, m) * cut(psi, 0, m) + r3(cc, 0, m) * d_lo
+            p_hi = (r3(b, m, 2 * m) * cut(psi, m, 2 * m)
+                    + r3(cc, m, 2 * m) * d_hi)
+            new_psi[key] = jnp.concatenate([p_lo, p_hi], axis=axis)
             dl = s * ((r3(ik, 0, m) - 1.0) * d_lo + p_lo)
             dh = s * ((r3(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
             cb = coeffs[("cb_" if family == "E" else "db_") + c]
             sign = 1.0 if family == "E" else -1.0
             if jnp.ndim(cb) == 3:
-                cb_lo, cb_hi = cb[:m], cb[n1 - m:]
+                cb_lo = cb[slab_slice(0, m)]
+                cb_hi = cb[slab_slice(n1 - m, n1)]
             else:
                 cb_lo = cb_hi = cb
             if family == "E":
-                # respect PEC walls (kernel already zeroed the field there)
-                wx = coeffs["wall_x"]
+                # respect PEC walls (kernel already zeroed the field)
+                wx = coeffs[f"wall_{ax}"]
                 dl = dl * r3(wx, 0, m)
                 dh = dh * r3(wx, n1 - m, n1)
                 ca_ax = component_axis(c)
-                for a2 in (1, 2):
-                    if a2 != ca_ax:
+                for a2 in range(3):
+                    if a2 != ca_ax and a2 != axis:
                         w = coeffs[f"wall_{AXES[a2]}"]
                         shape = [1, 1, 1]
                         shape[a2] = w.shape[0]
@@ -651,16 +669,24 @@ def x_slab_post(static, family: str, fields: Dict[str, jnp.ndarray],
             arr = new_fields[c]
             add_lo = (sign * cb_lo * dl).astype(arr.dtype)
             add_hi = (sign * cb_hi * dh).astype(arr.dtype)
-            arr = arr.at[:m].add(add_lo)
-            arr = arr.at[n1 - m:].add(add_hi)
+            arr = arr.at[slab_slice(0, m)].add(add_lo)
+            arr = arr.at[slab_slice(n1 - m, n1)].add(add_hi)
             new_fields[c] = arr
             if collect is not None:
-                shape = arr.shape
-                collect.append((c, 0, 0, jnp.broadcast_to(
-                    add_lo, (m, shape[1], shape[2]))))
-                collect.append((c, 0, n1 - m, jnp.broadcast_to(
-                    add_hi, (m, shape[1], shape[2]))))
+                lo_shape = list(arr.shape)
+                lo_shape[axis] = m
+                collect.append((c, axis, 0, jnp.broadcast_to(
+                    add_lo, lo_shape)))
+                collect.append((c, axis, n1 - m, jnp.broadcast_to(
+                    add_hi, lo_shape)))
     return new_fields, new_psi
+
+
+def x_slab_post(static, family, fields, src, psi_x, coeffs, slabs,
+                collect=None):
+    """Axis-0 wrapper of slab_post (the two-pass kernels' post-pass)."""
+    return slab_post(static, family, fields, src, psi_x, coeffs, slabs,
+                     0, collect)
 
 
 def plane_corrections(field: str, comp: str, setup, coeffs, inc,

@@ -134,6 +134,62 @@ def test_ineligible_falls_back(reason, cfg):
     assert pallas3d.make_pallas_step(static) is None, reason
 
 
+def test_slab_post_axis_generic_matches_transposed_axis0():
+    """slab_post's axis=1 path must equal the axis=0 path applied to
+    x<->y transposed data (covers the generic branches, which have no
+    production caller while the 2D-tiled fused kernel is shelved)."""
+    import numpy as np
+
+    cfg = SimConfig(**BASE, pml=PmlConfig(size=(3, 3, 3)))
+    static = solver.build_static(cfg)
+    slabs = solver.slab_axes(static)
+    coeffs = {k: jnp.asarray(v) for k, v in
+              solver.build_coeffs(static).items()}
+    rng = np.random.default_rng(7)
+    shape = static.grid_shape
+
+    def rnd():
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    fields = {c: rnd() for c in static.mode.e_components}
+    src = {c: rnd() for c in static.mode.h_components}
+    psi_y = {f"{c}_y": jnp.zeros((shape[0], 2 * slabs[1], shape[2]),
+                                 jnp.float32)
+             for c in static.mode.e_components}
+    out_y, psi_out_y = pallas3d.slab_post(
+        static, "E", fields, src, psi_y, coeffs, slabs, 1)
+
+    # transpose x<->y: swap axes 0/1 of every array AND the component
+    # roles (x-axis derivatives of the swapped components)
+    swap = {"Ex": "Ey", "Ey": "Ex", "Ez": "Ez",
+            "Hx": "Hy", "Hy": "Hx", "Hz": "Hz"}
+
+    def tr(v):
+        return jnp.swapaxes(v, 0, 1)
+
+    fields_t = {swap[c]: tr(v) for c, v in fields.items()}
+    src_t = {swap[c]: tr(v) for c, v in src.items()}
+    psi_x_t = {f"{swap[k[:2]]}_x": tr(v) for k, v in psi_y.items()}
+    # the cubic symmetric config has identical profiles on every axis
+    out_x, psi_out_x = pallas3d.slab_post(
+        static, "E", fields_t, src_t, psi_x_t, coeffs, slabs, 0)
+    for c in fields:
+        got = tr(out_x[swap[c]])
+        want = out_y[c]
+        # the x<->y swap flips the curl-term sign convention: Ey_x's
+        # term sign is the negative of Ex_y's, so compare the DELTAS
+        # in magnitude against the applied change
+        d_y = np.abs(np.asarray(want - fields[c]))
+        d_x = np.abs(np.asarray(got - fields[c]))
+        np.testing.assert_allclose(d_x, d_y, rtol=1e-5, atol=1e-7,
+                                   err_msg=c)
+    for k in psi_out_y:
+        kx = f"{swap[k[:2]]}_x"
+        np.testing.assert_allclose(
+            np.abs(np.asarray(tr(psi_out_x[kx]))),
+            np.abs(np.asarray(psi_out_y[k])), rtol=1e-5, atol=1e-7)
+
+
 def test_x_sharded_builds():
     """x-sharded meshes are eligible (VERDICT r2 item 1): the x boundary
     plane ppermutes into the shard-edge tiles. A vacuum 16^3 at px=2 has
