@@ -246,8 +246,10 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
             s[a] = 2 * slabs[a]
         return tuple(s)
 
-    # field storage may be bf16 (2 B); psi/J/coeffs/profiles stay f32
-    fbytes = np.dtype(static.field_dtype).itemsize
+    # f32-width accounting even for bf16 storage: in-kernel compute is
+    # f32, so Mosaic scratch scales with the f32 temporaries, not the
+    # storage bytes (see ops/pallas_fused.py for the measured overflow)
+    fbytes = max(np.dtype(static.field_dtype).itemsize, 4)
 
     def _block_bytes(t: int) -> int:
         """Summed operand-block bytes at x-tile size t (see _pick_tile)."""

@@ -225,7 +225,12 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
     inv_dx = np.float32(1.0 / static.dx)
     fdt = jnp.float32
     fst = static.field_dtype
-    fbytes = np.dtype(fst).itemsize
+    # VMEM accounting at f32 width even for bf16 STORAGE: the kernel
+    # casts every load to the f32 compute dtype, so Mosaic's scratch
+    # holds f32 temporaries per block — sizing tiles by the 2-byte
+    # storage width overflows scoped VMEM (measured: bf16 256^3 picked
+    # T=16 from 2-byte accounting and failed compile at 120.4M/100M).
+    fbytes = max(np.dtype(fst).itemsize, 4)
     e_comps = list(mode.e_components)
     h_comps = list(mode.h_components)
     drude_e = static.use_drude
