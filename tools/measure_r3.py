@@ -119,12 +119,19 @@ def main():
         ("yz_fused_f32", 256, 10, True, "float32", "yz"),
     ])
 
-    # Direct timing probe: 512^3 only if the 256^3 pallas bench ran fast
-    # enough that 512^3 (8x the cells) fits comfortably in the session.
+    # Direct timing probe: 512^3 unless the window is truly dead —
+    # same gate + wall-clock backstop as bench.py (256^3 x 10 steps is
+    # readback-dominated and underestimates the chip by up to ~4x; the
+    # time guard stops a degrading session from burning its remaining
+    # wall-clock on five 512^3 cases).
+    from bench import GATE_MCELLS_512, STAGE1_BUDGET_S
     p256 = next((r for r in record["results"]
                  if r.get("label") == "bench_pallas_f32" and "mcells" in r),
                 None)
-    healthy = p256 is not None and p256["mcells"] >= 1500.0
+    elapsed = sum(r.get("wall_s", 0) for r in record["results"])
+    healthy = (p256 is not None
+               and p256["mcells"] >= GATE_MCELLS_512
+               and elapsed < STAGE1_BUDGET_S)
     record["healthy_512"] = healthy
     if healthy:
         run_cases([
