@@ -62,14 +62,17 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3) -> float:
     # Warm up: compile AND force one real device->host readback (async
     # dispatch through the device tunnel can make a bare block_until_ready
     # return before execution — measured 0.3ms for 50 steps without this).
+    # sample() reads ONE element of the live carry — with the packed
+    # kernel engaged, sim.state[...] would unpack full volumes inside
+    # the timing window (~10% inflation at 256^3).
     sim.advance(steps)
-    float(sim.state["E"]["Ez"][n // 2, n // 2, n // 2])
+    sim.sample("Ez", (n // 2, n // 2, n // 2))
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
         sim.advance(steps)
         sim.block_until_ready()
-        float(sim.state["E"]["Ez"][n // 2, n // 2, n // 2])
+        sim.sample("Ez", (n // 2, n // 2, n // 2))
         best = min(best, time.perf_counter() - t0)
 
     for comp, v in sim.fields().items():
@@ -199,30 +202,39 @@ def run_measurement() -> None:
     # Stage 2: the 256^3 pallas timing itself is the 512^3 go/no-go —
     # a direct measurement of THIS window's speed, unlike the HBM probe.
     # A mid-stage failure (tunnel degrading, OOM) must not discard the
-    # stage-1 numbers already in hand. The raised VMEM budget lets the
-    # two-pass kernels run T=4 at 512^3 (measured 18% faster than the
-    # default budget's T=2); Mosaic VMEM overflow is a loud compile
-    # error, caught here with a default-budget retry.
+    # stage-1 numbers already in hand. The packed kernel sizes its own
+    # VMEM footprint (ops/pallas_packed.py) — no budget override needed
+    # (VERDICT r3 item 7); if its model is wrong for this hardware the
+    # Mosaic overflow is a loud compile error, caught with a two-pass
+    # retry at the raised budget that path was measured to want.
     if on_tpu and pallas_mc >= GATE_MCELLS_512 and \
             stage1_s < STAGE1_BUDGET_S:
         try:
             jnp_512 = measure(512, 20, use_pallas=False)
-            user_budget = os.environ.get("FDTD3D_VMEM_BUDGET_MB")
-            os.environ["FDTD3D_VMEM_BUDGET_MB"] = "86"
             try:
                 pallas_512 = measure(512, 20, use_pallas=True)
             except Exception:
-                # retry at the caller's own budget (or the default)
-                if user_budget is None:
-                    os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
-                else:
-                    os.environ["FDTD3D_VMEM_BUDGET_MB"] = user_budget
-                pallas_512 = measure(512, 20, use_pallas=True)
-            finally:
-                if user_budget is None:
-                    os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
-                else:
-                    os.environ["FDTD3D_VMEM_BUDGET_MB"] = user_budget
+                # retry ladder: two-pass at the raised budget (unless
+                # the caller pinned one), then two-pass at the default
+                # budget (86 MB itself can overflow on other hardware)
+                saved = {k: os.environ.get(k)
+                         for k in ("FDTD3D_NO_PACKED",
+                                   "FDTD3D_VMEM_BUDGET_MB")}
+                os.environ["FDTD3D_NO_PACKED"] = "1"
+                try:
+                    if saved["FDTD3D_VMEM_BUDGET_MB"] is None:
+                        os.environ["FDTD3D_VMEM_BUDGET_MB"] = "86"
+                    try:
+                        pallas_512 = measure(512, 20, use_pallas=True)
+                    except Exception:
+                        os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
+                        pallas_512 = measure(512, 20, use_pallas=True)
+                finally:
+                    for k, v in saved.items():
+                        if v is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = v
             n, jnp_mc, pallas_mc = 512, jnp_512, pallas_512
         except Exception:
             pass  # report the completed 256^3 measurements

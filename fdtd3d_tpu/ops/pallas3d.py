@@ -570,12 +570,128 @@ def gather_ghosts(src: Dict[str, jnp.ndarray],
 
 
 # ---------------------------------------------------------------------------
+# component views: dict-of-arrays or packed (ncomp, n1, n2, n3) stacks
+# ---------------------------------------------------------------------------
+
+
+class PackedView:
+    """Mutable per-component view over a packed ``(ncomp, n1, n2, n3)`` array.
+
+    Duck-types the ``Dict[str, array]`` interface the thin post-passes
+    consume: component reads are lazy leading-index slices (XLA fuses the
+    slice into the thin consumers), writes go through ``add_at`` as
+    scatter updates on the packed array — never a full per-component
+    materialization (which would cost a full HBM pass per step and negate
+    the packed kernel's traffic win; ops/pallas_packed.py).
+    """
+
+    __slots__ = ("arr", "comps", "_idx")
+
+    def __init__(self, arr, comps):
+        self.arr = arr
+        self.comps = tuple(comps)
+        self._idx = {c: j for j, c in enumerate(self.comps)}
+
+    def clone(self) -> "PackedView":
+        return PackedView(self.arr, self.comps)
+
+    def __contains__(self, c) -> bool:
+        return c in self._idx
+
+    def __getitem__(self, c):
+        return self.arr[self._idx[c]]
+
+    def keys(self):
+        return self._idx.keys()
+
+    def add_at(self, c, sl, val):
+        self.arr = self.arr.at[(self._idx[c],) + tuple(sl)].add(val)
+
+
+class PackedPsiView:
+    """CPML psi view: per-axis packed stacks + plain entries for the rest.
+
+    ``stacks[a]`` is a ``(k, ...)`` stack of the compact psi arrays whose
+    slab axis is ``a``; ``rows[key] = (a, j)`` maps a psi name to its row.
+    Keys not in ``rows`` (the x-axis psi of the packed kernel, which only
+    the jnp post-pass touches) live in ``extra`` as ordinary arrays.
+    """
+
+    __slots__ = ("stacks", "rows", "extra")
+
+    def __init__(self, stacks, rows, extra=None):
+        self.stacks = dict(stacks)
+        self.rows = rows
+        self.extra = dict(extra or {})
+
+    def clone(self) -> "PackedPsiView":
+        return PackedPsiView(self.stacks, self.rows, self.extra)
+
+    def __contains__(self, key) -> bool:
+        return key in self.rows or key in self.extra
+
+    def __getitem__(self, key):
+        if key in self.rows:
+            a, j = self.rows[key]
+            return self.stacks[a][j]
+        return self.extra[key]
+
+    def add_at(self, key, sl, val):
+        if key in self.rows:
+            a, j = self.rows[key]
+            self.stacks[a] = self.stacks[a].at[(j,) + tuple(sl)].add(val)
+        else:
+            self.extra[key] = self.extra[key].at[tuple(sl)].add(val)
+
+    def set_full(self, key, val):
+        if key in self.rows:
+            a, j = self.rows[key]
+            self.stacks[a] = self.stacks[a].at[j].set(val)
+        else:
+            self.extra[key] = val
+
+
+def fields_copy(fields):
+    """Shallow copy of a component container (dict or PackedView)."""
+    return dict(fields) if isinstance(fields, dict) else fields.clone()
+
+
+def fields_add(fields, c, sl, val):
+    """fields[c].at[sl].add(val) for either container; mutates and returns."""
+    if isinstance(fields, dict):
+        fields[c] = fields[c].at[tuple(sl)].add(val)
+    else:
+        fields.add_at(c, sl, val)
+    return fields
+
+
+def psi_copy(psi):
+    return dict(psi) if isinstance(psi, dict) else psi.clone()
+
+
+def psi_add(psi, key, sl, val):
+    if isinstance(psi, dict):
+        psi[key] = psi[key].at[tuple(sl)].add(val)
+    else:
+        psi.add_at(key, sl, val)
+    return psi
+
+
+def psi_set(psi, key, val):
+    if isinstance(psi, dict):
+        psi[key] = val
+    else:
+        psi.set_full(key, val)
+    return psi
+
+
+# ---------------------------------------------------------------------------
 # jnp post-passes (thin patches on kernel output)
 # ---------------------------------------------------------------------------
 
 
 def slab_post(static, family: str, fields, src, psi_ax, coeffs,
-              slabs, axis: int, collect=None):
+              slabs, axis: int, collect=None, src_slabs=None):
     """Apply one axis's CPML psi recursion + delta onto kernel output.
 
     The kernel computed plain s*dfa for this axis's curl terms; the
@@ -583,8 +699,16 @@ def slab_post(static, family: str, fields, src, psi_ax, coeffs,
     s*((ik-1)*dfa + psi') (solver.py's _slab_delta restricted to one
     axis). ``collect``, when a list, receives the APPLIED field deltas
     as thin patches (comp, axis, start, delta_array) — the single-pass
-    fused kernel (ops/pallas_fused.py) consumes them to correct the H
-    update it computed from the pre-patch E.
+    fused kernels (ops/pallas_fused.py, ops/pallas_packed.py) consume
+    them to correct the H update they computed from the pre-patch E.
+
+    ``src_slabs``, when given, maps each source component to its two
+    pre-sliced boundary regions ``(f_lo, f_hi)`` — the m+1 planes
+    [0, m+1) and [n1-m-1, n1) along `axis` — and `src` is not read.
+    The packed kernel donates its source arrays into the pallas call,
+    so reading them afterwards would force XLA to defensively copy the
+    whole family (+2 volumes/step); the thin regions are sliced off
+    BEFORE the call instead.
 
     All slices are shard-local: under a sharded topology the slab
     profile / wall / cb slices are per-shard (identity on interior
@@ -621,31 +745,41 @@ def slab_post(static, family: str, fields, src, psi_ax, coeffs,
         sl[axis] = slice(lo, hi)
         return tuple(sl)
 
-    new_fields = dict(fields)
-    new_psi = dict(psi_ax)
+    new_fields = fields_copy(fields)
+    new_psi = psi_copy(psi_ax)
     for c in upd:
         for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
             if a != axis:
                 continue
             d = ("H" if family == "E" else "E") + AXES[d_axis]
-            if d not in src:
-                continue
-            f = src[d].astype(static.compute_dtype)
+            if src_slabs is not None:
+                if d not in src_slabs:
+                    continue
+                f_lo, f_hi = src_slabs[d]
+                f_lo = f_lo.astype(static.compute_dtype)
+                f_hi = f_hi.astype(static.compute_dtype)
+            else:
+                if d not in src:
+                    continue
+                f = src[d].astype(static.compute_dtype)
+                # the m+1 boundary planes each side — all either family
+                # reads below (region-relative indexing)
+                f_lo = cut(f, 0, m + 1)
+                f_hi = cut(f, n1 - m - 1, n1)
             if family == "E":  # backward diff, slabs [0,m) / [n1-m,n1)
-                d_lo = (cut(f, 0, m) - pad1(cut(f, 0, m - 1), True)) \
+                d_lo = (cut(f_lo, 0, m) - pad1(cut(f_lo, 0, m - 1), True)) \
                     * inv_dx
-                d_hi = (cut(f, n1 - m, n1)
-                        - cut(f, n1 - m - 1, n1 - 1)) * inv_dx
+                d_hi = (cut(f_hi, 1, m + 1) - cut(f_hi, 0, m)) * inv_dx
             else:              # forward diff
-                d_lo = (cut(f, 1, m + 1) - cut(f, 0, m)) * inv_dx
-                d_hi = (pad1(cut(f, n1 - m + 1, n1), False)
-                        - cut(f, n1 - m, n1)) * inv_dx
+                d_lo = (cut(f_lo, 1, m + 1) - cut(f_lo, 0, m)) * inv_dx
+                d_hi = (pad1(cut(f_hi, 2, m + 1), False)
+                        - cut(f_hi, 1, m + 1)) * inv_dx
             key = f"{c}_{ax}"
             psi = psi_ax[key]
             p_lo = r3(b, 0, m) * cut(psi, 0, m) + r3(cc, 0, m) * d_lo
             p_hi = (r3(b, m, 2 * m) * cut(psi, m, 2 * m)
                     + r3(cc, m, 2 * m) * d_hi)
-            new_psi[key] = jnp.concatenate([p_lo, p_hi], axis=axis)
+            psi_set(new_psi, key, jnp.concatenate([p_lo, p_hi], axis=axis))
             dl = s * ((r3(ik, 0, m) - 1.0) * d_lo + p_lo)
             dh = s * ((r3(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
             cb = coeffs[("cb_" if family == "E" else "db_") + c]
@@ -668,14 +802,14 @@ def slab_post(static, family: str, fields, src, psi_ax, coeffs,
                         shape[a2] = w.shape[0]
                         dl = dl * w.reshape(shape)
                         dh = dh * w.reshape(shape)
-            arr = new_fields[c]
-            add_lo = (sign * cb_lo * dl).astype(arr.dtype)
-            add_hi = (sign * cb_hi * dh).astype(arr.dtype)
-            arr = arr.at[slab_slice(0, m)].add(add_lo)
-            arr = arr.at[slab_slice(n1 - m, n1)].add(add_hi)
-            new_fields[c] = arr
+            fdt = new_fields[c].dtype
+            fshape = new_fields[c].shape
+            add_lo = (sign * cb_lo * dl).astype(fdt)
+            add_hi = (sign * cb_hi * dh).astype(fdt)
+            fields_add(new_fields, c, slab_slice(0, m), add_lo)
+            fields_add(new_fields, c, slab_slice(n1 - m, n1), add_hi)
             if collect is not None:
-                lo_shape = list(arr.shape)
+                lo_shape = list(fshape)
                 lo_shape[axis] = m
                 collect.append((c, axis, 0, jnp.broadcast_to(
                     add_lo, lo_shape)))
@@ -685,10 +819,10 @@ def slab_post(static, family: str, fields, src, psi_ax, coeffs,
 
 
 def x_slab_post(static, family, fields, src, psi_x, coeffs, slabs,
-                collect=None):
+                collect=None, src_slabs=None):
     """Axis-0 wrapper of slab_post (the two-pass kernels' post-pass)."""
     return slab_post(static, family, fields, src, psi_x, coeffs, slabs,
-                     0, collect)
+                     0, collect, src_slabs)
 
 
 def plane_corrections(field: str, comp: str, setup, coeffs, inc,
@@ -762,20 +896,20 @@ def _local_index(static, coeffs, axis: int, pos: int):
     return jnp.clip(loc, 0, n_loc - 1), own
 
 
-def _plane_add(static, arr, axis: int, plane: int, val, coeffs):
-    """arr[..., plane, ...] += val, ownership-gated on a sharded axis.
+def _plane_add(static, fields, c, axis: int, plane: int, val, coeffs):
+    """fields[c][..., plane, ...] += val, ownership-gated on a sharded axis.
 
     Unsharded axis: static index (XLA folds to an in-place slice update).
     Sharded axis: the add is zeroed on non-owner shards.
     """
     if plane < 0 or plane >= static.grid_shape[axis]:
-        return arr
+        return fields
     loc, own = _local_index(static, coeffs, axis, plane)
     sl: List[Any] = [slice(None)] * 3
     sl[axis] = loc
     if own is not None:
-        val = jnp.where(own, val, 0.0).astype(arr.dtype)
-    return arr.at[tuple(sl)].add(val)
+        val = jnp.where(own, val, 0.0).astype(fields[c].dtype)
+    return fields_add(fields, c, sl, val)
 
 
 def _plane_coef(static, cb, axis: int, plane: int, coeffs):
@@ -802,7 +936,7 @@ def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
     setup = static.tfsf_setup
     mode = static.mode
     upd = mode.e_components if family == "E" else mode.h_components
-    out = dict(fields)
+    out = fields_copy(fields)
     for c in upd:
         patches = plane_corrections(family, c, setup, coeffs, inc,
                                     mode.active_axes, static.dx)
@@ -810,7 +944,8 @@ def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
             continue
         cb = coeffs[("cb_" if family == "E" else "db_") + c]
         sign = 1.0 if family == "E" else -1.0
-        arr = out[c]
+        fdt = out[c].dtype
+        fshape = out[c].shape
         for (axis, plane, term) in patches:
             if plane < 0 or plane >= static.grid_shape[axis]:
                 continue
@@ -826,14 +961,13 @@ def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
                         shp[a2] = w.shape[0]
                         t2 = t2 * jnp.squeeze(
                             w.reshape(shp), axis=axis)
-            val = (sign * scale * t2).astype(arr.dtype)
-            arr = _plane_add(static, arr, axis, plane, val, coeffs)
+            val = (sign * scale * t2).astype(fdt)
+            out = _plane_add(static, out, c, axis, plane, val, coeffs)
             if collect is not None:
-                pshape = list(arr.shape)
+                pshape = list(fshape)
                 pshape[axis] = 1
                 collect.append((c, axis, plane, jnp.broadcast_to(
                     jnp.expand_dims(val, axis), pshape)))
-        out[c] = arr
     return out
 
 
@@ -850,7 +984,9 @@ def point_source_patch(static, fields, coeffs, t, collect=None):
     wf = waveform(ps.waveform,
                   (t.astype(static.real_dtype) + 0.5) * static.dt,
                   static.omega, static.dt)
-    arr = fields[c]
+    out = fields_copy(fields)
+    fdt = out[c].dtype
+    fshape = out[c].shape
     cb = coeffs[f"cb_{c}"]
     idxs = []
     own = None
@@ -865,12 +1001,12 @@ def point_source_patch(static, fields, coeffs, t, collect=None):
     val = ps.amplitude * scale * wf
     if own is not None:
         val = jnp.where(own, val, 0.0)
-    val = val.astype(arr.dtype)
+    val = val.astype(fdt)
     if collect is not None:
-        plane = jnp.zeros((1,) + arr.shape[1:], arr.dtype)
+        plane = jnp.zeros((1,) + tuple(fshape[1:]), fdt)
         plane = plane.at[0, idxs[1], idxs[2]].add(val)
         collect.append((c, 0, ps.position[0], plane))
-    return dict(fields, **{c: arr.at[tuple(idxs)].add(val)})
+    return fields_add(out, c, tuple(idxs), val)
 
 
 # ---------------------------------------------------------------------------

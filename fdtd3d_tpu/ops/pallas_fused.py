@@ -85,11 +85,13 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
     array spanning `k` planes along b and full extents elsewhere.
     Unsharded topology only (the fused path's scope).
     """
+    from fdtd3d_tpu.ops import pallas3d as _p3
+
     mode = static.mode
     inv_dx = 1.0 / static.dx
     cdt = static.compute_dtype
-    out_H = dict(new_H)
-    out_psi = dict(psi_H)
+    out_H = _p3.fields_copy(new_H)
+    out_psi = _p3.psi_copy(psi_H)
 
     def slab_f(a: int, lo: int, hi: int) -> jnp.ndarray:
         """F = ik + c at ABSOLUTE planes [lo, hi) of axis a, from the
@@ -103,7 +105,7 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
         return v.reshape(shape)
 
     for c in mode.h_components:
-        h_arr = out_H[c]
+        h_dtype = out_H[c].dtype
         db = coeffs[f"db_{c}"]
         for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
             d = "E" + AXES[d_axis]
@@ -153,7 +155,6 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                     key = f"{c}_{AXES[a]}"
                     m = slabs[a]
                     ca_prof = coeffs[f"pml_slab_ch_{AXES[a]}"]
-                    psi_arr = out_psi[key]
                     if a == b:
                         # patch planes [pstart, pstart+plen) vs slabs
                         # [0, m) and [n_a-m, n_a) -> compact [0,m)/[m,2m)
@@ -172,8 +173,8 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                                          c_off + o_hi - s_lo]
                             shape = [1, 1, 1]
                             shape[a] = o_hi - o_lo
-                            psi_arr = psi_arr.at[tuple(psl)].add(
-                                cp.reshape(shape) * w[tuple(wsl)])
+                            _p3.psi_add(out_psi, key, tuple(psl),
+                                        cp.reshape(shape) * w[tuple(wsl)])
                     else:
                         # w spans full a; slice its slab planes, add at
                         # the patch's b-location in the compact array
@@ -190,16 +191,14 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                              * w[tuple(wsl_hi)]], axis=a)
                         bsl = [slice(None)] * 3
                         bsl[b] = slice(pstart, pstart + plen)
-                        psi_arr = psi_arr.at[tuple(bsl)].add(add)
-                    out_psi[key] = psi_arr
+                        _p3.psi_add(out_psi, key, tuple(bsl), add)
                 else:
                     # plain curl term (x "post" axis or no PML on a)
                     dacc = s * w
 
                 db_sl = db[sl] if jnp.ndim(db) == 3 else db
-                h_arr = h_arr.at[sl].add(
-                    (-db_sl * dacc).astype(h_arr.dtype))
-        out_H[c] = h_arr
+                _p3.fields_add(out_H, c, sl,
+                               (-db_sl * dacc).astype(h_dtype))
     return out_H, out_psi
 
 

@@ -1,0 +1,698 @@
+"""Packed, software-pipelined single-pass E+H Pallas kernel.
+
+Round-4 kernel (docs/PERFORMANCE.md "round-4 direction"): every measured
+ceiling of the round-3 kernels traced to OPERAND COUNT and redundant
+halo traffic, not arithmetic. This kernel attacks both:
+
+* **Operand packing**: the six field volumes ride as TWO stacked HBM
+  arrays — E ``(3, n1, n2, n3)`` and H ``(3, n1, n2, n3)`` — so a tile's
+  field traffic is 5 DMAs (E in/out, H in/out, one H halo plane) instead
+  of the old fused kernel's ~15; the CPML psi arrays stack per slab axis
+  the same way. Fewer, larger DMAs amortize per-iteration setup cost and
+  lift the per-array block-count pressure that killed the 2D-tiled
+  experiment (docs/PERFORMANCE.md).
+
+* **Software pipelining instead of recompute**: the old single-pass
+  kernel recomputed one redundant E plane per tile (plus a forward halo
+  of every E-side operand) so H never waited on a neighbor tile. Here
+  the H-family update simply LAGS ONE TILE: iteration i computes
+  new_E(tile i), then new_H(tile i-1) from VMEM scratch carrying
+  new_E(tile i-1), old-H(tile i-1), and the one-plane backward halo
+  (the last plane of the previously loaded H tile) — legal because the
+  TPU grid is sequential and pallas scratch persists across grid
+  iterations. No recompute, no halo operands at all. Per step the
+  kernel moves
+
+      read  E(3) + H(3);  write E(3) + H(3)
+
+  = 12 volumes = 48 B/cell (f32; 24 bf16) at ANY tile size, vs 72 for
+  the two-pass kernels and 66+ for the recompute-fused kernel — the
+  Yee update's information-theoretic minimum without temporal blocking.
+
+The last x-tile's H update runs as ONE extra grid iteration: its
+new-E/old-H sit in scratch and the lagged operand indices land on the
+last block naturally, while phase A's tile-indexed operands pin to
+their final block with writes masked (free under Mosaic's
+revisiting semantics). A jnp post-pass version of this was tried and
+reverted: XLA gave the psi stacks transposed layouts and inserted a
+full stacked-array copy per step (+24 B/cell). Post-kernel E
+modifications (x-slab CPML deltas, TFSF faces, point source) are the
+same thin patches as the fused kernel, applied through
+``pallas3d.PackedView`` scatter-adds so the packed arrays are never
+re-materialized; the kernel's H — computed from pre-patch E — is
+corrected by ``pallas_fused.apply_patch_h_corrections`` over the same
+views.
+
+Scope (everything else falls back to ops/pallas_fused.py /
+ops/pallas3d.py / solver.py): 3D, real f32/bf16 storage, UNSHARDED,
+slab-fitting CPML on any axes, Drude J (electric), TFSF, point source.
+Magnetic Drude (K lives in the lagged H phase and would need one more
+full-volume carry) falls back to the two-pass kernels.
+
+Reference parity: same role as the reference's fused CUDA step
+(SURVEY.md §2 CudaGrid/InternalScheme rows) — this is the
+one-kernel-per-step shape the reference reaches with hand-written
+CUDA, built here from the pipelined-grid + packed-operand primitives
+Mosaic actually optimizes well.
+
+Donation-safety (cf. pallas_fused.py's rule): every aliased array is
+read only at block indices whose output writes happen at the SAME
+iteration or later, and every out block revisited across iterations
+receives a well-defined value each visit (at i=0 the lagged H/psi_H
+outputs write through their loaded old values), so the scheme is
+correct under both Mosaic revisiting semantics (persist-until-change
+or flush-every-iteration).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fdtd3d_tpu.layout import CURL_TERMS, component_axis
+from fdtd3d_tpu.ops.pallas3d import (PackedPsiView, PackedView,
+                                     _vmem_budget)
+
+AXES = "xyz"
+
+
+def eligible(static, mesh_axes=None) -> bool:
+    if static.mode.name != "3D":
+        return False
+    if static.field_dtype not in (np.float32, jnp.bfloat16):
+        return False
+    if static.topology != (1, 1, 1):
+        return False
+    if mesh_axes and any(v is not None for v in mesh_axes.values()):
+        return False
+    if static.use_drude_m:
+        return False
+    return True
+
+
+def psi_rows(static, slabs, family: str) -> Dict[int, List[str]]:
+    """axis -> ordered comps with an in-kernel (y/z slab) psi term."""
+    mode = static.mode
+    comps = mode.e_components if family == "E" else mode.h_components
+    out: Dict[int, List[str]] = {}
+    for a in (1, 2):
+        if a not in slabs:
+            continue
+        rows = [c for c in comps
+                if any(t[0] == a for t in CURL_TERMS[component_axis(c)])
+                and a in static.pml_axes]
+        if rows:
+            out[a] = rows
+    return out
+
+
+# The packed kernel models its FULL VMEM footprint — double-buffered
+# operand blocks + the new-E/old-H scratch carry + Mosaic's own kernel
+# temporaries — against the physical limit, so the tile choice is the
+# kernel's own decision (VERDICT r3 item 7: no FDTD3D_VMEM_BUDGET_MB
+# needed by bench.py on this path; the env var still overrides the
+# blocks+scratch budget as a measurement escape hatch).
+#
+# Temporaries calibration (measured, v5e, this kernel body):
+#   128^3 T=32 fails compile at 143.66M/128M (excluded at 25: needs
+#   ~124.6M modeled);  512^3 T=2 compiles and runs (needs ~116M
+#   modeled) and measures 8% faster than T=1 (same traffic, fewer
+#   per-iteration DMA setups);  256^3 T=8 compiles (~114M modeled).
+# 25 f32 per (cell x tile plane) separates the measured pass/fail
+# boundary. Re-calibrate if the kernel body changes materially.
+_VMEM_TOTAL = 128 << 20
+_VMEM_MARGIN = 10 << 20       # compile-to-compile variance headroom
+_TEMPS_F32_PER_CELL = 25
+
+
+def _pick_tile_packed(n1: int, plane_cells: int, block_bytes_at,
+                      scratch_bytes_at) -> int:
+    """Largest divisor T (with >= 2 tiles) fitting physical VMEM.
+
+    Footprint model: 2*blocks (Mosaic double-buffers every operand
+    window) + scratch carry + measured per-tile temporaries.
+    """
+    import os
+    env_budget = _vmem_budget() if os.environ.get(
+        "FDTD3D_VMEM_BUDGET_MB") else None
+    for t in (32, 16, 8, 4, 2, 1):
+        if n1 % t != 0 or n1 // t < 2:
+            continue
+        need = 2 * block_bytes_at(t) + scratch_bytes_at(t)
+        if env_budget is not None:
+            if need <= env_budget:
+                return t
+            continue
+        need += _TEMPS_F32_PER_CELL * 4 * t * plane_cells
+        if need <= _VMEM_TOTAL - _VMEM_MARGIN:
+            return t
+    # not even T=1 fits the footprint model: dispatch falls back to the
+    # two-pass kernels (whose per-family working set is ~half) rather
+    # than building a call that will fail Mosaic's VMEM check
+    return 0
+
+
+def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
+    """One-pallas-call pipelined leapfrog step, or None if out of scope."""
+    from fdtd3d_tpu import solver as solver_mod
+
+    if not eligible(static, mesh_axes):
+        return None
+    slabs = solver_mod.slab_axes(static)
+    for a in static.pml_axes:
+        if a not in slabs:
+            return None  # thin-grid full-length psi: not covered
+    np_coeffs = solver_mod.build_coeffs(static)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    setup = static.tfsf_setup
+    x_pml = 0 in static.pml_axes
+
+    mode = static.mode
+    n1, n2, n3 = static.grid_shape
+    inv_dx = np.float32(1.0 / static.dx)
+    fdt = jnp.float32
+    fst = static.field_dtype
+    # f32-width accounting even for bf16 storage (see pallas3d.py: the
+    # in-kernel compute is f32, so Mosaic scratch scales with f32).
+    fbytes = max(np.dtype(fst).itemsize, 4)
+    e_comps = list(mode.e_components)
+    h_comps = list(mode.h_components)
+    ne, nh = len(e_comps), len(h_comps)
+    drude = static.use_drude
+
+    rows_e = psi_rows(static, slabs, "E")
+    rows_h = psi_rows(static, slabs, "H")
+    psi_axes_e = sorted(rows_e)
+    psi_axes_h = sorted(rows_h)
+
+    pairs_e = ["ca", "cb"] + (["kj", "bj"] if drude else [])
+    pairs_h = ["da", "db"]
+    coeff_is_array = {}
+    for c in e_comps:
+        for p in pairs_e:
+            coeff_is_array[f"{p}_{c}"] = np.ndim(np_coeffs[f"{p}_{c}"]) == 3
+    for c in h_comps:
+        for p in pairs_h:
+            coeff_is_array[f"{p}_{c}"] = np.ndim(np_coeffs[f"{p}_{c}"]) == 3
+    arr_e = [k for k, v in coeff_is_array.items()
+             if v and k.split("_")[0] in pairs_e]
+    arr_h = [k for k, v in coeff_is_array.items()
+             if v and k.split("_")[0] in pairs_h]
+
+    def _stack_shape(a: int, k: int) -> Tuple[int, int, int, int]:
+        s = [k, n1, n2, n3]
+        s[1 + a] = 2 * slabs[a]
+        return tuple(s)
+
+    def _block_bytes(t: int) -> int:
+        plane = n2 * n3
+        total = 0
+        total += 2 * ne * t * plane * fbytes       # E in + out
+        total += 2 * nh * t * plane * fbytes       # H in + out
+        for (axes, rows) in ((psi_axes_e, rows_e), (psi_axes_h, rows_h)):
+            for a in axes:                         # psi stacks in + out
+                s = _stack_shape(a, len(rows[a]))
+                total += 2 * s[0] * t * s[2] * s[3] * 4
+        if drude:
+            total += 2 * ne * t * plane * 4        # J in + out
+        total += (len(arr_e) + len(arr_h)) * t * plane * 4
+        for a in psi_axes_e + psi_axes_h:
+            total += 3 * 2 * slabs[a] * 4          # profile packs
+        total += (n2 + n3) * 4                     # walls
+        return total
+
+    def _scratch_bytes(t: int) -> int:
+        return (ne + nh) * t * n2 * n3 * 4 + nh * n2 * n3 * 4
+
+    T = _pick_tile_packed(n1, n2 * n3, _block_bytes, _scratch_bytes)
+    if T == 0:
+        return None
+    ntiles = n1 // T
+    # Grid runs ntiles + 1 iterations: the extra one exists solely to
+    # run phase B for the last tile (whose new-E/old-H live in scratch
+    # and whose lagged operand indices land on block ntiles-1
+    # naturally). Phase A's tile-indexed operands pin to block
+    # ntiles-1 with writes masked — under Mosaic's revisiting
+    # semantics (same block index across consecutive iterations =>
+    # keep the VMEM window, no refetch, no intermediate flush — the
+    # same guarantee reduction kernels accumulate on) this is free and
+    # race-free. Doing the last tile in-kernel (instead of a jnp
+    # post-pass) matters: the jnp version induced transposed psi
+    # layouts + a full stacked-array copy per step in XLA (measured:
+    # +0.3 GiB temp at 256^3 and a ~15% step-time regression).
+
+    # ---- the kernel -----------------------------------------------------
+    def kernel(*refs):
+        idx = {}
+        pos = 0
+
+        def take(names):
+            nonlocal pos
+            for nm in names:
+                idx[nm] = refs[pos]
+                pos += 1
+
+        take(["e_in", "h_in"])
+        take([f"psE{a}" for a in psi_axes_e])
+        take([f"psH{a}" for a in psi_axes_h])
+        if drude:
+            take(["j_in"])
+        take([f"prof_e_{a}" for a in psi_axes_e])
+        take([f"prof_h_{a}" for a in psi_axes_h])
+        take(["wall_y", "wall_z"])
+        take([f"ce_{k}" for k in arr_e])
+        take([f"ch_{k}" for k in arr_h])
+        take(["e_out", "h_out"])
+        take([f"psE{a}_out" for a in psi_axes_e])
+        take([f"psH{a}_out" for a in psi_axes_h])
+        if drude:
+            take(["j_out"])
+        take(["se", "sh", "shh"])  # scratch
+
+        i = pl.program_id(0)
+        # phase A is real work for i < ntiles; the final iteration only
+        # runs phase B (for the last tile) and discards phase A
+        valid_a = i < ntiles
+
+        h_vals = [idx["h_in"][j].astype(fdt) for j in range(nh)]
+        e_vals = [idx["e_in"][j].astype(fdt) for j in range(ne)]
+
+        def yz_diff(f, axis, backward):
+            zero = jnp.zeros_like(lax.slice_in_dim(f, 0, 1, axis=axis))
+            if backward:
+                body = lax.slice_in_dim(f, 0, f.shape[axis] - 1, axis=axis)
+                return (f - jnp.concatenate([zero, body], axis=axis)) \
+                    * inv_dx
+            body = lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
+            return (jnp.concatenate([body, zero], axis=axis) - f) * inv_dx
+
+        def slab_term(dfa, psi, tag, a, s, write):
+            """CPML slab psi recursion + curl term for slab axis a.
+
+            ``write(new_psi)`` stores the updated compact psi; returns
+            the full accumulator term for this derivative.
+            """
+            m = slabs[a]
+            pr = idx[f"prof_{tag}_{a}"]
+            b, cc, ik = pr[0], pr[1], pr[2]
+            cut = lambda f, lo, hi: lax.slice_in_dim(f, lo, hi, axis=a)  # noqa: E731
+            nloc = dfa.shape[a]
+            d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
+            p_lo = cut(b, 0, m) * cut(psi, 0, m) + cut(cc, 0, m) * d_lo
+            p_hi = (cut(b, m, 2 * m) * cut(psi, m, 2 * m)
+                    + cut(cc, m, 2 * m) * d_hi)
+            write(jnp.concatenate([p_lo, p_hi], axis=a))
+            dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
+            dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+            mid = list(dfa.shape)
+            mid[a] = nloc - 2 * m
+            delta = jnp.concatenate([dl, jnp.zeros(mid, fdt), dh], axis=a)
+            return s * dfa + delta
+
+        def coef(prefix, key):
+            if coeff_is_array[key]:
+                return idx[f"{prefix}_{key}"][:].astype(fdt)
+            return fdt(float(np_coeffs[key]))
+
+        # ---- phase A: E update on tile i -----------------------------
+        gx = i * T + lax.broadcasted_iota(jnp.int32, (T, 1, 1), 0)
+        wall_x = ((gx != 0) & (gx != n1 - 1)).astype(fdt)
+
+        e_new = []
+        for jc, c in enumerate(e_comps):
+            acc = None
+            for (a, jd, s) in CURL_TERMS[component_axis(c)]:
+                if a == 0:
+                    # bwd halo = last plane of tile i-1's H, carried in
+                    # scratch since the previous iteration (no extra
+                    # HBM operand, no extra read traffic)
+                    bh = idx["shh"][jd]
+                    ghost = jnp.where(i > 0, bh, jnp.zeros_like(bh))
+                    full = jnp.concatenate([ghost, h_vals[jd]], axis=0)
+                    term = s * ((full[1:] - full[:-1]) * inv_dx)
+                else:
+                    dfa = yz_diff(h_vals[jd], a, backward=True)
+                    if a in slabs and a in static.pml_axes:
+                        row = rows_e[a].index(c)
+                        psi = idx[f"psE{a}"][row].astype(fdt)
+                        out_ref = idx[f"psE{a}_out"]
+
+                        def wr(v, out_ref=out_ref, row=row):
+                            @pl.when(valid_a)
+                            def _():
+                                out_ref[row] = v.astype(fdt)
+
+                        term = slab_term(dfa, psi, "e", a, s, wr)
+                    else:
+                        term = s * dfa
+                acc = term if acc is None else acc + term
+            old = e_vals[jc]
+            if drude:
+                j_old = idx["j_in"][jc].astype(fdt)
+                j_new = (coef("ce", f"kj_{c}") * j_old
+                         + coef("ce", f"bj_{c}") * old)
+
+                @pl.when(valid_a)
+                def _(jc=jc, j_new=j_new):
+                    idx["j_out"][jc] = j_new.astype(fdt)
+                acc = acc - j_new
+            e = coef("ce", f"ca_{c}") * old \
+                + coef("ce", f"cb_{c}") * acc
+            ca_ax = component_axis(c)
+            if ca_ax != 0:
+                e = e * wall_x
+            for a2 in (1, 2):
+                if a2 != ca_ax:
+                    e = e * idx[f"wall_{AXES[a2]}"][:].astype(fdt)
+
+            @pl.when(valid_a)
+            def _(jc=jc, e=e):
+                idx["e_out"][jc] = e.astype(fst)
+            e_new.append(e)
+
+        # ---- phase B: H update on tile i-1 (scratch carry) -----------
+        valid = i > 0
+        se_vals = [idx["se"][j] for j in range(ne)]
+        sh_vals = [idx["sh"][j] for j in range(nh)]
+        # forward x-neighbor plane of the lagged tile: the current
+        # tile's first new-E plane, or the PEC zero ghost at i==ntiles
+        # (the global hi edge — there is no tile beyond)
+        first = [jnp.where(valid_a, e_new[j][0:1],
+                           jnp.zeros_like(e_new[j][0:1]))
+                 for j in range(ne)]
+        for jc, c in enumerate(h_comps):
+            acc = None
+            for (a, jd, s) in CURL_TERMS[component_axis(c)]:
+                if a == 0:
+                    ext = jnp.concatenate([se_vals[jd], first[jd]], axis=0)
+                    term = s * ((ext[1:] - ext[:-1]) * inv_dx)
+                else:
+                    dfa = yz_diff(se_vals[jd], a, backward=False)
+                    if a in slabs and a in static.pml_axes:
+                        row = rows_h[a].index(c)
+                        psi_old = idx[f"psH{a}"][row].astype(fdt)
+                        out_ref = idx[f"psH{a}_out"]
+
+                        def wr(v, out_ref=out_ref, row=row,
+                               psi_old=psi_old):
+                            out_ref[row] = jnp.where(
+                                valid, v, psi_old).astype(fdt)
+
+                        term = slab_term(dfa, psi_old, "h", a, s, wr)
+                    else:
+                        term = s * dfa
+                acc = term if acc is None else acc + term
+            h_old = sh_vals[jc]
+            h = coef("ch", f"da_{c}") * h_old \
+                - coef("ch", f"db_{c}") * acc
+            # i == 0: write through the loaded old tile-0 H so the
+            # revisited out block holds well-defined (old) values under
+            # either Mosaic flush semantics; iteration 1 overwrites it.
+            idx["h_out"][jc] = jnp.where(valid, h.astype(fst),
+                                         idx["h_in"][jc])
+
+        # ---- phase C: scratch carry for the next iteration -----------
+        for j in range(ne):
+            idx["se"][j] = e_new[j]
+        for j in range(nh):
+            idx["sh"][j] = h_vals[j]
+            idx["shh"][j] = h_vals[j][-1:]
+
+    # ---- specs ----------------------------------------------------------
+    def stack_spec(k, last2, imap):
+        return pl.BlockSpec((k, T, last2[0], last2[1]), imap,
+                            memory_space=pltpu.VMEM)
+
+    def tile_imap(i):
+        # pinned to the last block on the extra final iteration: same
+        # index as the previous iteration => Mosaic keeps the window
+        # (no refetch of the aliased arrays, no extra flush)
+        return (0, jnp.minimum(i, ntiles - 1), 0, 0)
+
+    def lag_imap(i):
+        return (0, jnp.maximum(i - 1, 0), 0, 0)
+
+    def psi_last2(a):
+        s = _stack_shape(a, 1)
+        return (s[2], s[3])
+
+    def coeff_spec(imap3):
+        return pl.BlockSpec((T, n2, n3), imap3, memory_space=pltpu.VMEM)
+
+    in_specs = [
+        stack_spec(ne, (n2, n3), tile_imap),                  # E in
+        stack_spec(nh, (n2, n3), tile_imap),                  # H in
+    ]
+    in_specs += [stack_spec(len(rows_e[a]), psi_last2(a),
+                            tile_imap) for a in psi_axes_e]
+    in_specs += [stack_spec(len(rows_h[a]), psi_last2(a),
+                            lag_imap) for a in psi_axes_h]
+    if drude:
+        in_specs += [stack_spec(ne, (n2, n3), tile_imap)]     # J in
+    for a in psi_axes_e + psi_axes_h:
+        s = [3, 1, 1, 1]
+        s[1 + a] = 2 * slabs[a]
+        in_specs += [pl.BlockSpec(tuple(s), lambda i: (0, 0, 0, 0),
+                                  memory_space=pltpu.VMEM)]
+    in_specs += [pl.BlockSpec((1, n2, 1), lambda i: (0, 0, 0),
+                              memory_space=pltpu.VMEM),       # wall_y
+                 pl.BlockSpec((1, 1, n3), lambda i: (0, 0, 0),
+                              memory_space=pltpu.VMEM)]       # wall_z
+    in_specs += [coeff_spec(lambda i: (jnp.minimum(i, ntiles - 1), 0, 0))
+                 for _ in arr_e]
+    in_specs += [coeff_spec(lambda i: (jnp.maximum(i - 1, 0), 0, 0))
+                 for _ in arr_h]
+
+    out_specs = [stack_spec(ne, (n2, n3), tile_imap),         # E out
+                 stack_spec(nh, (n2, n3), lag_imap)]          # H out
+    out_specs += [stack_spec(len(rows_e[a]), psi_last2(a),
+                             tile_imap) for a in psi_axes_e]
+    out_specs += [stack_spec(len(rows_h[a]), psi_last2(a),
+                             lag_imap) for a in psi_axes_h]
+    if drude:
+        out_specs += [stack_spec(ne, (n2, n3), tile_imap)]
+
+    out_shape = [jax.ShapeDtypeStruct((ne, n1, n2, n3), fst),
+                 jax.ShapeDtypeStruct((nh, n1, n2, n3), fst)]
+    out_shape += [jax.ShapeDtypeStruct(_stack_shape(a, len(rows_e[a])),
+                                       np.float32) for a in psi_axes_e]
+    out_shape += [jax.ShapeDtypeStruct(_stack_shape(a, len(rows_h[a])),
+                                       np.float32) for a in psi_axes_h]
+    if drude:
+        out_shape += [jax.ShapeDtypeStruct((ne, n1, n2, n3), np.float32)]
+
+    # Donation: every array is read only at block indices whose output
+    # writes happen at the same iteration or later (module docstring),
+    # and each enters the call exactly ONCE (the H bwd halo rides in
+    # scratch, not as a second operand — a second operand over an
+    # aliased buffer made XLA insert a defensive full copy; and an
+    # UN-aliased H output forced a full while-carry copy per step:
+    # both measured at +24 B/cell) -> alias everything.
+    n_psi = len(psi_axes_e) + len(psi_axes_h)
+    aliases = {0: 0, 1: 1}
+    for j in range(n_psi):
+        aliases[2 + j] = 2 + j
+    if drude:
+        aliases[2 + n_psi] = 2 + n_psi
+
+    scratch = [pltpu.VMEM((ne, T, n2, n3), jnp.float32),
+               pltpu.VMEM((nh, T, n2, n3), jnp.float32),
+               pltpu.VMEM((nh, 1, n2, n3), jnp.float32)]
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(ntiles + 1,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        input_output_aliases=aliases,
+        scratch_shapes=scratch,
+        # the tile picker models the full footprint against physical
+        # VMEM, so let Mosaic use all of it (the 100 MiB scoped limit
+        # the two-pass kernels use would just shrink T here)
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_TOTAL),
+        interpret=interpret,
+    )
+
+    # ---- pack / unpack --------------------------------------------------
+    def pack(state):
+        p = {"E": jnp.stack([state["E"][c] for c in e_comps]),
+             "H": jnp.stack([state["H"][c] for c in h_comps]),
+             "t": state["t"]}
+        for a in psi_axes_e:
+            p[f"psE{a}"] = jnp.stack(
+                [state["psi_E"][f"{c}_{AXES[a]}"] for c in rows_e[a]])
+        for a in psi_axes_h:
+            p[f"psH{a}"] = jnp.stack(
+                [state["psi_H"][f"{c}_{AXES[a]}"] for c in rows_h[a]])
+        if x_pml:
+            p["psxE"] = {k: v for k, v in state["psi_E"].items()
+                         if k.endswith("_x")}
+            p["psxH"] = {k: v for k, v in state["psi_H"].items()
+                         if k.endswith("_x")}
+            p["hxs"] = _h_slab_planes(p["H"])
+        if drude:
+            p["J"] = jnp.stack([state["J"][c] for c in e_comps])
+        if setup is not None:
+            p["inc"] = state["inc"]
+        return p
+
+    def unpack(p):
+        state = {"E": {c: p["E"][j] for j, c in enumerate(e_comps)},
+                 "H": {c: p["H"][j] for j, c in enumerate(h_comps)},
+                 "t": p["t"]}
+        psi_e, psi_h = {}, {}
+        for a in psi_axes_e:
+            for j, c in enumerate(rows_e[a]):
+                psi_e[f"{c}_{AXES[a]}"] = p[f"psE{a}"][j]
+        for a in psi_axes_h:
+            for j, c in enumerate(rows_h[a]):
+                psi_h[f"{c}_{AXES[a]}"] = p[f"psH{a}"][j]
+        if x_pml:
+            psi_e.update(p["psxE"])
+            psi_h.update(p["psxH"])
+        if psi_e or psi_h:
+            state["psi_E"] = psi_e
+            state["psi_H"] = psi_h
+        if drude:
+            state["J"] = {c: p["J"][j] for j, c in enumerate(e_comps)}
+        if setup is not None:
+            state["inc"] = p["inc"]
+        return state
+
+    # ---- the step -------------------------------------------------------
+    from fdtd3d_tpu.ops import pallas3d
+    from fdtd3d_tpu.ops import pallas_fused
+    from fdtd3d_tpu.ops import tfsf as tfsf_mod
+
+    m0 = slabs.get(0, 0)
+    # E-side x_slab_post reads OLD H at the x-boundary regions; H is
+    # donated into the pallas call, so even a pre-call slice of it
+    # makes XLA insert a defensive FULL copy of H (measured). Instead
+    # the m0+1 boundary planes per side ride in the packed carry
+    # ("hxs"): each step slices them off its H OUTPUT (alive, no
+    # aliasing conflict) for the NEXT step's post-pass; pack() seeds
+    # them from the initial H.
+    x_src_comps = sorted({
+        "H" + AXES[d_axis]
+        for c in e_comps
+        for (a, d_axis, s) in CURL_TERMS[component_axis(c)] if a == 0})
+
+    def _h_slab_planes(H):
+        """(lo, hi) boundary regions per x-curl source comp of H."""
+        return {d: (H[h_comps.index(d), :m0 + 1],
+                    H[h_comps.index(d), n1 - m0 - 1:])
+                for d in x_src_comps}
+
+    rows_meta_h = {f"{c}_{AXES[a]}": (a, rows_h[a].index(c))
+                   for a in psi_axes_h for c in rows_h[a]}
+
+    def _prof_pack(coeffs, tag, a):
+        v = jnp.stack([coeffs[f"pml_slab_{p}{tag}_{AXES[a]}"]
+                       for p in ("b", "c", "ik")]).astype(fdt)
+        s = [3, 1, 1, 1]
+        s[1 + a] = 2 * slabs[a]
+        return v.reshape(s)
+
+    def _vec3(v, a):
+        s = [1, 1, 1]
+        s[a] = v.shape[0]
+        return v.astype(fdt).reshape(s)
+
+    def step(pstate, coeffs):
+        t = pstate["t"]
+        new_state = dict(pstate)
+        if setup is not None:
+            new_state["inc"] = tfsf_mod.advance_einc(
+                pstate["inc"], coeffs, t, static.dt, static.omega, setup)
+
+        E_arr, H_arr = pstate["E"], pstate["H"]
+        h_slabs = pstate["hxs"] if x_pml else None
+
+        args = [E_arr, H_arr]
+        args += [pstate[f"psE{a}"] for a in psi_axes_e]
+        args += [pstate[f"psH{a}"] for a in psi_axes_h]
+        if drude:
+            args += [pstate["J"]]
+        args += [_prof_pack(coeffs, "e", a) for a in psi_axes_e]
+        args += [_prof_pack(coeffs, "h", a) for a in psi_axes_h]
+        args += [_vec3(coeffs["wall_y"], 1), _vec3(coeffs["wall_z"], 2)]
+        args += [coeffs[k] for k in arr_e]
+        args += [coeffs[k] for k in arr_h]
+        outs = call(*args)
+
+        p = 0
+        new_E_arr = outs[p]; p += 1
+        new_H_arr = outs[p]; p += 1
+        pse = {}
+        for a in psi_axes_e:
+            pse[a] = outs[p]; p += 1
+        psh = {}
+        for a in psi_axes_h:
+            psh[a] = outs[p]; p += 1
+        if drude:
+            new_state["J"] = outs[p]; p += 1
+
+        # ---- E post-passes over the packed view ----------------------
+        eview = PackedView(new_E_arr, e_comps)
+        psxE = dict(pstate.get("psxE", {}))
+        patches: list = []
+        if x_pml:
+            eview, psxE = pallas3d.x_slab_post(
+                static, "E", eview, None, psxE, coeffs, slabs,
+                collect=patches, src_slabs=h_slabs)
+        if setup is not None:
+            eview = pallas3d.tfsf_patch(static, "E", eview, coeffs,
+                                        new_state["inc"],
+                                        collect=patches)
+        if static.cfg.point_source.enabled:
+            eview = pallas3d.point_source_patch(static, eview, coeffs, t,
+                                                collect=patches)
+
+        # ---- H corrections for the E patches -------------------------
+        hview = PackedView(new_H_arr, h_comps)
+        psxH = dict(pstate.get("psxH", {}))
+        psi_h_view = PackedPsiView(psh, rows_meta_h, psxH)
+        if patches:
+            hview, psi_h_view = pallas_fused.apply_patch_h_corrections(
+                static, hview, psi_h_view, patches, coeffs, slabs)
+        if setup is not None:
+            new_state["inc"] = tfsf_mod.advance_hinc(
+                new_state["inc"], coeffs, setup)
+        if x_pml:
+            hview, psxH = pallas3d.x_slab_post(
+                static, "H", hview, eview, psi_h_view.extra, coeffs,
+                slabs)
+            psi_h_view.extra = psxH
+        if setup is not None:
+            hview = pallas3d.tfsf_patch(static, "H", hview, coeffs,
+                                        new_state["inc"])
+
+        new_state["E"] = eview.arr
+        new_state["H"] = hview.arr
+        if x_pml:
+            new_state["hxs"] = _h_slab_planes(hview.arr)
+        for a in psi_axes_e:
+            new_state[f"psE{a}"] = pse[a]
+        for a in psi_axes_h:
+            new_state[f"psH{a}"] = psi_h_view.stacks[a]
+        if x_pml:
+            new_state["psxE"] = psxE
+            new_state["psxH"] = psi_h_view.extra
+        new_state["t"] = t + 1
+        return new_state
+
+    step.pack = pack
+    step.unpack = unpack
+    step.packed = True
+    step.diag = {"tile": {"EH": T},
+                 "vmem_block_bytes": {"EH": _block_bytes(T)},
+                 "vmem_scratch_bytes": _scratch_bytes(T)}
+    return step

@@ -45,6 +45,15 @@ class Simulation:
 
     def __init__(self, cfg: SimConfig, devices: Optional[List] = None):
         self.cfg = cfg
+        # State lives in ONE of two forms: `_sstate` (the dict-of-arrays
+        # pytree every slow path uses) or `_pstate` (the packed stacked
+        # carry of ops/pallas_packed.py, kept across chunks so the
+        # pack/unpack conversion isn't paid per advance). `_dstate`
+        # caches the unpacked view of `_pstate` until the next advance.
+        self._sstate = None
+        self._pstate = None
+        self._dstate = None
+        self._dstate_ids: List[int] = []
         self.static: StaticSetup = build_static(cfg)
         # Topology must be known BEFORE coeffs/state: the CPML psi slab
         # layout (solver.slab_axes) is per-shard.
@@ -108,9 +117,55 @@ class Simulation:
             self.static.mode.active_axes,
             n_devices=len(devices or jax.devices()))
 
+    # -- state representation ---------------------------------------------
+
+    @property
+    def state(self):
+        """The solver state as the dict-of-arrays pytree.
+
+        When the packed kernel carries the state (stacked E/H/psi
+        arrays), this unpacks lazily and caches until the next advance;
+        prefer ``sample()`` for cheap single-value reads in hot loops.
+        In-place edits of the returned dict are honored: the next
+        advance leaf-identity-checks the cache and re-packs from it if
+        anything was replaced (``set_field`` remains the explicit API).
+        """
+        if self._pstate is not None:
+            if self._dstate is None:
+                self._dstate = self._runner.unpack(self._pstate)
+                self._dstate_ids = [id(x) for x in
+                                    jax.tree.leaves(self._dstate)]
+            return self._dstate
+        return self._sstate
+
+    @state.setter
+    def state(self, value):
+        self._sstate = value
+        self._pstate = None
+        self._dstate = None
+
+    def _adopt_dict_edits(self):
+        """Make direct edits of the unpacked view authoritative.
+
+        Callers that did ``sim.state["E"]["Ez"] = arr`` (which worked on
+        every pre-packed path) must not have the edit silently dropped:
+        compare the cached view's leaf identities against those recorded
+        at unpack time and, if anything was replaced, fall back to the
+        dict form (re-packed on this advance)."""
+        if self._pstate is None or self._dstate is None:
+            return
+        leaves = jax.tree.leaves(self._dstate)
+        if len(leaves) != len(self._dstate_ids) or any(
+                id(x) != i for x, i in zip(leaves, self._dstate_ids)):
+            self.state = self._dstate
+
+    def _carry(self):
+        """The live scan-carry pytree in whichever form is current."""
+        return self._pstate if self._pstate is not None else self._sstate
+
     # -- stepping ----------------------------------------------------------
 
-    def _chunk_fn(self, n: int):
+    def _chunk_fn(self, n: int, carry):
         if n not in self._compiled:
             fn = functools.partial(self._runner, n=n)
             if self.mesh is not None:
@@ -122,7 +177,7 @@ class Simulation:
             if self.clock is not None:
                 # Profiled runs must time steps, not compilation: compile
                 # ahead of time so the clocked call below is execute-only.
-                jitted = jitted.lower(self.state, self.coeffs).compile()
+                jitted = jitted.lower(carry, self.coeffs).compile()
             self._compiled[n] = jitted
         return self._compiled[n]
 
@@ -135,18 +190,30 @@ class Simulation:
         """
         if n_steps <= 0:
             return self
-        fn = self._chunk_fn(n_steps)
+        self._adopt_dict_edits()
+        if getattr(self._runner, "packed", False) and self._pstate is None:
+            # enter the packed representation once; it persists across
+            # chunks (the dict form rebuilds lazily via .state)
+            self._pstate = self._runner.pack(self._sstate)
+            self._sstate = None
+        carry = self._carry()
+        fn = self._chunk_fn(n_steps, carry)
         if self.clock is not None:
             self.block_until_ready()
             t0 = time.perf_counter()
-            self.state = fn(self.state, self.coeffs)
-            self.block_until_ready()
+            carry = fn(carry, self.coeffs)
+            self.block_until_ready_on(carry)
             self.clock.record(n_steps, time.perf_counter() - t0,
                               self._cells)
         else:
-            self.state = fn(self.state, self.coeffs)
+            carry = fn(carry, self.coeffs)
+        if self._pstate is not None:
+            self._pstate = carry
+            self._dstate = None
+        else:
+            self._sstate = carry
         if self._check_finite:
-            profiling.assert_finite(self.state, context=f"t={self.t}")
+            profiling.assert_finite(self._carry(), context=f"t={self.t}")
         return self
 
     def run(self, time_steps: Optional[int] = None,
@@ -174,7 +241,23 @@ class Simulation:
 
     @property
     def t(self) -> int:
-        return int(jax.device_get(self.state["t"]))
+        return int(jax.device_get(self._carry()["t"]))
+
+    def sample(self, comp: str, idx) -> float:
+        """One field value as a python float with minimal transfer.
+
+        Unlike ``self.state[...][...]`` this never materializes a full
+        per-component slice of a packed carry — it indexes the stacked
+        array directly (bench.py uses it as its readback sync point).
+        """
+        group = "E" if comp[0] == "E" else "H"
+        self._adopt_dict_edits()
+        if self._pstate is not None:
+            comps = (self.static.mode.e_components if group == "E"
+                     else self.static.mode.h_components)
+            j = comps.index(comp)
+            return float(self._pstate[group][(j,) + tuple(idx)])
+        return float(self._sstate[group][comp][tuple(idx)])
 
     def field(self, comp: str) -> np.ndarray:
         """Gather one field component to host as a global numpy array.
@@ -195,7 +278,11 @@ class Simulation:
         return out
 
     def block_until_ready(self):
-        jax.block_until_ready(self.state)
+        jax.block_until_ready(self._carry())
+        return self
+
+    def block_until_ready_on(self, carry):
+        jax.block_until_ready(carry)
         return self
 
     def set_field(self, comp: str, value: np.ndarray):
@@ -204,7 +291,8 @@ class Simulation:
         if comp not in self.state[group]:
             raise KeyError(f"{comp} not active in scheme {self.cfg.scheme}")
         self._metrics_cache = None  # diag cache keys on t, not contents
-        old = self.state[group][comp]
+        st = self.state
+        old = st[group][comp]
         vnp = np.asarray(np.broadcast_to(value, old.shape),
                          dtype=old.dtype)
         if self.mesh is not None:
@@ -212,7 +300,10 @@ class Simulation:
                                    self.mesh)
         else:
             arr = jnp.asarray(vnp)
-        self.state[group][comp] = arr
+        st[group][comp] = arr
+        # write back through the setter: drops any packed carry so the
+        # edit is authoritative (re-packed on the next advance)
+        self.state = st
         return self
 
     # -- checkpoint/resume (reference DAT save->load workflow, SURVEY §5.4)

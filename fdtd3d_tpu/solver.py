@@ -313,6 +313,25 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     pure-jnp step below (identical semantics) is built.
     """
     if _want_pallas(static, mesh_axes):
+        import os as _os
+
+        # Packed pipelined single-pass kernel (ops/pallas_packed.py):
+        # the round-4 hot path — stacked E/H operands, H update lagging
+        # one x-tile on VMEM scratch carry, 12 volumes/step vs the
+        # two-pass kernels' 18 — so it engages whenever eligible.
+        # FDTD3D_NO_PACKED is the measurement escape hatch
+        # (tools/measure_r4.py compares all three in one window);
+        # FDTD3D_FORCE_FUSED (below) also skips it, so forcing the
+        # fused kernel needs only the one variable.
+        if not _os.environ.get("FDTD3D_NO_PACKED") \
+                and not _os.environ.get("FDTD3D_FORCE_FUSED"):
+            from fdtd3d_tpu.ops import pallas_packed
+            pk = pallas_packed.make_packed_eh_step(static, mesh_axes,
+                                                   mesh_shape)
+            if pk is not None:
+                pk.kind = "pallas_packed"
+                return pk
+
         # single-pass E+H kernel where its (stricter) scope allows —
         # ~2/3 the HBM traffic of the two-pass kernels, but ONLY when
         # the VMEM-budgeted x-tile stays large enough: every tile
@@ -323,11 +342,15 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         # FDTD3D_NO_FUSED is a measurement escape hatch: it forces the
         # two-pass kernels so the fused advantage can be benchmarked on
         # configs where both are eligible (tools/measure_r3.py).
-        import os as _os
+        # FDTD3D_FORCE_FUSED bypasses the tile>=4 dispatch heuristic —
+        # the threshold was measured on one throttled tunneled chip and
+        # the crossover may sit elsewhere on other TPU generations
+        # (ADVICE r3).
         from fdtd3d_tpu.ops import pallas_fused
         eh = None if _os.environ.get("FDTD3D_NO_FUSED") else \
             pallas_fused.make_fused_eh_step(static, mesh_axes, mesh_shape)
-        if eh is not None and eh.diag["tile"]["EH"] >= 4:
+        if eh is not None and (eh.diag["tile"]["EH"] >= 4
+                               or _os.environ.get("FDTD3D_FORCE_FUSED")):
             eh.kind = "pallas_fused"
             return eh
         from fdtd3d_tpu.ops import pallas3d
@@ -517,7 +540,14 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
 
 
 def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
-    """scan-over-steps runner: run_chunk(state, coeffs, n) with static n."""
+    """scan-over-steps runner: run_chunk(state, coeffs, n) with static n.
+
+    When the packed kernel is engaged (``run_chunk.packed``), the scan
+    carry is the PACKED state pytree (stacked E/H/psi arrays); callers
+    convert once per run with ``run_chunk.pack`` / ``run_chunk.unpack``
+    (Simulation keeps the packed carry across chunks so the conversion
+    cost is paid once, not per chunk).
+    """
     step = make_step(static, mesh_axes, mesh_shape)
 
     def run_chunk(state, coeffs, n: int):
@@ -528,4 +558,8 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
 
     run_chunk.kind = getattr(step, "kind", "jnp")
     run_chunk.diag = getattr(step, "diag", None)
+    if getattr(step, "packed", False):
+        run_chunk.packed = True
+        run_chunk.pack = step.pack
+        run_chunk.unpack = step.unpack
     return run_chunk

@@ -20,6 +20,14 @@ from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
                                TfsfConfig)
 from fdtd3d_tpu.sim import Simulation
 
+
+@pytest.fixture(autouse=True)
+def _no_packed(monkeypatch):
+    """Pin the dispatch to the recompute-fused kernel under test: the
+    packed pipelined kernel (ops/pallas_packed.py, round 4) outranks it
+    and would otherwise take every eligible config here."""
+    monkeypatch.setenv("FDTD3D_NO_PACKED", "1")
+
 BASE = dict(scheme="3D", size=(16, 16, 16), time_steps=8, dx=1e-3,
             courant_factor=0.4, wavelength=8e-3)
 

@@ -1,0 +1,235 @@
+"""Packed pipelined single-pass kernel (ops/pallas_packed.py) vs jnp.
+
+The packed kernel stacks E/H (and the CPML psi) into single HBM arrays
+and computes the H family one x-tile behind the E family on VMEM
+scratch carry (grid-sequential pipelining). Parity with the jnp step
+must hold at f32 roundoff INCLUDING the psi recursion state; the
+Simulation keeps the packed carry across chunks, so the state
+property, sample(), set_field and checkpointing are exercised against
+it too. Out-of-scope configs (magnetic Drude, sharded) must fall back
+to the recompute-fused / two-pass kernels rather than silently
+degrade.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig, SphereConfig,
+                               TfsfConfig)
+from fdtd3d_tpu.sim import Simulation
+
+BASE = dict(scheme="3D", size=(16, 16, 16), time_steps=8, dx=1e-3,
+            courant_factor=0.4, wavelength=8e-3)
+
+
+def _seed_fields(sim, seed=0):
+    key = jax.random.PRNGKey(seed)
+    for grp in ("E", "H"):
+        for c in list(sim.state[grp]):
+            key, k2 = jax.random.split(key)
+            sim.set_field(c, 0.01 * np.asarray(
+                jax.random.normal(k2, sim.state[grp][c].shape)))
+
+
+def _run(use_pallas, **kw):
+    sim = Simulation(SimConfig(**BASE, use_pallas=use_pallas, **kw))
+    _seed_fields(sim)
+    sim.run()
+    return sim
+
+
+def _parity(tol=2e-6, **kw):
+    j = _run(False, **kw)
+    p = _run(True, **kw)
+    assert p.step_kind == "pallas_packed", p.step_kind
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < tol, f"{c}: rel {rel:.2e}"
+    return j, p
+
+
+def test_packed_vacuum_parity():
+    _parity()
+
+
+def test_packed_xyz_cpml_parity():
+    _parity(pml=PmlConfig(size=(3, 3, 3)))
+
+
+def test_packed_psi_state_parity():
+    """The recursion state itself must match — errors there accumulate
+    silently over long runs."""
+    j, p = _parity(pml=PmlConfig(size=(3, 3, 3)))
+    for grp in ("psi_E", "psi_H"):
+        for k in j.state[grp]:
+            a = np.asarray(j.state[grp][k])
+            b = np.asarray(p.state[grp][k])
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < 2e-6, f"{grp}/{k}: rel {rel:.2e}"
+
+
+def test_packed_tfsf_parity():
+    _parity(pml=PmlConfig(size=(3, 3, 3)),
+            tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                            angle_teta=30.0, angle_phi=40.0,
+                            angle_psi=15.0))
+
+
+def test_packed_point_source_drude_materials_parity():
+    """Kitchen sink within packed scope: x/y/z CPML + TFSF + point
+    source + electric Drude + a material grid (streamed array coeffs at
+    the lagged H tile index)."""
+    _parity(pml=PmlConfig(size=(3, 3, 3)),
+            tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2)),
+            point_source=PointSourceConfig(enabled=True, component="Ez",
+                                           position=(5, 9, 7)),
+            materials=MaterialsConfig(
+                eps=2.0,
+                eps_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                        radius=4, value=6.0),
+                use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+                drude_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                          radius=3)))
+
+
+def test_packed_uneven_tiles():
+    """Non-power-of-two x extent (12 -> T=4, 3 tiles): exercises the
+    lagged index maps and the last-tile jnp H pass on an odd tiling."""
+    cfg = dict(BASE)
+    cfg["size"] = (12, 16, 16)
+
+    def run(up):
+        sim = Simulation(SimConfig(**cfg, use_pallas=up,
+                                   pml=PmlConfig(size=(2, 3, 3))))
+        _seed_fields(sim, seed=2)
+        sim.run()
+        return sim
+    j = run(False)
+    p = run(True)
+    assert p.step_kind == "pallas_packed"
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-6, f"{c}: rel {rel:.2e}"
+
+
+def test_packed_bf16_smoke():
+    j = _run(False, dtype="bfloat16", pml=PmlConfig(size=(0, 3, 3)))
+    p = _run(True, dtype="bfloat16", pml=PmlConfig(size=(0, 3, 3)))
+    assert p.step_kind == "pallas_packed"
+    for c in ("Ez", "Hy"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-2, f"{c}: rel {rel:.2e}"
+
+
+def test_packed_multi_chunk_carry():
+    """Several advance() calls reuse the packed carry; interleaved state
+    reads (which unpack) must not fork it."""
+    cfg = SimConfig(**BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez", position=(8, 8, 8)))
+    one = Simulation(cfg)
+    one.advance(8)
+    many = Simulation(cfg)
+    for _ in range(4):
+        many.advance(2)
+        _ = many.state["E"]["Ez"]  # force an unpack between chunks
+    assert many.step_kind == "pallas_packed"
+    a = np.asarray(one.field("Ez"))
+    b = np.asarray(many.field("Ez"))
+    assert np.abs(a - b).max() / (np.abs(a).max() + 1e-30) < 1e-6
+    assert one.t == many.t == 8
+
+
+def test_packed_sample_matches_state():
+    cfg = SimConfig(**BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez", position=(8, 8, 8)))
+    sim = Simulation(cfg)
+    sim.advance(6)
+    got = sim.sample("Ez", (8, 8, 9))
+    want = float(np.asarray(sim.state["E"]["Ez"])[8, 8, 9])
+    assert got == pytest.approx(want, rel=0, abs=0)
+
+
+def test_packed_direct_state_mutation_adopted():
+    """sim.state['E']['Ez'] = arr worked on every pre-packed path; the
+    packed carry must leaf-identity-check the unpacked view and adopt
+    such edits instead of silently dropping them."""
+    import jax.numpy as jnp
+    cfg = SimConfig(**BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez", position=(8, 8, 8)))
+    sim = Simulation(cfg)
+    sim.advance(2)
+    sim.state["E"]["Ez"] = jnp.zeros(sim.state["E"]["Ez"].shape,
+                                     jnp.float32)
+    assert sim.sample("Ez", (8, 8, 9)) == 0.0  # adopted before the read
+    sim.advance(1)  # re-packs from the edited dict
+    other = Simulation(cfg)
+    other.advance(2)
+    other.set_field("Ez", np.zeros(other.state["E"]["Ez"].shape,
+                                   np.float32))
+    other.advance(1)
+    a = np.asarray(sim.field("Ez"))
+    b = np.asarray(other.field("Ez"))
+    assert np.abs(a - b).max() == 0.0
+
+
+def test_packed_set_field_after_advance():
+    """set_field must invalidate the packed carry (re-packed next
+    advance) — the edit, not the stale carry, is authoritative."""
+    cfg = SimConfig(**BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)))
+    sim = Simulation(cfg)
+    _seed_fields(sim)
+    sim.advance(2)
+    sim.set_field("Ez", np.zeros(sim.state["E"]["Ez"].shape,
+                                 np.float32))
+    assert sim.sample("Ez", (8, 8, 8)) == 0.0
+    sim.advance(1)  # must re-pack and keep running
+    assert np.isfinite(np.asarray(sim.field("Ez"))).all()
+
+
+def test_packed_checkpoint_roundtrip(tmp_path):
+    cfg = SimConfig(**BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez", position=(8, 8, 8)))
+    sim = Simulation(cfg)
+    sim.advance(4)
+    path = str(tmp_path / "ck.npz")
+    sim.checkpoint(path)
+    sim.advance(4)
+    ref = np.asarray(sim.field("Ez"))
+
+    res = Simulation(cfg)
+    res.restore(path)
+    assert res.t == 4
+    res.advance(4)
+    got = np.asarray(res.field("Ez"))
+    assert np.abs(ref - got).max() == 0.0  # bit-exact resume
+
+
+def test_packed_drude_m_falls_back():
+    """Magnetic Drude is out of packed scope -> recompute-fused path."""
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),
+        materials=MaterialsConfig(
+            use_drude_m=True, mu_inf=1.5, omega_pm=1e11, gamma_m=1e10,
+            drude_m_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                        radius=3))))
+    assert sim.step_kind in ("pallas_fused", "pallas")
+
+
+def test_packed_sharded_falls_back():
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(1, 2, 2))))
+    assert sim.step_kind == "pallas"

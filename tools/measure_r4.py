@@ -1,0 +1,152 @@
+"""Round-4 same-window measurement sweep (VERDICT.md round-3 items 1/5).
+
+Measures, in ONE session so the tunnel calibration is shared:
+  * HBM streaming probe (tunnel-health calibration)
+  * bench config (x+y+z CPML) at 256^3: jnp vs two-pass vs recompute-
+    fused vs the round-4 PACKED pipelined kernel, f32 and bf16
+  * 512^3 (gated on the same direct-timing health check bench.py
+    uses): jnp vs two-pass vs packed (f32 + bf16), plus a forced-T=2
+    packed attempt via the VMEM budget override (expected to OOM
+    loudly if the temporaries model is right — recorded either way).
+
+Writes one JSON dict per line to stdout and the full record to
+tools/measure_r4.json so BASELINE.md can cite it.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "measure_r4.json")
+
+KNOB_VARS = ("FDTD3D_NO_PACKED", "FDTD3D_NO_FUSED", "FDTD3D_FORCE_FUSED",
+             "FDTD3D_VMEM_BUDGET_MB")
+
+
+def log(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def measure(n, steps, use_pallas, dtype="float32", pml_axes="xyz",
+            repeats=3, env=None):
+    """(Mcells/s, step_kind, tile) for one config (best timed chunk)."""
+    import numpy as np
+
+    for k in KNOB_VARS:
+        os.environ.pop(k, None)
+    for k, v in (env or {}).items():
+        os.environ[k] = v
+
+    from fdtd3d_tpu.config import PmlConfig, SimConfig
+    from fdtd3d_tpu.sim import Simulation
+
+    size = tuple(10 if a in pml_axes else 0 for a in "xyz")
+    cfg = SimConfig(
+        scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
+        courant_factor=0.5, wavelength=32e-3,
+        pml=PmlConfig(size=size),
+        dtype=dtype, use_pallas=use_pallas,
+    )
+    sim = Simulation(cfg)
+    kind = sim.step_kind
+    tile = (sim.step_diag or {}).get("tile")
+    sim.advance(steps)
+    sim.sample("Ez", (n // 2, n // 2, n // 2))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        sim.advance(steps)
+        sim.block_until_ready()
+        sim.sample("Ez", (n // 2, n // 2, n // 2))
+        best = min(best, time.perf_counter() - t0)
+    v = np.asarray(sim.state["E"]["Ez"])
+    assert np.isfinite(v).all()
+    del sim
+    return (n ** 3) * steps / best / 1e6, kind, tile
+
+
+def main():
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/jax_fdtd3d"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
+
+    from bench import probe_hbm_gbps
+
+    record = {"session_start": time.strftime("%Y-%m-%d %H:%M:%S"),
+              "platform": jax.default_backend(),
+              "device_kind": jax.devices()[0].device_kind,
+              "results": []}
+    try:
+        record["hbm_probe_gbps"] = round(probe_hbm_gbps(), 1)
+    except Exception as e:
+        record["hbm_probe_gbps"] = -1.0
+        record["hbm_probe_error"] = str(e)[:200]
+    log({"hbm_probe_gbps": record["hbm_probe_gbps"]})
+
+    def run_cases(cases):
+        for (label, n, steps, up, dt, pa, env) in cases:
+            try:
+                t0 = time.time()
+                mc, kind, tile = measure(n, steps, up, dt, pa, env=env)
+                rec = {"label": label, "n": n, "steps": steps, "dtype": dt,
+                       "pml_axes": pa, "mcells": round(mc, 1),
+                       "step_kind": kind, "tile": tile,
+                       "wall_s": round(time.time() - t0, 1)}
+            except Exception as e:
+                rec = {"label": label, "error": str(e)[-300:]}
+            record["results"].append(rec)
+            log(rec)
+            with open(OUT_PATH, "w") as f:
+                json.dump(record, f, indent=1)
+
+    TWOPASS = {"FDTD3D_NO_PACKED": "1", "FDTD3D_NO_FUSED": "1"}
+    FUSED = {"FDTD3D_NO_PACKED": "1", "FDTD3D_FORCE_FUSED": "1"}
+    run_cases([
+        # (label, n, steps, use_pallas, dtype, pml_axes, env)
+        ("jnp_f32", 256, 10, False, "float32", "xyz", None),
+        ("twopass_f32", 256, 10, True, "float32", "xyz", TWOPASS),
+        ("fused_f32", 256, 10, True, "float32", "xyz", FUSED),
+        ("packed_f32", 256, 10, True, "float32", "xyz", None),
+        ("packed_bf16", 256, 10, True, "bfloat16", "xyz", None),
+        ("twopass_bf16", 256, 10, True, "bfloat16", "xyz", TWOPASS),
+    ])
+
+    from bench import GATE_MCELLS_512, STAGE1_BUDGET_S
+    p256 = next((r for r in record["results"]
+                 if r.get("label") == "packed_f32" and "mcells" in r),
+                None)
+    elapsed = sum(r.get("wall_s", 0) for r in record["results"])
+    healthy = (p256 is not None
+               and p256["mcells"] >= GATE_MCELLS_512
+               and elapsed < STAGE1_BUDGET_S)
+    record["healthy_512"] = healthy
+    if healthy:
+        run_cases([
+            ("jnp_f32_512", 512, 20, False, "float32", "xyz", None),
+            ("twopass_f32_512", 512, 20, True, "float32", "xyz",
+             dict(TWOPASS, FDTD3D_VMEM_BUDGET_MB="86")),
+            ("packed_f32_512", 512, 20, True, "float32", "xyz", None),
+            ("packed_bf16_512", 512, 20, True, "bfloat16", "xyz", None),
+            # forced T=2: expected loud Mosaic OOM per the temporaries
+            # model; recorded to validate (or re-calibrate) the model
+            ("packed_f32_512_T2", 512, 20, True, "float32", "xyz",
+             {"FDTD3D_VMEM_BUDGET_MB": "86"}),
+        ])
+
+    record["session_end"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    with open(OUT_PATH, "w") as f:
+        json.dump(record, f, indent=1)
+    log({"done": True})
+
+
+if __name__ == "__main__":
+    main()
