@@ -44,7 +44,8 @@ BACKOFF_S = 20
 ATTEMPT_TIMEOUT_S = 2400
 
 
-def measure(n: int, steps: int, use_pallas, repeats: int = 3) -> float:
+def measure(n: int, steps: int, use_pallas, repeats: int = 3,
+            dtype: str = "float32") -> float:
     """Mcells/s for one path. Import jax lazily: the parent never does."""
     import jax
     import numpy as np
@@ -56,7 +57,7 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3) -> float:
         scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
         courant_factor=0.5, wavelength=32e-3,
         pml=PmlConfig(size=(10, 10, 10)),
-        dtype="float32", use_pallas=use_pallas,
+        dtype=dtype, use_pallas=use_pallas,
     )
     sim = Simulation(cfg)
     # Warm up: compile AND force one real device->host readback (async
@@ -93,10 +94,16 @@ def probe_hbm_gbps() -> float:
     """
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
-    n = (1 << 29)  # 2 GiB of f32 (4 GiB of traffic per pass)
+    n = (1 << 28)  # 1 GiB of f32
+    passes = 8     # 8 read+write passes inside ONE dispatch: the fixed
+    # per-call readback latency through the tunnel drowned a single
+    # pass (the probe read -1.0 all of round 3); amortizing 16 GiB of
+    # traffic over one readback makes the device time measurable.
     x = jnp.ones((n,), jnp.float32)
-    stream = jax.jit(lambda v: v + 1.0)
+    stream = jax.jit(lambda v: lax.fori_loop(
+        0, passes, lambda i, a: a + 1.0, v))
     # block_until_ready returns before execution through the async device
     # tunnel (measured: tens of TB/s reported) — force a one-element
     # device->host readback, and subtract that readback's own latency.
@@ -113,7 +120,7 @@ def probe_hbm_gbps() -> float:
         best = min(best, time.perf_counter() - t0)
     if best - rb <= 0.25 * rb:
         return -1.0  # readback-dominated: calibration unreliable
-    return 2 * n * 4 / (best - rb) / 1e9  # read + write
+    return 2 * passes * n * 4 / (best - rb) / 1e9  # read + write
 
 
 BEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -140,22 +147,26 @@ def _load_best():
         return None
 
 
-def _maybe_update_best(pallas_mc, jnp_mc, n, gbps, device_kind):
+def _maybe_update_best(pallas_mc, jnp_mc, bf16_mc, n, gbps, device_kind):
     """Keep BENCH_BEST.json = the best session on record (+calibration)."""
     best = _load_best()
-    cur = max(pallas_mc, jnp_mc)
+    cur = max(pallas_mc, jnp_mc, bf16_mc)
     try:
         best_val = float(best.get("best_known_mcells", 0)) if best else 0.0
     except (TypeError, ValueError):
         best_val = 0.0  # malformed record: overwrite with a fresh one
     if best is not None and cur <= best_val:
         return best
+    path = "pallas-bf16" if cur == bf16_mc else (
+        "pallas" if pallas_mc >= jnp_mc else "jnp")
     new = {
         "comment": (best or {}).get("comment", ""),
         "best_known_mcells": round(cur, 1),
         "n": n,
-        "path": "pallas" if pallas_mc >= jnp_mc else "jnp",
+        "path": path,
         "jnp_mcells": round(jnp_mc, 1),
+        "f32_pallas_mcells": round(pallas_mc, 1),
+        "bf16_mcells": round(bf16_mc, 1),
         "hbm_probe_gbps": gbps,
         "session": time.strftime("%Y-%m-%d %H:%M:%S"),
         "device_kind": device_kind,
@@ -238,9 +249,19 @@ def run_measurement() -> None:
             n, jnp_mc, pallas_mc = 512, jnp_512, pallas_512
         except Exception:
             pass  # report the completed 256^3 measurements
-    mcells = max(jnp_mc, pallas_mc)
-    best = _maybe_update_best(pallas_mc, jnp_mc, n, gbps, device_kind) \
-        if on_tpu else None
+    # bf16 storage on the packed kernel: half the field traffic — the
+    # fastest path on record (VERDICT r3 item 5: capture the bf16/f32
+    # pair whenever the window is healthy enough to measure it).
+    bf16_mc = 0.0
+    if on_tpu and pallas_mc >= GATE_MCELLS_512:
+        try:
+            bf16_mc = measure(n, 20 if n >= 512 else 10,
+                              use_pallas=True, dtype="bfloat16")
+        except Exception:
+            pass
+    mcells = max(jnp_mc, pallas_mc, bf16_mc)
+    best = _maybe_update_best(pallas_mc, jnp_mc, bf16_mc, n, gbps,
+                              device_kind) if on_tpu else None
     out = {
         "metric": f"Mcells/s/chip (3D Yee + CPML, {n}^3, {device_kind})",
         "value": round(mcells, 1),
@@ -248,6 +269,7 @@ def run_measurement() -> None:
         "vs_baseline": round(mcells / 1e4, 4),
         "pallas_mcells": round(pallas_mc, 1),
         "jnp_mcells": round(jnp_mc, 1),
+        "bf16_mcells": round(bf16_mc, 1),
         "hbm_probe_gbps": gbps,
         "platform": platform,
     }

@@ -37,7 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="1D mode shorthand, e.g. --1d EzHy")
     g.add_argument("--2d", dest="dim2", metavar="POL",
                    help="2D mode shorthand, e.g. --2d TMz")
-    g.add_argument("--3d", dest="dim3", action="store_true",
+    g.add_argument("--3d", dest="dim3", action=argparse.BooleanOptionalAction, default=False,
                    help="3D mode shorthand")
     g.add_argument("--sizex", type=int, default=32)
     g.add_argument("--sizey", type=int, default=32)
@@ -51,10 +51,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="source wavelength, m")
     g.add_argument("--dtype", choices=["float32", "float64", "bfloat16"],
                    default="float32")
-    g.add_argument("--complex-field-values", action="store_true")
+    g.add_argument("--compensated", action=argparse.BooleanOptionalAction, default=False,
+                   help="Kahan-compensated f32 updates: f64-class "
+                        "long-horizon accuracy at ~1.25x the f32 "
+                        "traffic (float32 only)")
+    g.add_argument("--complex-field-values", action=argparse.BooleanOptionalAction, default=False)
 
     g = p.add_argument_group("boundaries (CPML)")
-    g.add_argument("--use-pml", action="store_true")
+    g.add_argument("--use-pml", action=argparse.BooleanOptionalAction, default=False)
     g.add_argument("--pml-size", type=int, default=8,
                    help="thickness on every active axis")
     g.add_argument("--pml-sizex", type=int, default=None)
@@ -62,7 +66,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--pml-sizez", type=int, default=None)
 
     g = p.add_argument_group("TFSF plane-wave source")
-    g.add_argument("--use-tfsf", action="store_true")
+    g.add_argument("--use-tfsf", action=argparse.BooleanOptionalAction, default=False)
     g.add_argument("--tfsf-margin", type=int, default=8)
     g.add_argument("--angle-teta", type=float, default=0.0)
     g.add_argument("--angle-phi", type=float, default=0.0)
@@ -94,7 +98,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--eps-sphere-radius", type=float, default=0.0)
     g.add_argument("--load-eps-from-file", metavar="PATH", default=None)
     g.add_argument("--load-mu-from-file", metavar="PATH", default=None)
-    g.add_argument("--use-drude", action="store_true")
+    g.add_argument("--use-drude", action=argparse.BooleanOptionalAction, default=False)
     g.add_argument("--eps-inf", type=float, default=1.0)
     g.add_argument("--omega-p", type=float, default=0.0, help="rad/s")
     g.add_argument("--gamma-d", type=float, default=0.0, help="rad/s")
@@ -103,7 +107,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--drude-sphere-center-z", type=float, default=0.0)
     g.add_argument("--drude-sphere-radius", type=float, default=0.0)
     # magnetic Drude (reference metamaterial mode: OmegaPM/GammaM)
-    g.add_argument("--use-drude-m", action="store_true",
+    g.add_argument("--use-drude-m", action=argparse.BooleanOptionalAction, default=False,
                    help="dispersive mu(w) via an ADE magnetic current")
     g.add_argument("--mu-inf", type=float, default=1.0)
     g.add_argument("--omega-pm", type=float, default=0.0, help="rad/s")
@@ -114,7 +118,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--drude-m-sphere-radius", type=float, default=0.0)
 
     g = p.add_argument_group("near-to-far-field (NTFF)")
-    g.add_argument("--ntff", action="store_true",
+    g.add_argument("--ntff", action=argparse.BooleanOptionalAction, default=False,
                    help="accumulate the NTFF running DFT during the run "
                         "and write the far-field pattern at the end")
     g.add_argument("--ntff-frequency", type=float, default=None,
@@ -152,7 +156,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "auto engages them on TPU when eligible; on "
                         "forces them (interpreter mode off-TPU, slow); "
                         "off always runs the jnp path")
-    g.add_argument("--require-pallas", action="store_true",
+    g.add_argument("--require-pallas", action=argparse.BooleanOptionalAction, default=False,
                    help="error out if the fused kernels do not engage "
                         "instead of silently running the jnp fallback")
 
@@ -162,7 +166,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--save-dir", default="out")
     g.add_argument("--save-formats", default="dat",
                    help="comma list of dat,txt,bmp")
-    g.add_argument("--save-materials", action="store_true")
+    g.add_argument("--save-materials", action=argparse.BooleanOptionalAction, default=False)
     g.add_argument("--checkpoint-every", type=int, default=0)
     g.add_argument("--checkpoint-backend", choices=["npz", "orbax"],
                    default="npz",
@@ -176,10 +180,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "norms, divergence residual) to "
                         "save_dir/metrics.jsonl every N steps")
     g.add_argument("--log-level", type=int, default=1)
-    g.add_argument("--profile", action="store_true",
+    g.add_argument("--profile", action=argparse.BooleanOptionalAction, default=False,
                    help="time every compute chunk (StepClock) and print a "
                         "throughput summary at the end")
-    g.add_argument("--check-finite", action="store_true",
+    g.add_argument("--check-finite", action=argparse.BooleanOptionalAction, default=False,
                    help="NaN/Inf tripwire over the state after each chunk")
     g.add_argument("--trace", metavar="DIR", default=None,
                    help="write a jax.profiler (XProf/TensorBoard) trace "
@@ -187,7 +191,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "halo collectives vs stencil compute")
 
     g = p.add_argument_group("planning")
-    g.add_argument("--dry-run", action="store_true",
+    g.add_argument("--dry-run", action=argparse.BooleanOptionalAction, default=False,
                    help="print the per-chip memory/communication plan "
                         "(no device needed) and exit — size pod-scale "
                         "configs on a laptop")
@@ -262,6 +266,7 @@ def args_to_config(args) -> SimConfig:
         courant_factor=args.courant_factor,
         wavelength=args.wavelength,
         dtype=args.dtype,
+        compensated=args.compensated,
         complex_fields=args.complex_field_values,
         pml=PmlConfig(size=pml_size),
         tfsf=TfsfConfig(
@@ -381,10 +386,17 @@ def save_cmd_file(args, path: str):
             continue
         opt = action.option_strings[0]
         if isinstance(val, bool):
-            # store_true flags: presence means True; False is the
-            # unexpressible (and only other) state.
+            # boolean flags use BooleanOptionalAction, so BOTH states
+            # are representable (--flag / --no-flag): a saved file
+            # replays identically even if a flag's default ever flips
+            # to True (ADVICE r3).
             if val:
                 lines.append(opt)
+            else:
+                neg = next((o for o in action.option_strings
+                            if o.startswith("--no-")), None)
+                if neg is not None:
+                    lines.append(neg)
         else:
             lines.append(f"{opt} {val}")
     with open(path, "w") as f:
@@ -451,30 +463,30 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     cfg = args_to_config(args)
     from fdtd3d_tpu import io
+    from fdtd3d_tpu.log import log, set_level
     from fdtd3d_tpu.sim import Simulation  # deferred: jax init is slow
+    set_level(cfg.output.log_level)
     sim = Simulation(cfg)
     if args.load_checkpoint:
         sim.restore(args.load_checkpoint)
-        if args.log_level >= 1:
-            print(f"restored checkpoint {args.load_checkpoint} at t={sim.t}")
+        log(f"restored checkpoint {args.load_checkpoint} at t={sim.t}")
     if cfg.output.save_materials:
         io.write_materials(sim)
-    if args.log_level >= 1:
-        import jax
-        print(f"fdtd3d-tpu: scheme={cfg.scheme} size={cfg.grid_shape} "
-              f"steps={cfg.time_steps} dt={cfg.dt:.3e}s "
-              f"topology={sim.topology} devices={jax.device_count()}")
-        # engaged-path observability (VERDICT r2 item 7): which kernel
-        # actually runs, its x-tile size, and the VMEM working set.
-        line = f"step_kind={sim.step_kind}"
-        if sim.step_diag:
-            tiles = ",".join(f"{k}:{v}"
-                             for k, v in sim.step_diag["tile"].items())
-            vmem = ",".join(
-                f"{k}:{v / 1048576:.1f}MiB"
-                for k, v in sim.step_diag["vmem_block_bytes"].items())
-            line += f" tile=[{tiles}] vmem_block=[{vmem}]"
-        print(line)
+    import jax
+    log(f"fdtd3d-tpu: scheme={cfg.scheme} size={cfg.grid_shape} "
+        f"steps={cfg.time_steps} dt={cfg.dt:.3e}s "
+        f"topology={sim.topology} devices={jax.device_count()}")
+    # engaged-path observability (VERDICT r2 item 7): which kernel
+    # actually runs, its x-tile size, and the VMEM working set.
+    line = f"step_kind={sim.step_kind}"
+    if sim.step_diag:
+        tiles = ",".join(f"{k}:{v}"
+                         for k, v in sim.step_diag["tile"].items())
+        vmem = ",".join(
+            f"{k}:{v / 1048576:.1f}MiB"
+            for k, v in sim.step_diag["vmem_block_bytes"].items())
+        line += f" tile=[{tiles}] vmem_block=[{vmem}]"
+    log(line)
 
     # NTFF: resolve cadence defaults and build the collector (reference
     # --ntff-* surface; running DFT sampled between compute chunks).
@@ -525,12 +537,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                                        "metrics.jsonl"), "a") as f:
                     f.write(json.dumps(rec) + "\n")
         if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
-            import jax
             norms = diag.field_norms(s)   # collective: ALL ranks
-            if jax.process_index() == 0:
-                txt = " ".join(f"{k}={v:.4e}"
-                               for k, v in sorted(norms.items()))
-                print(f"[t={s.t}] {txt}")
+            txt = " ".join(f"{k}={v:.4e}"
+                           for k, v in sorted(norms.items()))
+            log(f"[t={s.t}] {txt}")  # rank-0-only inside log()
         if cfg.output.save_res and s.t % cfg.output.save_res == 0:
             io.write_outputs(s, s.t)
         if cfg.output.checkpoint_every and \
@@ -562,22 +572,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             _ = ntff_col.acc  # collective gather: ALL ranks participate
             if jax.process_index() == 0:
                 path = write_ntff_pattern(ntff_col, cfg)
-                if args.log_level >= 1:
-                    print(f"ntff: {ntff_col.n_samples} samples -> {path}")
+                log(f"ntff: {ntff_col.n_samples} samples -> {path}")
         else:
-            print(f"ntff: WARNING: no samples collected (first sample at "
-                  f"step {ntff_start}, every {ntff_every}, run ends at "
-                  f"{cfg.time_steps}) — no pattern written")
+            from fdtd3d_tpu.log import warn
+            warn(f"ntff: no samples collected (first sample at "
+                 f"step {ntff_start}, every {ntff_every}, run ends at "
+                 f"{cfg.time_steps}) — no pattern written")
     dt_wall = time.time() - t0
     cells = 1.0
     for a in sim.static.mode.active_axes:
         cells *= cfg.grid_shape[a]
     mcps = cells * cfg.time_steps / dt_wall / 1e6
     if sim.clock is not None:
-        print(f"profile: {sim.clock.report()}")
-    if args.log_level >= 1:
-        print(f"done: {cfg.time_steps} steps in {dt_wall:.2f}s "
-              f"({mcps:.1f} Mcells/s)")
+        log(f"profile: {sim.clock.report()}")
+    log(f"done: {cfg.time_steps} steps in {dt_wall:.2f}s "
+        f"({mcps:.1f} Mcells/s)")
     return 0
 
 

@@ -225,6 +225,13 @@ class SimConfig:
     wavelength: float = 20e-3      # source wavelength, meters
     dtype: str = "float32"         # "float32" | "float64" | "bfloat16"
     complex_fields: bool = False   # reference COMPLEX_FIELD_VALUES mode
+    # Kahan-compensated f32 updates: each field family carries a bf16
+    # residual of the lost low-order bits of its leapfrog accumulation,
+    # recovering ~1e-7-class long-horizon accuracy (the reference is
+    # f64 C++; plain f32 drifts past 1e-6 by ~1000 steps — BASELINE.md
+    # frontier table) at ~1.25x the f32 HBM traffic instead of f64's
+    # ~10x slowdown. float32 only.
+    compensated: bool = False
 
     pml: PmlConfig = dataclasses.field(default_factory=PmlConfig)
     tfsf: TfsfConfig = dataclasses.field(default_factory=TfsfConfig)
@@ -320,6 +327,12 @@ class SimConfig:
                 f"(active: {mode.e_components})")
         if self.complex_fields and self.dtype == "bfloat16":
             raise ValueError("complex_fields requires float32/float64")
+        if self.compensated and (self.dtype != "float32"
+                                 or self.complex_fields):
+            raise ValueError(
+                "compensated updates require real float32 fields "
+                "(float64 needs no compensation; bfloat16 storage is "
+                "already below the residual's resolution)")
         if self.ntff.enabled:
             if mode.name != "3D":
                 raise ValueError("NTFF requires the 3D scheme")

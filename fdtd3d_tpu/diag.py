@@ -83,8 +83,17 @@ def _device_metrics(sim) -> Dict[str, jnp.ndarray]:
                     v = state[grp][c]
                     av = jnp.abs(v.astype(cdt) if v.dtype != cdt else v)
                     out[f"max_{c}"] = jnp.max(av)
+                    # two-level reduction: per-x-plane partial sums,
+                    # then the (n1,) vector — bounds the f32 error at
+                    # ~eps*sqrt(N) regardless of XLA's reduction order
+                    # (a flat 512^3 sum could reach ~1e-4 relative in
+                    # the worst ordering; ADVICE r3). NTFF keeps its
+                    # stronger Kahan accumulators — energy is a trend
+                    # metric, not a scored output.
+                    sq = weights[c] * jnp.square(av)
+                    planes = jnp.sum(sq, axis=(1, 2))
                     energy = energy + (0.5 * c0 * cell) * jnp.sum(
-                        weights[c] * jnp.square(av)).astype(jnp.float32)
+                        planes).astype(jnp.float32)
             out["energy"] = energy
             # Discrete divergence residual of E (charge-free health
             # metric): the Yee update conserves the discrete divergence

@@ -1,0 +1,43 @@
+"""Leveled logging for the whole package (SURVEY.md §2 assert/logging).
+
+One process-global level, set once from ``OutputConfig.log_level``
+(``--log-level``): 0 = silent (errors still raise), 1 = normal progress
+lines, 2+ = verbose. Replaces the scattered ``if log_level >= 1:
+print(...)`` gates (VERDICT r3 item 8) so library users and the CLI
+share one switch; multi-process runs log on rank 0 only unless
+``all_ranks`` is passed.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_level = 1
+
+
+def set_level(level: int) -> None:
+    global _level
+    _level = int(level)
+
+
+def get_level() -> int:
+    return _level
+
+
+def log(msg: str, level: int = 1, all_ranks: bool = False) -> None:
+    """Print ``msg`` when the configured level is >= ``level``."""
+    if _level < level:
+        return
+    if not all_ranks:
+        try:
+            import jax
+            if jax.process_index() != 0:
+                return
+        except Exception:
+            pass
+    print(msg, flush=True)
+
+
+def warn(msg: str) -> None:
+    """Warnings always print (to stderr), at any level."""
+    print(f"WARNING: {msg}", file=sys.stderr, flush=True)

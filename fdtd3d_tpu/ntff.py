@@ -55,6 +55,15 @@ class NtffCollector:
         self.dt = sim.static.dt
         self.dx = sim.static.dx
         shape = sim.static.grid_shape
+        if box is None and (sim.cfg.ntff.box_lo is not None
+                            or sim.cfg.ntff.box_hi is not None):
+            # honor the config's explicit box so library users get the
+            # same behavior the CLI implements (ADVICE r3): both ends
+            # must be given, matching the CLI's validation
+            if sim.cfg.ntff.box_lo is None or sim.cfg.ntff.box_hi is None:
+                raise ValueError(
+                    "ntff.box_lo and ntff.box_hi must be set together")
+            box = (tuple(sim.cfg.ntff.box_lo), tuple(sim.cfg.ntff.box_hi))
         if box is None:
             pml = sim.cfg.pml.size
             lo = tuple(pml[a] + margin for a in AXES)

@@ -89,6 +89,8 @@ def eligible(static, mesh_axes=None) -> bool:
         return False
     if static.field_dtype not in (np.float32, jnp.bfloat16):
         return False
+    if static.cfg.compensated:
+        return False  # Kahan residuals live in the packed kernel only
     return True
 
 
@@ -665,18 +667,6 @@ def fields_add(fields, c, sl, val):
     return fields
 
 
-def psi_copy(psi):
-    return dict(psi) if isinstance(psi, dict) else psi.clone()
-
-
-def psi_add(psi, key, sl, val):
-    if isinstance(psi, dict):
-        psi[key] = psi[key].at[tuple(sl)].add(val)
-    else:
-        psi.add_at(key, sl, val)
-    return psi
-
-
 def psi_set(psi, key, val):
     if isinstance(psi, dict):
         psi[key] = val
@@ -746,7 +736,7 @@ def slab_post(static, family: str, fields, src, psi_ax, coeffs,
         return tuple(sl)
 
     new_fields = fields_copy(fields)
-    new_psi = psi_copy(psi_ax)
+    new_psi = fields_copy(psi_ax)
     for c in upd:
         for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
             if a != axis:
@@ -981,9 +971,8 @@ def point_source_patch(static, fields, coeffs, t, collect=None):
     c = ps.component
     if c not in fields:
         return fields
-    wf = waveform(ps.waveform,
-                  (t.astype(static.real_dtype) + 0.5) * static.dt,
-                  static.omega, static.dt)
+    wf = waveform(ps.waveform, t, 0.5, static.omega, static.dt,
+                  static.real_dtype)
     out = fields_copy(fields)
     fdt = out[c].dtype
     fshape = out[c].shape

@@ -52,6 +52,8 @@ def eligible(static, mesh_axes=None) -> bool:
         return False
     if mesh_axes and any(v is not None for v in mesh_axes.values()):
         return False
+    if static.cfg.compensated:
+        return False  # Kahan residuals live in the packed kernel only
     return True
 
 
@@ -91,7 +93,7 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
     inv_dx = 1.0 / static.dx
     cdt = static.compute_dtype
     out_H = _p3.fields_copy(new_H)
-    out_psi = _p3.psi_copy(psi_H)
+    out_psi = _p3.fields_copy(psi_H)
 
     def slab_f(a: int, lo: int, hi: int) -> jnp.ndarray:
         """F = ik + c at ABSOLUTE planes [lo, hi) of axis a, from the
@@ -173,8 +175,8 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                                          c_off + o_hi - s_lo]
                             shape = [1, 1, 1]
                             shape[a] = o_hi - o_lo
-                            _p3.psi_add(out_psi, key, tuple(psl),
-                                        cp.reshape(shape) * w[tuple(wsl)])
+                            _p3.fields_add(out_psi, key, tuple(psl),
+                                           cp.reshape(shape) * w[tuple(wsl)])
                     else:
                         # w spans full a; slice its slab planes, add at
                         # the patch's b-location in the compact array
@@ -191,7 +193,7 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                              * w[tuple(wsl_hi)]], axis=a)
                         bsl = [slice(None)] * 3
                         bsl[b] = slice(pstart, pstart + plen)
-                        _p3.psi_add(out_psi, key, tuple(bsl), add)
+                        _p3.fields_add(out_psi, key, tuple(bsl), add)
                 else:
                     # plain curl term (x "post" axis or no PML on a)
                     dacc = s * w

@@ -49,6 +49,17 @@ slab-fitting CPML on any axes, Drude J (electric), TFSF, point source.
 Magnetic Drude (K lives in the lagged H phase and would need one more
 full-volume carry) falls back to the two-pass kernels.
 
+Compensated-mode caveat: the in-kernel updates carry the full Kahan +
+double-single-coefficient treatment, but the thin post-kernel patches
+(x-slab CPML deltas, TFSF faces, point source, H corrections) apply in
+plain f32 and do not touch the rE/rH residuals — those O(slab/face
+plane) regions keep plain-f32-class rounding. This is a measured
+non-issue at the current accuracy floor (the f32 curl arithmetic's
+systematic eigenfrequency shift dominates the long-horizon error well
+before patch-region rounding does; BASELINE.md frontier section), and
+is why compensated parity with the jnp path is asserted at 2e-6, not
+roundoff.
+
 Reference parity: same role as the reference's fused CUDA step
 (SURVEY.md §2 CudaGrid/InternalScheme rows) — this is the
 one-kernel-per-step shape the reference reaches with hand-written
@@ -176,6 +187,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     mode = static.mode
     n1, n2, n3 = static.grid_shape
     inv_dx = np.float32(1.0 / static.dx)
+    # compensated: double-single 1/dx (see solver.build_coeffs._cast_ds)
+    inv_dx_lo = np.float32(1.0 / static.dx - np.float64(inv_dx))
     fdt = jnp.float32
     fst = static.field_dtype
     # f32-width accounting even for bf16 storage (see pallas3d.py: the
@@ -185,6 +198,7 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     h_comps = list(mode.h_components)
     ne, nh = len(e_comps), len(h_comps)
     drude = static.use_drude
+    comp = static.cfg.compensated
 
     rows_e = psi_rows(static, slabs, "E")
     rows_h = psi_rows(static, slabs, "H")
@@ -204,6 +218,11 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
              if v and k.split("_")[0] in pairs_e]
     arr_h = [k for k, v in coeff_is_array.items()
              if v and k.split("_")[0] in pairs_h]
+    if comp and (arr_e or arr_h):
+        # double-single coefficient GRIDS are not streamed (scalars are
+        # embedded hi+lo below); material-grid + compensated runs take
+        # the jnp path
+        return None
 
     def _stack_shape(a: int, k: int) -> Tuple[int, int, int, int]:
         s = [k, n1, n2, n3]
@@ -221,6 +240,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                 total += 2 * s[0] * t * s[2] * s[3] * 4
         if drude:
             total += 2 * ne * t * plane * 4        # J in + out
+        if comp:                                   # bf16 residuals
+            total += 2 * (ne + nh) * t * plane * 2
         total += (len(arr_e) + len(arr_h)) * t * plane * 4
         for a in psi_axes_e + psi_axes_h:
             total += 3 * 2 * slabs[a] * 4          # profile packs
@@ -263,6 +284,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         take([f"psH{a}" for a in psi_axes_h])
         if drude:
             take(["j_in"])
+        if comp:
+            take(["re_in", "rh_in"])
         take([f"prof_e_{a}" for a in psi_axes_e])
         take([f"prof_h_{a}" for a in psi_axes_h])
         take(["wall_y", "wall_z"])
@@ -273,6 +296,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         take([f"psH{a}_out" for a in psi_axes_h])
         if drude:
             take(["j_out"])
+        if comp:
+            take(["re_out", "rh_out"])
         take(["se", "sh", "shh"])  # scratch
 
         i = pl.program_id(0)
@@ -283,14 +308,19 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         h_vals = [idx["h_in"][j].astype(fdt) for j in range(nh)]
         e_vals = [idx["e_in"][j].astype(fdt) for j in range(ne)]
 
+        def scale_dx(d0):
+            if comp:
+                return d0 * inv_dx + d0 * inv_dx_lo
+            return d0 * inv_dx
+
         def yz_diff(f, axis, backward):
             zero = jnp.zeros_like(lax.slice_in_dim(f, 0, 1, axis=axis))
             if backward:
                 body = lax.slice_in_dim(f, 0, f.shape[axis] - 1, axis=axis)
-                return (f - jnp.concatenate([zero, body], axis=axis)) \
-                    * inv_dx
+                return scale_dx(f - jnp.concatenate([zero, body],
+                                                    axis=axis))
             body = lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
-            return (jnp.concatenate([body, zero], axis=axis) - f) * inv_dx
+            return scale_dx(jnp.concatenate([body, zero], axis=axis) - f)
 
         def slab_term(dfa, psi, tag, a, s, write):
             """CPML slab psi recursion + curl term for slab axis a.
@@ -335,7 +365,7 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                     bh = idx["shh"][jd]
                     ghost = jnp.where(i > 0, bh, jnp.zeros_like(bh))
                     full = jnp.concatenate([ghost, h_vals[jd]], axis=0)
-                    term = s * ((full[1:] - full[:-1]) * inv_dx)
+                    term = s * scale_dx(full[1:] - full[:-1])
                 else:
                     dfa = yz_diff(h_vals[jd], a, backward=True)
                     if a in slabs and a in static.pml_axes:
@@ -362,18 +392,37 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                 def _(jc=jc, j_new=j_new):
                     idx["j_out"][jc] = j_new.astype(fdt)
                 acc = acc - j_new
-            e = coef("ce", f"ca_{c}") * old \
-                + coef("ce", f"cb_{c}") * acc
+            if comp:
+                # Kahan + double-single coefficients: E' = E + u with
+                # u = (ca-1)E + cb*acc (solver.py's exact form)
+                u = (coef("ce", f"ca_{c}") - 1.0) * old \
+                    + coef("ce", f"cb_{c}") * acc \
+                    + (fdt(float(np_coeffs[f"ca_{c}_lo"])) * old
+                       + fdt(float(np_coeffs[f"cb_{c}_lo"])) * acc)
+                y = u - idx["re_in"][jc].astype(fdt)
+                e = old + y
+                r = (e - old) - y
+            else:
+                e = coef("ce", f"ca_{c}") * old \
+                    + coef("ce", f"cb_{c}") * acc
+                r = None
             ca_ax = component_axis(c)
             if ca_ax != 0:
                 e = e * wall_x
+                if r is not None:
+                    r = r * wall_x
             for a2 in (1, 2):
                 if a2 != ca_ax:
-                    e = e * idx[f"wall_{AXES[a2]}"][:].astype(fdt)
+                    w2 = idx[f"wall_{AXES[a2]}"][:].astype(fdt)
+                    e = e * w2
+                    if r is not None:
+                        r = r * w2
 
             @pl.when(valid_a)
-            def _(jc=jc, e=e):
+            def _(jc=jc, e=e, r=r):
                 idx["e_out"][jc] = e.astype(fst)
+                if r is not None:
+                    idx["re_out"][jc] = r.astype(jnp.bfloat16)
             e_new.append(e)
 
         # ---- phase B: H update on tile i-1 (scratch carry) -----------
@@ -391,7 +440,7 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             for (a, jd, s) in CURL_TERMS[component_axis(c)]:
                 if a == 0:
                     ext = jnp.concatenate([se_vals[jd], first[jd]], axis=0)
-                    term = s * ((ext[1:] - ext[:-1]) * inv_dx)
+                    term = s * scale_dx(ext[1:] - ext[:-1])
                 else:
                     dfa = yz_diff(se_vals[jd], a, backward=False)
                     if a in slabs and a in static.pml_axes:
@@ -409,8 +458,19 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                         term = s * dfa
                 acc = term if acc is None else acc + term
             h_old = sh_vals[jc]
-            h = coef("ch", f"da_{c}") * h_old \
-                - coef("ch", f"db_{c}") * acc
+            if comp:
+                u = (coef("ch", f"da_{c}") - 1.0) * h_old \
+                    - coef("ch", f"db_{c}") * acc \
+                    + (fdt(float(np_coeffs[f"da_{c}_lo"])) * h_old
+                       - fdt(float(np_coeffs[f"db_{c}_lo"])) * acc)
+                y = u - idx["rh_in"][jc].astype(fdt)
+                h = h_old + y
+                rh = (h - h_old) - y
+                idx["rh_out"][jc] = jnp.where(
+                    valid, rh.astype(jnp.bfloat16), idx["rh_in"][jc])
+            else:
+                h = coef("ch", f"da_{c}") * h_old \
+                    - coef("ch", f"db_{c}") * acc
             # i == 0: write through the loaded old tile-0 H so the
             # revisited out block holds well-defined (old) values under
             # either Mosaic flush semantics; iteration 1 overwrites it.
@@ -455,6 +515,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                             lag_imap) for a in psi_axes_h]
     if drude:
         in_specs += [stack_spec(ne, (n2, n3), tile_imap)]     # J in
+    if comp:
+        in_specs += [stack_spec(ne, (n2, n3), tile_imap),     # rE in
+                     stack_spec(nh, (n2, n3), lag_imap)]      # rH in
     for a in psi_axes_e + psi_axes_h:
         s = [3, 1, 1, 1]
         s[1 + a] = 2 * slabs[a]
@@ -477,6 +540,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                              lag_imap) for a in psi_axes_h]
     if drude:
         out_specs += [stack_spec(ne, (n2, n3), tile_imap)]
+    if comp:
+        out_specs += [stack_spec(ne, (n2, n3), tile_imap),
+                      stack_spec(nh, (n2, n3), lag_imap)]
 
     out_shape = [jax.ShapeDtypeStruct((ne, n1, n2, n3), fst),
                  jax.ShapeDtypeStruct((nh, n1, n2, n3), fst)]
@@ -486,6 +552,11 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                                        np.float32) for a in psi_axes_h]
     if drude:
         out_shape += [jax.ShapeDtypeStruct((ne, n1, n2, n3), np.float32)]
+    if comp:
+        out_shape += [jax.ShapeDtypeStruct((ne, n1, n2, n3),
+                                           jnp.bfloat16),
+                      jax.ShapeDtypeStruct((nh, n1, n2, n3),
+                                           jnp.bfloat16)]
 
     # Donation: every array is read only at block indices whose output
     # writes happen at the same iteration or later (module docstring),
@@ -498,8 +569,15 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     aliases = {0: 0, 1: 1}
     for j in range(n_psi):
         aliases[2 + j] = 2 + j
+    k = 2 + n_psi
     if drude:
-        aliases[2 + n_psi] = 2 + n_psi
+        aliases[k] = k
+        k += 1
+    if comp:
+        # rE follows the E pattern (own tile), rH the lagged H pattern;
+        # both enter once -> donation-safe by the same argument
+        aliases[k] = k
+        aliases[k + 1] = k + 1
 
     scratch = [pltpu.VMEM((ne, T, n2, n3), jnp.float32),
                pltpu.VMEM((nh, T, n2, n3), jnp.float32),
@@ -540,6 +618,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             p["hxs"] = _h_slab_planes(p["H"])
         if drude:
             p["J"] = jnp.stack([state["J"][c] for c in e_comps])
+        if comp:
+            p["rE"] = jnp.stack([state["rE"][c] for c in e_comps])
+            p["rH"] = jnp.stack([state["rH"][c] for c in h_comps])
         if setup is not None:
             p["inc"] = state["inc"]
         return p
@@ -563,6 +644,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             state["psi_H"] = psi_h
         if drude:
             state["J"] = {c: p["J"][j] for j, c in enumerate(e_comps)}
+        if comp:
+            state["rE"] = {c: p["rE"][j] for j, c in enumerate(e_comps)}
+            state["rH"] = {c: p["rH"][j] for j, c in enumerate(h_comps)}
         if setup is not None:
             state["inc"] = p["inc"]
         return state
@@ -621,6 +705,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         args += [pstate[f"psH{a}"] for a in psi_axes_h]
         if drude:
             args += [pstate["J"]]
+        if comp:
+            args += [pstate["rE"], pstate["rH"]]
         args += [_prof_pack(coeffs, "e", a) for a in psi_axes_e]
         args += [_prof_pack(coeffs, "h", a) for a in psi_axes_h]
         args += [_vec3(coeffs["wall_y"], 1), _vec3(coeffs["wall_z"], 2)]
@@ -639,6 +725,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             psh[a] = outs[p]; p += 1
         if drude:
             new_state["J"] = outs[p]; p += 1
+        if comp:
+            new_state["rE"] = outs[p]; p += 1
+            new_state["rH"] = outs[p]; p += 1
 
         # ---- E post-passes over the packed view ----------------------
         eview = PackedView(new_E_arr, e_comps)

@@ -12,10 +12,47 @@ from __future__ import annotations
 import math
 
 import jax.numpy as jnp
+import numpy as np
 
 
-def waveform(kind: str, t: jnp.ndarray, omega: float, dt: float):
-    """Scalar source waveform at physical time ``t`` (seconds).
+def _phase_frac(step: jnp.ndarray, f: float) -> jnp.ndarray:
+    """frac(step * f) as f32, via 64-bit fixed-point modular arithmetic.
+
+    The naive f32 evaluation of ``sin(omega * t)`` loses ~eps32 * omega*t
+    of PHASE — a source error growing linearly with the step count that
+    dominated the f32 accuracy frontier (~1e-5 by step 400, swamping the
+    Kahan-compensated field accumulation entirely). Here f is quantized
+    host-side to q/2^64 (error 2^-64 -> phase error ~t*2^-64, negligible)
+    and step*q mod 2^64 is computed with wrapping uint32 multiplies, so
+    the only remaining error is the f32 cast of the final fraction:
+    a CONSTANT ~2pi*2^-24 ~= 4e-7 rad at any horizon.
+    """
+    q = int(round((f % 1.0) * 2.0 ** 64)) & ((1 << 64) - 1)
+    q_hi = jnp.uint32(q >> 32)
+    b = q & 0xffffffff
+    s = step.astype(jnp.uint32)
+    # high 32 bits of s * q_lo via 16-bit schoolbook (u32 wraps are exact
+    # mod-2^32 arithmetic)
+    s1, s0 = s >> 16, s & 0xffff
+    b1, b0 = jnp.uint32(b >> 16), jnp.uint32(b & 0xffff)
+    m1 = s1 * b0
+    m2 = s0 * b1
+    low = s0 * b0
+    carry = ((m1 & 0xffff) + (m2 & 0xffff) + (low >> 16)) >> 16
+    hi = s1 * b1 + (m1 >> 16) + (m2 >> 16) + carry
+    u = s * q_hi + hi              # mod 2^32 wrap = frac's top 32 bits
+    return u.astype(jnp.float32) * jnp.float32(2.0 ** -32)
+
+
+def waveform(kind: str, step: jnp.ndarray, offset: float, omega: float,
+             dt: float, real_dtype=np.float32):
+    """Scalar source waveform at time ``(step + offset) * dt``.
+
+    ``step`` is the INTEGER step counter (traced i32): the oscillatory
+    phase is computed exactly-mod-2pi from it (see ``_phase_frac``), so
+    long runs do not accumulate source phase error; envelopes (slowly
+    varying) use the plain f32 time. f64 runs use the naive product —
+    eps64-accurate at any realistic horizon.
 
     kind:
       "sin"         — CW sinusoid with a smooth half-period ramp (avoids
@@ -24,19 +61,27 @@ def waveform(kind: str, t: jnp.ndarray, omega: float, dt: float):
                       omega
       "ricker"      — Ricker (Mexican-hat) wavelet, peak frequency omega/2pi
     """
+    t = (step.astype(real_dtype) + real_dtype(offset)) * real_dtype(dt)
     period = 2.0 * math.pi / omega
-    if kind == "sin":
-        ramp = jnp.clip(t / (2.0 * period), 0.0, 1.0)
-        ramp = ramp * ramp * (3.0 - 2.0 * ramp)  # smoothstep
-        return ramp * jnp.sin(omega * t)
-    if kind == "gauss_pulse":
+    if kind in ("sin", "gauss_pulse"):
+        if np.dtype(real_dtype) == np.float64:
+            osc = jnp.sin(omega * t)
+        else:
+            f = (omega * dt) / (2.0 * math.pi)   # cycles per step (f64)
+            frac = _phase_frac(step, f) + np.float32((offset * f) % 1.0)
+            osc = jnp.sin(np.float32(2.0 * math.pi) * frac)
+        if kind == "sin":
+            ramp = jnp.clip(t / real_dtype(2.0 * period), 0.0, 1.0)
+            ramp = ramp * ramp * (3.0 - 2.0 * ramp)  # smoothstep
+            return ramp * osc
         tau = 1.5 * period
         t0 = 4.0 * tau
-        return jnp.sin(omega * t) * jnp.exp(-(((t - t0) / tau) ** 2))
+        return osc * jnp.exp(-(((t - real_dtype(t0)) / real_dtype(tau))
+                               ** 2))
     if kind == "ricker":
         f0 = omega / (2.0 * math.pi)
         t0 = 1.5 / f0
-        a = (math.pi * f0) ** 2 * (t - t0) ** 2
+        a = real_dtype((math.pi * f0) ** 2) * (t - real_dtype(t0)) ** 2
         return (1.0 - 2.0 * a) * jnp.exp(-a)
     raise ValueError(f"unknown waveform {kind!r}")
 

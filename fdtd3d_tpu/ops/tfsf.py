@@ -187,8 +187,8 @@ def advance_einc(inc: Dict[str, jnp.ndarray], coeffs, t, dt, omega,
     einc = coeffs["inc_ae"] * einc - coeffs["inc_be"] * dh
     # waveform time is REAL even in complex_fields mode
     src = setup.amplitude * waveform(
-        setup.waveform, (t.astype(jnp.real(einc).dtype) + 1.0) * dt,
-        omega, dt)
+        setup.waveform, t, 1.0, omega, dt,
+        np.dtype(jnp.real(einc).dtype).type)
     einc = einc.at[0].set(src.astype(einc.dtype))
     return dict(inc, Einc=einc)
 

@@ -252,12 +252,13 @@ class Simulation:
         """
         group = "E" if comp[0] == "E" else "H"
         self._adopt_dict_edits()
-        if self._pstate is not None:
+        if self._pstate is not None and group in self._pstate:
             comps = (self.static.mode.e_components if group == "E"
                      else self.static.mode.h_components)
             j = comps.index(comp)
             return float(self._pstate[group][(j,) + tuple(idx)])
-        return float(self._sstate[group][comp][tuple(idx)])
+        v = self.state[group][comp][tuple(idx)]
+        return complex(v) if np.iscomplexobj(np.asarray(v)) else float(v)
 
     def field(self, comp: str) -> np.ndarray:
         """Gather one field component to host as a global numpy array.
@@ -298,6 +299,8 @@ class Simulation:
         if self.mesh is not None:
             arr = pmesh.shard_leaf(vnp, self._state_specs[group][comp],
                                    self.mesh)
+        elif self.static.paired_complex:
+            arr = vnp  # complex outer state stays host-side (solver.py)
         else:
             arr = jnp.asarray(vnp)
         st[group][comp] = arr

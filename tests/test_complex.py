@@ -102,6 +102,76 @@ def test_complex_cavity_phasor_exact():
     assert err < 1e-10, f"complex cavity drifted: {err:.2e}"
 
 
+def test_paired_complex_matches_native(monkeypatch):
+    """The paired-real step (the TPU route for COMPLEX_FIELD_VALUES —
+    the axon backend lacks complex arithmetic) must reproduce the
+    native complex run: re leg sourced, im leg source-free, combined
+    on the host. Forced on CPU via the test hook env var."""
+    def build(paired):
+        if paired:
+            monkeypatch.setenv("FDTD3D_FORCE_PAIRED_COMPLEX", "1")
+        else:
+            monkeypatch.delenv("FDTD3D_FORCE_PAIRED_COMPLEX",
+                               raising=False)
+        cfg = SimConfig(scheme="3D", size=(16, 16, 16), time_steps=10,
+                        dx=1e-3, courant_factor=0.4, wavelength=8e-3,
+                        complex_fields=True,
+                        pml=PmlConfig(size=(3, 3, 3)),
+                        tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                                        angle_teta=30.0, angle_phi=40.0,
+                                        angle_psi=15.0))
+        sim = Simulation(cfg)
+        key = jax.random.PRNGKey(3)
+        for grp in ("E", "H"):
+            for comp in list(sim.state[grp]):
+                key, k1, k2 = jax.random.split(key, 3)
+                shape = sim.state[grp][comp].shape
+                re = 0.01 * np.asarray(jax.random.normal(k1, shape))
+                im = 0.01 * np.asarray(jax.random.normal(k2, shape))
+                sim.set_field(comp, re + 1j * im)
+        sim.run()
+        return sim
+
+    native = build(False)
+    assert not native.static.paired_complex
+    paired = build(True)
+    assert paired.static.paired_complex
+    assert paired.step_kind.startswith("complex2x_"), paired.step_kind
+    for comp in ("Ez", "Hy"):
+        a = np.asarray(native.field(comp))
+        b = np.asarray(paired.field(comp))
+        err = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert err < 2e-6, f"{comp}: rel {err:.2e}"
+        assert np.iscomplexobj(b)
+
+
+def test_paired_complex_packed_legs(monkeypatch):
+    """With use_pallas forced, the paired legs ride the packed kernel
+    (interpret mode on CPU) — the path real TPU complex runs take."""
+    monkeypatch.setenv("FDTD3D_FORCE_PAIRED_COMPLEX", "1")
+    cfg = SimConfig(scheme="3D", size=(16, 16, 16), time_steps=6,
+                    dx=1e-3, courant_factor=0.4, wavelength=8e-3,
+                    complex_fields=True, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez", position=(8, 8, 8)))
+    sim = Simulation(cfg)
+    assert sim.step_kind == "complex2x_pallas_packed", sim.step_kind
+    sim.run()
+    monkeypatch.delenv("FDTD3D_FORCE_PAIRED_COMPLEX")
+    ref = Simulation(dataclasses_replace_native(cfg))
+    ref.run()
+    a = np.asarray(ref.field("Ez"))
+    b = np.asarray(sim.field("Ez"))
+    err = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+    assert err < 2e-6, err
+
+
+def dataclasses_replace_native(cfg):
+    import dataclasses
+    return dataclasses.replace(cfg, use_pallas=False)
+
+
 def test_complex_falls_back_from_pallas():
     from fdtd3d_tpu.ops import pallas3d
     cfg = SimConfig(scheme="3D", size=(16, 16, 16), complex_fields=True)

@@ -160,10 +160,13 @@ def test_save_cmd_survives_default_drift(tmp_path):
     args = parser.parse_args(argv)
     cli.save_cmd_file(args, out)
     cfg_direct = cli.args_to_config(parser.parse_args(argv))
-    # simulate a future release changing defaults
+    # simulate a future release changing defaults — including a BOOLEAN
+    # default flipping to True (ADVICE r3: False must be representable
+    # in the saved file, via the --no- forms BooleanOptionalAction adds)
     drifted = cli.build_parser()
     drifted.set_defaults(pml_size=4, courant_factor=0.9,
-                         time_steps=7, dtype="bfloat16")
+                         time_steps=7, dtype="bfloat16",
+                         use_tfsf=True, compensated=True)
     cfg_replayed = cli.args_to_config(
         drifted.parse_args(cli.read_cmd_file(out)))
     assert cfg_direct == cfg_replayed

@@ -38,9 +38,12 @@ if dtype == "float64":
 from fdtd3d_tpu.config import PmlConfig, SimConfig, TfsfConfig
 from fdtd3d_tpu.sim import Simulation
 
+# "float32c" = compensated f32 (Kahan residuals; --compensated)
+compensated = dtype == "float32c"
 cfg = SimConfig(
     scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
-    courant_factor=0.5, wavelength=n * 1e-3 / 4.0, dtype=dtype,
+    courant_factor=0.5, wavelength=n * 1e-3 / 4.0,
+    dtype="float32" if compensated else dtype, compensated=compensated,
     pml=PmlConfig(size=(8, 8, 8)),
     tfsf=TfsfConfig(enabled=True, margin=(6, 6, 6),
                     angle_teta=30.0, angle_phi=40.0, angle_psi=15.0),
@@ -82,7 +85,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=128)
     ap.add_argument("--steps", type=int, default=1000)
-    ap.add_argument("--dtypes", default="float64,float32,bfloat16")
+    ap.add_argument("--dtypes",
+                    default="float64,float32,float32c,bfloat16")
     args = ap.parse_args()
 
     tmp = tempfile.mkdtemp(prefix="acc_frontier_")
