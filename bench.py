@@ -249,27 +249,48 @@ def run_measurement() -> None:
             n, jnp_mc, pallas_mc = 512, jnp_512, pallas_512
         except Exception:
             pass  # report the completed 256^3 measurements
-    # bf16 storage on the packed kernel: half the field traffic — the
-    # fastest path on record (VERDICT r3 item 5: capture the bf16/f32
-    # pair whenever the window is healthy enough to measure it).
+    # Stage 3 (healthy windows): the largest grids each dtype fits —
+    # bigger grids amortize the fixed per-step overheads that cap the
+    # tunneled chip (measured same-window: f32 512^3 5526 -> 640^3
+    # 6271; bf16 512^3 6002 -> 768^3 7867 Mcells/s). bf16 storage on
+    # the packed kernel is the fastest path on record (VERDICT r3
+    # item 5: capture the bf16/f32 pair whenever the window is
+    # healthy); each size attempt degrades gracefully.
     bf16_mc = 0.0
+    bf16_n = 0
     if on_tpu and pallas_mc >= GATE_MCELLS_512:
-        try:
-            bf16_mc = measure(n, 20 if n >= 512 else 10,
-                              use_pallas=True, dtype="bfloat16")
-        except Exception:
-            pass
+        if n >= 512:
+            try:
+                f32_640 = measure(640, 10, use_pallas=True)
+                if f32_640 > pallas_mc:
+                    pallas_mc, n = f32_640, 640
+            except Exception:
+                pass
+        for bn in ((768, 512) if n >= 512 else (n,)):
+            try:
+                bf16_mc = measure(bn, 20 if bn == 512 else 10,
+                                  use_pallas=True, dtype="bfloat16")
+                bf16_n = bn
+                break
+            except Exception:
+                continue
     mcells = max(jnp_mc, pallas_mc, bf16_mc)
-    best = _maybe_update_best(pallas_mc, jnp_mc, bf16_mc, n, gbps,
+    best = _maybe_update_best(pallas_mc, jnp_mc, bf16_mc,
+                              bf16_n if (bf16_mc >= pallas_mc and bf16_n)
+                              else n, gbps,
                               device_kind) if on_tpu else None
+    best_n = bf16_n if (bf16_mc == mcells and bf16_n) else n
     out = {
-        "metric": f"Mcells/s/chip (3D Yee + CPML, {n}^3, {device_kind})",
+        "metric": f"Mcells/s/chip (3D Yee + CPML, {best_n}^3, "
+                  f"{device_kind})",
         "value": round(mcells, 1),
         "unit": "Mcells/s",
         "vs_baseline": round(mcells / 1e4, 4),
         "pallas_mcells": round(pallas_mc, 1),
+        "f32_n": n,
         "jnp_mcells": round(jnp_mc, 1),
         "bf16_mcells": round(bf16_mc, 1),
+        "bf16_n": bf16_n,
         "hbm_probe_gbps": gbps,
         "platform": platform,
     }

@@ -191,9 +191,13 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     inv_dx_lo = np.float32(1.0 / static.dx - np.float64(inv_dx))
     fdt = jnp.float32
     fst = static.field_dtype
-    # f32-width accounting even for bf16 storage (see pallas3d.py: the
-    # in-kernel compute is f32, so Mosaic scratch scales with f32).
-    fbytes = max(np.dtype(fst).itemsize, 4)
+    # Operand WINDOWS are sized at the true storage width: unlike the
+    # round-3 kernels (which folded Mosaic's f32 temporaries into a
+    # conservative f32-width block budget), this picker models the f32
+    # temporaries as their own term, so bf16 blocks may count their
+    # real 2 bytes — that is what lets bf16 grids beyond 512^3 fit
+    # (e.g. 768^3 at T=1).
+    fbytes = np.dtype(fst).itemsize
     e_comps = list(mode.e_components)
     h_comps = list(mode.h_components)
     ne, nh = len(e_comps), len(h_comps)

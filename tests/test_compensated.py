@@ -158,3 +158,25 @@ def test_compensated_sharded_falls_back_to_jnp():
     assert sim.step_kind == "jnp"
     sim.advance(2)
     assert np.isfinite(np.asarray(sim.field("Ez"))).all()
+
+
+def test_phase_frac_exact_modular():
+    """_phase_frac must track frac(t*f) to ~2^-24 at ANY step count —
+    the property that keeps source phase error constant instead of
+    growing as eps*omega*t."""
+    import math
+
+    import jax.numpy as jnp
+
+    from fdtd3d_tpu.ops.sources import _phase_frac
+
+    f = 0.04283919274719  # generic cycles-per-step
+    steps = np.concatenate([np.arange(0, 4096),
+                            np.arange(10 ** 6, 10 ** 6 + 64),
+                            np.arange(2 ** 24 - 32, 2 ** 24 + 32)])
+    got = np.asarray(_phase_frac(jnp.asarray(steps.astype(np.int32)), f),
+                     np.float64)
+    want = (steps.astype(np.float64) * f) % 1.0
+    d = np.abs(got - want)
+    d = np.minimum(d, 1.0 - d)  # wrap-around distance
+    assert d.max() < 2.0 ** -23, d.max()

@@ -1,0 +1,23 @@
+"""Leveled logging helper (fdtd3d_tpu/log.py)."""
+
+import contextlib
+import io
+
+from fdtd3d_tpu import log as flog
+
+
+def test_log_levels(capsys):
+    old = flog.get_level()
+    try:
+        flog.set_level(1)
+        flog.log("visible")
+        flog.log("hidden", level=2)
+        out = capsys.readouterr().out
+        assert "visible" in out and "hidden" not in out
+        flog.set_level(0)
+        flog.log("silenced")
+        assert capsys.readouterr().out == ""
+        flog.warn("always")
+        assert "WARNING: always" in capsys.readouterr().err
+    finally:
+        flog.set_level(old)
