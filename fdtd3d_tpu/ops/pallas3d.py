@@ -330,10 +330,14 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
                     edge = jnp.zeros_like(h)
                 if backward:
                     ghost = jnp.where(i > 0, h, edge)
-                    sh = jnp.concatenate([ghost, f[:-1]], axis=0)
+                    # T == 1: the shifted tile IS the ghost plane (a
+                    # zero-size f[:-1] slice is rejected by Mosaic)
+                    sh = ghost if T == 1 else jnp.concatenate(
+                        [ghost, f[:-1]], axis=0)
                     return (f - sh) * inv_dx
                 ghost = jnp.where(i < ntiles - 1, h, edge)
-                sh = jnp.concatenate([f[1:], ghost], axis=0)
+                sh = ghost if T == 1 else jnp.concatenate(
+                    [f[1:], ghost], axis=0)
                 return (sh - f) * inv_dx
             if axis in sharded_axes:
                 # neighbor plane (zeros at the global mesh edge = PEC ghost)

@@ -118,6 +118,34 @@ def test_packed_uneven_tiles():
         assert rel < 2e-6, f"{c}: rel {rel:.2e}"
 
 
+def test_two_pass_tile1_parity(monkeypatch):
+    """x extent 17 forces T=1 in every kernel: the two-pass x-halo
+    concat built a zero-size f[:-1] slice there (Mosaic rejects
+    0-sized vectors — surfaced first at 640^3 on hardware, where the
+    VMEM budget pushes the two-pass tile to 1). Parity guards the
+    T==1 special case."""
+    monkeypatch.setenv("FDTD3D_NO_PACKED", "1")
+    monkeypatch.setenv("FDTD3D_NO_FUSED", "1")
+    cfg = dict(BASE)
+    cfg["size"] = (17, 16, 16)
+
+    def run(up):
+        sim = Simulation(SimConfig(**cfg, use_pallas=up,
+                                   pml=PmlConfig(size=(3, 3, 3))))
+        _seed_fields(sim, seed=5)
+        sim.run()
+        return sim
+    j = run(False)
+    p = run(True)
+    assert p.step_kind == "pallas"
+    assert p.step_diag["tile"] == {"E": 1, "H": 1}
+    for c in ("Ex", "Ez", "Hy"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-6, f"{c}: rel {rel:.2e}"
+
+
 def test_packed_bf16_smoke():
     j = _run(False, dtype="bfloat16", pml=PmlConfig(size=(0, 3, 3)))
     p = _run(True, dtype="bfloat16", pml=PmlConfig(size=(0, 3, 3)))
