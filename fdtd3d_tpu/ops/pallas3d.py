@@ -755,11 +755,13 @@ def slab_post(static, family: str, fields, src, psi_ax, coeffs,
             else:
                 if d not in src:
                     continue
-                f = src[d].astype(static.compute_dtype)
-                # the m+1 boundary planes each side — all either family
-                # reads below (region-relative indexing)
-                f_lo = cut(f, 0, m + 1)
-                f_hi = cut(f, n1 - m - 1, n1)
+                # slice FIRST, convert the thin regions after: astype on
+                # the full array risks a full-volume materialization if
+                # XLA does not fuse the convert into the slices
+                # (measured as a ~35% step tax on bf16 at 768^3)
+                f = src[d]
+                f_lo = cut(f, 0, m + 1).astype(static.compute_dtype)
+                f_hi = cut(f, n1 - m - 1, n1).astype(static.compute_dtype)
             if family == "E":  # backward diff, slabs [0,m) / [n1-m,n1)
                 d_lo = (cut(f_lo, 0, m) - pad1(cut(f_lo, 0, m - 1), True)) \
                     * inv_dx
