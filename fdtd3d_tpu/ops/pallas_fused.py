@@ -66,7 +66,7 @@ def _shift_lo(v: jnp.ndarray, axis: int) -> jnp.ndarray:
 
 
 def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
-                              slabs):
+                              slabs, mesh_axes=None, mesh_shape=None):
     """Correct the kernel's H update for post-kernel E patches.
 
     The kernel computed H from E' (pre-patch). The exact H uses
@@ -118,7 +118,10 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                     continue
                 delta = delta.astype(cdt)
                 k = delta.shape[b]
-                n_a = static.grid_shape[a]
+                # LOCAL extent: patches carry shard-local plane starts
+                # (identical to global when unsharded; the packed
+                # kernel also runs this under shard_map)
+                n_a = static.grid_shape[a] // static.topology[a]
                 if a == b:
                     # forward diff along the patch normal: k+1 planes
                     # starting at start-1 (zero ghost beyond the patch)
@@ -140,6 +143,20 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                     # in-patch forward diff along a (zero ghost at the
                     # global hi edge — the kernel's PEC convention)
                     w = (_shift_lo(delta, a) - delta) * inv_dx
+                    if mesh_axes and mesh_axes.get(a):
+                        # sharded axis: the local hi plane's forward
+                        # neighbor is the UPPER shard's first patch
+                        # plane (zeros arrive at the global edge)
+                        name = mesh_axes[a]
+                        n_sh = mesh_shape[name]
+                        first = lax.slice_in_dim(delta, 0, 1, axis=a)
+                        nxt = lax.ppermute(
+                            first, name,
+                            [(r + 1, r) for r in range(n_sh - 1)])
+                        n_loc = delta.shape[a]
+                        hi_sl = [slice(None)] * 3
+                        hi_sl[a] = slice(n_loc - 1, n_loc)
+                        w = w.at[tuple(hi_sl)].add(nxt * inv_dx)
                     pstart, plen = start, k
 
                 # position of the correction along the patch-extent axis

@@ -44,10 +44,17 @@ corrected by ``pallas_fused.apply_patch_h_corrections`` over the same
 views.
 
 Scope (everything else falls back to ops/pallas_fused.py /
-ops/pallas3d.py / solver.py): 3D, real f32/bf16 storage, UNSHARDED,
-slab-fitting CPML on any axes, Drude J (electric), TFSF, point source.
+ops/pallas3d.py / solver.py): 3D, real f32/bf16 storage, slab-fitting
+CPML on any axes, Drude J (electric); unsharded runs additionally get
+TFSF and point sources. SHARDED topologies run the source-free scope
+(the weak-scaling workload class): E-phase halos arrive as ppermuted
+ghost operands (the x ghost feeds tile 0's edge, y/z ghosts ride as
+one-plane blocks), the H phase's local hi-edge planes receive the
+missing neighbor new-E contribution as a thin post-fix, and the x-slab
+patch curls ppermute their boundary plane (apply_patch_h_corrections).
 Magnetic Drude (K lives in the lagged H phase and would need one more
-full-volume carry) falls back to the two-pass kernels.
+full-volume carry) falls back to the two-pass kernels, as do sharded
+runs with TFSF/point sources (ownership-gated patches).
 
 Compensated-mode caveat: the in-kernel updates carry the full Kahan +
 double-single-coefficient treatment, but the thin post-kernel patches
@@ -94,14 +101,27 @@ AXES = "xyz"
 
 
 def eligible(static, mesh_axes=None) -> bool:
+    """Packed-kernel scope. Sharded topologies are in scope (round 4):
+    E-phase halos ppermute in as thin ghost operands, H-phase hi-edge
+    planes are fixed by thin post-corrections from ppermuted new-E
+    boundary planes. Sharded runs with TFSF/point sources fall back to
+    the two-pass kernels (their patch machinery is ownership-gated;
+    the packed H-correction algebra is not)."""
     if static.mode.name != "3D":
         return False
     if static.field_dtype not in (np.float32, jnp.bfloat16):
         return False
-    if static.topology != (1, 1, 1):
-        return False
-    if mesh_axes and any(v is not None for v in mesh_axes.values()):
-        return False
+    sharded = static.topology != (1, 1, 1)
+    if sharded:
+        if not mesh_axes or any(
+                static.topology[a] > 1 and not mesh_axes.get(a)
+                for a in range(3)):
+            return False  # sharded axis without a mesh axis name
+        if static.tfsf_setup is not None \
+                or static.cfg.point_source.enabled:
+            return False
+        if static.cfg.compensated:
+            return False  # jnp path covers sharded compensated
     if static.use_drude_m:
         return False
     return True
@@ -185,7 +205,12 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     x_pml = 0 in static.pml_axes
 
     mode = static.mode
-    n1, n2, n3 = static.grid_shape
+    topo = static.topology
+    mesh_axes = mesh_axes or {}
+    mesh_shape = mesh_shape or {}
+    sharded_axes = tuple(a for a in range(3) if topo[a] > 1)
+    # all kernel dims are the per-shard LOCAL extents
+    n1, n2, n3 = (static.grid_shape[a] // topo[a] for a in range(3))
     inv_dx = np.float32(1.0 / static.dx)
     # compensated: double-single 1/dx (see solver.build_coeffs._cast_ds)
     inv_dx_lo = np.float32(1.0 / static.dx - np.float64(inv_dx))
@@ -249,7 +274,12 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         total += (len(arr_e) + len(arr_h)) * t * plane * 4
         for a in psi_axes_e + psi_axes_h:
             total += 3 * 2 * slabs[a] * 4          # profile packs
-        total += (n2 + n3) * 4                     # walls
+        if 0 in sharded_axes:
+            total += nh * plane * fbytes           # xgh
+        for a in sharded_axes:
+            if a != 0:
+                total += nh * t * (plane // (n2, n3)[a - 1]) * fbytes
+        total += (t + n2 + n3) * 4                 # walls
         return total
 
     def _scratch_bytes(t: int) -> int:
@@ -292,7 +322,10 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             take(["re_in", "rh_in"])
         take([f"prof_e_{a}" for a in psi_axes_e])
         take([f"prof_h_{a}" for a in psi_axes_h])
-        take(["wall_y", "wall_z"])
+        if 0 in sharded_axes:
+            take(["xgh"])                    # x neighbor's last H plane
+        take([f"ygh{a}" for a in sharded_axes if a != 0])
+        take(["wall_x", "wall_y", "wall_z"])
         take([f"ce_{k}" for k in arr_e])
         take([f"ch_{k}" for k in arr_h])
         take(["e_out", "h_out"])
@@ -317,12 +350,18 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                 return d0 * inv_dx + d0 * inv_dx_lo
             return d0 * inv_dx
 
-        def yz_diff(f, axis, backward):
-            zero = jnp.zeros_like(lax.slice_in_dim(f, 0, 1, axis=axis))
+        def yz_diff(f, axis, backward, ghost=None):
+            if ghost is None:
+                ghost = jnp.zeros_like(
+                    lax.slice_in_dim(f, 0, 1, axis=axis))
             if backward:
                 body = lax.slice_in_dim(f, 0, f.shape[axis] - 1, axis=axis)
-                return scale_dx(f - jnp.concatenate([zero, body],
+                return scale_dx(f - jnp.concatenate([ghost, body],
                                                     axis=axis))
+            # forward diff: the hi-edge ghost is zero in-kernel; on a
+            # sharded axis the missing neighbor contribution is added
+            # by the thin post-correction in step()
+            zero = jnp.zeros_like(lax.slice_in_dim(f, 0, 1, axis=axis))
             body = lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
             return scale_dx(jnp.concatenate([body, zero], axis=axis) - f)
 
@@ -355,8 +394,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             return fdt(float(np_coeffs[key]))
 
         # ---- phase A: E update on tile i -----------------------------
-        gx = i * T + lax.broadcasted_iota(jnp.int32, (T, 1, 1), 0)
-        wall_x = ((gx != 0) & (gx != n1 - 1)).astype(fdt)
+        # per-shard PEC wall masks from the coeffs pytree (zeros only
+        # at the GLOBAL walls; all-ones on interior shards)
+        wall_x = idx["wall_x"][:].astype(fdt)
 
         e_new = []
         for jc, c in enumerate(e_comps):
@@ -365,13 +405,21 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                 if a == 0:
                     # bwd halo = last plane of tile i-1's H, carried in
                     # scratch since the previous iteration (no extra
-                    # HBM operand, no extra read traffic)
+                    # HBM operand, no extra read traffic); tile 0 uses
+                    # the x neighbor's ppermuted boundary plane when x
+                    # is sharded (zeros at the global edge = PEC ghost)
                     bh = idx["shh"][jd]
-                    ghost = jnp.where(i > 0, bh, jnp.zeros_like(bh))
+                    if 0 in sharded_axes:
+                        edge = idx["xgh"][jd].astype(fdt)
+                    else:
+                        edge = jnp.zeros_like(bh)
+                    ghost = jnp.where(i > 0, bh, edge)
                     full = jnp.concatenate([ghost, h_vals[jd]], axis=0)
                     term = s * scale_dx(full[1:] - full[:-1])
                 else:
-                    dfa = yz_diff(h_vals[jd], a, backward=True)
+                    dfa = yz_diff(h_vals[jd], a, backward=True,
+                                  ghost=(idx[f"ygh{a}"][jd].astype(fdt)
+                                         if a in sharded_axes else None))
                     if a in slabs and a in static.pml_axes:
                         row = rows_e[a].index(c)
                         psi = idx[f"psE{a}"][row].astype(fdt)
@@ -527,7 +575,22 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         s[1 + a] = 2 * slabs[a]
         in_specs += [pl.BlockSpec(tuple(s), lambda i: (0, 0, 0, 0),
                                   memory_space=pltpu.VMEM)]
-    in_specs += [pl.BlockSpec((1, n2, 1), lambda i: (0, 0, 0),
+    if 0 in sharded_axes:                                     # xgh
+        in_specs += [pl.BlockSpec((nh, 1, n2, n3),
+                                  lambda i: (0, 0, 0, 0),
+                                  memory_space=pltpu.VMEM)]
+    for a in sharded_axes:                                    # ygh{a}
+        if a == 0:
+            continue
+        gs = [nh, T, n2, n3]
+        gs[1 + a] = 1
+        in_specs += [pl.BlockSpec(tuple(gs), tile_imap,
+                                  memory_space=pltpu.VMEM)]
+    in_specs += [pl.BlockSpec((T, 1, 1),
+                              lambda i: (jnp.minimum(i, ntiles - 1),
+                                         0, 0),
+                              memory_space=pltpu.VMEM),       # wall_x
+                 pl.BlockSpec((1, n2, 1), lambda i: (0, 0, 0),
                               memory_space=pltpu.VMEM),       # wall_y
                  pl.BlockSpec((1, 1, n3), lambda i: (0, 0, 0),
                               memory_space=pltpu.VMEM)]       # wall_z
@@ -704,6 +767,23 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         E_arr, H_arr = pstate["E"], pstate["H"]
         h_slabs = pstate["hxs"] if x_pml else None
 
+        # E-phase halos: each shard needs its LOWER neighbor's boundary
+        # plane of OLD H along every sharded axis (backward diffs);
+        # ppermute delivers zeros at the global lo edge (the PEC ghost)
+        ghosts_x = None
+        ghosts_yz = {}
+        for a in sharded_axes:
+            name = mesh_axes[a]
+            n_sh = mesh_shape[name]
+            n_a = (n1, n2, n3)[a]
+            plane = lax.slice_in_dim(H_arr, n_a - 1, n_a, axis=1 + a)
+            gh = lax.ppermute(plane, name,
+                              [(r, r + 1) for r in range(n_sh - 1)])
+            if a == 0:
+                ghosts_x = gh
+            else:
+                ghosts_yz[a] = gh
+
         args = [E_arr, H_arr]
         args += [pstate[f"psE{a}"] for a in psi_axes_e]
         args += [pstate[f"psH{a}"] for a in psi_axes_h]
@@ -713,7 +793,13 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             args += [pstate["rE"], pstate["rH"]]
         args += [_prof_pack(coeffs, "e", a) for a in psi_axes_e]
         args += [_prof_pack(coeffs, "h", a) for a in psi_axes_h]
-        args += [_vec3(coeffs["wall_y"], 1), _vec3(coeffs["wall_z"], 2)]
+        if 0 in sharded_axes:
+            args += [ghosts_x]
+        for a in sharded_axes:
+            if a != 0:
+                args += [ghosts_yz[a]]
+        args += [_vec3(coeffs["wall_x"], 0), _vec3(coeffs["wall_y"], 1),
+                 _vec3(coeffs["wall_z"], 2)]
         args += [coeffs[k] for k in arr_e]
         args += [coeffs[k] for k in arr_h]
         outs = call(*args)
@@ -749,13 +835,43 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             eview = pallas3d.point_source_patch(static, eview, coeffs, t,
                                                 collect=patches)
 
+        # ---- sharded hi-edge H fix -----------------------------------
+        # the kernel's forward diffs used the PEC zero ghost at each
+        # local hi edge; on a sharded axis the true neighbor plane is
+        # the UPPER neighbor's first new-E plane — ppermute it and add
+        # the missing -db*s*E_next/dx contribution on the one edge
+        # plane (thin). Interior-shard slab psi profiles are identity,
+        # so no psi term needs fixing; at the global hi edge ppermute
+        # delivers zeros and the fix vanishes (one SPMD program).
+        for a in sharded_axes:
+            name = mesh_axes[a]
+            n_sh = mesh_shape[name]
+            n_a = (n1, n2, n3)[a]
+            first = lax.slice_in_dim(new_E_arr, 0, 1, axis=1 + a)
+            nxt = lax.ppermute(first, name,
+                               [(r + 1, r) for r in range(n_sh - 1)])
+            for jc, c in enumerate(h_comps):
+                for (aa, jd, sg) in CURL_TERMS[component_axis(c)]:
+                    if aa != a or ("E" + AXES[jd]) not in e_comps:
+                        continue
+                    db = coeffs[f"db_{c}"]
+                    sl = [slice(None)] * 3
+                    sl[a] = slice(n_a - 1, n_a)
+                    if jnp.ndim(db) == 3:
+                        db = db[tuple(sl)]
+                    delta = (-db * sg * inv_dx) * \
+                        nxt[jd].astype(static.compute_dtype)
+                    new_H_arr = new_H_arr.at[(jc,) + tuple(sl)].add(
+                        delta.astype(new_H_arr.dtype))
+
         # ---- H corrections for the E patches -------------------------
         hview = PackedView(new_H_arr, h_comps)
         psxH = dict(pstate.get("psxH", {}))
         psi_h_view = PackedPsiView(psh, rows_meta_h, psxH)
         if patches:
             hview, psi_h_view = pallas_fused.apply_patch_h_corrections(
-                static, hview, psi_h_view, patches, coeffs, slabs)
+                static, hview, psi_h_view, patches, coeffs, slabs,
+                mesh_axes, mesh_shape)
         if setup is not None:
             new_state["inc"] = tfsf_mod.advance_hinc(
                 new_state["inc"], coeffs, setup)

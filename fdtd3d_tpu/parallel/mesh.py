@@ -172,6 +172,25 @@ def state_specs(state: Dict, topology) -> Dict:
     return jax.tree.map(spec_of, state)
 
 
+def packed_specs(packed_shapes, topology) -> Dict:
+    """PartitionSpec tree for the PACKED carry (ops/pallas_packed.py).
+
+    Stacked component leaves are rank-4 (comp-leading): the comp dim
+    replicates and the trailing three shard as a field; rank-3 leaves
+    (psi compacts, boundary bands) shard as fields; vectors (the TFSF
+    incident line) and scalars replicate.
+    """
+    r3 = _rank3_spec(topology)
+
+    def spec_of(leaf):
+        nd = getattr(leaf, "ndim", 0)
+        if nd == 4:
+            return P(None, *r3)
+        return r3 if nd == 3 else P()
+
+    return jax.tree.map(spec_of, packed_shapes)
+
+
 def shard_tree(tree, specs, mesh: Mesh):
     """Shard a host pytree: each device receives ONLY its own slice.
 

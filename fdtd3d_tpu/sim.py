@@ -83,6 +83,25 @@ class Simulation:
             self.state = init_state(self.static)
 
         self._runner = make_chunk_runner(self.static, mesh_axes, mesh_shape)
+        # Packed-carry plumbing: pack/unpack are per-shard functions, so
+        # under a mesh they run inside shard_map with specs inferred
+        # from the packed pytree's ranks (stacked 4D leaves shard their
+        # trailing three dims; 3D leaves shard all dims; vectors and
+        # scalars replicate).
+        self._pack_fn = getattr(self._runner, "pack", None)
+        self._unpack_fn = getattr(self._runner, "unpack", None)
+        self._packed_specs = None
+        if self.mesh is not None and self._pack_fn is not None:
+            packed_shapes = jax.eval_shape(self._runner.pack, state_shapes)
+            self._packed_specs = pmesh.packed_specs(packed_shapes, topo)
+            self._pack_fn = jax.jit(_shard_map_compat(
+                self._runner.pack, self.mesh,
+                in_specs=(self._state_specs,),
+                out_specs=self._packed_specs))
+            self._unpack_fn = jax.jit(_shard_map_compat(
+                self._runner.unpack, self.mesh,
+                in_specs=(self._packed_specs,),
+                out_specs=self._state_specs))
         # "pallas"/"pallas_fused" when fused kernels are engaged, else "jnp"
         self.step_kind: str = getattr(self._runner, "kind", "jnp")
         # kernel diagnostics (x-tile size, VMEM block bytes) or None (jnp)
@@ -132,7 +151,7 @@ class Simulation:
         """
         if self._pstate is not None:
             if self._dstate is None:
-                self._dstate = self._runner.unpack(self._pstate)
+                self._dstate = self._unpack_fn(self._pstate)
                 self._dstate_ids = [id(x) for x in
                                     jax.tree.leaves(self._dstate)]
             return self._dstate
@@ -169,10 +188,12 @@ class Simulation:
         if n not in self._compiled:
             fn = functools.partial(self._runner, n=n)
             if self.mesh is not None:
+                st_specs = self._packed_specs \
+                    if self._packed_specs is not None else self._state_specs
                 fn = _shard_map_compat(fn, self.mesh,
-                                       in_specs=(self._state_specs,
+                                       in_specs=(st_specs,
                                                  self._coeff_specs),
-                                       out_specs=self._state_specs)
+                                       out_specs=st_specs)
             jitted = jax.jit(fn, donate_argnums=0)
             if self.clock is not None:
                 # Profiled runs must time steps, not compilation: compile
@@ -194,7 +215,7 @@ class Simulation:
         if getattr(self._runner, "packed", False) and self._pstate is None:
             # enter the packed representation once; it persists across
             # chunks (the dict form rebuilds lazily via .state)
-            self._pstate = self._runner.pack(self._sstate)
+            self._pstate = self._pack_fn(self._sstate)
             self._sstate = None
         carry = self._carry()
         fn = self._chunk_fn(n_steps, carry)
