@@ -48,6 +48,11 @@ CASES = {
                       "--eps-sphere-radius", "6"],
         {"Ex": 4.4693e-02, "Ey": 6.1280e-03, "Ez": 7.6921e-03,
          "Hy": 1.2000e-04}),
+    "precision3D_compensated.txt": (
+        ["--same-size", "32", "--time-steps", "60", "--pml-size", "4",
+         "--point-source-x", "16", "--point-source-y", "16",
+         "--point-source-z", "16", "--norms-every", "60"],
+        {"Ex": 6.4461e-02, "Ez": 1.5448e-01, "Hy": 5.0197e-05}),
     "drude3D_nanoantenna.txt": (
         _SHRINK_3D + ["--drude-sphere-center-x", "16",
                       "--drude-sphere-center-y", "16",
