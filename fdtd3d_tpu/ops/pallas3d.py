@@ -60,7 +60,7 @@ path is testable without a TPU (tests/test_pallas.py).
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -657,6 +657,32 @@ class PackedPsiView:
             self.extra[key] = val
 
 
+class Patch(NamedTuple):
+    """One applied E-side field delta, for post-hoc H correction.
+
+    The single-pass fused kernels (ops/pallas_fused.py,
+    ops/pallas_packed.py) compute H from the PRE-patch E; the linearity
+    of the update lets them re-add the curl of each patch afterwards
+    (pallas_fused.apply_patch_h_corrections). Two flavors:
+
+    * static (``own is None``): ``start`` is a shard-local int — slab
+      patches (always at local planes 0 / n-m on every shard) and
+      TFSF/point patches on an unsharded axis.
+    * traced (``own`` is a traced bool): the patch normal axis is
+      SHARDED, so the local index of the global plane ``gstart`` is the
+      traced ``start`` (ownership-clamped, pallas3d._local_index) and
+      ``delta`` is owner-gated (zero on non-owner shards). Always one
+      plane (k == 1).
+    """
+
+    comp: str
+    axis: int
+    start: Any            # local plane index (static int or traced i32)
+    delta: Any            # thin local delta array, owner-gated
+    own: Any = None       # traced ownership bool (sharded axis) or None
+    gstart: int = -1      # static GLOBAL plane (traced patches only)
+
+
 def fields_copy(fields):
     """Shallow copy of a component container (dict or PackedView)."""
     return dict(fields) if isinstance(fields, dict) else fields.clone()
@@ -807,9 +833,9 @@ def slab_post(static, family: str, fields, src, psi_ax, coeffs,
             if collect is not None:
                 lo_shape = list(fshape)
                 lo_shape[axis] = m
-                collect.append((c, axis, 0, jnp.broadcast_to(
+                collect.append(Patch(c, axis, 0, jnp.broadcast_to(
                     add_lo, lo_shape)))
-                collect.append((c, axis, n1 - m, jnp.broadcast_to(
+                collect.append(Patch(c, axis, n1 - m, jnp.broadcast_to(
                     add_hi, lo_shape)))
     return new_fields, new_psi
 
@@ -896,16 +922,17 @@ def _plane_add(static, fields, c, axis: int, plane: int, val, coeffs):
     """fields[c][..., plane, ...] += val, ownership-gated on a sharded axis.
 
     Unsharded axis: static index (XLA folds to an in-place slice update).
-    Sharded axis: the add is zeroed on non-owner shards.
+    Sharded axis: the add is zeroed on non-owner shards. Returns
+    (fields, loc, own, gated_val) so callers that collect Patch records
+    (tfsf_patch) reuse the same gating/indexing — the sharded-plane-add
+    convention lives in exactly one place.
     """
-    if plane < 0 or plane >= static.grid_shape[axis]:
-        return fields
     loc, own = _local_index(static, coeffs, axis, plane)
     sl: List[Any] = [slice(None)] * 3
     sl[axis] = loc
     if own is not None:
         val = jnp.where(own, val, 0.0).astype(fields[c].dtype)
-    return fields_add(fields, c, sl, val)
+    return fields_add(fields, c, sl, val), loc, own, val
 
 
 def _plane_coef(static, cb, axis: int, plane: int, coeffs):
@@ -924,10 +951,11 @@ def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
                coeffs, inc, collect=None) -> Dict[str, jnp.ndarray]:
     """Add the TFSF face corrections onto the kernel output planes.
 
-    ``collect`` (list or None): receives the applied deltas as
-    (comp, axis, plane, 3D one-plane array) patches — see x_slab_post.
-    Only valid on an unsharded topology (the fused E+H path's scope);
-    the two-pass path passes None.
+    ``collect`` (list or None): receives the applied deltas as Patch
+    records — static local-plane patches on unsharded axes, traced
+    ownership-gated ones on sharded axes (round 5) — see x_slab_post
+    and pallas_fused.apply_patch_h_corrections. The two-pass path
+    passes None.
     """
     setup = static.tfsf_setup
     mode = static.mode
@@ -958,20 +986,24 @@ def tfsf_patch(static, family: str, fields: Dict[str, jnp.ndarray],
                         t2 = t2 * jnp.squeeze(
                             w.reshape(shp), axis=axis)
             val = (sign * scale * t2).astype(fdt)
-            out = _plane_add(static, out, c, axis, plane, val, coeffs)
+            out, loc, own, val = _plane_add(static, out, c, axis, plane,
+                                            val, coeffs)
             if collect is not None:
                 pshape = list(fshape)
                 pshape[axis] = 1
-                collect.append((c, axis, plane, jnp.broadcast_to(
-                    jnp.expand_dims(val, axis), pshape)))
+                collect.append(Patch(
+                    c, axis, plane if own is None else loc,
+                    jnp.broadcast_to(jnp.expand_dims(val, axis), pshape),
+                    own, plane))
     return out
 
 
 def point_source_patch(static, fields, coeffs, t, collect=None):
     """Soft point source as a single-cell add, ownership-gated per shard.
 
-    ``collect`` (unsharded only): receives the applied delta as a
-    one-x-plane patch with a single nonzero cell.
+    ``collect``: receives the applied delta as a one-x-plane Patch with
+    a single nonzero cell — static on an unsharded x axis, traced
+    (local index + x-ownership) on a sharded one.
     """
     ps = static.cfg.point_source
     c = ps.component
@@ -984,10 +1016,12 @@ def point_source_patch(static, fields, coeffs, t, collect=None):
     fshape = out[c].shape
     cb = coeffs[f"cb_{c}"]
     idxs = []
+    owns = []
     own = None
     for a in range(3):
         loc, o = _local_index(static, coeffs, a, ps.position[a])
         idxs.append(loc)
+        owns.append(o)
         if o is not None:
             own = o if own is None else own & o
     scale = cb
@@ -1000,7 +1034,11 @@ def point_source_patch(static, fields, coeffs, t, collect=None):
     if collect is not None:
         plane = jnp.zeros((1,) + tuple(fshape[1:]), fdt)
         plane = plane.at[0, idxs[1], idxs[2]].add(val)
-        collect.append((c, 0, ps.position[0], plane))
+        if owns[0] is None:
+            collect.append(Patch(c, 0, ps.position[0], plane))
+        else:
+            collect.append(Patch(c, 0, idxs[0], plane, owns[0],
+                                 ps.position[0]))
     return fields_add(out, c, tuple(idxs), val)
 
 

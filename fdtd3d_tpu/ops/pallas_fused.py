@@ -65,6 +65,73 @@ def _shift_lo(v: jnp.ndarray, axis: int) -> jnp.ndarray:
                    pad)
 
 
+def _traced_patch_fix(static, out_H, c, p, a, s, db, coeffs,
+                      mesh_axes, mesh_shape, inv_dx, cdt, h_dtype):
+    """H correction for one traced (sharded-normal-axis) plane patch.
+
+    The packed kernel admits sharded TFSF/point-source runs only when
+    the patch support sits strictly inside the CPML identity region
+    (pallas_packed._sources_interior), so F == identity and no psi term
+    arises — the correction is the plain curl of the one-plane delta.
+    Two pieces cross shards and ride ppermute: the P-1 plane of an
+    a == b term when the patch sits at a shard's first plane (the
+    correction lands on the LOWER b-neighbor's last plane), and the
+    transverse forward-diff's hi-edge ghost on a sharded a.
+    """
+    from fdtd3d_tpu.ops import pallas3d as _p3
+
+    b, loc, own, gplane = p.axis, p.start, p.own, p.gstart
+    delta = p.delta.astype(cdt)          # one owner-gated plane along b
+    name_b = mesh_axes[b]
+    n_b = static.grid_shape[b] // static.topology[b]
+
+    def db_plane(loc_b):
+        if jnp.ndim(db) != 3:
+            return db
+        return lax.dynamic_slice_in_dim(db, loc_b, 1, b)
+
+    def add_plane(H, loc_b, val):
+        sl: list = [slice(None)] * 3
+        sl[b] = loc_b            # traced int -> dynamic one-plane add
+        return _p3.fields_add(H, c, sl,
+                              jnp.squeeze(val, b).astype(h_dtype))
+
+    if a == b:
+        # forward diff along the patch normal: +delta/dx at P-1,
+        # -delta/dx at P (dH = -db * s * that)
+        here = -db_plane(loc) * (s * inv_dx) * (-delta)
+        out_H = add_plane(out_H, loc, here)
+        locm = jnp.maximum(loc - 1, 0)
+        prev = -db_plane(locm) * (s * inv_dx) * delta
+        prev = jnp.where(own & (loc > 0), prev, 0.0)
+        out_H = add_plane(out_H, locm, prev)
+        # cross-shard: when the owner holds P at its first plane, P-1
+        # is the lower b-neighbor's LAST plane — ship the delta down
+        n_sh_b = mesh_shape[name_b]
+        recv = lax.ppermute(delta, name_b,
+                            [(r + 1, r) for r in range(n_sh_b - 1)])
+        gate = coeffs[f"g{AXES[b]}"][0] + n_b == gplane
+        last = -db_plane(n_b - 1) * (s * inv_dx) * recv
+        last = jnp.where(gate, last, 0.0)
+        out_H = add_plane(out_H, n_b - 1, last)
+    else:
+        w = (_shift_lo(delta, a) - delta) * inv_dx
+        if mesh_axes.get(a):
+            # sharded transverse axis: the local hi plane's forward
+            # neighbor is the upper a-shard's first patch plane
+            name_a = mesh_axes[a]
+            n_sh_a = mesh_shape[name_a]
+            first = lax.slice_in_dim(delta, 0, 1, axis=a)
+            nxt = lax.ppermute(first, name_a,
+                               [(r + 1, r) for r in range(n_sh_a - 1)])
+            n_a_loc = delta.shape[a]
+            hi_sl = [slice(None)] * 3
+            hi_sl[a] = slice(n_a_loc - 1, n_a_loc)
+            w = w.at[tuple(hi_sl)].add(nxt * inv_dx)
+        out_H = add_plane(out_H, loc, -db_plane(loc) * (s * w))
+    return out_H
+
+
 def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                               slabs, mesh_axes=None, mesh_shape=None):
     """Correct the kernel's H update for post-kernel E patches.
@@ -83,9 +150,13 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
         the stored psi' needs +c * D_a(dE)/dx at the slab overlap.
       * else: plain curl, F = identity.
 
-    ``patches``: list of (e_comp, axis b, start, delta) with delta a 3D
-    array spanning `k` planes along b and full extents elsewhere.
-    Unsharded topology only (the fused path's scope).
+    ``patches``: list of pallas3d.Patch. Static patches (own None)
+    carry a shard-local int start and a delta spanning `k` planes along
+    b (full extents elsewhere); traced patches (sharded patch axis,
+    round 5) carry a traced local index + ownership and take the
+    _traced_patch_fix branch, which assumes the CPML-identity-region
+    precondition (pallas_packed._sources_interior). Runs unsharded and
+    under shard_map.
     """
     from fdtd3d_tpu.ops import pallas3d as _p3
 
@@ -113,9 +184,16 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
             d = "E" + AXES[d_axis]
             if d not in mode.e_components:
                 continue
-            for (pc, b, start, delta) in patches:
-                if pc != d:
+            for p in patches:
+                if p.comp != d:
                     continue
+                if p.own is not None:
+                    # sharded patch axis: traced local index + ownership
+                    out_H = _traced_patch_fix(
+                        static, out_H, c, p, a, s, db, coeffs,
+                        mesh_axes, mesh_shape, inv_dx, cdt, h_dtype)
+                    continue
+                b, start, delta = p.axis, p.start, p.delta
                 delta = delta.astype(cdt)
                 k = delta.shape[b]
                 # LOCAL extent: patches carry shard-local plane starts

@@ -45,16 +45,19 @@ views.
 
 Scope (everything else falls back to ops/pallas_fused.py /
 ops/pallas3d.py / solver.py): 3D, real f32/bf16 storage, slab-fitting
-CPML on any axes, Drude J (electric); unsharded runs additionally get
-TFSF and point sources. SHARDED topologies run the source-free scope
-(the weak-scaling workload class): E-phase halos arrive as ppermuted
-ghost operands (the x ghost feeds tile 0's edge, y/z ghosts ride as
-one-plane blocks), the H phase's local hi-edge planes receive the
-missing neighbor new-E contribution as a thin post-fix, and the x-slab
-patch curls ppermute their boundary plane (apply_patch_h_corrections).
-Magnetic Drude (K lives in the lagged H phase and would need one more
-full-volume carry) falls back to the two-pass kernels, as do sharded
-runs with TFSF/point sources (ownership-gated patches).
+CPML on any axes, Drude J (electric), TFSF and point sources — sharded
+or not. SHARDED topologies (the weak-scaling workload class): E-phase
+halos arrive as ppermuted ghost operands (the x ghost feeds tile 0's
+edge, y/z ghosts ride as one-plane blocks), the H phase's local
+hi-edge planes receive the missing neighbor new-E contribution as a
+thin post-fix, and the x-slab patch curls ppermute their boundary
+plane (apply_patch_h_corrections). Sharded TFSF/point sources (round
+5) ride the same correction pipeline as traced ownership-gated plane
+patches (pallas3d.Patch, pallas_fused._traced_patch_fix) — admitted
+when the source support sits inside the CPML identity region
+(_sources_interior; true for standard margins), else two-pass
+fallback. Magnetic Drude (K lives in the lagged H phase and would
+need one more full-volume carry) falls back to the two-pass kernels.
 
 Compensated-mode caveat: the in-kernel updates carry the full Kahan +
 double-single-coefficient treatment, but the thin post-kernel patches
@@ -100,13 +103,56 @@ from fdtd3d_tpu.ops.pallas3d import (PackedPsiView, PackedView,
 AXES = "xyz"
 
 
+def _sources_interior(static) -> bool:
+    """True iff every TFSF E-correction plane and the point source sit,
+    with a one-plane guard for the H-correction curls, strictly inside
+    the region where BOTH CPML profile sets are exactly identity
+    (planes [npml, n-2-npml] per ops/cpml.axis_profiles).
+
+    This is the static condition under which the sharded packed path's
+    traced patch corrections (pallas_fused._traced_patch_fix) are exact
+    with NO slab-psi or F-factor terms: with standard TFSF margins >= 2
+    it always holds (box planes are pml+margin in from each wall)."""
+    lo = [None, None, None]
+    hi = [None, None, None]
+
+    def grow(a, v):
+        lo[a] = v if lo[a] is None else min(lo[a], v)
+        hi[a] = v if hi[a] is None else max(hi[a], v)
+
+    setup = static.tfsf_setup
+    if setup is not None:
+        for corr in setup.corrections:
+            if corr.field != "E":
+                continue  # H-side corrections are applied, not collected
+            grow(corr.axis, corr.plane)
+            for b in range(3):
+                if b != corr.axis and b in static.mode.active_axes:
+                    grow(b, setup.lo[b])
+                    grow(b, setup.hi[b])
+    if static.cfg.point_source.enabled:
+        for a in range(3):
+            grow(a, static.cfg.point_source.position[a])
+    for a in static.mode.active_axes:
+        if lo[a] is None:
+            continue
+        npml = static.cfg.pml.size[a] if a in static.pml_axes else 0
+        n = static.grid_shape[a]
+        if lo[a] - 1 < npml or hi[a] + 1 > n - 2 - npml:
+            return False
+    return True
+
+
 def eligible(static, mesh_axes=None) -> bool:
     """Packed-kernel scope. Sharded topologies are in scope (round 4):
     E-phase halos ppermute in as thin ghost operands, H-phase hi-edge
     planes are fixed by thin post-corrections from ppermuted new-E
-    boundary planes. Sharded runs with TFSF/point sources fall back to
-    the two-pass kernels (their patch machinery is ownership-gated;
-    the packed H-correction algebra is not)."""
+    boundary planes. Sharded TFSF / point-source runs are in scope too
+    (round 5) when the source support sits inside the CPML identity
+    region (always true for standard margins): the E patches are
+    ownership-gated traced adds and the packed H corrections ship the
+    two cross-shard pieces by ppermute (pallas_fused._traced_patch_fix);
+    otherwise they fall back to the two-pass kernels."""
     if static.mode.name != "3D":
         return False
     if static.field_dtype not in (np.float32, jnp.bfloat16):
@@ -117,8 +163,9 @@ def eligible(static, mesh_axes=None) -> bool:
                 static.topology[a] > 1 and not mesh_axes.get(a)
                 for a in range(3)):
             return False  # sharded axis without a mesh axis name
-        if static.tfsf_setup is not None \
-                or static.cfg.point_source.enabled:
+        if (static.tfsf_setup is not None
+                or static.cfg.point_source.enabled) \
+                and not _sources_interior(static):
             return False
         if static.cfg.compensated:
             return False  # jnp path covers sharded compensated

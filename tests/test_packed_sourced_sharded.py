@@ -1,0 +1,118 @@
+"""Sharded packed kernel WITH sources (TFSF + point source).
+
+Round-5 scope extension (VERDICT r4 missing item 2): the 48 B/cell
+packed pipelined kernel must keep running under a decomposition when
+the run is SOURCED — BASELINE configs #4 (Mie sphere, TFSF) and #5
+(Drude nanoantenna) are the actual multi-chip validation workloads.
+The E-side TFSF/point patches become traced ownership-gated plane adds
+(pallas3d.Patch) and the packed H-correction algebra ships the two
+cross-shard pieces by ppermute (pallas_fused._traced_patch_fix).
+
+Runs in interpreter mode on the 8-device virtual CPU mesh; parity is
+against the unsharded jnp step. A mu sphere makes db_{c} a 3D grid so
+the dynamic-slice coefficient path is exercised too.
+"""
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig, SphereConfig,
+                               TfsfConfig)
+from fdtd3d_tpu.sim import Simulation
+
+N = 16
+TOPOLOGIES = [(2, 1, 1), (1, 2, 2), (2, 2, 2)]
+
+
+def _cfg(parallel=None, use_pallas=None, ps_pos=(5, 9, 7)):
+    return SimConfig(
+        scheme="3D", size=(N, N, N), time_steps=8, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3, use_pallas=use_pallas,
+        pml=PmlConfig(size=(2, 2, 2)),
+        tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                        angle_teta=30.0, angle_phi=40.0, angle_psi=15.0),
+        materials=MaterialsConfig(
+            eps=1.0, use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+            drude_sphere=SphereConfig(enabled=True,
+                                      center=(8.0, 8.0, 8.0), radius=3.0),
+            mu_sphere=SphereConfig(enabled=True, center=(7.0, 8.0, 9.0),
+                                   radius=3.0, value=1.5)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=ps_pos),
+        parallel=parallel or ParallelConfig(),
+    )
+
+
+@pytest.fixture(scope="module")
+def reference_fields():
+    sim = Simulation(_cfg(use_pallas=False))
+    sim.run()
+    return sim.fields()
+
+
+@pytest.mark.parametrize("topo", TOPOLOGIES)
+def test_sharded_packed_with_sources(topo, reference_fields):
+    cfg = _cfg(ParallelConfig(topology="manual", manual_topology=topo),
+               use_pallas=True)
+    sim = Simulation(cfg)
+    assert sim.mesh is not None, "sharded path not engaged"
+    assert sim.step_kind == "pallas_packed", \
+        f"packed kernel not engaged on {topo} (got {sim.step_kind})"
+    sim.run()
+    got = sim.fields()
+    for comp, ref in reference_fields.items():
+        scale = np.abs(ref).max() + 1e-30
+        err = np.abs(got[comp] - ref).max()
+        assert err < 1e-5 * scale, f"{comp}: {err/scale:.2e} on {topo}"
+
+
+def test_psi_state_parity_sharded_sourced():
+    """The CPML psi recursion state must match too: the traced patch
+    corrections may not leak into the slab psi stacks (the interior
+    condition guarantees no psi term arises from the patches). Compared
+    against the sharded jnp step on the SAME topology so the per-shard
+    slab-compacted psi layouts coincide."""
+    topo = ParallelConfig(topology="manual", manual_topology=(2, 2, 2))
+    ref = Simulation(_cfg(topo, use_pallas=False))
+    assert ref.step_kind == "jnp"
+    ref.run()
+    sim = Simulation(_cfg(topo, use_pallas=True))
+    assert sim.step_kind == "pallas_packed"
+    sim.run()
+    from fdtd3d_tpu.parallel import distributed as pdist
+    for grp in ("psi_E", "psi_H"):
+        for key, rv in ref.state[grp].items():
+            gv = pdist.gather_to_host(sim.state[grp][key])
+            rn = pdist.gather_to_host(rv)
+            scale = np.abs(rn).max() + 1e-30
+            assert np.abs(gv - rn).max() < 1e-5 * scale, key
+
+
+def test_source_near_pml_falls_back():
+    """A point source INSIDE the CPML guard band fails the static
+    interior condition -> the sharded run must take the (fully general)
+    two-pass kernels and stay correct."""
+    ref = Simulation(_cfg(use_pallas=False, ps_pos=(2, 9, 7)))
+    ref.run()
+    cfg = _cfg(ParallelConfig(topology="manual", manual_topology=(2, 2, 2)),
+               use_pallas=True, ps_pos=(2, 9, 7))
+    sim = Simulation(cfg)
+    assert sim.step_kind == "pallas", \
+        f"expected two-pass fallback, got {sim.step_kind}"
+    sim.run()
+    got = sim.fields()
+    for comp, rv in ref.fields().items():
+        scale = np.abs(rv).max() + 1e-30
+        assert np.abs(got[comp] - rv).max() < 1e-5 * scale, comp
+
+
+def test_unsharded_packed_unaffected(reference_fields):
+    """The unsharded packed path (static patches) still matches."""
+    sim = Simulation(_cfg(use_pallas=True))
+    assert sim.step_kind == "pallas_packed"
+    sim.run()
+    got = sim.fields()
+    for comp, ref in reference_fields.items():
+        scale = np.abs(ref).max() + 1e-30
+        assert np.abs(got[comp] - ref).max() < 1e-5 * scale, comp
