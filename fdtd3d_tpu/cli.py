@@ -49,7 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--courant-factor", type=float, default=0.5)
     g.add_argument("--wavelength", type=float, default=20e-3,
                    help="source wavelength, m")
-    g.add_argument("--dtype", choices=["float32", "float64", "bfloat16"],
+    g.add_argument("--dtype", choices=["float32", "float64", "bfloat16",
+                                       "float32x2"],
                    default="float32")
     g.add_argument("--compensated", action=argparse.BooleanOptionalAction, default=False,
                    help="Kahan-compensated f32 updates: f64-class "

@@ -223,7 +223,9 @@ class SimConfig:
     dx: float = 1e-3               # uniform spatial step, meters
     courant_factor: float = 0.5
     wavelength: float = 20e-3      # source wavelength, meters
-    dtype: str = "float32"         # "float32" | "float64" | "bfloat16"
+    # "float32" | "float64" | "bfloat16" | "float32x2" (double-single:
+    # hi+lo f32 pairs, ~f64-class accumulation at 2x f32 traffic)
+    dtype: str = "float32"
     complex_fields: bool = False   # reference COMPLEX_FIELD_VALUES mode
     # Kahan-compensated f32 updates: each field family carries a bf16
     # residual of the lost low-order bits of its leapfrog accumulation,
@@ -275,14 +277,21 @@ class SimConfig:
     def np_dtype(self):
         import numpy as np
         base = {"float32": np.float32, "float64": np.float64,
-                "bfloat16": None}[self.dtype]
+                "bfloat16": None, "float32x2": np.float32}[self.dtype]
         if self.dtype == "bfloat16":
             import jax.numpy as jnp
             base = jnp.bfloat16
         if self.complex_fields:
-            return {"float32": np.complex64,
+            return {"float32": np.complex64, "float32x2": np.complex64,
                     "float64": np.complex128}[self.dtype]
         return base
+
+    @property
+    def ds_fields(self) -> bool:
+        """Double-single (hi+lo f32 pair) field storage — ~f64-class
+        accumulation on the f32 vector units (ops/ds.py) at 2x field
+        traffic; the ``--dtype float32x2`` accuracy rung."""
+        return self.dtype == "float32x2"
 
     def validate(self) -> "SimConfig":
         mode = self.mode  # raises on bad scheme
@@ -296,7 +305,8 @@ class SimConfig:
                 if self.pml.size[a] * 2 + 4 > self.size[a] and \
                         self.pml.size[a] > 0:
                     raise ValueError(f"PML too thick on axis {a}")
-        if self.dtype not in ("float32", "float64", "bfloat16"):
+        if self.dtype not in ("float32", "float64", "bfloat16",
+                              "float32x2"):
             raise ValueError(f"bad dtype {self.dtype}")
         if self.output.checkpoint_backend not in ("npz", "orbax"):
             raise ValueError(
@@ -332,7 +342,9 @@ class SimConfig:
             raise ValueError(
                 "compensated updates require real float32 fields "
                 "(float64 needs no compensation; bfloat16 storage is "
-                "already below the residual's resolution)")
+                "already below the residual's resolution; float32x2 "
+                "supersedes compensation — its lo words ARE the "
+                "residuals, carried through the curls too)")
         if self.ntff.enabled:
             if mode.name != "3D":
                 raise ValueError("NTFF requires the 3D scheme")

@@ -1,0 +1,235 @@
+"""Double-single (float-float) arithmetic primitives.
+
+The accuracy rung between f32 and XLA-emulated f64 (BASELINE.md
+"Accuracy" section): a value is carried as an unevaluated sum
+``hi + lo`` of two f32 words with ``|lo| <= ulp(hi)/2``, giving
+~2^-47 effective significand at native-f32 vector throughput — on TPU
+the FDTD step stays HBM-bound, so the extra FLOPs are nearly free
+while emulated f64 forfeits every Pallas path and pays ~10x.
+
+Classic error-free transformations (Dekker 1971, Knuth TAOCP 4.2.2;
+the same algorithms behind CUDA's ``double-single`` and the QD
+library's ``dd_real``, restated for f32):
+
+* ``two_sum`` / ``two_diff`` — exact rounding error of a +- b
+  (6 flops, no magnitude precondition).
+* ``two_prod`` — exact error of a * b via Dekker magnitude splitting
+  (f32 splits at 2^12: the 4097 constant), since jnp exposes no fma.
+* ``add_ff`` / ``sub_ff`` / ``mul_ff`` / ``add_f`` / ``scale_f`` —
+  float-float combinations with one renormalization at the end.
+  Renormalization uses the FULL two_sum, never quick_two_sum: the
+  3-op form's single error path is corrupted when the backend
+  fma-contracts a product feeding the sum (measured: jitted mul_ff
+  lost the two_prod residual — a half-ulp-class total error — while
+  the 6-op form computes the exact residual of WHATEVER rounded sum
+  the compiler produced, surviving contraction).
+
+Correctness of every primitive here REQUIRES that the compiler neither
+reassociates nor contracts the float expressions; XLA guarantees both
+(the Kahan path in solver.py leans on the same contract). Everything
+operates elementwise on jnp arrays and is shape/broadcast agnostic.
+All functions take and return (hi, lo) pairs of f32 arrays — no
+wrapper class, so the same code runs unchanged inside Pallas kernels.
+
+Reference parity: the reference computes in C++ double end-to-end
+(SURVEY.md §2 FieldValue row); this module is what lets the TPU
+framework match that accuracy class without leaving the f32 vector
+units.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+# Dekker split point for f32: 2^ceil(24/2) + 1.
+_SPLIT = 4097.0
+
+# EFT pivot barriers (see _ob). BOTH defenses are load-bearing and
+# were each verified necessary by eager-vs-jit full-step equality:
+# without the barriers the full solver graph re-loses the TFSF
+# accumulation's error term (the simplifier-level fold) even with the
+# full-two_sum renormalization; without the two_sum renormalization
+# mul_ff loses its product residual to fma contraction even with the
+# barriers. Flag kept as a one-change re-test lever.
+_BARRIERS = True
+
+Pair = Tuple[jnp.ndarray, jnp.ndarray]
+
+
+def _ob(x):
+    """Optimization barrier on the EFT pivot value.
+
+    XLA's simplifier (allow_excess_precision is on by default, and this
+    environment's TPU terminal pins it on) may fold patterns like
+    ``(a + b) - a`` once the surrounding graph gives it the chance —
+    measured: the jitted full solver step lost the error term of the
+    TFSF-correction accumulation (~1.3e-7 per-step deviation from the
+    eager/exact result) while every primitive in isolation compiled
+    exactly. Pinning just the pivot (the rounded sum/product the error
+    term is derived from) behind a barrier makes the cancellation
+    pattern opaque to the simplifier at negligible fusion cost.
+    """
+    return lax.optimization_barrier(x) if _BARRIERS else x
+
+
+def quick_two_sum(a, b) -> Pair:
+    """Exact a + b = s + err, REQUIRES |a| >= |b| (3 flops).
+
+    WARNING: not optimizer-robust — do NOT use as a renormalization
+    step (see module docstring); kept for reference/tests only.
+    """
+    s = _ob(a + b)
+    err = b - (s - a)
+    return s, err
+
+
+def two_sum(a, b) -> Pair:
+    """Exact a + b = s + err, no precondition (6 flops, Knuth)."""
+    s = _ob(a + b)
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def two_diff(a, b) -> Pair:
+    """Exact a - b = s + err, no precondition (6 flops)."""
+    s = _ob(a - b)
+    bb = s - a
+    err = (a - (s - bb)) - (b + bb)
+    return s, err
+
+
+def split(a) -> Pair:
+    """a = hi + lo with hi carrying the top 12 significand bits."""
+    t = _ob(_SPLIT * a)
+    hi = _ob(t - (t - a))
+    return hi, a - hi
+
+
+def two_prod(a, b) -> Pair:
+    """Exact a * b = p + err (17 flops; Dekker, no fma needed)."""
+    p = _ob(a * b)
+    ah, al = split(a)
+    bh, bl = split(b)
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, err
+
+
+def add_ff(ah, al, bh, bl) -> Pair:
+    """(ah,al) + (bh,bl), error O(eps^2) (Dekker add, 20 flops)."""
+    sh, se = two_sum(ah, bh)
+    te, tf = two_sum(al, bl)
+    se = se + te
+    sh, se = two_sum(sh, se)
+    se = se + tf
+    return two_sum(sh, se)
+
+
+def sub_ff(ah, al, bh, bl) -> Pair:
+    return add_ff(ah, al, -bh, -bl)
+
+
+def add_f(ah, al, b) -> Pair:
+    """(ah,al) + plain-f32 b (10 flops)."""
+    sh, se = two_sum(ah, b)
+    se = se + al
+    return two_sum(sh, se)
+
+
+def mul_ff(ah, al, bh, bl) -> Pair:
+    """(ah,al) * (bh,bl), error O(eps^2) (24 flops)."""
+    p, e = two_prod(ah, bh)
+    e = e + (ah * bl + al * bh)
+    return two_sum(p, e)
+
+
+def scale_f(ah, al, b) -> Pair:
+    """(ah,al) * plain-f32 b (21 flops)."""
+    p, e = two_prod(ah, b)
+    e = e + al * b
+    return two_sum(p, e)
+
+
+def neg(ah, al) -> Pair:
+    return -ah, -al
+
+
+def to_f32(ah, al):
+    """Collapse to the nearest single f32 (hi absorbs lo by invariant)."""
+    return ah + al
+
+
+def from_f64(x) -> Tuple:
+    """Host-side split of a float64 numpy array/scalar into (hi, lo).
+
+    Setup-time only (coefficients): not a jnp op.
+    """
+    import numpy as np
+    hi = np.asarray(x, np.float64).astype(np.float32)
+    lo = (np.asarray(x, np.float64) - hi.astype(np.float64)) \
+        .astype(np.float32)
+    return hi, lo
+
+
+# ---------------------------------------------------------------------------
+# double-single sin(2*pi*x) — the source oscillator
+# ---------------------------------------------------------------------------
+# An f32 libm sin has ~eps32 RELATIVE error — but the source error is
+# wave-COHERENT (a deterministic function of phase), so it pumps the
+# field at ~eps32 per period and was measured as the ~1e-6 residual of
+# the float32x2 TFSF frontier at 1000 steps. Taylor-in-ds evaluation
+# restores ~2^-45; the cost is ~40 scalar FLOP-pairs per step (the
+# oscillator is evaluated once per source per step).
+
+def _horner(cs, zh, zl):
+    ph, pl = cs[-1]
+    for c in cs[-2::-1]:
+        ph, pl = mul_ff(ph, pl, zh, zl)
+        ph, pl = add_ff(ph, pl, c[0], c[1])
+    return ph, pl
+
+
+def _taylor_coeffs():
+    import math
+    sin_c = [from_f64(((-1.0) ** k) / math.factorial(2 * k + 1))
+             for k in range(11)]
+    cos_c = [from_f64(((-1.0) ** k) / math.factorial(2 * k))
+             for k in range(11)]
+    return sin_c, cos_c
+
+
+_SIN_C, _COS_C = _taylor_coeffs()
+
+
+def sin2pi(fh, fl) -> Pair:
+    """sin(2*pi*(fh + fl)) as a ds pair, |error| ~ 2^-45.
+
+    Input is a ds phase FRACTION (turns), fh >= 0 truncated-from-below
+    with 0 <= fl (sources.phase_frac_ds's layout); any f in [0, 2) is
+    accepted so a static fractional offset may be pre-added. Quadrant
+    reduction is exact: 4*fh is an exact f32 product, 4*fh - q is exact
+    by Sterbenz, and the Taylor sums in ds Horner hold ~2^-45 on the
+    reduced range.
+    """
+    import numpy as np
+    pio2 = from_f64(np.float64(np.pi) / 2.0)
+    xh = fh * 4.0
+    xl = fl * 4.0
+    q = jnp.floor(xh)
+    rh, rl = two_sum(xh - q, xl)
+    th, tl = mul_ff(rh, rl, pio2[0], pio2[1])      # theta in [0, pi/2)
+    zh, zl = mul_ff(th, tl, th, tl)                # theta^2
+    sh_, sl_ = _horner(_SIN_C, zh, zl)
+    sh_, sl_ = mul_ff(th, tl, sh_, sl_)            # sin(theta)
+    ch_, cl_ = _horner(_COS_C, zh, zl)             # cos(theta)
+    qm = jnp.mod(q, 4.0)
+    out_h = jnp.where(qm == 0.0, sh_,
+                      jnp.where(qm == 1.0, ch_,
+                                jnp.where(qm == 2.0, -sh_, -ch_)))
+    out_l = jnp.where(qm == 0.0, sl_,
+                      jnp.where(qm == 1.0, cl_,
+                                jnp.where(qm == 2.0, -sl_, -cl_)))
+    return out_h, out_l

@@ -91,6 +91,8 @@ def eligible(static, mesh_axes=None) -> bool:
         return False
     if static.cfg.compensated:
         return False  # Kahan residuals live in the packed kernel only
+    if static.cfg.ds_fields:
+        return False  # double-single pairs: jnp_ds / packed-ds only
     return True
 
 
@@ -873,8 +875,10 @@ def plane_corrections(field: str, comp: str, setup, coeffs, inc,
             pb = gs[b].astype(rdt) + off[b]
             shape = [1, 1, 1]
             shape[b] = pb.shape[0]
-            zeta = zeta + setup.khat[b] * (
-                pb - setup.origin[b]).reshape(shape)
+            # khat/origin are strong-typed f64 scalars: cast to rdt so
+            # an f32 run stays f32 even with jax_enable_x64 on
+            zeta = zeta + jnp.asarray(setup.khat[b], rdt) * (
+                pb - jnp.asarray(setup.origin[b], rdt)).reshape(shape)
         if corr.src[0] == "E":
             val = tfsf_mod._interp_line(inc["Einc"], zeta)
             pol = setup.ehat[component_axis(corr.src)]
@@ -894,7 +898,7 @@ def plane_corrections(field: str, comp: str, setup, coeffs, inc,
             shape_b[b] = ind.shape[0]
             ind = ind.reshape(shape_b).astype(val.dtype)
             gate = ind if gate is None else gate * ind
-        term = (corr.sign * pol / dx) * val
+        term = jnp.asarray(corr.sign * pol / dx, rdt) * val
         if gate is not None:
             term = term * gate
         out.append((corr.axis, corr.plane, term))

@@ -54,6 +54,8 @@ def eligible(static, mesh_axes=None) -> bool:
         return False
     if static.cfg.compensated:
         return False  # Kahan residuals live in the packed kernel only
+    if static.cfg.ds_fields:
+        return False  # double-single pairs: jnp_ds / packed-ds only
     return True
 
 

@@ -44,6 +44,95 @@ def _phase_frac(step: jnp.ndarray, f: float) -> jnp.ndarray:
     return u.astype(jnp.float32) * jnp.float32(2.0 ** -32)
 
 
+def _phase_words(step: jnp.ndarray, f: float):
+    """(top-32, low-32) uint32 words of frac(step * f) via 64-bit
+    fixed-point modular arithmetic (see _phase_frac)."""
+    q = int(round((f % 1.0) * 2.0 ** 64)) & ((1 << 64) - 1)
+    q_hi = jnp.uint32(q >> 32)
+    b = q & 0xffffffff
+    s = step.astype(jnp.uint32)
+    s1, s0 = s >> 16, s & 0xffff
+    b1, b0 = jnp.uint32(b >> 16), jnp.uint32(b & 0xffff)
+    m1 = s1 * b0
+    m2 = s0 * b1
+    low = s0 * b0
+    carry = ((m1 & 0xffff) + (m2 & 0xffff) + (low >> 16)) >> 16
+    hi = s1 * b1 + (m1 >> 16) + (m2 >> 16) + carry
+    u = s * q_hi + hi
+    low32 = s * jnp.uint32(b)      # (step*q) mod 2^32: exact wrap
+    return u, low32
+
+
+def phase_frac_ds(step: jnp.ndarray, f: float):
+    """frac(step * f) as an EXACT-to-2^-48 ds pair (hi truncated from
+    below, 0 <= lo): the float32x2 oscillator's phase input."""
+    u, low32 = _phase_words(step, f)
+    uh = u & jnp.uint32(0xffffff00)          # top 24 bits: exact in f32
+    rem = u & jnp.uint32(0xff)
+    fh = uh.astype(jnp.float32) * jnp.float32(2.0 ** -32)
+    fl = rem.astype(jnp.float32) * jnp.float32(2.0 ** -32) \
+        + low32.astype(jnp.float32) * jnp.float32(2.0 ** -64)
+    return fh, fl
+
+
+# Shared waveform shape constants: the ramp lasts _RAMP_PERIODS
+# periods (smoothstep), the Gaussian pulse has tau = _PULSE_TAU_PERIODS
+# periods centered at _PULSE_T0_TAUS * tau. waveform() and waveform_ds()
+# MUST inject physically identical sources for every dtype — keep shape
+# knobs here, never inline in one of them.
+_RAMP_PERIODS = 2.0
+_PULSE_TAU_PERIODS = 1.5
+_PULSE_T0_TAUS = 4.0
+
+
+def waveform_ds(kind: str, step: jnp.ndarray, offset: float, omega: float,
+                dt: float):
+    """Double-single source waveform: (hi, lo) pair.
+
+    The f32 sin's ~eps32 error is wave-COHERENT (a deterministic
+    function of phase) and was measured pumping the float32x2 TFSF
+    frontier at ~1e-6 by 1000 steps; the ds oscillator (ds.sin2pi over
+    the exact fixed-point phase) removes it. Non-oscillatory kinds fall
+    back to the f32 waveform with a zero lo word.
+    """
+    from fdtd3d_tpu.ops import ds
+    if kind not in ("sin", "gauss_pulse"):
+        return waveform(kind, step, offset, omega, dt, np.float32), \
+            jnp.float32(0.0)
+    f = (omega * dt) / (2.0 * math.pi)
+    fh, fl = phase_frac_ds(step, f)
+    oh, ol = ds.from_f64(np.float64((offset * f) % 1.0))
+    fh, fl = ds.add_ff(fh, fl, jnp.float32(oh), jnp.float32(ol))
+    osc = ds.sin2pi(fh, fl)
+    period = 2.0 * math.pi / omega
+    if kind == "sin":
+        # The ramp runs in ds too: its f32 rounding is a ~eps32-relative
+        # error on the LAUNCH transient, and part of that transient
+        # lands in zero-group-velocity grid modes at the injection
+        # planes which never propagate into the PML — the error then
+        # persists at the deposit amplitude forever (measured as a
+        # saturated ~1e-6-class face residual of the ds TFSF frontier).
+        # After the ramp the ds ramp is exactly 1 and costs nothing.
+        sph, spl = ds.from_f64(np.float64(dt)
+                               / (_RAMP_PERIODS * period))
+        th, tl = ds.scale_f(jnp.float32(sph), jnp.float32(spl),
+                            step.astype(np.float32) + np.float32(offset))
+        rh = jnp.clip(th + tl, 0.0, 1.0)
+        inside = (rh > 0.0) & (rh < 1.0)
+        rl = jnp.where(inside, tl, 0.0)
+        rh = jnp.where(inside, th, rh)
+        # smoothstep r*r*(3-2r) in ds
+        r2h, r2l = ds.mul_ff(rh, rl, rh, rl)
+        mh, ml = ds.add_f(-2.0 * rh, -2.0 * rl, jnp.float32(3.0))
+        rmp = ds.mul_ff(r2h, r2l, mh, ml)
+        return ds.mul_ff(*osc, *rmp)
+    t = (step.astype(np.float32) + np.float32(offset)) * np.float32(dt)
+    tau = _PULSE_TAU_PERIODS * period
+    t0 = _PULSE_T0_TAUS * tau
+    env = jnp.exp(-(((t - np.float32(t0)) / np.float32(tau)) ** 2))
+    return ds.scale_f(*osc, env)
+
+
 def waveform(kind: str, step: jnp.ndarray, offset: float, omega: float,
              dt: float, real_dtype=np.float32):
     """Scalar source waveform at time ``(step + offset) * dt``.
@@ -71,11 +160,12 @@ def waveform(kind: str, step: jnp.ndarray, offset: float, omega: float,
             frac = _phase_frac(step, f) + np.float32((offset * f) % 1.0)
             osc = jnp.sin(np.float32(2.0 * math.pi) * frac)
         if kind == "sin":
-            ramp = jnp.clip(t / real_dtype(2.0 * period), 0.0, 1.0)
+            ramp = jnp.clip(t / real_dtype(_RAMP_PERIODS * period),
+                            0.0, 1.0)
             ramp = ramp * ramp * (3.0 - 2.0 * ramp)  # smoothstep
             return ramp * osc
-        tau = 1.5 * period
-        t0 = 4.0 * tau
+        tau = _PULSE_TAU_PERIODS * period
+        t0 = _PULSE_T0_TAUS * tau
         return osc * jnp.exp(-(((t - real_dtype(t0)) / real_dtype(tau))
                                ** 2))
     if kind == "ricker":

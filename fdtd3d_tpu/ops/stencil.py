@@ -88,36 +88,52 @@ def make_diff_ops(
         return name, mesh_shape.get(name, 1) if name else 1
 
     def diff_b(f: jnp.ndarray, axis: int) -> jnp.ndarray:
+        shifted = shift_b(f, axis)
+        return f - shifted if shifted is not None else jnp.zeros_like(f)
+
+    def shift_b(f: jnp.ndarray, axis: int):
+        """f[i-1] (left-neighbor halo), or None on an inactive axis.
+
+        The ds (float32x2) path needs the shifted OPERAND, not the
+        difference: ds.two_diff(f, shift_b(f)) captures the exact f32
+        rounding error of the backward difference, which diff_b's fused
+        form cannot expose. None (size-1 unsharded axis) means the
+        difference is identically zero — the caller skips the term,
+        mirroring diff_b's zeros_like."""
         name, n_sh = _shards(axis)
         n = f.shape[axis]
         if n == 1 and n_sh <= 1:
-            return jnp.zeros_like(f)
+            return None
         if n == 1:
-            # Fully sharded-out axis: the local diff is f - left-neighbor.
-            ghost = _neighbor_plane(f, name, n_sh, downstream=True)
-            return f - ghost
+            return _neighbor_plane(f, name, n_sh, downstream=True)
         shifted = _pad_plane(lax.slice_in_dim(f, 0, n - 1, axis=axis),
                              axis, lo=True)
         if name is not None and n_sh > 1:
             last = lax.slice_in_dim(f, n - 1, n, axis=axis)
             ghost = _neighbor_plane(last, name, n_sh, downstream=True)
             shifted = shifted + _pad_to_extent(ghost, n, axis, at_lo=True)
-        return f - shifted
+        return shifted
 
     def diff_f(f: jnp.ndarray, axis: int) -> jnp.ndarray:
+        shifted = shift_f(f, axis)
+        return shifted - f if shifted is not None else jnp.zeros_like(f)
+
+    def shift_f(f: jnp.ndarray, axis: int):
+        """f[i+1] (right-neighbor halo), or None on an inactive axis."""
         name, n_sh = _shards(axis)
         n = f.shape[axis]
         if n == 1 and n_sh <= 1:
-            return jnp.zeros_like(f)
+            return None
         if n == 1:
-            ghost = _neighbor_plane(f, name, n_sh, downstream=False)
-            return ghost - f
+            return _neighbor_plane(f, name, n_sh, downstream=False)
         shifted = _pad_plane(lax.slice_in_dim(f, 1, n, axis=axis),
                              axis, lo=False)
         if name is not None and n_sh > 1:
             first = lax.slice_in_dim(f, 0, 1, axis=axis)
             ghost = _neighbor_plane(first, name, n_sh, downstream=False)
             shifted = shifted + _pad_to_extent(ghost, n, axis, at_lo=False)
-        return shifted - f
+        return shifted
 
+    diff_b.shift = shift_b
+    diff_f.shift = shift_f
     return diff_b, diff_f

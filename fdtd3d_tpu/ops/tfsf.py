@@ -182,6 +182,8 @@ def line_loss_profiles(n_inc: int, dt: float, dx: float, dtype):
 def advance_einc(inc: Dict[str, jnp.ndarray], coeffs, t, dt, omega,
                  setup: TfsfSetup):
     """Einc^{n} -> Einc^{n+1} using Hinc^{n+1/2}; hard source at cell 0."""
+    if "Einc_lo" in inc:
+        return _advance_einc_ds(inc, coeffs, t, dt, omega, setup)
     einc, hinc = inc["Einc"], inc["Hinc"]
     dh = hinc - jnp.concatenate([jnp.zeros_like(hinc[:1]), hinc[:-1]])
     einc = coeffs["inc_ae"] * einc - coeffs["inc_be"] * dh
@@ -195,10 +197,59 @@ def advance_einc(inc: Dict[str, jnp.ndarray], coeffs, t, dt, omega,
 
 def advance_hinc(inc: Dict[str, jnp.ndarray], coeffs, setup: TfsfSetup):
     """Hinc^{n+1/2} -> Hinc^{n+3/2} using Einc^{n+1}."""
+    if "Einc_lo" in inc:
+        return _advance_hinc_ds(inc, coeffs, setup)
     einc, hinc = inc["Einc"], inc["Hinc"]
     de = jnp.concatenate([einc[1:], jnp.zeros_like(einc[:1])]) - einc
     hinc = coeffs["inc_ah"] * hinc - coeffs["inc_bh"] * de
     return dict(inc, Hinc=hinc)
+
+
+def _ds_line_diff(fh, fl, forward: bool):
+    """Double-single neighbor difference on the 1D line (PEC ghost)."""
+    from fdtd3d_tpu.ops import ds
+    z = jnp.zeros_like(fh[:1])
+    if forward:
+        sh = jnp.concatenate([fh[1:], z])
+        sl = jnp.concatenate([fl[1:], z])
+        dh, de = ds.two_diff(sh, fh)
+        dl = sl - fl
+    else:
+        sh = jnp.concatenate([z, fh[:-1]])
+        sl = jnp.concatenate([z, fl[:-1]])
+        dh, de = ds.two_diff(fh, sh)
+        dl = fl - sl
+    return ds.two_sum(dh, de + dl)
+
+
+def _advance_einc_ds(inc, coeffs, t, dt, omega, setup: TfsfSetup):
+    """float32x2 incident line: the line's own leapfrog must hold the
+    same ~2^-47 accumulation class as the 3D fields it forces — its f32
+    coefficient rounding was a measured linear-in-t drift source
+    (BASELINE.md round-4 accuracy section)."""
+    from fdtd3d_tpu.ops import ds
+    eh, el = inc["Einc"], inc["Einc_lo"]
+    dh_h, dh_l = _ds_line_diff(inc["Hinc"], inc["Hinc_lo"], forward=False)
+    t1 = ds.mul_ff(eh, el, coeffs["inc_ae"], coeffs["inc_ae_lo"])
+    t2 = ds.mul_ff(dh_h, dh_l, coeffs["inc_be"], coeffs["inc_be_lo"])
+    eh, el = ds.sub_ff(*t1, *t2)
+    from fdtd3d_tpu.ops.sources import waveform_ds
+    sh, sl = waveform_ds(setup.waveform, t, 1.0, omega, dt)
+    ah, al = ds.from_f64(np.float64(setup.amplitude))
+    sh, sl = ds.mul_ff(sh, sl, jnp.float32(ah), jnp.float32(al))
+    eh = eh.at[0].set(sh)
+    el = el.at[0].set(sl)
+    return dict(inc, Einc=eh, Einc_lo=el)
+
+
+def _advance_hinc_ds(inc, coeffs, setup: TfsfSetup):
+    from fdtd3d_tpu.ops import ds
+    hh, hl = inc["Hinc"], inc["Hinc_lo"]
+    de_h, de_l = _ds_line_diff(inc["Einc"], inc["Einc_lo"], forward=True)
+    t1 = ds.mul_ff(hh, hl, coeffs["inc_ah"], coeffs["inc_ah_lo"])
+    t2 = ds.mul_ff(de_h, de_l, coeffs["inc_bh"], coeffs["inc_bh_lo"])
+    hh, hl = ds.sub_ff(*t1, *t2)
+    return dict(inc, Hinc=hh, Hinc_lo=hl)
 
 
 def _interp_line(line: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
@@ -207,6 +258,30 @@ def _interp_line(line: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
     i0 = jnp.floor(u).astype(jnp.int32)
     w = (u - i0.astype(u.dtype))
     return (1.0 - w) * jnp.take(line, i0) + w * jnp.take(line, i0 + 1)
+
+
+def _corr_gate(corr: Correction, setup: TfsfSetup, gs, active_axes,
+               dtype):
+    """Plane-onehot x staggered transverse box membership, as a
+    broadcastable 0/1 mask. THE single authority for which cells a
+    correction touches — shared by the f32 and float32x2 paths (and
+    mirrored by pallas3d.plane_corrections' patch gating) so the
+    box-membership rule (half-offset components occupy [lo, hi-1])
+    can never drift between dtypes."""
+    onehot_shape = [1, 1, 1]
+    onehot_shape[corr.axis] = gs[corr.axis].shape[0]
+    gate = (gs[corr.axis] == corr.plane).reshape(onehot_shape)
+    gate = gate.astype(dtype)
+    m_off = YEE_OFFSETS[corr.mask_comp]
+    for b in range(3):
+        if b == corr.axis or b not in active_axes:
+            continue
+        hi_b = setup.hi[b] - 1 if m_off[b] == 0.5 else setup.hi[b]
+        ind = (gs[b] >= setup.lo[b]) & (gs[b] <= hi_b)
+        shape_b = [1, 1, 1]
+        shape_b[b] = ind.shape[0]
+        gate = gate * ind.reshape(shape_b).astype(dtype)
+    return gate
 
 
 def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
@@ -236,8 +311,10 @@ def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
             pb = gs[b].astype(rdt) + off[b]
             shape = [1, 1, 1]
             shape[b] = pb.shape[0]
-            zeta = zeta + setup.khat[b] * (
-                pb - setup.origin[b]).reshape(shape)
+            # khat/origin are strong-typed f64 scalars: cast to rdt so
+            # an f32 run stays f32 even with jax_enable_x64 on
+            zeta = zeta + jnp.asarray(setup.khat[b], rdt) * (
+                pb - jnp.asarray(setup.origin[b], rdt)).reshape(shape)
         if corr.src[0] == "E":
             val = _interp_line(inc["Einc"], zeta)
             pol = setup.ehat[component_axis(corr.src)]
@@ -247,21 +324,93 @@ def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
             pol = setup.hhat[component_axis(corr.src)]
         if abs(pol) < 1e-14:
             continue
-        onehot_shape = [1, 1, 1]
-        onehot_shape[corr.axis] = gs[corr.axis].shape[0]
-        gate = (gs[corr.axis] == corr.plane).reshape(onehot_shape)
-        gate = gate.astype(val.dtype)
-        # Restrict to the box's transverse cross-section (mask_comp's own
-        # staggered membership: half-offset positions occupy [lo, hi-1]).
-        m_off = YEE_OFFSETS[corr.mask_comp]
+        gate = _corr_gate(corr, setup, gs, active_axes, val.dtype)
+        term = jnp.asarray(corr.sign * pol / dx, rdt) * gate * val
+        total = term if total is None else total + term
+    return total
+
+
+def corrections_for_ds(field: str, comp: str, setup: TfsfSetup, coeffs,
+                       inc: Dict[str, jnp.ndarray], active_axes,
+                       dx: float):
+    """corrections_for in double-single: returns an (hi, lo) pair.
+
+    The per-step corrections are a boundary forcing whose f32 rounding
+    would accumulate ~eps32*sqrt(steps) in the field — above the 1e-6
+    bar by ~1000 steps — so the line samples, the sign*pol/dx
+    coefficient, AND the line coordinate zeta are all carried as pairs:
+    zeta grows to O(line length), so a single-f32 zeta has an ABSOLUTE
+    sampling-position error of eps32*|zeta| ~ 1e-6 cells, which times
+    the line's O(1/cell) gradient was measured as the dominant ~1e-6
+    residual. The ds zeta keeps the FRACTIONAL interpolation weight
+    accurate to ~2^-24 absolute.
+    """
+    from fdtd3d_tpu.ops import ds
+    gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
+    rdt = inc["Einc"].dtype
+    tot = None
+    for corr in setup.corrections:
+        if corr.field != field or corr.comp != comp:
+            continue
+        off = YEE_OFFSETS[corr.src]
+        z0 = np.float64(setup.zeta0) + np.float64(
+            setup.khat[corr.axis]) * (corr.pos_a
+                                      - setup.origin[corr.axis])
+        zh, zl = ds.from_f64(z0)
+        zh = jnp.asarray(zh, rdt)
+        zl = jnp.asarray(zl, rdt)
         for b in range(3):
             if b == corr.axis or b not in active_axes:
                 continue
-            hi_b = setup.hi[b] - 1 if m_off[b] == 0.5 else setup.hi[b]
-            ind = (gs[b] >= setup.lo[b]) & (gs[b] <= hi_b)
-            shape_b = [1, 1, 1]
-            shape_b[b] = ind.shape[0]
-            gate = gate * ind.reshape(shape_b).astype(val.dtype)
-        term = (corr.sign * pol / dx) * gate * val
-        total = term if total is None else total + term
-    return total
+            # pb values are integers + 0.5: exact in f32
+            pb = gs[b].astype(rdt) + off[b]
+            shape = [1, 1, 1]
+            shape[b] = pb.shape[0]
+            oh, ol = ds.from_f64(np.float64(setup.origin[b]))
+            dh_, dl_ = ds.add_f(-oh, -ol, pb)
+            th_, tl_ = ds.mul_ff(dh_, dl_,
+                                 *ds.from_f64(np.float64(setup.khat[b])))
+            zh, zl = ds.add_ff(zh, zl, th_.reshape(shape),
+                               tl_.reshape(shape))
+        if corr.src[0] == "E":
+            vh, vl = _interp_line_ds(inc["Einc"], inc["Einc_lo"],
+                                     (zh, zl))
+            pol = setup.ehat[component_axis(corr.src)]
+        else:
+            vh, vl = _interp_line_ds(inc["Hinc"], inc["Hinc_lo"],
+                                     ds.add_f(zh, zl, np.float32(-0.5)))
+            pol = setup.hhat[component_axis(corr.src)]
+        if abs(pol) < 1e-14:
+            continue
+        gate = _corr_gate(corr, setup, gs, active_axes, vh.dtype)
+        ch, cl = ds.from_f64(np.float64(corr.sign) * pol / dx)
+        th, tl = ds.mul_ff(vh, vl, ch, cl)
+        th, tl = th * gate, tl * gate      # 0/1 mask: exact
+        tot = (th, tl) if tot is None else ds.add_ff(*tot, th, tl)
+    return tot
+
+
+def _interp_line_ds(line_h, line_l, u_pair):
+    """Double-single linear interpolation of the (hi, lo) line.
+
+    ``u_pair`` is the ds line coordinate; the fractional weight is
+    extracted with an exact two_diff against the floored index so its
+    absolute error is ~2^-24 regardless of |u|. A near-integer u whose
+    collapsed floor differs from the pair's true floor yields w just
+    outside [0, 1] — the linear form extrapolates the same segment, so
+    the result stays continuous and correct to the same order.
+    """
+    from fdtd3d_tpu.ops import ds
+    uh, ul = u_pair
+    u = jnp.clip(uh + ul, 0.0, line_h.shape[0] - 1.001)
+    i0 = jnp.floor(u).astype(jnp.int32)
+    wh, we = ds.two_diff(uh, i0.astype(uh.dtype))
+    wh, wl = ds.two_sum(wh, we + ul)
+    # (1 - w) in ds too: a single-f32 weight's ~2^-24 error is FIXED
+    # per cell while the line values slide past it — a coherent forcing
+    # error at the wave frequency that accumulates ~linearly in t
+    owh, owl = ds.add_f(-wh, -wl, jnp.float32(1.0))
+    v0 = (jnp.take(line_h, i0), jnp.take(line_l, i0))
+    v1 = (jnp.take(line_h, i0 + 1), jnp.take(line_l, i0 + 1))
+    return ds.add_ff(*ds.mul_ff(*v0, owh, owl),
+                     *ds.mul_ff(*v1, wh, wl))

@@ -106,12 +106,13 @@ class Simulation:
         self.step_kind: str = getattr(self._runner, "kind", "jnp")
         # kernel diagnostics (x-tile size, VMEM block bytes) or None (jnp)
         self.step_diag = getattr(self._runner, "diag", None)
-        if cfg.require_pallas and self.step_kind == "jnp":
+        if cfg.require_pallas and self.step_kind in ("jnp", "jnp_ds"):
             import jax as _jax
             from fdtd3d_tpu.ops import pallas3d
             backend = _jax.default_backend()
-            hint = ("likely causes: non-3D/complex/f64 config, a shard "
-                    "too thin for the CPML slabs, or use_pallas=False")
+            hint = ("likely causes: non-3D/complex/f64/float32x2 "
+                    "config, a shard too thin for the CPML slabs, or "
+                    "use_pallas=False")
             if cfg.use_pallas is None and backend not in ("tpu", "axon"):
                 # the most common cause: auto mode only engages on TPU
                 hint = (f"use_pallas=auto engages only on TPU and this "
@@ -325,6 +326,13 @@ class Simulation:
         else:
             arr = jnp.asarray(vnp)
         st[group][comp] = arr
+        if self.static.cfg.ds_fields:
+            # the pair's value is hi + lo: an overwritten hi with a
+            # stale lo word would silently perturb the set value
+            lo_key = "loE" if group == "E" else "loH"
+            lv = st[lo_key][comp]
+            st[lo_key][comp] = np.zeros_like(lv) \
+                if isinstance(lv, np.ndarray) else jnp.zeros_like(lv)
         # write back through the setter: drops any packed carry so the
         # edit is authoritative (re-packed on the next advance)
         self.state = st

@@ -172,7 +172,7 @@ def build_static(cfg: SimConfig) -> StaticSetup:
     # loses the wave within tens of steps, while bf16 storage alone
     # halves the HBM traffic that bounds FDTD throughput.
     real = {"float32": np.float32, "float64": np.float64,
-            "bfloat16": np.float32}[cfg.dtype]
+            "bfloat16": np.float32, "float32x2": np.float32}[cfg.dtype]
     field = cfg.np_dtype()
     pml_axes = tuple(a for a in mode.active_axes if cfg.pml.size[a] > 0)
     st = StaticSetup(
@@ -209,8 +209,9 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
         return rd(v) if np.isscalar(v) else v.astype(rd)
 
     def _cast_ds(key, v):
-        """Store coefficient `key`; in compensated mode also store its
-        double-single low word ``key_lo`` = f32(v64 - f32(v64)).
+        """Store coefficient `key`; in compensated and float32x2 modes
+        also store its double-single low word
+        ``key_lo`` = f32(v64 - f32(v64)).
 
         Why: rounding ca/cb/da/db to f32 perturbs the DISCRETE SYSTEM
         itself (an effective material/impedance shift of ~eps32), which
@@ -220,7 +221,7 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
         accuracy for two extra FMAs per term (free: the step is
         HBM-bound)."""
         out[key] = _cast(v)
-        if cfg.compensated:
+        if cfg.compensated or cfg.ds_fields:
             v64 = np.asarray(v, np.float64)
             out[f"{key}_lo"] = _cast(v64 - np.asarray(out[key],
                                                       np.float64))
@@ -259,14 +260,44 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
                  / (1.0 + sm))
 
     if static.pml_axes:
-        full = cpml.build_cpml_coeffs(cfg, static, rd)
-        out.update(full)
-        out.update(cpml.build_slab_coeffs(full, static, slab_axes(static)))
+        if cfg.ds_fields:
+            # double-single CPML profiles: the slab algebra runs in ds
+            # (f32 slab deltas were the measured ~1e-6 residual — the
+            # eps32 noise injected at the absorbing interface reflects
+            # back into the interior coherently). Naming keeps the
+            # _x/_y/_z suffix LAST: parallel/mesh.coeff_specs keys its
+            # sharding inference on it.
+            from fdtd3d_tpu.ops import ds as _ds_mod
+            full64 = cpml.build_cpml_coeffs(cfg, static, np.float64)
+            slab64 = cpml.build_slab_coeffs(full64, static,
+                                            slab_axes(static))
+            for src64 in (full64, slab64):
+                for k, v in src64.items():
+                    hi, lo = _ds_mod.from_f64(v)
+                    out[k] = hi
+                    base, ax = k.rsplit("_", 1)
+                    out[f"{base}lo_{ax}"] = lo
+        else:
+            full = cpml.build_cpml_coeffs(cfg, static, rd)
+            out.update(full)
+            out.update(cpml.build_slab_coeffs(full, static,
+                                              slab_axes(static)))
 
     if static.tfsf_setup is not None:
-        ae, be, ah, bh = tfsf.line_loss_profiles(
-            static.tfsf_setup.n_inc, dt, static.dx, rd)
-        out.update(inc_ae=ae, inc_be=be, inc_ah=ah, inc_bh=bh)
+        if cfg.ds_fields:
+            # double-single line coefficients: the incident line's own
+            # f32 coefficient rounding would otherwise re-introduce the
+            # linear-in-t operator drift the mode exists to remove
+            from fdtd3d_tpu.ops import ds as _ds
+            prof64 = tfsf.line_loss_profiles(
+                static.tfsf_setup.n_inc, dt, static.dx, np.float64)
+            for k, v in zip(("inc_ae", "inc_be", "inc_ah", "inc_bh"),
+                            prof64):
+                out[k], out[f"{k}_lo"] = _ds.from_f64(v)
+        else:
+            ae, be, ah, bh = tfsf.line_loss_profiles(
+                static.tfsf_setup.n_inc, dt, static.dx, rd)
+            out.update(inc_ae=ae, inc_be=be, inc_ah=ah, inc_bh=bh)
 
     return out
 
@@ -307,6 +338,12 @@ def init_state(static: StaticSetup) -> Dict[str, Any]:
     if psi_e:
         state["psi_E"] = psi_e
         state["psi_H"] = psi_h
+        if static.cfg.ds_fields:
+            # psi recursions run in ds too (see build_coeffs)
+            state["lopsi_E"] = {k: xp.zeros(v.shape, np.float32)
+                                for k, v in psi_e.items()}
+            state["lopsi_H"] = {k: xp.zeros(v.shape, np.float32)
+                                for k, v in psi_h.items()}
     if static.use_drude:
         state["J"] = {c: xp.zeros(shape, dtype=aux)
                       for c in mode.e_components}
@@ -323,10 +360,21 @@ def init_state(static: StaticSetup) -> Dict[str, Any]:
                        for c in mode.e_components}
         state["rH"] = {c: jnp.zeros(shape, dtype=jnp.bfloat16)
                        for c in mode.h_components}
+    if static.cfg.ds_fields:
+        # double-single low words: E/H are carried as hi+lo f32 pairs
+        # end-to-end (ops/ds.py; _make_ds_step) — ~f64-class
+        # accumulation at 2x f32 field traffic.
+        state["loE"] = {c: xp.zeros(shape, dtype=np.float32)
+                        for c in mode.e_components}
+        state["loH"] = {c: xp.zeros(shape, dtype=np.float32)
+                        for c in mode.h_components}
     if static.tfsf_setup is not None:
         n = static.tfsf_setup.n_inc
         state["inc"] = {"Einc": xp.zeros(n, dtype=aux),
                         "Hinc": xp.zeros(n, dtype=aux)}
+        if static.cfg.ds_fields:
+            state["inc"]["Einc_lo"] = xp.zeros(n, dtype=np.float32)
+            state["inc"]["Hinc_lo"] = xp.zeros(n, dtype=np.float32)
     return state
 
 
@@ -338,6 +386,51 @@ def _bcast1d(arr: jnp.ndarray, axis: int) -> jnp.ndarray:
     shape = [1, 1, 1]
     shape[axis] = arr.shape[0]
     return arr.reshape(shape)
+
+
+def _slab_delta(a, tag, s, dfa, psi, coeffs, m):
+    """Slab-psi CPML correction: -> (new compact psi, lo delta, hi delta).
+
+    The full-domain family update runs the PURE interior curl (term =
+    dfa, no PML logic at all — one fused memory-bound pass); the exact
+    CPML term differs from it only inside the two npml slabs of axis a,
+    by  s * ((ik - 1) * dfa + psi).  Those deltas are added back onto
+    the thin slab regions with in-place slice-adds. Deltas of different
+    axes commute, so overlap corners compose correctly.
+
+    Local shapes are trace-time static, so this is shard_map-safe; on
+    interior shards the slab profiles are identically (b=0, c=0, ik=1)
+    and both deltas are exactly zero. Shared by the f32 jnp step and
+    the float32x2 step (whose dfa is the collapsed hi+lo — exact
+    outside the slabs, where the delta vanishes identically).
+    """
+    ax = AXES[a]
+    nloc = dfa.shape[a]
+    cut = lambda f, lo, hi: jax.lax.slice_in_dim(f, lo, hi, axis=a)  # noqa: E731
+    b = _bcast1d(coeffs[f"pml_slab_b{tag}_{ax}"], a)
+    cc = _bcast1d(coeffs[f"pml_slab_c{tag}_{ax}"], a)
+    ik = _bcast1d(coeffs[f"pml_slab_ik{tag}_{ax}"], a)
+    d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
+    p_lo = cut(b, 0, m) * cut(psi, 0, m) + cut(cc, 0, m) * d_lo
+    p_hi = cut(b, m, 2 * m) * cut(psi, m, 2 * m) + cut(cc, m, 2 * m) * d_hi
+    dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
+    dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+    return jnp.concatenate([p_lo, p_hi], axis=a), dl, dh
+
+
+def _pad_slab(dl, dh, a, nloc, m):
+    """Zero-pad the two slab deltas back to the full local extent.
+
+    jnp.pad (constant 0) fuses into its elementwise consumer under XLA,
+    so adding the padded deltas onto the accumulator costs no extra
+    full-array materialization — unlike dynamic-update-slice patches,
+    which compile to full copies here.
+    """
+    pad_lo = [(0, 0)] * 3
+    pad_hi = [(0, 0)] * 3
+    pad_lo[a] = (0, nloc - m)
+    pad_hi[a] = (nloc - m, 0)
+    return jnp.pad(dl, pad_lo) + jnp.pad(dh, pad_hi)
 
 
 def _want_pallas(static: StaticSetup, mesh_axes) -> bool:
@@ -364,6 +457,10 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     """
     if static.paired_complex:
         return _make_paired_complex_step(static, mesh_axes, mesh_shape)
+    if static.cfg.ds_fields:
+        step = _make_ds_step(static, mesh_axes, mesh_shape)
+        step.kind = "jnp_ds"
+        return step
     if _want_pallas(static, mesh_axes):
         import os as _os
 
@@ -424,47 +521,6 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     setup = static.tfsf_setup
     ps = cfg.point_source
     slabs = slab_axes(static)
-
-    def _slab_delta(a, tag, s, dfa, psi, coeffs, m):
-        """Slab-psi CPML correction: -> (new compact psi, lo delta, hi delta).
-
-        The full-domain family update runs the PURE interior curl (term =
-        dfa, no PML logic at all — one fused memory-bound pass); the exact
-        CPML term differs from it only inside the two npml slabs of axis a,
-        by  s * ((ik - 1) * dfa + psi).  Those deltas are added back onto
-        the thin slab regions with in-place slice-adds. Deltas of different
-        axes commute, so overlap corners compose correctly.
-
-        Local shapes are trace-time static, so this is shard_map-safe; on
-        interior shards the slab profiles are identically (b=0, c=0, ik=1)
-        and both deltas are exactly zero.
-        """
-        ax = AXES[a]
-        nloc = dfa.shape[a]
-        cut = lambda f, lo, hi: jax.lax.slice_in_dim(f, lo, hi, axis=a)  # noqa: E731
-        b = _bcast1d(coeffs[f"pml_slab_b{tag}_{ax}"], a)
-        cc = _bcast1d(coeffs[f"pml_slab_c{tag}_{ax}"], a)
-        ik = _bcast1d(coeffs[f"pml_slab_ik{tag}_{ax}"], a)
-        d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
-        p_lo = cut(b, 0, m) * cut(psi, 0, m) + cut(cc, 0, m) * d_lo
-        p_hi = cut(b, m, 2 * m) * cut(psi, m, 2 * m) + cut(cc, m, 2 * m) * d_hi
-        dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
-        dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
-        return jnp.concatenate([p_lo, p_hi], axis=a), dl, dh
-
-    def _pad_slab(dl, dh, a, nloc, m):
-        """Zero-pad the two slab deltas back to the full local extent.
-
-        jnp.pad (constant 0) fuses into its elementwise consumer under XLA,
-        so adding the padded deltas onto the accumulator costs no extra
-        full-array materialization — unlike dynamic-update-slice patches,
-        which compile to full copies here.
-        """
-        pad_lo = [(0, 0)] * 3
-        pad_hi = [(0, 0)] * 3
-        pad_lo[a] = (0, nloc - m)
-        pad_hi[a] = (nloc - m, 0)
-        return jnp.pad(dl, pad_lo) + jnp.pad(dh, pad_hi)
 
     def _half_update(field: str, state, coeffs, new_psi):
         """One family update (field='E' or 'H'). Returns new component dict."""
@@ -631,6 +687,258 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         if new_psi["psi_E"]:
             new_state["psi_E"] = new_psi["psi_E"]
             new_state["psi_H"] = new_psi["psi_H"]
+        new_state["t"] = t + 1
+        return new_state
+
+    return step
+
+
+def _make_ds_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
+    """Double-single (float32x2) leapfrog step: hi+lo f32 field pairs.
+
+    The accuracy rung between f32 and XLA-emulated f64 (BASELINE.md
+    "Accuracy"): plain f32's measured floor is the curl arithmetic
+    itself — its rounding acts as an eps32-scale systematic
+    perturbation of the discrete operator that no accumulation
+    compensation can remove (compensated f32 froze at ~6e-6 vs f64 at
+    1000 steps, round 4). Carrying E/H and the TFSF incident line as
+    double-single pairs, with error-free-transform arithmetic
+    (ops/ds.py) in every difference, product, and accumulation,
+    restores ~2^-47 effective significand end-to-end while staying on
+    the f32 vector units.
+
+    Deliberately plain-f32 sub-parts (argued/measured non-factors at
+    the 1e-6 bar): CPML psi recursions and the slab-delta algebra
+    (identically zero outside the absorbing slabs, geometrically
+    decaying inside them), Drude J/K ADE currents, the source
+    waveform's sin (a constant ~eps32 amplitude error on a hard
+    source — the 64-bit fixed-point phase already removed the growing
+    part), and interpolation weights (fixed geometry). Reference
+    parity: the C++ double accuracy class of the reference's
+    FieldValue (SURVEY.md §2 FieldValue row).
+    """
+    mode, cfg = static.mode, static.cfg
+    from fdtd3d_tpu.ops import ds as _ds
+    diff_b, diff_f = make_diff_ops(mesh_axes, mesh_shape)
+    shift_b, shift_f = diff_b.shift, diff_f.shift
+    iv_h, iv_l = _ds.from_f64(1.0 / np.float64(static.dx))
+    setup = static.tfsf_setup
+    ps = cfg.point_source
+    slabs = slab_axes(static)
+
+    def _slab_delta_ds(a, tag, s, dfa, psi, coeffs, m):
+        """_slab_delta in double-single: -> (psi pair, lo/hi delta pairs).
+
+        The f32 slab algebra was the measured ~1e-6 residual of the
+        float32x2 mode: its eps32-scale per-step noise enters at the
+        absorbing interface (where fields are O(1)) and reflects back
+        into the interior coherently. Profiles are hi+lo pairs
+        (build_coeffs), psi carries lo words (lopsi_* state).
+        """
+        ax = AXES[a]
+        dh_, dl_ = dfa
+        ph_, pl_ = psi
+        nloc = dh_.shape[a]
+        cut = lambda f, lo, hi: jax.lax.slice_in_dim(f, lo, hi, axis=a)  # noqa: E731
+
+        def prof(name):
+            return (_bcast1d(coeffs[f"pml_slab_{name}{tag}_{ax}"], a),
+                    _bcast1d(coeffs[f"pml_slab_{name}{tag}lo_{ax}"], a))
+
+        bh, bl = prof("b")
+        ch, cl = prof("c")
+        ikh, ikl = prof("ik")
+
+        def side(d0, d1, p0, p1):
+            d_pair = (cut(dh_, d0, d1), cut(dl_, d0, d1))
+            p_pair = (cut(ph_, p0, p1), cut(pl_, p0, p1))
+            p_new = _ds.add_ff(
+                *_ds.mul_ff(cut(bh, p0, p1), cut(bl, p0, p1), *p_pair),
+                *_ds.mul_ff(cut(ch, p0, p1), cut(cl, p0, p1), *d_pair))
+            ikm1 = _ds.add_f(cut(ikh, p0, p1), cut(ikl, p0, p1),
+                             np.float32(-1.0))
+            delta = _ds.add_ff(*_ds.mul_ff(*ikm1, *d_pair), *p_new)
+            if s < 0:
+                delta = (-delta[0], -delta[1])
+            return p_new, delta
+
+        pn_lo, delta_lo = side(0, m, 0, m)
+        pn_hi, delta_hi = side(nloc - m, nloc, m, 2 * m)
+        psi_new = (jnp.concatenate([pn_lo[0], pn_hi[0]], axis=a),
+                   jnp.concatenate([pn_lo[1], pn_hi[1]], axis=a))
+        return psi_new, delta_lo, delta_hi
+
+    def ds_diff(fh, fl, a, backward):
+        """Exact-error double-single difference * (1/dx)."""
+        if backward:
+            sh, sl_ = shift_b(fh, a), shift_b(fl, a)
+            if sh is None:
+                return None
+            dh, de = _ds.two_diff(fh, sh)
+            dl = fl - sl_
+        else:
+            sh, sl_ = shift_f(fh, a), shift_f(fl, a)
+            if sh is None:
+                return None
+            dh, de = _ds.two_diff(sh, fh)
+            dl = sl_ - fl
+        dh, dl = _ds.two_sum(dh, de + dl)
+        return _ds.mul_ff(dh, dl, iv_h, iv_l)
+
+    def _half_update(field, state, coeffs, new_psi):
+        upd = mode.e_components if field == "E" else mode.h_components
+        srch = state["H"] if field == "E" else state["E"]
+        srcl = state["loH"] if field == "E" else state["loE"]
+        backward = field == "E"
+        tag = "e" if field == "E" else "h"
+        psi_key = "psi_E" if field == "E" else "psi_H"
+        lopsi_key = "lopsi_E" if field == "E" else "lopsi_H"
+        out = {}
+        for c in upd:
+            acc = None
+            for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
+                d = ("H" if field == "E" else "E") + AXES[d_axis]
+                if d not in srch:
+                    continue
+                dfa = ds_diff(srch[d], srcl[d], a, backward)
+                if dfa is None:
+                    continue
+                dh, dl = dfa
+                fix = None
+                if a in slabs:
+                    key = f"{c}_{AXES[a]}"
+                    psi_new, delta_lo, delta_hi = _slab_delta_ds(
+                        a, tag, s, (dh, dl),
+                        (state[psi_key][key], state[lopsi_key][key]),
+                        coeffs, slabs[a])
+                    new_psi[psi_key][key] = psi_new[0]
+                    new_psi[lopsi_key][key] = psi_new[1]
+                    nloc = dh.shape[a]
+                    fix = (_pad_slab(delta_lo[0], delta_hi[0], a, nloc,
+                                     slabs[a]),
+                           _pad_slab(delta_lo[1], delta_hi[1], a, nloc,
+                                     slabs[a]))
+                    th, tl = dh, dl
+                elif a in static.pml_axes:
+                    ax = AXES[a]
+                    key = f"{c}_{ax}"
+
+                    def pr(name, ax=ax):
+                        return (_bcast1d(coeffs[f"pml_{name}{tag}_{ax}"],
+                                         a),
+                                _bcast1d(
+                                    coeffs[f"pml_{name}{tag}lo_{ax}"],
+                                    a))
+
+                    psi_new = _ds.add_ff(
+                        *_ds.mul_ff(*pr("b"), state[psi_key][key],
+                                    state[lopsi_key][key]),
+                        *_ds.mul_ff(*pr("c"), dh, dl))
+                    new_psi[psi_key][key] = psi_new[0]
+                    new_psi[lopsi_key][key] = psi_new[1]
+                    th, tl = _ds.mul_ff(*pr("ik"), dh, dl)
+                    th, tl = _ds.add_ff(th, tl, *psi_new)
+                else:
+                    th, tl = dh, dl
+                if s < 0:
+                    th, tl = -th, -tl
+                acc = (th, tl) if acc is None \
+                    else _ds.add_ff(*acc, th, tl)
+                if fix is not None:  # carries s already (_slab_delta_ds)
+                    acc = _ds.add_ff(*acc, *fix)
+            if acc is None:
+                z = jnp.zeros(state[field][c].shape, np.float32)
+                acc = (z, z)
+            if setup is not None:
+                corr = tfsf.corrections_for_ds(
+                    field, c, setup, coeffs, state["inc"],
+                    mode.active_axes, static.dx)
+                if corr is not None:
+                    acc = _ds.add_ff(*acc, *corr)
+            out[c] = acc
+        return out
+
+    def step(state, coeffs):
+        t = state["t"]
+        new_state = dict(state)
+        new_psi = {"psi_E": dict(state.get("psi_E", {})),
+                   "psi_H": dict(state.get("psi_H", {})),
+                   "lopsi_E": dict(state.get("lopsi_E", {})),
+                   "lopsi_H": dict(state.get("lopsi_H", {}))}
+        if setup is not None:
+            new_state["inc"] = tfsf.advance_einc(
+                state["inc"], coeffs, t, static.dt, static.omega, setup)
+            state = dict(state, inc=new_state["inc"])
+
+        acc_e = _half_update("E", state, coeffs, new_psi)
+        new_E, new_lo, new_J = {}, {}, {}
+        for c in mode.e_components:
+            ah, al = acc_e[c]
+            if static.use_drude:
+                j_new = coeffs[f"kj_{c}"] * state["J"][c] \
+                    + coeffs[f"bj_{c}"] * state["E"][c]
+                new_J[c] = j_new
+                ah, al = _ds.add_f(ah, al, -j_new)
+            if ps.enabled and ps.component == c:
+                from fdtd3d_tpu.ops.sources import waveform_ds
+                mask = point_mask(coeffs["gx"], coeffs["gy"],
+                                  coeffs["gz"], ps.position,
+                                  mode.active_axes)
+                wh, wl = waveform_ds(ps.waveform, t, 0.5, static.omega,
+                                     static.dt)
+                amph, ampl = _ds.from_f64(np.float64(ps.amplitude))
+                wh, wl = _ds.mul_ff(wh, wl, jnp.float32(amph),
+                                    jnp.float32(ampl))
+                m = mask.astype(ah.dtype)
+                ah, al = _ds.add_ff(ah, al, wh * m, wl * m)
+            t1 = _ds.mul_ff(state["E"][c], state["loE"][c],
+                            coeffs[f"ca_{c}"], coeffs[f"ca_{c}_lo"])
+            t2 = _ds.mul_ff(ah, al,
+                            coeffs[f"cb_{c}"], coeffs[f"cb_{c}_lo"])
+            eh, el = _ds.add_ff(*t1, *t2)
+            for a in mode.active_axes:     # PEC walls: exact 0/1 mask
+                if a != component_axis(c):
+                    w = _bcast1d(coeffs[f"wall_{AXES[a]}"], a)
+                    eh = eh * w
+                    el = el * w
+            new_E[c] = eh
+            new_lo[c] = el
+        new_state["E"] = new_E
+        new_state["loE"] = new_lo
+        if static.use_drude:
+            new_state["J"] = new_J
+        state = dict(state, E=new_E, loE=new_lo)
+
+        if setup is not None:
+            new_state["inc"] = tfsf.advance_hinc(new_state["inc"],
+                                                 coeffs, setup)
+            state = dict(state, inc=new_state["inc"])
+
+        acc_h = _half_update("H", state, coeffs, new_psi)
+        new_H, new_loH, new_K = {}, {}, {}
+        for c in mode.h_components:
+            ah, al = acc_h[c]
+            if static.use_drude_m:
+                k_new = coeffs[f"km_{c}"] * state["K"][c] \
+                    + coeffs[f"bm_{c}"] * state["H"][c]
+                new_K[c] = k_new
+                ah, al = _ds.add_f(ah, al, k_new)
+            t1 = _ds.mul_ff(state["H"][c], state["loH"][c],
+                            coeffs[f"da_{c}"], coeffs[f"da_{c}_lo"])
+            t2 = _ds.mul_ff(ah, al,
+                            coeffs[f"db_{c}"], coeffs[f"db_{c}_lo"])
+            hh, hl = _ds.sub_ff(*t1, *t2)
+            new_H[c] = hh
+            new_loH[c] = hl
+        new_state["H"] = new_H
+        new_state["loH"] = new_loH
+        if static.use_drude_m:
+            new_state["K"] = new_K
+        if new_psi["psi_E"]:
+            new_state["psi_E"] = new_psi["psi_E"]
+            new_state["psi_H"] = new_psi["psi_H"]
+            new_state["lopsi_E"] = new_psi["lopsi_E"]
+            new_state["lopsi_H"] = new_psi["lopsi_H"]
         new_state["t"] = t + 1
         return new_state
 

@@ -19,3 +19,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the float32x2 step's EFT graph is
+# ~11k HLO ops and XLA:CPU takes minutes to compile it; caching makes
+# repeat test runs (and reruns within CI) skip that cost.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/jax_fdtd3d_tests"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)

@@ -1,0 +1,171 @@
+"""Double-single primitive correctness vs float64 (ops/ds.py).
+
+Each primitive's (hi + lo) result must match the f64 computation to
+~2^-45 relative — far below f32's 2^-24 — on adversarial operand mixes
+(near-cancellation, wide magnitude spread). These are the invariants
+the float32x2 field-storage mode (the reference's C++ double accuracy
+class, SURVEY.md §2 FieldValue row) is built on.
+"""
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu.ops import ds
+
+RNG = np.random.default_rng(7)
+
+
+def _pairs(n=4096):
+    """Adversarial operand set: magnitudes spread over ~2^40."""
+    a64 = RNG.standard_normal(n) * np.exp2(RNG.integers(-20, 20, n))
+    b64 = np.where(RNG.random(n) < 0.3,
+                   -a64 * (1 + RNG.standard_normal(n) * 1e-6),  # cancels
+                   RNG.standard_normal(n) * np.exp2(RNG.integers(-20, 20, n)))
+    return a64, b64
+
+
+def _ff(x64):
+    hi, lo = ds.from_f64(x64)
+    return hi, lo
+
+
+def _err(got_pair, want64):
+    got = np.asarray(got_pair[0], np.float64) \
+        + np.asarray(got_pair[1], np.float64)
+    scale = np.maximum(np.abs(want64), 1e-300)
+    return np.max(np.abs(got - want64) / scale)
+
+
+def test_from_f64_roundtrip():
+    x = RNG.standard_normal(1000) * np.exp2(RNG.integers(-30, 30, 1000))
+    hi, lo = ds.from_f64(x)
+    back = hi.astype(np.float64) + lo.astype(np.float64)
+    assert _err((hi, lo), x) < 2e-14
+    assert np.all(np.abs(lo) <= np.spacing(np.abs(hi)) / 2 + 1e-300)
+    assert np.allclose(back, x, rtol=2e-14)
+
+
+def test_two_sum_exact():
+    import jax.numpy as jnp
+    a64, b64 = _pairs()
+    a = jnp.asarray(a64, jnp.float32)
+    b = jnp.asarray(b64, jnp.float32)
+    s, e = ds.two_sum(a, b)
+    # exactness: s + e == fl(a) + fl(b) in f64, bit-for-bit
+    want = np.asarray(a, np.float64) + np.asarray(b, np.float64)
+    got = np.asarray(s, np.float64) + np.asarray(e, np.float64)
+    assert np.array_equal(got, want)
+
+
+def test_two_diff_exact():
+    import jax.numpy as jnp
+    a64, b64 = _pairs()
+    a = jnp.asarray(a64, jnp.float32)
+    b = jnp.asarray(b64, jnp.float32)
+    s, e = ds.two_diff(a, b)
+    want = np.asarray(a, np.float64) - np.asarray(b, np.float64)
+    got = np.asarray(s, np.float64) + np.asarray(e, np.float64)
+    assert np.array_equal(got, want)
+
+
+def test_two_prod_exact():
+    import jax.numpy as jnp
+    a64, b64 = _pairs()
+    a = jnp.asarray(a64, jnp.float32)
+    b = jnp.asarray(b64, jnp.float32)
+    p, e = ds.two_prod(a, b)
+    want = np.asarray(a, np.float64) * np.asarray(b, np.float64)
+    got = np.asarray(p, np.float64) + np.asarray(e, np.float64)
+    # a*b of two f32 is exactly representable in f64 -> exact equality
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("op,ref", [
+    (ds.add_ff, lambda a, b: a + b),
+    (ds.sub_ff, lambda a, b: a - b),
+    (ds.mul_ff, lambda a, b: a * b),
+])
+def test_ff_ops(op, ref):
+    a64, b64 = _pairs()
+    ah, al = _ff(a64)
+    bh, bl = _ff(b64)
+    a_eff = ah.astype(np.float64) + al.astype(np.float64)
+    b_eff = bh.astype(np.float64) + bl.astype(np.float64)
+    got = op(ah, al, bh, bl)
+    assert _err(got, ref(a_eff, b_eff)) < 1e-12
+
+
+def test_add_f_and_scale_f():
+    a64, b64 = _pairs()
+    ah, al = _ff(a64)
+    b = b64.astype(np.float32)
+    a_eff = ah.astype(np.float64) + al.astype(np.float64)
+    assert _err(ds.add_f(ah, al, b),
+                a_eff + b.astype(np.float64)) < 1e-12
+    assert _err(ds.scale_f(ah, al, b),
+                a_eff * b.astype(np.float64)) < 1e-12
+
+
+def test_sin2pi_vs_f64():
+    """ds oscillator: ~2^-45 absolute error over the whole period, and
+    over multi-million-step phases via the exact fixed-point frac."""
+    import jax.numpy as jnp
+
+    from fdtd3d_tpu.ops.sources import phase_frac_ds
+
+    x = np.linspace(0.0, 2.0, 40001, endpoint=False)
+    fh = x.astype(np.float32)
+    fl = (x - fh.astype(np.float64)).astype(np.float32)
+    sh, sl = ds.sin2pi(jnp.asarray(fh), jnp.asarray(fl))
+    got = np.asarray(sh, np.float64) + np.asarray(sl, np.float64)
+    want = np.sin(2.0 * np.pi * (fh.astype(np.float64)
+                                 + fl.astype(np.float64)))
+    assert np.abs(got - want).max() < 1e-12
+
+    # long-horizon phase: steps up to 2^31, irrational-ish frequency
+    f = 0.0137281964502347
+    steps = jnp.asarray([1, 1000, 123457, 2 ** 27 + 5], jnp.int32)
+    fh2, fl2 = phase_frac_ds(steps, f)
+    got2 = np.asarray(*[np.asarray(v, np.float64) for v in [fh2]]) \
+        + np.asarray(fl2, np.float64)
+    q = int(round(f * 2.0 ** 64))
+    want2 = np.array([((int(s) * q) % (1 << 64)) / 2.0 ** 64
+                      for s in np.asarray(steps)])
+    assert np.abs(got2 - want2).max() < 2 ** -46
+    sh2, sl2 = ds.sin2pi(fh2, fl2)
+    gots = np.asarray(sh2, np.float64) + np.asarray(sl2, np.float64)
+    assert np.abs(gots - np.sin(2 * np.pi * want2)).max() < 1e-12
+
+
+def test_accumulation_beats_f32():
+    """1e5-term recurrence x += c*x + d: ds tracks f64 ~5 orders better
+    than plain f32 — the property the float32x2 leapfrog rides."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 100_000
+    c64 = 1e-5
+    d64 = 1.0 / 3.0
+
+    ch, cl = ds.from_f64(c64)   # c is not f32-representable: split it,
+    dh, dl = ds.from_f64(d64)   # exactly as build_coeffs does (_cast_ds)
+
+    def step_ds(carry, _):
+        h, l = carry
+        th, tl = ds.mul_ff(h, l, ch, cl)
+        th, tl = ds.add_ff(th, tl, dh, dl)
+        return ds.add_ff(h, l, th, tl), None
+
+    def step_f32(x, _):
+        return x + (np.float32(c64) * x + np.float32(d64)), None
+
+    (h, l), _ = jax.lax.scan(step_ds, (jnp.float32(1.0), jnp.float32(0.0)),
+                             None, length=n)
+    xf, _ = jax.lax.scan(step_f32, jnp.float32(1.0), None, length=n)
+    x64 = 1.0
+    for _ in range(n):
+        x64 = x64 + (c64 * x64 + d64)
+    ds_err = abs((float(h) + float(l)) - x64) / abs(x64)
+    f32_err = abs(float(xf) - x64) / abs(x64)
+    assert ds_err < 1e-11
+    assert ds_err < f32_err * 1e-3

@@ -86,7 +86,7 @@ def main():
     ap.add_argument("--n", type=int, default=128)
     ap.add_argument("--steps", type=int, default=1000)
     ap.add_argument("--dtypes",
-                    default="float64,float32,float32c,bfloat16")
+                    default="float64,float32,float32c,float32x2,bfloat16")
     args = ap.parse_args()
 
     tmp = tempfile.mkdtemp(prefix="acc_frontier_")
