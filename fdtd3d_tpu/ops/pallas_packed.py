@@ -56,8 +56,11 @@ plane (apply_patch_h_corrections). Sharded TFSF/point sources (round
 patches (pallas3d.Patch, pallas_fused._traced_patch_fix) — admitted
 when the source support sits inside the CPML identity region
 (_sources_interior; true for standard margins), else two-pass
-fallback. Magnetic Drude (K lives in the lagged H phase and would
-need one more full-volume carry) falls back to the two-pass kernels.
+fallback. Magnetic Drude K (round 5) rides plain lag-mapped operands:
+its ADE recursion reads/writes tile i-1 exactly like H itself, so
+metamaterial runs keep the packed kernel at +2*nh volumes of
+traffic; only compensated+magnetic-Drude falls back (K residuals are
+not Kahan-treated).
 
 Compensated-mode caveat: the in-kernel updates carry the full Kahan +
 double-single-coefficient treatment, but the thin post-kernel patches
@@ -167,10 +170,16 @@ def eligible(static, mesh_axes=None) -> bool:
                 or static.cfg.point_source.enabled) \
                 and not _sources_interior(static):
             return False
-        if static.cfg.compensated:
-            return False  # jnp path covers sharded compensated
-    if static.use_drude_m:
-        return False
+        # compensated composes with sharding (round 5): the rE/rH
+        # residual stacks ride the same tile/lag index maps unsharded
+        # runs use, the coefficient double-singles are embedded scalars
+        # (material-grid + compensated already returns None below), and
+        # the post-kernel patches keep their documented plain-f32 scope
+        # either way.
+    if static.cfg.ds_fields:
+        return False  # double-single packed kernel: round-5 follow-up
+    if static.use_drude_m and static.cfg.compensated:
+        return False  # K residuals are not Kahan-treated: jnp covers
     return True
 
 
@@ -274,6 +283,7 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     h_comps = list(mode.h_components)
     ne, nh = len(e_comps), len(h_comps)
     drude = static.use_drude
+    drude_m = static.use_drude_m
     comp = static.cfg.compensated
 
     rows_e = psi_rows(static, slabs, "E")
@@ -282,7 +292,12 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     psi_axes_h = sorted(rows_h)
 
     pairs_e = ["ca", "cb"] + (["kj", "bj"] if drude else [])
-    pairs_h = ["da", "db"]
+    # magnetic Drude K (round 5): the ADE recursion lives entirely in
+    # the lagged H phase — old K reads and new K writes both index tile
+    # i-1, exactly H's own lag pattern, so K rides plain lag-mapped
+    # operands (no scratch carry; +2*nh volumes of traffic on
+    # metamaterial runs only)
+    pairs_h = ["da", "db"] + (["km", "bm"] if drude_m else [])
     coeff_is_array = {}
     for c in e_comps:
         for p in pairs_e:
@@ -316,6 +331,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                 total += 2 * s[0] * t * s[2] * s[3] * 4
         if drude:
             total += 2 * ne * t * plane * 4        # J in + out
+        if drude_m:
+            total += 2 * nh * t * plane * 4        # K in + out
         if comp:                                   # bf16 residuals
             total += 2 * (ne + nh) * t * plane * 2
         total += (len(arr_e) + len(arr_h)) * t * plane * 4
@@ -365,6 +382,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         take([f"psH{a}" for a in psi_axes_h])
         if drude:
             take(["j_in"])
+        if drude_m:
+            take(["k_in"])
         if comp:
             take(["re_in", "rh_in"])
         take([f"prof_e_{a}" for a in psi_axes_e])
@@ -380,6 +399,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         take([f"psH{a}_out" for a in psi_axes_h])
         if drude:
             take(["j_out"])
+        if drude_m:
+            take(["k_out"])
         if comp:
             take(["re_out", "rh_out"])
         take(["se", "sh", "shh"])  # scratch
@@ -557,6 +578,14 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                         term = s * dfa
                 acc = term if acc is None else acc + term
             h_old = sh_vals[jc]
+            if drude_m:
+                k_old = idx["k_in"][jc].astype(fdt)
+                k_new = (coef("ch", f"km_{c}") * k_old
+                         + coef("ch", f"bm_{c}") * h_old)
+                # i == 0: write through old K (same revisited-block rule
+                # as h_out below)
+                idx["k_out"][jc] = jnp.where(valid, k_new, k_old)
+                acc = acc + k_new
             if comp:
                 u = (coef("ch", f"da_{c}") - 1.0) * h_old \
                     - coef("ch", f"db_{c}") * acc \
@@ -614,6 +643,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                             lag_imap) for a in psi_axes_h]
     if drude:
         in_specs += [stack_spec(ne, (n2, n3), tile_imap)]     # J in
+    if drude_m:
+        in_specs += [stack_spec(nh, (n2, n3), lag_imap)]      # K in
     if comp:
         in_specs += [stack_spec(ne, (n2, n3), tile_imap),     # rE in
                      stack_spec(nh, (n2, n3), lag_imap)]      # rH in
@@ -654,6 +685,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                              lag_imap) for a in psi_axes_h]
     if drude:
         out_specs += [stack_spec(ne, (n2, n3), tile_imap)]
+    if drude_m:
+        out_specs += [stack_spec(nh, (n2, n3), lag_imap)]
     if comp:
         out_specs += [stack_spec(ne, (n2, n3), tile_imap),
                       stack_spec(nh, (n2, n3), lag_imap)]
@@ -666,6 +699,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                                        np.float32) for a in psi_axes_h]
     if drude:
         out_shape += [jax.ShapeDtypeStruct((ne, n1, n2, n3), np.float32)]
+    if drude_m:
+        out_shape += [jax.ShapeDtypeStruct((nh, n1, n2, n3), np.float32)]
     if comp:
         out_shape += [jax.ShapeDtypeStruct((ne, n1, n2, n3),
                                            jnp.bfloat16),
@@ -685,6 +720,10 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         aliases[2 + j] = 2 + j
     k = 2 + n_psi
     if drude:
+        aliases[k] = k
+        k += 1
+    if drude_m:
+        # K follows the lagged H pattern and enters once -> donation-safe
         aliases[k] = k
         k += 1
     if comp:
@@ -732,6 +771,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             p["hxs"] = _h_slab_planes(p["H"])
         if drude:
             p["J"] = jnp.stack([state["J"][c] for c in e_comps])
+        if drude_m:
+            p["K"] = jnp.stack([state["K"][c] for c in h_comps])
         if comp:
             p["rE"] = jnp.stack([state["rE"][c] for c in e_comps])
             p["rH"] = jnp.stack([state["rH"][c] for c in h_comps])
@@ -758,6 +799,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             state["psi_H"] = psi_h
         if drude:
             state["J"] = {c: p["J"][j] for j, c in enumerate(e_comps)}
+        if drude_m:
+            state["K"] = {c: p["K"][j] for j, c in enumerate(h_comps)}
         if comp:
             state["rE"] = {c: p["rE"][j] for j, c in enumerate(e_comps)}
             state["rH"] = {c: p["rH"][j] for j, c in enumerate(h_comps)}
@@ -836,6 +879,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         args += [pstate[f"psH{a}"] for a in psi_axes_h]
         if drude:
             args += [pstate["J"]]
+        if drude_m:
+            args += [pstate["K"]]
         if comp:
             args += [pstate["rE"], pstate["rH"]]
         args += [_prof_pack(coeffs, "e", a) for a in psi_axes_e]
@@ -862,6 +907,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             psh[a] = outs[p]; p += 1
         if drude:
             new_state["J"] = outs[p]; p += 1
+        if drude_m:
+            new_state["K"] = outs[p]; p += 1
         if comp:
             new_state["rE"] = outs[p]; p += 1
             new_state["rH"] = outs[p]; p += 1

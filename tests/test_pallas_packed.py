@@ -244,15 +244,23 @@ def test_packed_checkpoint_roundtrip(tmp_path):
     assert np.abs(ref - got).max() == 0.0  # bit-exact resume
 
 
-def test_packed_drude_m_falls_back():
-    """Magnetic Drude is out of packed scope -> recompute-fused path."""
+def test_packed_drude_m_in_scope():
+    """Magnetic Drude joined the packed scope in round 5 (K rides
+    lag-mapped operands in the lagged H phase; parity coverage in
+    tests/test_packed_sourced_sharded.py); only compensated+K still
+    falls back (K residuals are not Kahan-treated)."""
+    mats = MaterialsConfig(
+        use_drude_m=True, mu_inf=1.5, omega_pm=1e11, gamma_m=1e10,
+        drude_m_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                    radius=3))
     sim = Simulation(SimConfig(
         **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),
-        materials=MaterialsConfig(
-            use_drude_m=True, mu_inf=1.5, omega_pm=1e11, gamma_m=1e10,
-            drude_m_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
-                                        radius=3))))
-    assert sim.step_kind in ("pallas_fused", "pallas")
+        materials=mats))
+    assert sim.step_kind == "pallas_packed"
+    comp = Simulation(SimConfig(
+        **BASE, use_pallas=True, compensated=True,
+        pml=PmlConfig(size=(0, 3, 3)), materials=mats))
+    assert comp.step_kind in ("pallas_fused", "pallas", "jnp")
 
 
 @pytest.mark.parametrize("topo", [(2, 1, 1), (1, 2, 1), (1, 2, 2),

@@ -10,9 +10,21 @@ Runs in interpreter mode on the 8-device virtual CPU mesh.
 """
 
 import dataclasses
+import os
 
 import numpy as np
 import pytest
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _pin_two_pass():
+    """This module covers the TWO-PASS sharded kernels. Since round 5
+    the packed kernel's scope includes sourced + magnetic-Drude sharded
+    runs, so without the pin every config here would engage it instead
+    (its own coverage lives in tests/test_packed_sourced_sharded.py)."""
+    os.environ["FDTD3D_NO_PACKED"] = "1"
+    yield
+    os.environ.pop("FDTD3D_NO_PACKED", None)
 
 from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
                                PointSourceConfig, SimConfig, SphereConfig,
