@@ -46,7 +46,16 @@ ATTEMPT_TIMEOUT_S = 2400
 
 def measure(n: int, steps: int, use_pallas, repeats: int = 3,
             dtype: str = "float32") -> float:
-    """Mcells/s for one path. Import jax lazily: the parent never does."""
+    """Mcells/s for one path. Import jax lazily: the parent never does.
+
+    ``steps`` is the CHUNK length of one timed advance(). It matters a
+    lot: the tunnel charges a fixed ~180 ms per dispatch+readback
+    round-trip (tools/decompose_overhead.py, round 5: 512^3 f32
+    measured 35.5/21.5/17.8 ms per step at chunks of 10/30/120), so
+    short chunks were taxing the headline 20-40%. Stages now time
+    60-120-step chunks — the production posture (Simulation.run does
+    the whole horizon in one scan) rather than a latency microbench.
+    """
     import jax
     import numpy as np
 
@@ -221,9 +230,9 @@ def run_measurement() -> None:
     if on_tpu and pallas_mc >= GATE_MCELLS_512 and \
             stage1_s < STAGE1_BUDGET_S:
         try:
-            jnp_512 = measure(512, 20, use_pallas=False)
+            jnp_512 = measure(512, 30, use_pallas=False)
             try:
-                pallas_512 = measure(512, 20, use_pallas=True)
+                pallas_512 = measure(512, 90, use_pallas=True)
             except Exception:
                 # retry ladder: two-pass at the raised budget (unless
                 # the caller pinned one), then two-pass at the default
@@ -236,10 +245,10 @@ def run_measurement() -> None:
                     if saved["FDTD3D_VMEM_BUDGET_MB"] is None:
                         os.environ["FDTD3D_VMEM_BUDGET_MB"] = "86"
                     try:
-                        pallas_512 = measure(512, 20, use_pallas=True)
+                        pallas_512 = measure(512, 90, use_pallas=True)
                     except Exception:
                         os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
-                        pallas_512 = measure(512, 20, use_pallas=True)
+                        pallas_512 = measure(512, 90, use_pallas=True)
                 finally:
                     for k, v in saved.items():
                         if v is None:
@@ -261,14 +270,14 @@ def run_measurement() -> None:
     if on_tpu and pallas_mc >= GATE_MCELLS_512:
         if n >= 512:
             try:
-                f32_640 = measure(640, 10, use_pallas=True)
+                f32_640 = measure(640, 60, use_pallas=True)
                 if f32_640 > pallas_mc:
                     pallas_mc, n = f32_640, 640
             except Exception:
                 pass
         for bn in ((768, 512) if n >= 512 else (n,)):
             try:
-                bf16_mc = measure(bn, 20 if bn == 512 else 10,
+                bf16_mc = measure(bn, 90 if bn == 512 else 60,
                                   use_pallas=True, dtype="bfloat16")
                 bf16_n = bn
                 break
@@ -293,7 +302,25 @@ def run_measurement() -> None:
         "bf16_n": bf16_n,
         "hbm_probe_gbps": gbps,
         "platform": platform,
+        # Per-dtype accuracy class (measured frontier, BASELINE.md):
+        # the headline bf16 number is a THROUGHPUT mode — it fails the
+        # repo's own <=1e-6 accuracy bar; quote the f32 number next to
+        # it wherever the headline is used (VERDICT r4 weak item 2).
+        "accuracy_class": {
+            "f32": "~6e-6 rel-err vs f64 @1000 steps",
+            "bf16": "~1e-1 rel-err vs f64 @1000 steps"
+                    " (throughput mode only)",
+            "float32x2": "<=2e-7 rel-err vs f64 @600 steps"
+                         " (--dtype float32x2, jnp path)",
+        },
     }
+    if n <= 256 and on_tpu:
+        # 256^3 timings through the tunnel are readback-dominated:
+        # kernel RANKING at this size is noise (BASELINE.md round-4
+        # table) — flag it so the artifact can't be mis-read.
+        out["f32_note"] = ("256^3 stage is readback-dominated through "
+                           "the device tunnel; not meaningful for "
+                           "kernel ranking (512^3+ rows are the signal)")
     if best is not None:
         out["best_known_mcells"] = best.get("best_known_mcells")
         out["best_known_n"] = best.get("n")

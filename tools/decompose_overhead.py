@@ -1,0 +1,106 @@
+"""Decompose the per-step overhead wall (VERDICT r4 'what's weak' #1).
+
+At the round-4 headline point the packed kernel moved ~220 GB/s of its
+own traffic against a same-window 591 GB/s HBM calibration — the step
+is NOT bandwidth-bound in this environment. This tool separates the
+candidate costs with controlled contrasts, all on the real chip:
+
+  * chunk-length sweep (same total steps, different scan chunking) —
+    per-dispatch + readback overhead vs per-scan-iteration cost;
+  * pml=10 vs pml=0 at fixed grid — the slab_post patch passes, psi
+    stacks, and hxs carry cost;
+  * volume sweep at fixed config — fit t_step = a + b*cells: `a` is
+    the per-step floor (sequencer/DMA-setup/fusion overheads), `b`
+    the marginal bandwidth cost (1/b vs the HBM probe = how
+    bandwidth-bound the marginal cell is);
+  * f32 vs bf16 at the largest common grid.
+
+Prints one JSON blob; paste the table into docs/PERFORMANCE.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _mk(n, pml, dtype="float32", steps=400):
+    from fdtd3d_tpu.config import PmlConfig, SimConfig
+    from fdtd3d_tpu.sim import Simulation
+    cfg = SimConfig(scheme="3D", size=(n, n, n), time_steps=steps,
+                    dx=1e-3, courant_factor=0.5, wavelength=32e-3,
+                    dtype=dtype, pml=PmlConfig(size=(pml,) * 3))
+    return Simulation(cfg)
+
+
+def time_chunk(sim, n_steps, repeats=3):
+    """best-of wall seconds for one advance(n_steps), sync'd."""
+    import jax
+    sim.advance(n_steps)   # compile + warm
+    sim.sample("Ez", (1, 1, 1))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        sim.advance(n_steps)
+        jax.block_until_ready(sim._carry())
+        sim.sample("Ez", (1, 1, 1))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    out = {"device": jax.devices()[0].device_kind}
+    from bench import probe_hbm_gbps
+    try:
+        out["hbm_probe_gbps"] = round(probe_hbm_gbps(), 1)
+    except Exception:
+        out["hbm_probe_gbps"] = -1.0
+
+    # 1. chunk-length sweep at 512^3 f32 (fixed 120 steps total)
+    sim = _mk(512, 10)
+    out["step_kind"] = sim.step_kind
+    chunks = {}
+    for n in (10, 30, 120):
+        t = time_chunk(sim, n)
+        chunks[n] = round(t / n * 1e3, 3)       # ms/step
+    out["ms_per_step_by_chunk_512_pml10"] = chunks
+    del sim
+
+    # 2. pml=0 contrast at 512^3 (no psi, no slab_post, no hxs carry)
+    sim0 = _mk(512, 0)
+    out["ms_per_step_512_pml0"] = round(time_chunk(sim0, 30) / 30 * 1e3, 3)
+    out["step_kind_pml0"] = sim0.step_kind
+    del sim0
+
+    # 3. volume sweep (pml=10, f32): fit t = a + b*cells
+    vols = {}
+    for n in (256, 384, 448, 512):
+        s = _mk(n, 10)
+        vols[n] = time_chunk(s, 30) / 30
+        del s
+    out["s_per_step_by_n"] = {k: round(v, 6) for k, v in vols.items()}
+    ns = np.array(sorted(vols))
+    cells = ns.astype(np.float64) ** 3
+    ts = np.array([vols[int(n)] for n in ns])
+    b, a = np.polyfit(cells, ts, 1)
+    out["fit_per_step_overhead_ms"] = round(a * 1e3, 3)
+    out["fit_marginal_ns_per_cell"] = round(b * 1e9, 4)
+    # marginal bandwidth implied by the fit at 48 B/cell f32
+    out["fit_marginal_gbps_at_48B"] = round(48.0 / b / 1e9, 1)
+
+    # 4. bf16 at 512^3 for the dtype contrast
+    sb = _mk(512, 10, dtype="bfloat16")
+    out["ms_per_step_512_bf16"] = round(time_chunk(sb, 30) / 30 * 1e3, 3)
+    del sb
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
