@@ -82,6 +82,8 @@ class Simulation:
             self.coeffs = jax.tree.map(jnp.asarray, coeffs_np)
             self.state = init_state(self.static)
 
+        self._mesh_axes = mesh_axes
+        self._mesh_shape = mesh_shape
         self._runner = make_chunk_runner(self.static, mesh_axes, mesh_shape)
         # Packed-carry plumbing: pack/unpack are per-shard functions, so
         # under a mesh they run inside shard_map with specs inferred
@@ -186,7 +188,17 @@ class Simulation:
     # -- stepping ----------------------------------------------------------
 
     def _chunk_fn(self, n: int, carry):
-        if n not in self._compiled:
+        """AOT-compile the n-step chunk (cached per n).
+
+        Compilation happens here, explicitly, for every path — so (a)
+        profiled runs time steps, not compilation, and (b) a COMPILE
+        failure of the packed kernel is caught before any donated
+        buffer is consumed, letting the VMEM-budget fallback ladder
+        rebuild at a smaller tile and recompile with the live carry
+        intact. Runtime failures of the compiled executable propagate
+        untouched (retrying them with donated inputs would be unsound).
+        """
+        while n not in self._compiled:
             fn = functools.partial(self._runner, n=n)
             if self.mesh is not None:
                 st_specs = self._packed_specs \
@@ -196,11 +208,12 @@ class Simulation:
                                                  self._coeff_specs),
                                        out_specs=st_specs)
             jitted = jax.jit(fn, donate_argnums=0)
-            if self.clock is not None:
-                # Profiled runs must time steps, not compilation: compile
-                # ahead of time so the clocked call below is execute-only.
-                jitted = jitted.lower(carry, self.coeffs).compile()
-            self._compiled[n] = jitted
+            try:
+                compiled = jitted.lower(carry, self.coeffs).compile()
+            except Exception as exc:
+                self._vmem_fallback(exc)   # next rung, or re-raise
+                continue
+            self._compiled[n] = compiled
         return self._compiled[n]
 
     def advance(self, n_steps: int):
@@ -237,6 +250,65 @@ class Simulation:
         if self._check_finite:
             profiling.assert_finite(self._carry(), context=f"t={self.t}")
         return self
+
+    # Budget rungs for the packed kernel's VMEM-model fallback: the
+    # model's Mosaic-temporaries constant is calibrated on one v5e
+    # tunnel (ops/pallas_packed.py); on other TPU generations a
+    # model-picked tile may fail Mosaic's VMEM check at compile time.
+    _VMEM_LADDER_MB = (86, 64, 48)
+
+    def _vmem_fallback(self, exc):
+        """Rebuild the packed runner at the next smaller VMEM budget
+        (smaller x-tile), loudly, after a COMPILE failure.
+
+        The tunneled backend surfaces Mosaic VMEM overflows as opaque
+        remote-compile errors, so any compile exception of a packed
+        runner walks the ladder; rungs that re-pick a tile >= the one
+        that just failed are skipped (no doomed recompiles). The packed
+        carry layout does not depend on the tile, so the live state
+        stays valid across the rebuild.
+        """
+        from fdtd3d_tpu import log as _log
+        from fdtd3d_tpu.ops import pallas_packed
+        from fdtd3d_tpu.solver import make_chunk_runner
+        if self.step_kind != "pallas_packed":
+            raise exc
+        failed_tile = ((self.step_diag or {}).get("tile") or {}).get("EH")
+        while True:
+            rung = getattr(self, "_vmem_rung", 0)
+            if rung >= len(self._VMEM_LADDER_MB):
+                raise RuntimeError(
+                    "packed kernel failed to compile at every "
+                    "VMEM-budget rung; set FDTD3D_NO_PACKED=1 to use "
+                    "the two-pass kernels") from exc
+            self._vmem_rung = rung + 1
+            nxt = self._VMEM_LADDER_MB[rung] << 20
+            # pin the budget only for THIS rebuild's tile pick, then
+            # release the global so unrelated sims are unaffected
+            pallas_packed._RUNTIME_BUDGET = nxt
+            try:
+                runner = make_chunk_runner(self.static, self._mesh_axes,
+                                           self._mesh_shape)
+            finally:
+                pallas_packed._RUNTIME_BUDGET = None
+            if getattr(runner, "kind", None) != "pallas_packed":
+                # the shrunken budget fell out of packed scope entirely
+                # — switching carry representations mid-run is unsound
+                raise exc
+            new_tile = (runner.diag.get("tile") or {}).get("EH")
+            if failed_tile is not None and new_tile is not None \
+                    and new_tile >= failed_tile:
+                continue      # same/bigger tile would fail again
+            break
+        _log.warn(
+            f"packed kernel compile failed at tile {failed_tile}; "
+            f"retrying at tile {new_tile} ({nxt >> 20} MiB VMEM "
+            f"budget). The VMEM-temporaries model is calibrated for "
+            f"v5e — see ops/pallas_packed.py. Original error: "
+            f"{str(exc)[:200]}")
+        self._runner = runner
+        self.step_diag = getattr(self._runner, "diag", None)
+        self._compiled.clear()
 
     def run(self, time_steps: Optional[int] = None,
             on_interval: Optional[Callable] = None,

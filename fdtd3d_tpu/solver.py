@@ -965,6 +965,16 @@ def _make_paired_complex_step(static: StaticSetup, mesh_axes=None,
     NUMPY: re/im extraction and re + 1j*im are themselves complex ops
     the backend lacks.
     """
+    if mesh_axes and any(v is not None for v in mesh_axes.values()):
+        raise ValueError(
+            "complex fields on a backend without native complex "
+            "arithmetic (the paired-real path) cannot run on a sharded "
+            "topology: the complex<->paired conversion routes through "
+            "host numpy (complex device arrays are unsupported on this "
+            "backend), which cannot execute inside shard_map. Run "
+            "complex sharded on a backend with native complex (CPU), "
+            "or run real-dtype sharded; see solver._make_paired_"
+            "complex_step.")
     cfg = static.cfg
     cfg_re = dataclasses.replace(cfg, complex_fields=False)
     cfg_im = dataclasses.replace(

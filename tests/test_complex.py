@@ -190,3 +190,20 @@ def test_complex_cli_black_box():
                        "--norms-every", "20"])
     assert rc == 0
     assert "[t=20]" in buf.getvalue()
+
+
+def test_paired_complex_sharded_loudly_rejects(monkeypatch):
+    """Paired-complex + a sharded topology cannot work (the
+    complex<->paired conversion routes through host numpy, which
+    cannot run inside shard_map) — it must fail at construction with
+    an actionable error, not an obscure trace failure (VERDICT r4
+    missing item 5)."""
+    from fdtd3d_tpu.config import ParallelConfig
+    monkeypatch.setenv("FDTD3D_FORCE_PAIRED_COMPLEX", "1")
+    cfg = SimConfig(scheme="3D", size=(16, 16, 16), time_steps=4,
+                    dx=1e-3, courant_factor=0.4, wavelength=8e-3,
+                    complex_fields=True,
+                    parallel=ParallelConfig(topology="manual",
+                                            manual_topology=(1, 2, 2)))
+    with pytest.raises(ValueError, match="native complex"):
+        Simulation(cfg)
