@@ -39,6 +39,8 @@ units.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Tuple
 
 import jax.numpy as jnp
@@ -59,6 +61,27 @@ _BARRIERS = True
 Pair = Tuple[jnp.ndarray, jnp.ndarray]
 
 
+@contextlib.contextmanager
+def no_barriers():
+    """Trace ds ops without optimization barriers — REQUIRED inside
+    Pallas kernel bodies (Mosaic has no optimization_barrier lowering)
+    and SAFE there: Mosaic does not run XLA's algebraic simplifier, and
+    the EFT primitives were measured bit-exact in Pallas TPU kernels
+    without barriers (tests/test_ds.py::test_pallas_eft_exactness).
+    Thread-local, so a concurrent trace of non-kernel ds code in
+    another thread keeps its load-bearing barriers.
+    """
+    old = getattr(_TRACE_STATE, "no_barriers", False)
+    _TRACE_STATE.no_barriers = True
+    try:
+        yield
+    finally:
+        _TRACE_STATE.no_barriers = old
+
+
+_TRACE_STATE = threading.local()
+
+
 def _ob(x):
     """Optimization barrier on the EFT pivot value.
 
@@ -72,7 +95,9 @@ def _ob(x):
     term is derived from) behind a barrier makes the cancellation
     pattern opaque to the simplifier at negligible fusion cost.
     """
-    return lax.optimization_barrier(x) if _BARRIERS else x
+    if not _BARRIERS or getattr(_TRACE_STATE, "no_barriers", False):
+        return x
+    return lax.optimization_barrier(x)
 
 
 def quick_two_sum(a, b) -> Pair:

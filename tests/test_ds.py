@@ -137,6 +137,47 @@ def test_sin2pi_vs_f64():
     assert np.abs(gots - np.sin(2 * np.pi * want2)).max() < 1e-12
 
 
+def test_pallas_eft_exactness():
+    """EFT primitives inside a Pallas kernel (interpret mode on CPU;
+    the same body was verified bit-exact compiled by Mosaic on the real
+    chip, 2026-07-31) — the feasibility basis for the packed-ds kernel.
+    Barriers must be off inside kernels (Mosaic has no
+    optimization_barrier lowering): ds.no_barriers() scopes that."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(a_ref, b_ref, s_ref, e_ref, p_ref, pe_ref):
+        with ds.no_barriers():
+            a = a_ref[...]
+            b = b_ref[...]
+            s, e = ds.two_sum(a, b)
+            p, pe = ds.two_prod(a, b)
+        s_ref[...] = s
+        e_ref[...] = e
+        p_ref[...] = p
+        pe_ref[...] = pe
+
+    rng2 = np.random.default_rng(1)
+    a64 = rng2.standard_normal((8, 128)) * np.exp2(
+        rng2.integers(-18, 18, (8, 128)))
+    b64 = rng2.standard_normal((8, 128)) * np.exp2(
+        rng2.integers(-18, 18, (8, 128)))
+    a = jnp.asarray(a64, jnp.float32)
+    b = jnp.asarray(b64, jnp.float32)
+    out = [jax.ShapeDtypeStruct(a.shape, jnp.float32)] * 4
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    s, e, p, pe = pl.pallas_call(kernel, out_shape=out,
+                                 interpret=interpret)(a, b)
+    ws = np.asarray(a, np.float64) + np.asarray(b, np.float64)
+    wp = np.asarray(a, np.float64) * np.asarray(b, np.float64)
+    assert np.array_equal(np.asarray(s, np.float64)
+                          + np.asarray(e, np.float64), ws)
+    assert np.array_equal(np.asarray(p, np.float64)
+                          + np.asarray(pe, np.float64), wp)
+    assert not getattr(ds._TRACE_STATE, "no_barriers", False)  # restored
+
+
 def test_accumulation_beats_f32():
     """1e5-term recurrence x += c*x + d: ds tracks f64 ~5 orders better
     than plain f32 — the property the float32x2 leapfrog rides."""
