@@ -45,7 +45,7 @@ ATTEMPT_TIMEOUT_S = 2400
 
 
 def measure(n: int, steps: int, use_pallas, repeats: int = 3,
-            dtype: str = "float32") -> float:
+            dtype: str = "float32", require_kind: str = "") -> float:
     """Mcells/s for one path. Import jax lazily: the parent never does.
 
     ``steps`` is the CHUNK length of one timed advance(). It matters a
@@ -69,6 +69,13 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3,
         dtype=dtype, use_pallas=use_pallas,
     )
     sim = Simulation(cfg)
+    if require_kind and sim.step_kind != require_kind:
+        # a silent fallback (e.g. jnp-ds at ~140 Mcells/s) must not be
+        # reported as the kernel's number — raise so the caller's
+        # grid-size ladder treats it like any other failed attempt
+        raise RuntimeError(
+            f"stage requires step_kind {require_kind}, got "
+            f"{sim.step_kind}")
     # Warm up: compile AND force one real device->host readback (async
     # dispatch through the device tunnel can make a bare block_until_ready
     # return before execution — measured 0.3ms for 50 steps without this).
@@ -283,6 +290,23 @@ def run_measurement() -> None:
                 break
             except Exception:
                 continue
+    # Stage 4: float32x2 on the packed-ds kernel (round 5) — the
+    # accuracy mode's throughput (96 B/cell pair traffic + ~10x EFT
+    # flops; ops/pallas_packed_ds.py). Smaller grids than f32: the
+    # pair state is 2x per cell and the initial pack() transiently
+    # doubles it.
+    ds_mc = 0.0
+    ds_n = 0
+    if on_tpu and pallas_mc >= GATE_MCELLS_512:
+        for dn in (448, 384, 256):
+            try:
+                ds_mc = measure(dn, 60, use_pallas=True,
+                                dtype="float32x2",
+                                require_kind="pallas_packed_ds")
+                ds_n = dn
+                break
+            except Exception:
+                continue
     mcells = max(jnp_mc, pallas_mc, bf16_mc)
     best = _maybe_update_best(pallas_mc, jnp_mc, bf16_mc,
                               bf16_n if (bf16_mc >= pallas_mc and bf16_n)
@@ -300,6 +324,8 @@ def run_measurement() -> None:
         "jnp_mcells": round(jnp_mc, 1),
         "bf16_mcells": round(bf16_mc, 1),
         "bf16_n": bf16_n,
+        "float32x2_mcells": round(ds_mc, 1),
+        "float32x2_n": ds_n,
         "hbm_probe_gbps": gbps,
         "platform": platform,
         # Per-dtype accuracy class (measured frontier, BASELINE.md):

@@ -260,6 +260,26 @@ def _interp_line(line: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
     return (1.0 - w) * jnp.take(line, i0) + w * jnp.take(line, i0 + 1)
 
 
+def _corr_gate_transverse(corr: Correction, setup: TfsfSetup, gs,
+                          active_axes, dtype):
+    """Staggered transverse box membership (no normal-axis onehot) as a
+    broadcastable 0/1 mask, or None when no transverse axis is active.
+    Split out of _corr_gate for consumers that carry the normal plane
+    index statically (the packed-ds kernel's per-plane records)."""
+    gate = None
+    m_off = YEE_OFFSETS[corr.mask_comp]
+    for b in range(3):
+        if b == corr.axis or b not in active_axes:
+            continue
+        hi_b = setup.hi[b] - 1 if m_off[b] == 0.5 else setup.hi[b]
+        ind = (gs[b] >= setup.lo[b]) & (gs[b] <= hi_b)
+        shape_b = [1, 1, 1]
+        shape_b[b] = ind.shape[0]
+        ind = ind.reshape(shape_b).astype(dtype)
+        gate = ind if gate is None else gate * ind
+    return gate
+
+
 def _corr_gate(corr: Correction, setup: TfsfSetup, gs, active_axes,
                dtype):
     """Plane-onehot x staggered transverse box membership, as a
@@ -272,16 +292,8 @@ def _corr_gate(corr: Correction, setup: TfsfSetup, gs, active_axes,
     onehot_shape[corr.axis] = gs[corr.axis].shape[0]
     gate = (gs[corr.axis] == corr.plane).reshape(onehot_shape)
     gate = gate.astype(dtype)
-    m_off = YEE_OFFSETS[corr.mask_comp]
-    for b in range(3):
-        if b == corr.axis or b not in active_axes:
-            continue
-        hi_b = setup.hi[b] - 1 if m_off[b] == 0.5 else setup.hi[b]
-        ind = (gs[b] >= setup.lo[b]) & (gs[b] <= hi_b)
-        shape_b = [1, 1, 1]
-        shape_b[b] = ind.shape[0]
-        gate = gate * ind.reshape(shape_b).astype(dtype)
-    return gate
+    tg = _corr_gate_transverse(corr, setup, gs, active_axes, dtype)
+    return gate if tg is None else gate * tg
 
 
 def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
@@ -345,49 +357,76 @@ def corrections_for_ds(field: str, comp: str, setup: TfsfSetup, coeffs,
     residual. The ds zeta keeps the FRACTIONAL interpolation weight
     accurate to ~2^-24 absolute.
     """
-    from fdtd3d_tpu.ops import ds
     gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
-    rdt = inc["Einc"].dtype
+    from fdtd3d_tpu.ops import ds
     tot = None
     for corr in setup.corrections:
         if corr.field != field or corr.comp != comp:
             continue
-        off = YEE_OFFSETS[corr.src]
-        z0 = np.float64(setup.zeta0) + np.float64(
-            setup.khat[corr.axis]) * (corr.pos_a
-                                      - setup.origin[corr.axis])
-        zh, zl = ds.from_f64(z0)
-        zh = jnp.asarray(zh, rdt)
-        zl = jnp.asarray(zl, rdt)
-        for b in range(3):
-            if b == corr.axis or b not in active_axes:
-                continue
-            # pb values are integers + 0.5: exact in f32
-            pb = gs[b].astype(rdt) + off[b]
-            shape = [1, 1, 1]
-            shape[b] = pb.shape[0]
-            oh, ol = ds.from_f64(np.float64(setup.origin[b]))
-            dh_, dl_ = ds.add_f(-oh, -ol, pb)
-            th_, tl_ = ds.mul_ff(dh_, dl_,
-                                 *ds.from_f64(np.float64(setup.khat[b])))
-            zh, zl = ds.add_ff(zh, zl, th_.reshape(shape),
-                               tl_.reshape(shape))
-        if corr.src[0] == "E":
-            vh, vl = _interp_line_ds(inc["Einc"], inc["Einc_lo"],
-                                     (zh, zl))
-            pol = setup.ehat[component_axis(corr.src)]
-        else:
-            vh, vl = _interp_line_ds(inc["Hinc"], inc["Hinc_lo"],
-                                     ds.add_f(zh, zl, np.float32(-0.5)))
-            pol = setup.hhat[component_axis(corr.src)]
-        if abs(pol) < 1e-14:
+        term = record_term_ds(corr, setup, coeffs, inc, active_axes, dx)
+        if term is None:
             continue
-        gate = _corr_gate(corr, setup, gs, active_axes, vh.dtype)
-        ch, cl = ds.from_f64(np.float64(corr.sign) * pol / dx)
-        th, tl = ds.mul_ff(vh, vl, ch, cl)
-        th, tl = th * gate, tl * gate      # 0/1 mask: exact
+        th, tl = term
+        onehot_shape = [1, 1, 1]
+        onehot_shape[corr.axis] = gs[corr.axis].shape[0]
+        onehot = (gs[corr.axis] == corr.plane) \
+            .reshape(onehot_shape).astype(th.dtype)
+        th, tl = th * onehot, tl * onehot  # 0/1 mask: exact
         tot = (th, tl) if tot is None else ds.add_ff(*tot, th, tl)
     return tot
+
+
+def record_term_ds(corr: Correction, setup: TfsfSetup, coeffs, inc,
+                   active_axes, dx: float):
+    """ONE correction's ds accumulator term on its plane (hi, lo), with
+    the TRANSVERSE box gate applied but WITHOUT the normal-axis onehot
+    — or None when the polarization projection vanishes.
+
+    The single authority for the per-correction ds math: the jnp-ds
+    step consumes it through corrections_for_ds (which adds the onehot)
+    and the packed-ds kernel (ops/pallas_packed_ds.py) consumes it
+    directly, carrying the plane index statically, so the two paths
+    cannot drift.
+    """
+    from fdtd3d_tpu.ops import ds
+    gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
+    rdt = inc["Einc"].dtype
+    off = YEE_OFFSETS[corr.src]
+    z0 = np.float64(setup.zeta0) + np.float64(
+        setup.khat[corr.axis]) * (corr.pos_a
+                                  - setup.origin[corr.axis])
+    zh, zl = ds.from_f64(z0)
+    zh = jnp.asarray(zh, rdt)
+    zl = jnp.asarray(zl, rdt)
+    for b in range(3):
+        if b == corr.axis or b not in active_axes:
+            continue
+        # pb values are integers + 0.5: exact in f32
+        pb = gs[b].astype(rdt) + off[b]
+        shape = [1, 1, 1]
+        shape[b] = pb.shape[0]
+        oh, ol = ds.from_f64(np.float64(setup.origin[b]))
+        dh_, dl_ = ds.add_f(-oh, -ol, pb)
+        th_, tl_ = ds.mul_ff(dh_, dl_,
+                             *ds.from_f64(np.float64(setup.khat[b])))
+        zh, zl = ds.add_ff(zh, zl, th_.reshape(shape),
+                           tl_.reshape(shape))
+    if corr.src[0] == "E":
+        vh, vl = _interp_line_ds(inc["Einc"], inc["Einc_lo"],
+                                 (zh, zl))
+        pol = setup.ehat[component_axis(corr.src)]
+    else:
+        vh, vl = _interp_line_ds(inc["Hinc"], inc["Hinc_lo"],
+                                 ds.add_f(zh, zl, np.float32(-0.5)))
+        pol = setup.hhat[component_axis(corr.src)]
+    if abs(pol) < 1e-14:
+        return None
+    ch, cl = ds.from_f64(np.float64(corr.sign) * pol / dx)
+    th, tl = ds.mul_ff(vh, vl, ch, cl)
+    gate = _corr_gate_transverse(corr, setup, gs, active_axes, th.dtype)
+    if gate is not None:
+        th, tl = th * gate, tl * gate      # 0/1 mask: exact
+    return th, tl
 
 
 def _interp_line_ds(line_h, line_l, u_pair):

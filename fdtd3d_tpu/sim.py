@@ -112,9 +112,11 @@ class Simulation:
             import jax as _jax
             from fdtd3d_tpu.ops import pallas3d
             backend = _jax.default_backend()
-            hint = ("likely causes: non-3D/complex/f64/float32x2 "
-                    "config, a shard too thin for the CPML slabs, or "
-                    "use_pallas=False")
+            hint = ("likely causes: non-3D/complex/f64 config, a shard "
+                    "too thin for the CPML slabs, use_pallas=False, or "
+                    "a float32x2 config outside the packed-ds kernel's "
+                    "scope (sharded, Drude, material grids — see "
+                    "ops/pallas_packed_ds.py)")
             if cfg.use_pallas is None and backend not in ("tpu", "axon"):
                 # the most common cause: auto mode only engages on TPU
                 hint = (f"use_pallas=auto engages only on TPU and this "
@@ -271,8 +273,9 @@ class Simulation:
         from fdtd3d_tpu import log as _log
         from fdtd3d_tpu.ops import pallas_packed
         from fdtd3d_tpu.solver import make_chunk_runner
-        if self.step_kind != "pallas_packed":
+        if self.step_kind not in ("pallas_packed", "pallas_packed_ds"):
             raise exc
+        kind = self.step_kind
         failed_tile = ((self.step_diag or {}).get("tile") or {}).get("EH")
         while True:
             rung = getattr(self, "_vmem_rung", 0)
@@ -291,7 +294,7 @@ class Simulation:
                                            self._mesh_shape)
             finally:
                 pallas_packed._RUNTIME_BUDGET = None
-            if getattr(runner, "kind", None) != "pallas_packed":
+            if getattr(runner, "kind", None) != kind:
                 # the shrunken budget fell out of packed scope entirely
                 # — switching carry representations mid-run is unsound
                 raise exc

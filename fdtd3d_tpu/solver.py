@@ -458,6 +458,25 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     if static.paired_complex:
         return _make_paired_complex_step(static, mesh_axes, mesh_shape)
     if static.cfg.ds_fields:
+        # float32x2 hot path: the packed double-single Pallas kernel
+        # (ops/pallas_packed_ds.py) — same dispatch policy as the f32
+        # kernels (use_pallas flag, TPU-or-interpret backend rule,
+        # FDTD3D_NO_PACKED escape hatch); jnp-ds covers everything
+        # out of its scope (sharded, Drude, material grids, thin psi)
+        import os as _os
+        flag = static.cfg.use_pallas
+        want = flag is not False and not _os.environ.get(
+            "FDTD3D_NO_PACKED")
+        if want and flag is None:
+            import jax as _jax
+            want = _jax.default_backend() in ("tpu", "axon")
+        if want:
+            from fdtd3d_tpu.ops import pallas_packed_ds
+            pk = pallas_packed_ds.make_packed_ds_step(
+                static, mesh_axes, mesh_shape)
+            if pk is not None:
+                pk.kind = "pallas_packed_ds"
+                return pk
         step = _make_ds_step(static, mesh_axes, mesh_shape)
         step.kind = "jnp_ds"
         return step

@@ -1,0 +1,168 @@
+"""Packed double-single kernel (ops/pallas_packed_ds.py) vs jnp-ds.
+
+The float32x2 mode's jnp step is the accuracy gold standard (6.7e-8
+vs f64 at 1000 steps, BASELINE.md); the packed-ds kernel must
+reproduce it to EFT-reordering tolerance — the only differences are
+summation order (the kernel applies the x-slab CPML delta post-
+coefficient where jnp-ds folds it into the accumulator) which is
+O(eps^2) per step, far below the mode's own error floor. Vacuum runs
+(no post-pass at all) must be BIT-EXACT: every in-kernel operation is
+the same EFT sequence jnp-ds traces.
+
+Out-of-scope configs (sharded, Drude, material grids) must fall back
+to jnp_ds rather than silently degrade.
+
+In this CPU test env the kernel runs in interpret mode WITH the
+optimization barriers kept (module docstring: interpret-mode bodies
+land in the XLA graph where the simplifier folds are real); the
+compiled-Mosaic EFT exactness is covered on real TPU by
+tests/test_ds.py::test_pallas_eft_exactness.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig, TfsfConfig)
+from fdtd3d_tpu.sim import Simulation
+
+BASE = dict(scheme="3D", size=(16, 16, 16), time_steps=6, dx=1e-3,
+            courant_factor=0.4, wavelength=8e-3, dtype="float32x2")
+
+
+def _seed_fields(sim, seed=0):
+    key = jax.random.PRNGKey(seed)
+    for grp in ("E", "H"):
+        for c in list(sim.state[grp]):
+            key, k2 = jax.random.split(key)
+            sim.set_field(c, 0.01 * np.asarray(
+                jax.random.normal(k2, sim.state[grp][c].shape)))
+
+
+def _run(use_pallas, **kw):
+    sim = Simulation(SimConfig(**BASE, use_pallas=use_pallas, **kw))
+    _seed_fields(sim)
+    sim.run()
+    return sim
+
+
+def _parity(tol, **kw):
+    j = _run(False, **kw)
+    p = _run(True, **kw)
+    assert p.step_kind == "pallas_packed_ds", p.step_kind
+    assert j.step_kind == "jnp_ds", j.step_kind
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < tol, f"{c}: rel {rel:.2e}"
+    # the LO words must agree too — they carry the accuracy claim
+    for grp, lo in (("E", "loE"), ("H", "loH")):
+        for c in j.state[lo]:
+            a = np.asarray(j.state[lo][c])
+            b = np.asarray(p.state[lo][c])
+            ref = np.abs(np.asarray(j.state[grp][c])).max() + 1e-30
+            rel = np.abs(a - b).max() / ref
+            assert rel < tol, f"{lo}/{c}: rel {rel:.2e}"
+    return j, p
+
+
+def test_packed_ds_vacuum_bit_exact():
+    _parity(1e-12)
+
+
+def test_packed_ds_cpml_parity():
+    j, p = _parity(1e-9, pml=PmlConfig(size=(3, 3, 3)))
+    # psi recursion state (hi and lo) must match as well
+    for grp in ("psi_E", "psi_H", "lopsi_E", "lopsi_H"):
+        for k in j.state[grp]:
+            a = np.asarray(j.state[grp][k])
+            b = np.asarray(p.state[grp][k])
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < 1e-6, f"{grp}/{k}: rel {rel:.2e}"
+
+
+def test_packed_ds_tfsf_scattered_clean():
+    """In-kernel TFSF records, single run against the PHYSICS oracle.
+
+    Axis-aligned incidence: the scattered region outside the TFSF box
+    must be clean to the mode's accuracy floor. Any error in the
+    in-kernel record machinery (apply_corr's tile gating, stack_terms'
+    operand row layout, a sign/plane off-by-one) leaks O(1) incident
+    field outside the box; float32x2 must sit ~1e-12, far below f32's
+    ~1e-7 floor. One packed-ds run — no slow jnp-ds reference — so the
+    intricate path is exercised by the DEFAULT suite (the exact-parity
+    twin below is slow-marked)."""
+    cfg = SimConfig(scheme="3D", size=(24, 24, 24), time_steps=30,
+                    dx=1e-3, courant_factor=0.5, wavelength=6e-3,
+                    dtype="float32x2", use_pallas=True,
+                    pml=PmlConfig(size=(4, 4, 4)),
+                    tfsf=TfsfConfig(enabled=True, margin=(4, 4, 4),
+                                    angle_teta=90.0, angle_phi=0.0,
+                                    angle_psi=180.0))
+    sim = Simulation(cfg)
+    assert sim.step_kind == "pallas_packed_ds", sim.step_kind
+    sim.run()
+    ez = np.asarray(sim.field("Ez"), np.float64)
+    tot = np.abs(ez[8:16, 8:16, 8:16]).max()
+    sc = np.abs(ez[5:7, 5:19, 5:19]).max()
+    assert tot > 1e-3, tot           # the wave actually launched
+    assert sc / tot < 1e-10, (sc, tot)
+
+
+def test_packed_ds_point_source_vs_f32():
+    """In-kernel point-source pseudo-record vs the f32 packed kernel.
+
+    The f32 packed path applies the same source post-kernel; agreement
+    to ~f32 accumulation error (<<1) catches any gating/one-hot/tile
+    indexing bug in the ds pseudo-record, which would be O(1). Both
+    paths compile fast (no jnp-ds reference; the exact-parity twin is
+    slow-marked)."""
+    kw = dict(scheme="3D", size=(16, 16, 16), time_steps=10, dx=1e-3,
+              courant_factor=0.4, wavelength=8e-3, use_pallas=True,
+              pml=PmlConfig(size=(3, 3, 3)),
+              point_source=PointSourceConfig(
+                  enabled=True, component="Ez", position=(8, 8, 8)))
+    ds_sim = Simulation(SimConfig(dtype="float32x2", **kw))
+    assert ds_sim.step_kind == "pallas_packed_ds", ds_sim.step_kind
+    ds_sim.run()
+    f32_sim = Simulation(SimConfig(dtype="float32", **kw))
+    f32_sim.run()
+    for c in ("Ez", "Hx", "Hy"):
+        a = np.asarray(f32_sim.field(c), np.float64)
+        b = np.asarray(ds_sim.field(c), np.float64)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 1e-4, f"{c}: rel {rel:.2e}"
+
+
+@pytest.mark.slow
+def test_packed_ds_tfsf_parity():
+    _parity(1e-9, pml=PmlConfig(size=(3, 3, 3)),
+            tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                            angle_teta=30.0, angle_phi=40.0,
+                            angle_psi=15.0))
+
+
+@pytest.mark.slow
+def test_packed_ds_point_source_parity():
+    _parity(1e-9, pml=PmlConfig(size=(3, 3, 3)),
+            point_source=PointSourceConfig(enabled=True, component="Ez",
+                                           position=(8, 8, 8)))
+
+
+def test_packed_ds_fallbacks():
+    """Out-of-scope configs dispatch to jnp_ds, never silently degrade."""
+    # sharded topology
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True,
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(2, 1, 1))))
+    assert sim.step_kind == "jnp_ds", sim.step_kind
+    # Drude material (omega_p well inside the leapfrog stability bound)
+    omega = 2.0 * np.pi * 3e8 / BASE["wavelength"]
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True,
+        materials=MaterialsConfig(use_drude=True, eps_inf=1.0,
+                                  omega_p=0.05 * omega, gamma=0.0)))
+    assert sim.step_kind == "jnp_ds", sim.step_kind
