@@ -48,12 +48,14 @@ land in the surrounding XLA graph where the simplifier folds are real
 (ops/ds.py module docstring).
 
 Scope (else solver's jnp-ds step covers, sharded included): 3D,
-ds_fields, UNSHARDED topology, scalar material coefficients (no
-eps/mu grids), no Drude J/K, slab-fitting CPML on any pml axes, TFSF
-and point sources. Reference parity: the C++ double compute path of
-the reference's InternalScheme (SURVEY.md §2 FieldValue/InternalScheme
-rows) — this kernel is what makes the reference's accuracy class fast
-on TPU instead of merely available.
+ds_fields, UNSHARDED topology, slab-fitting CPML on any pml axes, TFSF
+and point sources, Drude J/K (uniform or grids), and material
+eps/mu grids — grid coefficients stream as per-tile operands (ca/cb/
+da/db as hi+lo pair streams, the ADE kj/bj/km/bm as plain f32, which
+is the jnp-ds accuracy posture). Reference parity: the C++ double
+compute path of the reference's InternalScheme (SURVEY.md §2
+FieldValue/InternalScheme rows) — this kernel is what makes the
+reference's accuracy class fast on TPU instead of merely available.
 """
 
 from __future__ import annotations
@@ -92,8 +94,6 @@ def eligible(static, mesh_axes=None) -> bool:
         return False
     if static.topology != (1, 1, 1):
         return False  # sharded float32x2: jnp-ds path (mesh-aware)
-    if static.use_drude or static.use_drude_m:
-        return False  # ADE currents: jnp-ds covers
     return True
 
 
@@ -250,8 +250,13 @@ def _x_slab_post_ds(static, family, arr, comps, src_slab_pairs, psx,
                         dh_pair = (dh_pair[0] * w, dh_pair[1] * w)
             cb = (coeffs[("cb_" if family == "E" else "db_") + c],
                   coeffs[("cb_" if family == "E" else "db_") + c + "_lo"])
-            add_lo = ds.mul_ff(*dl_pair, cb[0], cb[1])
-            add_hi = ds.mul_ff(*dh_pair, cb[0], cb[1])
+            if jnp.ndim(cb[0]) == 3:       # material grid: slab slices
+                cb_lo_s = (cb[0][:m], cb[1][:m])
+                cb_hi_s = (cb[0][n1 - m:], cb[1][n1 - m:])
+            else:
+                cb_lo_s = cb_hi_s = cb
+            add_lo = ds.mul_ff(*dl_pair, *cb_lo_s)
+            add_hi = ds.mul_ff(*dh_pair, *cb_hi_s)
             if family == "H":
                 add_lo = _neg_pair(add_lo)
                 add_hi = _neg_pair(add_hi)
@@ -362,7 +367,9 @@ def _apply_x_patch_h_ds(static, h_arr, h_comps, psh_stacks, rows_h,
                         dacc = w if s > 0 else _neg_pair(w)
                     sl = (slice(start, start + klen),
                           slice(None), slice(None))
-                fix = _neg_pair(ds.mul_ff(db[0], db[1], *dacc))
+                db_s = (db[0][sl], db[1][sl]) \
+                    if jnp.ndim(db[0]) == 3 else db
+                fix = _neg_pair(ds.mul_ff(db_s[0], db_s[1], *dacc))
                 h_arr = _pair_add_at(h_arr, jc, nh, sl, fix[0], fix[1])
     return h_arr, psh_stacks
 
@@ -387,14 +394,32 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     e_comps = list(mode.e_components)
     h_comps = list(mode.h_components)
     ne, nh = len(e_comps), len(h_comps)
-    for c in e_comps:
-        if np.ndim(np_coeffs[f"ca_{c}"]) == 3 \
-                or np.ndim(np_coeffs[f"cb_{c}"]) == 3:
-            return None  # material grids: jnp-ds covers
-    for c in h_comps:
-        if np.ndim(np_coeffs[f"da_{c}"]) == 3 \
-                or np.ndim(np_coeffs[f"db_{c}"]) == 3:
-            return None
+    drude = static.use_drude
+    drude_m = static.use_drude_m
+    # Material / Drude coefficient GRIDS stream as per-tile operands:
+    # ca/cb/da/db as hi+lo pair streams (the update multiplies in ds),
+    # kj/bj/km/bm as plain f32 (the ADE currents are deliberately
+    # plain-f32 sub-parts, solver._make_ds_step docstring).
+    pair_keys = [f"{p}_{c}" for c in e_comps for p in ("ca", "cb")] \
+        + [f"{p}_{c}" for c in h_comps for p in ("da", "db")]
+    plain_keys = ([f"{p}_{c}" for c in e_comps for p in ("kj", "bj")]
+                  if drude else []) \
+        + ([f"{p}_{c}" for c in h_comps for p in ("km", "bm")]
+           if drude_m else [])
+    coeff_is_array = {k: np.ndim(np_coeffs[k]) == 3
+                      for k in pair_keys + plain_keys}
+    arr_pair_e = [k for k in pair_keys
+                  if k.split("_")[0] in ("ca", "cb")
+                  and coeff_is_array[k]]
+    arr_pair_h = [k for k in pair_keys
+                  if k.split("_")[0] in ("da", "db")
+                  and coeff_is_array[k]]
+    arr_plain_e = [k for k in plain_keys
+                   if k.split("_")[0] in ("kj", "bj")
+                   and coeff_is_array[k]]
+    arr_plain_h = [k for k in plain_keys
+                   if k.split("_")[0] in ("km", "bm")
+                   and coeff_is_array[k]]
     interpret = jax.default_backend() not in ("tpu", "axon")
     setup = static.tfsf_setup
     ps = static.cfg.point_source
@@ -409,10 +434,6 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     rows_h = psi_rows(static, slabs, "H")
     psi_axes_e = sorted(rows_e)
     psi_axes_h = sorted(rows_h)
-
-    def cpair(key):
-        return (fdt(float(np_coeffs[key])),
-                fdt(float(np_coeffs[f"{key}_lo"])))
 
     # ---- static source records ------------------------------------------
     recs_e = _corr_records(static, "E")
@@ -453,12 +474,19 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             for a in axes_:
                 s = _stack_shape(a, 2 * len(rows[a]))
                 total += 2 * s[0] * t * s[2] * s[3] * 4
+        if drude:
+            total += 2 * ne * t * plane * 4     # J in + out
+        if drude_m:
+            total += 2 * nh * t * plane * 4     # K in + out
         for a in psi_axes_e + psi_axes_h:
             total += 6 * 2 * slabs[a] * 4       # profile packs
         total += 2 * k0e * plane * 4 + 2 * k0h * plane * 4
         total += 2 * (k1e + k1h) * t * n3 * 4
         total += 2 * (k2e + k2h) * t * n2 * 4
         total += (t + n2 + n3) * 4              # walls
+        total += (2 * (len(arr_pair_e) + len(arr_pair_h))
+                  + len(arr_plain_e) + len(arr_plain_h)) \
+            * t * plane * 4                     # coeff grid streams
         return total
 
     def _scratch_bytes(t: int) -> int:
@@ -491,6 +519,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         take(["e_in", "h_in"])
         take([f"psE{a}" for a in psi_axes_e])
         take([f"psH{a}" for a in psi_axes_h])
+        if drude:
+            take(["j_in"])
+        if drude_m:
+            take(["k_in"])
         take([f"prof_e_{a}" for a in psi_axes_e])
         take([f"prof_h_{a}" for a in psi_axes_h])
         if k0e:
@@ -506,9 +538,18 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         if k2h:
             take(["c2h"])
         take(["wall_x", "wall_y", "wall_z"])
+        for k in arr_pair_e:
+            take([f"ce_{k}", f"ce_{k}_lo"])
+        for k in arr_pair_h:
+            take([f"ch_{k}", f"ch_{k}_lo"])
+        take([f"cp_{k}" for k in arr_plain_e + arr_plain_h])
         take(["e_out", "h_out"])
         take([f"psE{a}_out" for a in psi_axes_e])
         take([f"psH{a}_out" for a in psi_axes_h])
+        if drude:
+            take(["j_out"])
+        if drude_m:
+            take(["k_out"])
         take(["se", "sh", "shh"])
 
         i = pl.program_id(0)
@@ -522,6 +563,23 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         el_v = [idx["e_in"][ne + j] for j in range(ne)]
         hh_v = [idx["h_in"][j] for j in range(nh)]
         hl_v = [idx["h_in"][nh + j] for j in range(nh)]
+
+        def cpair(key):
+            """ca/cb/da/db as (hi, lo): embedded scalars or streamed
+            pair operands (material grids)."""
+            if coeff_is_array[key]:
+                pref = "ce" if key.split("_")[0] in ("ca", "cb") \
+                    else "ch"
+                return (idx[f"{pref}_{key}"][:],
+                        idx[f"{pref}_{key}_lo"][:])
+            return (fdt(float(np_coeffs[key])),
+                    fdt(float(np_coeffs[f"{key}_lo"])))
+
+        def cplain(key):
+            """kj/bj/km/bm plain f32: embedded scalar or streamed grid."""
+            if coeff_is_array[key]:
+                return idx[f"cp_{key}"][:]
+            return fdt(float(np_coeffs[key]))
 
         def ds_diff(fp, sp):
             """(f - s) * (1/dx): the one EFT difference sequence, shared
@@ -645,8 +703,26 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                         term = dfa if s > 0 else _neg_pair(dfa)
                 acc = term if acc is None else ds.add_ff(*acc, *term)
             if k0e or k1e or k2e:
+                # TFSF records fold into the accumulator BEFORE the
+                # Drude subtraction, mirroring jnp-ds's summation order
+                # (_half_update applies corrections inside acc; the
+                # point-source pseudo-record rides here too, which
+                # swaps jnp-ds's J-then-psrc order — an O(eps^2)
+                # reordering on the rare drude+point-source combo)
                 acc = apply_corr(acc, jc, ge, "e", (k0e, k1e, k2e),
                                  lambda tp: i == tp)
+            if drude:
+                # ADE current, deliberately plain f32 (jnp-ds parity:
+                # solver's j_new = kj*J + bj*E_hi, subtracted from the
+                # accumulator with an exact add_f)
+                j_old = idx["j_in"][jc]
+                j_new = cplain(f"kj_{c}") * j_old \
+                    + cplain(f"bj_{c}") * eh_v[jc]
+
+                @pl.when(valid_a)
+                def _(jc=jc, j_new=j_new):
+                    idx["j_out"][jc] = j_new
+                acc = ds.add_f(*acc, -j_new)
             t1 = ds.mul_ff(eh_v[jc], el_v[jc], *cpair(f"ca_{c}"))
             t2 = ds.mul_ff(*acc, *cpair(f"cb_{c}"))
             eh_n, el_n = ds.add_ff(*t1, *t2)
@@ -708,8 +784,17 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                         term = dfa if s > 0 else _neg_pair(dfa)
                 acc = term if acc is None else ds.add_ff(*acc, *term)
             if k0h or k1h or k2h:
+                # before the K addition: jnp-ds's summation order
                 acc = apply_corr(acc, jc, gh, "h", (k0h, k1h, k2h),
                                  lambda tp: i - 1 == tp)
+            if drude_m:
+                # magnetic ADE current rides the lagged H phase (reads
+                # and writes tile i-1, H's own lag pattern)
+                k_old = idx["k_in"][jc]
+                k_new = cplain(f"km_{c}") * k_old \
+                    + cplain(f"bm_{c}") * sh_h[jc]
+                idx["k_out"][jc] = jnp.where(valid, k_new, k_old)
+                acc = ds.add_f(*acc, k_new)
             t1 = ds.mul_ff(sh_h[jc], sh_l[jc], *cpair(f"da_{c}"))
             t2 = ds.mul_ff(*acc, *cpair(f"db_{c}"))
             hh_n, hl_n = ds.sub_ff(*t1, *t2)
@@ -751,6 +836,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                  for a in psi_axes_e]
     in_specs += [stack_spec(2 * len(rows_h[a]), psi_last2(a), lag_imap)
                  for a in psi_axes_h]
+    if drude:
+        in_specs += [stack_spec(ne, (n2, n3), tile_imap)]     # J in
+    if drude_m:
+        in_specs += [stack_spec(nh, (n2, n3), lag_imap)]      # K in
     for a in psi_axes_e + psi_axes_h:
         s = [6, 1, 1, 1]
         s[1 + a] = 2 * slabs[a]
@@ -783,12 +872,31 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                  pl.BlockSpec((1, 1, n3), lambda i: (0, 0, 0),
                               memory_space=pltpu.VMEM)]
 
+    def coeff_spec(imap3):
+        return pl.BlockSpec((T, n2, n3), imap3,
+                            memory_space=pltpu.VMEM)
+
+    def tile3(i):
+        return (jnp.minimum(i, ntiles - 1), 0, 0)
+
+    def lag3(i):
+        return (jnp.maximum(i - 1, 0), 0, 0)
+
+    in_specs += [coeff_spec(tile3) for _ in arr_pair_e for _2 in (0, 1)]
+    in_specs += [coeff_spec(lag3) for _ in arr_pair_h for _2 in (0, 1)]
+    in_specs += [coeff_spec(tile3) for _ in arr_plain_e]
+    in_specs += [coeff_spec(lag3) for _ in arr_plain_h]
+
     out_specs = [stack_spec(2 * ne, (n2, n3), tile_imap),
                  stack_spec(2 * nh, (n2, n3), lag_imap)]
     out_specs += [stack_spec(2 * len(rows_e[a]), psi_last2(a), tile_imap)
                   for a in psi_axes_e]
     out_specs += [stack_spec(2 * len(rows_h[a]), psi_last2(a), lag_imap)
                   for a in psi_axes_h]
+    if drude:
+        out_specs += [stack_spec(ne, (n2, n3), tile_imap)]
+    if drude_m:
+        out_specs += [stack_spec(nh, (n2, n3), lag_imap)]
 
     out_shape = [jax.ShapeDtypeStruct((2 * ne, n1, n2, n3), np.float32),
                  jax.ShapeDtypeStruct((2 * nh, n1, n2, n3), np.float32)]
@@ -798,11 +906,25 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     out_shape += [jax.ShapeDtypeStruct(
         _stack_shape(a, 2 * len(rows_h[a])), np.float32)
         for a in psi_axes_h]
+    if drude:
+        out_shape += [jax.ShapeDtypeStruct((ne, n1, n2, n3),
+                                           np.float32)]
+    if drude_m:
+        out_shape += [jax.ShapeDtypeStruct((nh, n1, n2, n3),
+                                           np.float32)]
 
     n_psi = len(psi_axes_e) + len(psi_axes_h)
     aliases = {0: 0, 1: 1}
     for j in range(n_psi):
         aliases[2 + j] = 2 + j
+    k_in_idx = 2 + n_psi
+    if drude:
+        # J reads/writes its own tile; enters once -> donation-safe
+        aliases[k_in_idx] = k_in_idx
+        k_in_idx += 1
+    if drude_m:
+        # K follows H's lag pattern; enters once -> donation-safe
+        aliases[k_in_idx] = k_in_idx
 
     scratch = [pltpu.VMEM((2 * ne, T, n2, n3), jnp.float32),
                pltpu.VMEM((2 * nh, T, n2, n3), jnp.float32),
@@ -858,6 +980,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                          for k in state.get("psi_H", {})
                          if k.endswith("_x")}
             p["hxs"] = _h_slab_pairs(p["H"])
+        if drude:
+            p["J"] = jnp.stack([state["J"][c] for c in e_comps])
+        if drude_m:
+            p["K"] = jnp.stack([state["K"][c] for c in h_comps])
         if setup is not None:
             p["inc"] = state["inc"]
         return p
@@ -893,6 +1019,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             state["psi_H"] = psi_h
             state["lopsi_E"] = lo_e
             state["lopsi_H"] = lo_h
+        if drude:
+            state["J"] = {c: p["J"][j] for j, c in enumerate(e_comps)}
+        if drude_m:
+            state["K"] = {c: p["K"][j] for j, c in enumerate(h_comps)}
         if setup is not None:
             state["inc"] = p["inc"]
         return state
@@ -949,6 +1079,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         args = [pstate["E"], pstate["H"]]
         args += [pstate[f"psE{a}"] for a in psi_axes_e]
         args += [pstate[f"psH{a}"] for a in psi_axes_h]
+        if drude:
+            args += [pstate["J"]]
+        if drude_m:
+            args += [pstate["K"]]
 
         def _prof_pack(tag, a):
             v = jnp.stack(
@@ -979,6 +1113,9 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
 
         args += [_vec3(coeffs["wall_x"], 0), _vec3(coeffs["wall_y"], 1),
                  _vec3(coeffs["wall_z"], 2)]
+        for k in arr_pair_e + arr_pair_h:
+            args += [coeffs[k], coeffs[f"{k}_lo"]]
+        args += [coeffs[k] for k in arr_plain_e + arr_plain_h]
         outs = call(*args)
 
         p = 0
@@ -989,6 +1126,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         psh_stacks = {}
         for a in psi_axes_h:
             psh_stacks[a] = outs[p]; p += 1
+        if drude:
+            new_state["J"] = outs[p]; p += 1
+        if drude_m:
+            new_state["K"] = outs[p]; p += 1
 
         if x_pml:
             psxE = dict(pstate["psxE"])

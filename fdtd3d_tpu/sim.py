@@ -115,8 +115,8 @@ class Simulation:
             hint = ("likely causes: non-3D/complex/f64 config, a shard "
                     "too thin for the CPML slabs, use_pallas=False, or "
                     "a float32x2 config outside the packed-ds kernel's "
-                    "scope (sharded, Drude, material grids — see "
-                    "ops/pallas_packed_ds.py)")
+                    "scope (sharded topology, thin-grid full-length "
+                    "psi — see ops/pallas_packed_ds.py)")
             if cfg.use_pallas is None and backend not in ("tpu", "axon"):
                 # the most common cause: auto mode only engages on TPU
                 hint = (f"use_pallas=auto engages only on TPU and this "

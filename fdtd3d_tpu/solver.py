@@ -462,7 +462,7 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         # (ops/pallas_packed_ds.py) — same dispatch policy as the f32
         # kernels (use_pallas flag, TPU-or-interpret backend rule,
         # FDTD3D_NO_PACKED escape hatch); jnp-ds covers everything
-        # out of its scope (sharded, Drude, material grids, thin psi)
+        # out of its scope (sharded topology, thin-grid psi)
         import os as _os
         flag = static.cfg.use_pallas
         want = flag is not False and not _os.environ.get(

@@ -9,8 +9,10 @@ O(eps^2) per step, far below the mode's own error floor. Vacuum runs
 (no post-pass at all) must be BIT-EXACT: every in-kernel operation is
 the same EFT sequence jnp-ds traces.
 
-Out-of-scope configs (sharded, Drude, material grids) must fall back
-to jnp_ds rather than silently degrade.
+Out-of-scope configs (sharded topology) must fall back to jnp_ds
+rather than silently degrade; Drude (uniform or sphere) and material
+coefficient grids are IN scope (streamed operands) with their own
+parity tests below.
 
 In this CPU test env the kernel runs in interpret mode WITH the
 optimization barriers kept (module docstring: interpret-mode bodies
@@ -24,7 +26,8 @@ import numpy as np
 import pytest
 
 from fdtd3d_tpu.config import (MaterialsConfig, ParallelConfig, PmlConfig,
-                               PointSourceConfig, SimConfig, TfsfConfig)
+                               PointSourceConfig, SimConfig, SphereConfig,
+                               TfsfConfig)
 from fdtd3d_tpu.sim import Simulation
 
 BASE = dict(scheme="3D", size=(16, 16, 16), time_steps=6, dx=1e-3,
@@ -153,16 +156,43 @@ def test_packed_ds_point_source_parity():
 
 def test_packed_ds_fallbacks():
     """Out-of-scope configs dispatch to jnp_ds, never silently degrade."""
-    # sharded topology
+    # sharded topology: the packed-ds kernel is unsharded-only
     sim = Simulation(SimConfig(
         **BASE, use_pallas=True,
         parallel=ParallelConfig(topology="manual",
                                 manual_topology=(2, 1, 1))))
     assert sim.step_kind == "jnp_ds", sim.step_kind
-    # Drude material (omega_p well inside the leapfrog stability bound)
+
+
+def test_packed_ds_drude_parity():
+    """In-kernel plain-f32 ADE currents (uniform Drude e+m) vs jnp-ds.
+
+    Tolerance note: the ADE currents are DELIBERATELY plain f32 in ds
+    mode (solver._make_ds_step docstring), so a single hi-word ulp
+    difference between the two implementations feeds back through J/K
+    at f32-relative scale (~6e-8/step) — measured 1.7e-8 at 6 steps.
+    That is the mode's intrinsic ADE floor, far below the <=1e-6
+    accuracy bar; a real gating/indexing bug would be O(1)."""
     omega = 2.0 * np.pi * 3e8 / BASE["wavelength"]
-    sim = Simulation(SimConfig(
-        **BASE, use_pallas=True,
-        materials=MaterialsConfig(use_drude=True, eps_inf=1.0,
-                                  omega_p=0.05 * omega, gamma=0.0)))
-    assert sim.step_kind == "jnp_ds", sim.step_kind
+    j, p = _parity(1e-6, pml=PmlConfig(size=(3, 3, 3)),
+                   materials=MaterialsConfig(
+                       use_drude=True, eps_inf=1.0,
+                       omega_p=0.05 * omega, gamma=0.0,
+                       use_drude_m=True, mu_inf=1.0,
+                       omega_pm=0.05 * omega, gamma_m=0.0))
+    for grp in ("J", "K"):
+        for c in j.state[grp]:
+            a = np.asarray(j.state[grp][c])
+            b = np.asarray(p.state[grp][c])
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < 1e-5, f"{grp}/{c}: rel {rel:.2e}"
+
+
+def test_packed_ds_material_grid_parity():
+    """Streamed hi+lo coefficient grids (eps sphere) vs jnp-ds."""
+    _parity(1e-9, pml=PmlConfig(size=(3, 3, 3)),
+            materials=MaterialsConfig(
+                eps=1.0,
+                eps_sphere=SphereConfig(enabled=True, value=4.0,
+                                        center=(8.0, 8.0, 8.0),
+                                        radius=3.0)))
