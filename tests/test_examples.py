@@ -60,6 +60,17 @@ CASES = {
                       "--drude-sphere-radius", "6"],
         {"Ex": 4.4692e-02, "Ey": 9.9613e-03, "Ez": 1.3982e-02,
          "Hy": 1.2808e-04}),
+    # --use-pallas on: replays the packed-ds kernel (interpret mode
+    # here) — the CPU jnp-ds fallback's cold XLA compile of the EFT
+    # graph is minutes-slow (tests/test_float32x2.py docstring), while
+    # the kernel path compiles in seconds and is the path the example
+    # documents
+    "precision3D_float32x2.txt": (
+        ["--use-pallas", "on", "--same-size", "24", "--time-steps",
+         "40", "--pml-size", "4", "--tfsf-margin", "3",
+         "--norms-every", "40"],
+        {"Ex": 3.0504e-02, "Ey": 4.7151e-02, "Ez": 3.1139e-02,
+         "Hy": 1.0143e-04}),
 }
 
 RTOL = 5e-3

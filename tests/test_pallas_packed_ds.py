@@ -139,6 +139,34 @@ def test_packed_ds_point_source_vs_f32():
         assert rel < 1e-4, f"{c}: rel {rel:.2e}"
 
 
+def test_packed_ds_checkpoint_resume_bit_exact(tmp_path):
+    """Checkpoint/resume through the packed pair carry: the lo words,
+    pair psi state, and incident-line pairs must all round-trip — a
+    dropped lo word would silently demote the run to f32 accuracy."""
+    def mk():
+        return Simulation(SimConfig(
+            **{**BASE, "time_steps": 0}, use_pallas=True,
+            pml=PmlConfig(size=(3, 3, 3)),
+            tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                            angle_teta=30.0, angle_phi=40.0,
+                            angle_psi=15.0)))
+    ckpt = str(tmp_path / "ck.npz")
+    a = mk()
+    assert a.step_kind == "pallas_packed_ds"
+    a.advance(6)
+    a.checkpoint(ckpt)
+    a.advance(6)
+    b = mk()
+    b.restore(ckpt)
+    assert b.t == 6
+    b.advance(6)
+    for grp in ("E", "H", "loE", "loH", "lopsi_E", "lopsi_H", "inc"):
+        for c in a.state[grp]:
+            ref = np.asarray(a.state[grp][c])
+            got = np.asarray(b.state[grp][c])
+            assert np.array_equal(got, ref), f"{grp}/{c} diverged"
+
+
 @pytest.mark.slow
 def test_packed_ds_tfsf_parity():
     _parity(1e-9, pml=PmlConfig(size=(3, 3, 3)),
