@@ -47,12 +47,17 @@ interpret mode (CPU tests) the body keeps the barriers: there the ops
 land in the surrounding XLA graph where the simplifier folds are real
 (ops/ds.py module docstring).
 
-Scope (else solver's jnp-ds step covers, sharded included): 3D,
-ds_fields, UNSHARDED topology, slab-fitting CPML on any pml axes, TFSF
-and point sources, Drude J/K (uniform or grids), and material
-eps/mu grids — grid coefficients stream as per-tile operands (ca/cb/
-da/db as hi+lo pair streams, the ADE kj/bj/km/bm as plain f32, which
-is the jnp-ds accuracy posture). Reference parity: the C++ double
+Scope (else solver's jnp-ds step covers): 3D, ds_fields, slab-fitting
+CPML on any pml axes, TFSF and point sources, Drude J/K (uniform or
+grids), material eps/mu grids (streamed per-tile operands: ca/cb/
+da/db as hi+lo pair streams, the ADE kj/bj/km/bm as plain f32 — the
+jnp-ds accuracy posture), and SHARDED topologies (round 5): the E
+phase's lower-neighbor H pair planes ppermute in as stacked ghosts,
+the local hi-edge H fix runs post-kernel in pair arithmetic, and
+source records carry traced shard-local plane indices (SMEM vectors)
+with ownership folded into the terms as exact 0/1 masks. jnp-ds
+remains the fallback for thin-grid full-length psi and for a sharded
+axis without a mesh axis name. Reference parity: the C++ double
 compute path of the reference's InternalScheme (SURVEY.md §2
 FieldValue/InternalScheme rows) — this kernel is what makes the
 reference's accuracy class fast on TPU instead of merely available.
@@ -93,7 +98,15 @@ def eligible(static, mesh_axes=None) -> bool:
     if static.mode.name != "3D":
         return False
     if static.topology != (1, 1, 1):
-        return False  # sharded float32x2: jnp-ds path (mesh-aware)
+        # sharded topologies are in scope (round 5, mirroring the f32
+        # packed kernel): pair ghosts ppermute in, the hi-edge H fix
+        # runs in pair arithmetic, and source records carry traced
+        # shard-local plane indices — but only when every sharded axis
+        # has a mesh axis name to permute on
+        if not mesh_axes or any(
+                static.topology[a] > 1 and not mesh_axes.get(a)
+                for a in range(3)):
+            return False
     return True
 
 
@@ -174,7 +187,7 @@ def _x_slab_post_ds(static, family, arr, comps, src_slab_pairs, psx,
     tag = "e" if family == "E" else "h"
     k = len(comps)
     idx = {c: j for j, c in enumerate(comps)}
-    n1 = static.grid_shape[0]
+    n1 = arr.shape[1]              # shard-LOCAL x extent
 
     def prof(name):
         return (coeffs[f"pml_slab_{name}{tag}_x"],
@@ -278,14 +291,20 @@ def _x_slab_post_ds(static, family, arr, comps, src_slab_pairs, psx,
 
 
 def _apply_x_patch_h_ds(static, h_arr, h_comps, psh_stacks, rows_h,
-                        patches, coeffs, slabs, iv_pair):
+                        patches, coeffs, slabs, iv_pair,
+                        mesh_axes=None, mesh_shape=None):
     """Correct the kernel's pair-H for the x-slab E patches (ds port of
     pallas_fused.apply_patch_h_corrections restricted to the static
     axis-0 patches this path produces; the TFSF/point sources need no
     correction here — they were applied in-kernel before the H phase).
+    Shard-local throughout; on a sharded transverse axis the in-patch
+    forward diff's hi plane receives the upper shard's first patch
+    plane by ppermute (zeros arrive at the global edge), in pairs.
     """
     nh = len(h_comps)
-    n_x = static.grid_shape[0]
+    n_x = h_arr.shape[1]           # shard-LOCAL x extent
+    mesh_axes = mesh_axes or {}
+    mesh_shape = mesh_shape or {}
 
     def slab_f_pair(a, length):
         v = ds.add_ff(coeffs[f"pml_ikh_{AXES[a]}"],
@@ -330,6 +349,23 @@ def _apply_x_patch_h_ds(static, h_arr, h_comps, psh_stacks, rows_h,
                     pad = [(0, 0)] * 3
                     pad[a] = (0, 1)
                     shifted = _pad_pair(_cut_pair(delta, 1, n_a, a), pad)
+                    if mesh_axes.get(a):
+                        # sharded transverse axis: the local hi plane's
+                        # forward neighbor is the UPPER shard's first
+                        # patch plane (pair ppermute; zeros at the
+                        # global edge keep the PEC convention)
+                        name = mesh_axes[a]
+                        n_sh = mesh_shape[name]
+                        first = _cut_pair(delta, 0, 1, a)
+                        perm = [(r + 1, r) for r in range(n_sh - 1)]
+                        nxt = (lax.ppermute(first[0], name, perm),
+                               lax.ppermute(first[1], name, perm))
+                        hi_sl = [slice(None)] * 3
+                        hi_sl[a] = slice(n_a - 1, n_a)
+                        hi_sl = tuple(hi_sl)
+                        shifted = tuple(
+                            s.at[hi_sl].set(v)
+                            for s, v in zip(shifted, nxt))
                     w = _ds_sub_scale(shifted, delta, iv_pair)
                     if a in slabs and a in static.pml_axes:
                         f = slab_f_pair(a, n_a)
@@ -425,7 +461,12 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     ps = static.cfg.point_source
     x_pml = 0 in static.pml_axes
 
-    n1, n2, n3 = static.grid_shape
+    topo = static.topology
+    mesh_axes = mesh_axes or {}
+    mesh_shape = mesh_shape or {}
+    sharded_axes = tuple(a for a in range(3) if topo[a] > 1)
+    # all kernel dims are the per-shard LOCAL extents
+    n1, n2, n3 = (static.grid_shape[a] // topo[a] for a in range(3))
     iv_pair = ds.from_f64(1.0 / np.float64(static.dx))
     ivh, ivl = np.float32(iv_pair[0]), np.float32(iv_pair[1])
     fdt = jnp.float32
@@ -455,7 +496,16 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     k0e = len(ge[0])
     k1e, k2e = len(ge[1]), len(ge[2])
     k0h, k1h, k2h = len(gh[0]), len(gh[1]), len(gh[2])
-    # per-axis-group operand row for a record r within its group
+    n_rec_e = k0e + k1e + k2e
+    n_rec_h = k0h + k1h + k2h
+    # traced shard-local plane-index vectors ride only when a sharded
+    # axis exists (static planes cover the unsharded fast path)
+    need_cie = bool(sharded_axes) and n_rec_e > 0
+    need_cih = bool(sharded_axes) and n_rec_h > 0
+    # per-axis-group operand row for a record r within its group; the
+    # static plane p stays for the unsharded fast path, while sharded
+    # axes read the traced local index from the cie/cih SMEM vectors
+    # (group-major order: axis-0 rows, then axis-1, then axis-2)
     for g in (ge, gh):
         for a in (0, 1, 2):
             g[a] = [(i, jc, p) for i, (_r, jc, p) in enumerate(g[a])]
@@ -487,6 +537,11 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         total += (2 * (len(arr_pair_e) + len(arr_pair_h))
                   + len(arr_plain_e) + len(arr_plain_h)) \
             * t * plane * 4                     # coeff grid streams
+        if 0 in sharded_axes:
+            total += 2 * nh * plane * 4         # xgh pair plane
+        for a in sharded_axes:
+            if a != 0:                          # ygh: (2nh,T,1,n3)/(...,n2,1)
+                total += 2 * nh * t * (n3, n2)[a - 1] * 4
         return total
 
     def _scratch_bytes(t: int) -> int:
@@ -537,6 +592,13 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             take(["c1h"])
         if k2h:
             take(["c2h"])
+        if need_cie:
+            take(["cie"])          # traced local plane indices (SMEM)
+        if need_cih:
+            take(["cih"])
+        if 0 in sharded_axes:
+            take(["xgh"])          # x neighbor's last H pair plane
+        take([f"ygh{a}" for a in sharded_axes if a != 0])
         take(["wall_x", "wall_y", "wall_z"])
         for k in arr_pair_e:
             take([f"ce_{k}", f"ce_{k}_lo"])
@@ -586,15 +648,22 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             with the x-slab post-pass (bit-exactness contract)."""
             return _ds_sub_scale(fp, sp, (ivh, ivl))
 
-        def yz_shift(fp, a, backward):
+        def yz_shift(fp, a, backward, ghost=None):
+            # ghost feeds the BACKWARD (E-phase) branch only; the
+            # forward (H-phase) hi edge always uses the PEC zero and is
+            # fixed post-kernel on sharded axes
+            assert ghost is None or backward
             nloc = fp[0].shape[a]
-            zero = jnp.zeros_like(lax.slice_in_dim(fp[0], 0, 1, axis=a))
+            if ghost is None:
+                z = jnp.zeros_like(lax.slice_in_dim(fp[0], 0, 1, axis=a))
+                ghost = (z, z)
             if backward:
                 return tuple(jnp.concatenate(
-                    [zero, lax.slice_in_dim(f, 0, nloc - 1, axis=a)],
-                    axis=a) for f in fp)
+                    [g, lax.slice_in_dim(f, 0, nloc - 1, axis=a)],
+                    axis=a) for f, g in zip(fp, ghost))
+            z = jnp.zeros_like(lax.slice_in_dim(fp[0], 0, 1, axis=a))
             return tuple(jnp.concatenate(
-                [lax.slice_in_dim(f, 1, nloc, axis=a), zero], axis=a)
+                [lax.slice_in_dim(f, 1, nloc, axis=a), z], axis=a)
                 for f in fp)
 
         def slab_term_ds(dpair, psipair, tag, a, s, write):
@@ -631,8 +700,8 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
 
         def apply_corr(acc, jc, grp, suf, k_grp, gate_of):
             """Add this comp's source records into the accumulator pair
-            at their static planes (exact: add_ff with a zero operand
-            passes through)."""
+            at their planes (exact: add_ff with a zero operand passes
+            through)."""
             # Full-tile masked add: Mosaic lowers neither scatter nor
             # value-level dynamic_update_slice (both measured failing
             # on the real chip), so the thin plane term is broadcast
@@ -640,15 +709,26 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             # EXACT, because add_ff with a zero operand preserves the
             # pair's value (it only renormalizes the split). Costs one
             # full-tile add_ff (20 flops/cell) per record on the
-            # source-bearing components only.
+            # source-bearing components only. The plane index is the
+            # static python int on an unsharded axis and a traced
+            # shard-local index from the cie/cih SMEM vector on a
+            # sharded one (ownership was folded into the term — zeroed
+            # off-owner — so an arbitrary clipped index is harmless).
+            def rec_idx(axis, r, p):
+                if topo[axis] == 1:
+                    return p
+                off = {0: 0, 1: k_grp[0], 2: k_grp[0] + k_grp[1]}[axis]
+                return idx[f"ci{suf}"][off + r]
+
             ah, al = acc
             for (r, jj, p) in grp[0]:
                 if jj != jc:
                     continue
                 th = idx[f"c0{suf}"][r]
                 tl = idx[f"c0{suf}"][k_grp[0] + r]
+                ci = rec_idx(0, r, p)
                 rows = lax.broadcasted_iota(jnp.int32, ah.shape, 0)
-                m = (rows == (p % T)) & gate_of(p // T)
+                m = (rows == ci % T) & gate_of(ci // T)
                 zh = jnp.where(m, th, 0.0)
                 zl = jnp.where(m, tl, 0.0)
                 ah, al = ds.add_ff(ah, al, zh, zl)
@@ -659,9 +739,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                     ref = idx[f"c{a}{suf}"]
                     th = ref[r]
                     tl = ref[k_grp[a] + r]
+                    ci = rec_idx(a, r, p)
                     pos = lax.broadcasted_iota(jnp.int32, ah.shape, a)
-                    zh = jnp.where(pos == p, th, 0.0)
-                    zl = jnp.where(pos == p, tl, 0.0)
+                    zh = jnp.where(pos == ci, th, 0.0)
+                    zl = jnp.where(pos == ci, tl, 0.0)
                     ah, al = ds.add_ff(ah, al, zh, zl)
             return ah, al
 
@@ -673,10 +754,17 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             acc = None
             for (a, jd, s) in CURL_TERMS[component_axis(c)]:
                 if a == 0:
-                    gh_ = jnp.where(i > 0, idx["shh"][jd],
-                                    jnp.zeros_like(idx["shh"][jd]))
-                    gl_ = jnp.where(i > 0, idx["shh"][nh + jd],
-                                    jnp.zeros_like(idx["shh"][nh + jd]))
+                    # bwd halo: scratch carry for i > 0; at tile 0 the
+                    # x neighbor's ppermuted boundary H pair plane when
+                    # x is sharded (zeros at the global edge = PEC)
+                    if 0 in sharded_axes:
+                        eh_g = idx["xgh"][jd]
+                        el_g = idx["xgh"][nh + jd]
+                    else:
+                        eh_g = jnp.zeros_like(idx["shh"][jd])
+                        el_g = jnp.zeros_like(idx["shh"][nh + jd])
+                    gh_ = jnp.where(i > 0, idx["shh"][jd], eh_g)
+                    gl_ = jnp.where(i > 0, idx["shh"][nh + jd], el_g)
                     fh = jnp.concatenate([gh_, hh_v[jd]], axis=0)
                     fl = jnp.concatenate([gl_, hl_v[jd]], axis=0)
                     term = ds_diff((fh[1:], fl[1:]), (fh[:-1], fl[:-1]))
@@ -684,7 +772,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                         term = _neg_pair(term)
                 else:
                     fp = (hh_v[jd], hl_v[jd])
-                    dfa = ds_diff(fp, yz_shift(fp, a, backward=True))
+                    ghost = ((idx[f"ygh{a}"][jd], idx[f"ygh{a}"][nh + jd])
+                             if a in sharded_axes else None)
+                    dfa = ds_diff(fp, yz_shift(fp, a, backward=True,
+                                               ghost=ghost))
                     if a in slabs and a in static.pml_axes:
                         row = rows_e[a].index(c)
                         kk = len(rows_e[a])
@@ -862,6 +953,20 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                                   memory_space=pltpu.VMEM)]
     if k2h:
         in_specs += [pl.BlockSpec((2 * k2h, T, n2, 1), lag_imap,
+                                  memory_space=pltpu.VMEM)]
+    if need_cie:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    if need_cih:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    if 0 in sharded_axes:                                     # xgh
+        in_specs += [pl.BlockSpec((2 * nh, 1, n2, n3), pin_imap,
+                                  memory_space=pltpu.VMEM)]
+    for a in sharded_axes:                                    # ygh{a}
+        if a == 0:
+            continue
+        gs_ = [2 * nh, T, n2, n3]
+        gs_[1 + a] = 1
+        in_specs += [pl.BlockSpec(tuple(gs_), tile_imap,
                                   memory_space=pltpu.VMEM)]
     in_specs += [pl.BlockSpec((T, 1, 1),
                               lambda i: (jnp.minimum(i, ntiles - 1),
@@ -1047,15 +1152,34 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             s[a] = 1
             return tuple(s)
 
+        def loc_own(axis, plane):
+            """Shard-local index + ownership of a global plane (the
+            static python int passes through on an unsharded axis)."""
+            if topo[axis] == 1:
+                return plane, None
+            g0 = coeffs[f"g{AXES[axis]}"][0]
+            loc = jnp.int32(plane) - g0
+            nloc = (n1, n2, n3)[axis]
+            own = (loc >= 0) & (loc < nloc)
+            return jnp.clip(loc, 0, nloc - 1), own
+
         def stack_terms(recs, inc_for, with_psrc):
             out = {0: [], 1: [], 2: []}
+            locs = {0: [], 1: [], 2: []}
             for corr in recs:
                 # never None: _corr_records pre-filtered |pol| < 1e-14
                 # with the same projection record_term_ds uses
                 th, tl = tfsf_mod.record_term_ds(
                     corr, setup, coeffs, inc_for,
                     static.mode.active_axes, static.dx)
+                loc, own = loc_own(corr.axis, corr.plane)
+                if own is not None:
+                    # fold normal-axis ownership into the term (exact
+                    # 0/1) so the kernel's clipped index is harmless
+                    th = jnp.where(own, th, 0.0)
+                    tl = jnp.where(own, tl, 0.0)
                 out[corr.axis].append((th, tl))
+                locs[corr.axis].append(loc)
             stacks = {}
             for a in (0, 1, 2):
                 if not out[a] and not (a == 0 and with_psrc):
@@ -1069,12 +1193,25 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                     ah_, al_ = ds.from_f64(np.float64(ps.amplitude))
                     wh, wl = ds.mul_ff(wh, wl, jnp.float32(ah_),
                                        jnp.float32(al_))
+                    lx, ox = loc_own(0, ps.position[0])
+                    ly, oy = loc_own(1, ps.position[1])
+                    lz, oz = loc_own(2, ps.position[2])
+                    own = None
+                    for o in (ox, oy, oz):
+                        if o is not None:
+                            own = o if own is None else own & o
                     onehot = jnp.zeros((1, n2, n3), np.float32).at[
-                        0, ps.position[1], ps.position[2]].set(1.0)
+                        0, ly, lz].set(1.0)
+                    if own is not None:
+                        onehot = jnp.where(own, onehot, 0.0)
                     his.append(wh * onehot)
                     los.append(wl * onehot)
+                    locs[0].append(lx)
                 stacks[a] = jnp.stack(his + los)
-            return stacks
+            ivec = locs[0] + locs[1] + locs[2]
+            ivec = jnp.stack([jnp.asarray(v, jnp.int32)
+                              for v in ivec]) if ivec else None
+            return stacks, ivec
 
         args = [pstate["E"], pstate["H"]]
         args += [pstate[f"psE{a}"] for a in psi_axes_e]
@@ -1096,15 +1233,34 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
 
         args += [_prof_pack("e", a) for a in psi_axes_e]
         args += [_prof_pack("h", a) for a in psi_axes_h]
-        st_e = stack_terms(recs_e, inc_e, psrc) \
-            if (recs_e or psrc) else {}
-        st_h = stack_terms(recs_h, inc, False) if recs_h else {}
+        st_e, iv_e = stack_terms(recs_e, inc_e, psrc) \
+            if (recs_e or psrc) else ({}, None)
+        st_h, iv_h = stack_terms(recs_h, inc, False) \
+            if recs_h else ({}, None)
         for a, k in ((0, k0e), (1, k1e), (2, k2e)):
             if k:
                 args.append(st_e[a])
         for a, k in ((0, k0h), (1, k1h), (2, k2h)):
             if k:
                 args.append(st_h[a])
+        if need_cie:
+            args.append(iv_e)
+        if need_cih:
+            args.append(iv_h)
+
+        # E-phase halos: each shard needs its LOWER neighbor's boundary
+        # H pair plane along every sharded axis (backward diffs);
+        # ppermute delivers zeros at the global lo edge (PEC ghost).
+        # Hi and lo words ship together in the one stacked plane.
+        for a in sharded_axes:
+            name = mesh_axes[a]
+            n_sh = mesh_shape[name]
+            n_a = (n1, n2, n3)[a]
+            plane = lax.slice_in_dim(pstate["H"], n_a - 1, n_a,
+                                     axis=1 + a)
+            gh_ = lax.ppermute(plane, name,
+                               [(r, r + 1) for r in range(n_sh - 1)])
+            args.append(gh_)
 
         def _vec3(v, a):
             s = [1, 1, 1]
@@ -1131,6 +1287,41 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         if drude_m:
             new_state["K"] = outs[p]; p += 1
 
+        # ---- sharded hi-edge H fix (pair arithmetic) -----------------
+        # the kernel's forward diffs used the PEC zero ghost at each
+        # local hi edge; on a sharded axis the true neighbor plane is
+        # the UPPER neighbor's first new-E pair plane — ppermute it and
+        # add the missing -db*s*E_next/dx contribution on the one edge
+        # plane. Uses the PRE-x-slab-patch kernel output (the x-patch H
+        # correction handles patch effects separately), mirroring the
+        # f32 kernel. Interior-shard slab profiles are identity, so no
+        # psi term needs fixing; at the global hi edge ppermute
+        # delivers zeros and the fix vanishes (one SPMD program).
+        for a in sharded_axes:
+            name = mesh_axes[a]
+            n_sh = mesh_shape[name]
+            n_a = (n1, n2, n3)[a]
+            first = lax.slice_in_dim(new_E, 0, 1, axis=1 + a)
+            nxt = lax.ppermute(first, name,
+                               [(r + 1, r) for r in range(n_sh - 1)])
+            sl_hi = [slice(None)] * 3
+            sl_hi[a] = slice(n_a - 1, n_a)
+            sl_hi = tuple(sl_hi)
+            for jc, c in enumerate(h_comps):
+                for (aa, jd, sg) in CURL_TERMS[component_axis(c)]:
+                    if aa != a or ("E" + AXES[jd]) not in e_comps:
+                        continue
+                    db = (coeffs[f"db_{c}"], coeffs[f"db_{c}_lo"])
+                    if jnp.ndim(db[0]) == 3:
+                        db = (db[0][sl_hi], db[1][sl_hi])
+                    term = ds.mul_ff(nxt[jd], nxt[ne + jd],
+                                     iv_pair[0], iv_pair[1])
+                    if sg > 0:
+                        term = _neg_pair(term)  # dH = -db * s * E/dx
+                    fix = ds.mul_ff(db[0], db[1], *term)
+                    new_H = _pair_add_at(new_H, jc, nh, sl_hi,
+                                         fix[0], fix[1])
+
         if x_pml:
             psxE = dict(pstate["psxE"])
             psxH = dict(pstate["psxH"])
@@ -1141,7 +1332,8 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             if patches:
                 new_H, psh_stacks = _apply_x_patch_h_ds(
                     static, new_H, h_comps, psh_stacks, rows_h,
-                    patches, coeffs, slabs, iv_pair)
+                    patches, coeffs, slabs, iv_pair,
+                    mesh_axes, mesh_shape)
             e_slabs = {d: ((new_E[e_comps.index(d), :m0 + 1],
                             new_E[ne + e_comps.index(d), :m0 + 1]),
                            (new_E[e_comps.index(d), n1 - m0 - 1:],

@@ -113,10 +113,9 @@ class Simulation:
             from fdtd3d_tpu.ops import pallas3d
             backend = _jax.default_backend()
             hint = ("likely causes: non-3D/complex/f64 config, a shard "
-                    "too thin for the CPML slabs, use_pallas=False, or "
-                    "a float32x2 config outside the packed-ds kernel's "
-                    "scope (sharded topology, thin-grid full-length "
-                    "psi — see ops/pallas_packed_ds.py)")
+                    "too thin for the CPML slabs (full-length psi), or "
+                    "use_pallas=False — see ops/pallas_packed_ds.py "
+                    "for the float32x2 kernel's scope")
             if cfg.use_pallas is None and backend not in ("tpu", "axon"):
                 # the most common cause: auto mode only engages on TPU
                 hint = (f"use_pallas=auto engages only on TPU and this "

@@ -461,8 +461,9 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         # float32x2 hot path: the packed double-single Pallas kernel
         # (ops/pallas_packed_ds.py) — same dispatch policy as the f32
         # kernels (use_pallas flag, TPU-or-interpret backend rule,
-        # FDTD3D_NO_PACKED escape hatch); jnp-ds covers everything
-        # out of its scope (sharded topology, thin-grid psi)
+        # FDTD3D_NO_PACKED escape hatch); sharded topologies included
+        # (round 5) — jnp-ds covers what remains (thin-grid psi, or a
+        # sharded axis without a mesh axis name)
         import os as _os
         flag = static.cfg.use_pallas
         want = flag is not False and not _os.environ.get(

@@ -9,10 +9,11 @@ O(eps^2) per step, far below the mode's own error floor. Vacuum runs
 (no post-pass at all) must be BIT-EXACT: every in-kernel operation is
 the same EFT sequence jnp-ds traces.
 
-Out-of-scope configs (sharded topology) must fall back to jnp_ds
-rather than silently degrade; Drude (uniform or sphere) and material
-coefficient grids are IN scope (streamed operands) with their own
-parity tests below.
+Out-of-scope configs (a shard too thin for the CPML slabs) must fall
+back to jnp_ds rather than silently degrade; Drude (uniform or
+sphere), material coefficient grids (streamed operands), and sharded
+topologies (pair ghosts + traced source records) are IN scope with
+their own parity tests below.
 
 In this CPU test env the kernel runs in interpret mode WITH the
 optimization barriers kept (module docstring: interpret-mode bodies
@@ -184,12 +185,56 @@ def test_packed_ds_point_source_parity():
 
 def test_packed_ds_fallbacks():
     """Out-of-scope configs dispatch to jnp_ds, never silently degrade."""
-    # sharded topology: the packed-ds kernel is unsharded-only
+    # a shard too thin for the CPML slabs (x-local 12 vs 2*(5+1)):
+    # thin-grid full-length psi is jnp-ds territory
     sim = Simulation(SimConfig(
-        **BASE, use_pallas=True,
+        **{**BASE, "size": (24, 24, 24)}, use_pallas=True,
+        pml=PmlConfig(size=(5, 5, 5)),
         parallel=ParallelConfig(topology="manual",
                                 manual_topology=(2, 1, 1))))
     assert sim.step_kind == "jnp_ds", sim.step_kind
+
+
+_SHARD_KW = dict(pml=PmlConfig(size=(2, 2, 2)),
+                 tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2),
+                                 angle_teta=30.0, angle_phi=40.0,
+                                 angle_psi=15.0),
+                 point_source=PointSourceConfig(enabled=True,
+                                                component="Ez",
+                                                position=(5, 9, 7)))
+
+
+@pytest.fixture(scope="module")
+def _unsharded_ds_fields():
+    """Reference: the UNSHARDED packed-ds kernel (itself held to jnp-ds
+    parity by the tests above; the jnp-ds+point-source reference's cold
+    XLA:CPU compile is minutes-slow — test_float32x2.py docstring)."""
+    sim = Simulation(SimConfig(**BASE, use_pallas=True, **_SHARD_KW))
+    assert sim.step_kind == "pallas_packed_ds"
+    sim.run()
+    return sim.fields()
+
+
+@pytest.mark.parametrize("topo", [(2, 1, 1), (1, 2, 2), (2, 2, 2)])
+def test_packed_ds_sharded_parity(topo, _unsharded_ds_fields):
+    """Sharded packed-ds (pair ghosts, hi-edge pair fix, traced source
+    records) vs the unsharded kernel — full sources on.
+
+    The ghost arithmetic is the same EFT sequence on the same values
+    (ppermute only moves planes), so parity holds at the pair level
+    like the unsharded CPML case."""
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True,
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=topo), **_SHARD_KW))
+    assert sim.mesh is not None
+    assert sim.step_kind == "pallas_packed_ds", sim.step_kind
+    sim.run()
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        a = np.asarray(_unsharded_ds_fields[c], np.float32)
+        b = np.asarray(sim.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 1e-9, f"{c}: rel {rel:.2e}"
 
 
 def test_packed_ds_drude_parity():
