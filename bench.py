@@ -336,8 +336,8 @@ def run_measurement() -> None:
             "f32": "~6e-6 rel-err vs f64 @1000 steps",
             "bf16": "~1e-1 rel-err vs f64 @1000 steps"
                     " (throughput mode only)",
-            "float32x2": "<=2e-7 rel-err vs f64 @600 steps"
-                         " (--dtype float32x2, jnp path)",
+            "float32x2": "6.7e-8 rel-err vs f64 @1000 steps"
+                         " (--dtype float32x2, pallas_packed_ds)",
         },
     }
     if n <= 256 and on_tpu:
