@@ -280,15 +280,18 @@ def run_measurement() -> None:
                 f32_640 = measure(640, 60, use_pallas=True)
                 if f32_640 > pallas_mc:
                     pallas_mc, n = f32_640, 640
-            except Exception:
-                pass
+            except Exception as e:
+                print(f"stage3 f32 640 failed: {e!r:.300}",
+                      file=sys.stderr, flush=True)
         for bn in ((768, 512) if n >= 512 else (n,)):
             try:
                 bf16_mc = measure(bn, 90 if bn == 512 else 60,
                                   use_pallas=True, dtype="bfloat16")
                 bf16_n = bn
                 break
-            except Exception:
+            except Exception as e:
+                print(f"stage3 bf16 {bn} failed: {e!r:.300}",
+                      file=sys.stderr, flush=True)
                 continue
     # Stage 4: float32x2 on the packed-ds kernel (round 5) — the
     # accuracy mode's throughput (96 B/cell pair traffic + ~10x EFT
@@ -298,14 +301,19 @@ def run_measurement() -> None:
     ds_mc = 0.0
     ds_n = 0
     if on_tpu and pallas_mc >= GATE_MCELLS_512:
-        for dn in (448, 384, 256):
+        # 384^3 leads: measured fastest (1615-1622 Mcells/s vs 1385 at
+        # 448^3 — the ds kernel partially VPU-binds, so the bigger
+        # grid's overhead amortization no longer wins)
+        for dn in (384, 448, 256):
             try:
                 ds_mc = measure(dn, 60, use_pallas=True,
                                 dtype="float32x2",
                                 require_kind="pallas_packed_ds")
                 ds_n = dn
                 break
-            except Exception:
+            except Exception as e:
+                print(f"stage4 float32x2 {dn} failed: {e!r:.300}",
+                      file=sys.stderr, flush=True)
                 continue
     mcells = max(jnp_mc, pallas_mc, bf16_mc)
     best = _maybe_update_best(pallas_mc, jnp_mc, bf16_mc,
@@ -328,10 +336,13 @@ def run_measurement() -> None:
         "float32x2_n": ds_n,
         "hbm_probe_gbps": gbps,
         "platform": platform,
-        # Per-dtype accuracy class (measured frontier, BASELINE.md):
-        # the headline bf16 number is a THROUGHPUT mode — it fails the
-        # repo's own <=1e-6 accuracy bar; quote the f32 number next to
-        # it wherever the headline is used (VERDICT r4 weak item 2).
+        # Per-dtype accuracy class: the RECORDED frontier measurements
+        # (BASELINE.md), not re-measured in this window — the headline
+        # bf16 number is a THROUGHPUT mode that fails the repo's own
+        # <=1e-6 accuracy bar; quote the f32 number next to it
+        # wherever the headline is used (VERDICT r4 weak item 2).
+        "accuracy_class_note": "recorded frontier classes (BASELINE.md),"
+                               " not re-measured per run",
         "accuracy_class": {
             "f32": "~6e-6 rel-err vs f64 @1000 steps",
             "bf16": "~1e-1 rel-err vs f64 @1000 steps"
@@ -377,6 +388,12 @@ def main() -> None:
             last_err = f"attempt {attempt}: timeout {ATTEMPT_TIMEOUT_S}s"
             continue
         if proc.returncode == 0:
+            # surface the child's stage-failure diagnostics (stage3/
+            # stage4 degrade gracefully to 0.0 in the JSON — without
+            # this the reason never reaches the operator)
+            for ln in (proc.stderr or "").splitlines():
+                if "failed" in ln:
+                    print(ln, file=sys.stderr, flush=True)
             for line in proc.stdout.splitlines():
                 line = line.strip()
                 if line.startswith("{"):
