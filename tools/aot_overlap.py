@@ -25,7 +25,15 @@ inside the windows, gaps up to 88 scheduled instructions. The TPU
 schedule demonstrably straddles interior compute across every halo
 exchange.
 
+--dtype float32x2 compiles the packed-ds kernel's executable instead
+(use --n 64: this tool compiles the raw chunk runner without
+Simulation's VMEM fallback ladder, and the 128^3 pair-operand tile
+exceeds one chip's VMEM). Measured 2026-07-31: pallas_packed_ds,
+0 synchronous, 12 async pairs (4 extra: the lo-word ghost planes),
+11/12 windows with compute inside, 940 heavy ops total.
+
 Usage: python tools/aot_overlap.py [--n 128] [--topo v5e:2x2]
+       [--dtype float32|float32x2]
 """
 
 import argparse
@@ -40,7 +48,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def build_compiled(n: int, topo_name: str):
+def build_compiled(n: int, topo_name: str, dtype: str = "float32"):
     import numpy as np
 
     import jax
@@ -59,7 +67,7 @@ def build_compiled(n: int, topo_name: str):
     topo3 = (1, 2, len(devs) // 2)
 
     cfg = SimConfig(scheme="3D", size=(n, n, n), time_steps=8, dx=1e-3,
-                    courant_factor=0.5, wavelength=32e-3,
+                    courant_factor=0.5, wavelength=32e-3, dtype=dtype,
                     pml=PmlConfig(size=(8, 8, 8)))
     st = dataclasses.replace(build_static(cfg), topology=topo3)
     mesh_axes = pmesh.mesh_axis_map(topo3)
@@ -67,6 +75,13 @@ def build_compiled(n: int, topo_name: str):
     coeffs_np = build_coeffs(st)
     state_shapes = jax.eval_shape(lambda: init_state(st))
     runner = make_chunk_runner(st, mesh_axes, mesh_shape)
+    want = "pallas_packed_ds" if dtype == "float32x2" else "pallas_packed"
+    if runner.kind != want:
+        raise SystemExit(
+            f"step_kind {runner.kind!r}, wanted {want!r} — the overlap "
+            f"numbers would not measure the packed kernel this tool "
+            f"exists to analyze (non-TPU default backend, or an "
+            f"out-of-scope config)")
     packed = getattr(runner, "packed", False)
     shapes = jax.eval_shape(runner.pack, state_shapes) if packed \
         else state_shapes
@@ -149,16 +164,27 @@ def analyze(txt: str):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=128)
+    ap.add_argument("--n", type=int, default=None,
+                    help="global grid edge (default 128; 64 for "
+                         "float32x2, whose 128^3 pair-operand tile "
+                         "exceeds one chip's VMEM — this tool compiles "
+                         "the raw runner, no VMEM fallback ladder)")
     ap.add_argument("--topo", default="v5e:2x2")
     ap.add_argument("--dump", default="")
+    ap.add_argument("--dtype", default="float32",
+                    choices=("float32", "float32x2"),
+                    help="field storage dtype; float32x2 compiles the "
+                         "packed-ds kernel's 4-chip executable")
     args = ap.parse_args()
-    kind, compiled = build_compiled(args.n, args.topo)
+    if args.n is None:
+        args.n = 64 if args.dtype == "float32x2" else 128
+    kind, compiled = build_compiled(args.n, args.topo, args.dtype)
     txt = compiled.as_text()
     if args.dump:
         with open(args.dump, "w") as f:
             f.write(txt)
-    out = {"topology": args.topo, "n": args.n, "step_kind": kind}
+    out = {"topology": args.topo, "n": args.n, "dtype": args.dtype,
+           "step_kind": kind}
     out.update(analyze(txt))
     print(json.dumps(out), flush=True)
 
