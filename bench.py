@@ -277,7 +277,7 @@ def run_measurement() -> None:
     if on_tpu and pallas_mc >= GATE_MCELLS_512:
         if n >= 512:
             try:
-                f32_640 = measure(640, 60, use_pallas=True)
+                f32_640 = measure(640, 120, use_pallas=True)
                 if f32_640 > pallas_mc:
                     pallas_mc, n = f32_640, 640
             except Exception as e:
@@ -285,7 +285,11 @@ def run_measurement() -> None:
                       file=sys.stderr, flush=True)
         for bn in ((768, 512) if n >= 512 else (n,)):
             try:
-                bf16_mc = measure(bn, 90 if bn == 512 else 60,
+                # 120-step chunks at the headline size: measured
+                # same-window 768^3 bf16 13849 (120) vs 13488 (60) —
+                # the fixed ~180 ms round-trip tax is still ~3 ms/step
+                # at 60; session-3 close-out, 2026-07-31
+                bf16_mc = measure(bn, 90 if bn == 512 else 120,
                                   use_pallas=True, dtype="bfloat16")
                 bf16_n = bn
                 break
