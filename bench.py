@@ -139,6 +139,111 @@ def probe_hbm_gbps() -> float:
     return 2 * passes * n * 4 / (best - rb) / 1e9  # read + write
 
 
+def accuracy_spotcheck(n: int = 32, steps: int = 60) -> dict:
+    """Fast (<=100-step) per-dtype accuracy-class guard (VERDICT
+    weak-8): a sourceless CPML run from an f32-rounded Gaussian Ez
+    blob, each dtype vs an f64 reference in THIS window, so a numerics
+    regression cannot ship the recorded accuracy classes next to new
+    throughput numbers. Bounds are ~10x the CPU-measured values at
+    32^3/60 steps (f32 1.4e-7, bf16 6e-3, float32x2 4.7e-8 — the ds
+    short-horizon floor is the mode's documented plain-f32 sub-parts,
+    not accumulation); a real regression moves a dtype by orders of
+    magnitude, not 10x. Sourceless on purpose: the float32x2 jnp
+    reference path stalls on XLA:CPU only with a point source
+    (tests/test_pallas_packed_ds.py), and sources add compile time.
+
+    The f64 reference may be unavailable on some TPU backends; then
+    the float32x2 path itself becomes the reference (its own row is
+    dropped — it is trivially zero) and the fallback is recorded.
+    """
+    import numpy as np
+
+    from fdtd3d_tpu.config import PmlConfig, SimConfig
+    from fdtd3d_tpu.sim import Simulation
+
+    def run(dtype):
+        cfg = SimConfig(scheme="3D", size=(n, n, n), time_steps=steps,
+                        dx=1e-3, courant_factor=0.5, wavelength=8e-3,
+                        dtype=dtype, pml=PmlConfig(size=(4, 4, 4)))
+        sim = Simulation(cfg)
+        ax = (np.arange(n) - (n - 1) / 2.0) / 3.0
+        r2 = (ax[:, None, None] ** 2 + ax[None, :, None] ** 2
+              + ax[None, None, :] ** 2)
+        sim.set_field("Ez", np.exp(-r2).astype(np.float32))
+        sim.run()
+        kind = sim.step_kind
+        # np.array (a COPY, never a zero-copy view): the snapshot must
+        # not alias a device buffer a later sim's run could recycle
+        return np.array(sim.field("Ez"), np.float64), kind
+
+    out = {"grid": f"{n}^3", "steps": steps}
+    try:
+        ref, _ = run("float64")
+        ref_dtype = "float64"
+        out["reference"] = "float64"
+    except Exception as exc:
+        ref, _ = run("float32x2")
+        ref_dtype = "float32x2"
+        out["reference"] = f"float32x2 (float64 unavailable: " \
+                           f"{str(exc)[:80]})"
+    out["reference_dtype"] = ref_dtype
+    scale = float(np.abs(ref).max())
+    bounds = {"float32": 2e-6, "bfloat16": 0.3, "float32x2": 5e-7}
+    ok = True
+    for dtype, bound in bounds.items():
+        if ref_dtype == dtype:
+            continue  # self-reference row is trivially zero
+        try:
+            got, kind = run(dtype)
+            rel = float(np.abs(got - ref).max()) / (scale + 1e-300)
+            row = {"rel_err": float(f"{rel:.3e}"), "bound": bound,
+                   "step_kind": kind, "ok": bool(rel < bound)}
+        except Exception as exc:
+            row = {"error": str(exc)[:200], "ok": False}
+        ok = ok and row["ok"]
+        out[dtype] = row
+    out["ok"] = ok
+    return out
+
+
+# f32 north-star provenance (round 6): the goal is 1e4 Mcells/s on the
+# accuracy-bearing f32 packed path. A miss must carry its reason in the
+# artifact: either the same-window HBM roof (probe GB/s / 48 B per
+# cell) is itself below the goal AND the kernel runs at >= 85% of that
+# probe (the window, not the kernel, is the limit), or the record says
+# MISSED outright — never a silent gap next to a bf16 headline.
+F32_GOAL_MCELLS = 1e4
+F32_BYTES_PER_CELL = 48.0
+
+
+def f32_goal_record(pallas_mc: float, gbps: float) -> dict:
+    rec = {"goal_mcells": F32_GOAL_MCELLS,
+           "f32_mcells": round(pallas_mc, 1)}
+    if pallas_mc >= F32_GOAL_MCELLS:
+        rec["status"] = "MET"
+        return rec
+    kernel_gbps = pallas_mc * 1e6 * F32_BYTES_PER_CELL / 1e9
+    rec["kernel_gbps_at_48B"] = round(kernel_gbps, 1)
+    if gbps and gbps > 0:
+        roof_mcells = gbps * 1e9 / F32_BYTES_PER_CELL / 1e6
+        frac = kernel_gbps / gbps
+        rec["hbm_probe_gbps"] = gbps
+        rec["hbm_roof_mcells_at_48B"] = round(roof_mcells, 1)
+        rec["kernel_frac_of_probe"] = round(frac, 3)
+        if roof_mcells < F32_GOAL_MCELLS and frac >= 0.85:
+            rec["status"] = "HBM-ROOF-PROOF"
+            rec["note"] = ("this window's HBM roof x 48 B/cell is "
+                           "below the goal and the kernel runs at "
+                           ">=85% of the same-window probe: the "
+                           "window, not the kernel, is the limit")
+            return rec
+    rec["status"] = "MISSED"
+    rec["note"] = ("no roof proof: probe unreliable, kernel below "
+                   "85% of it, or the roof clears 1e4 — re-measure "
+                   "in a healthy window")
+    return rec
+
+
 BEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_BEST.json")
 
@@ -199,18 +304,23 @@ def run_measurement() -> None:
     """Child-process entry: measure both paths, print the one JSON line."""
     import jax
 
+    platform = jax.default_backend()
+    on_tpu = platform in ("tpu", "axon")
     try:
         # 512^3 Mosaic+XLA compiles take minutes; let repeat runs (the
         # driver's end-of-round invocation after this session already
-        # compiled once) hit the persistent cache instead.
+        # compiled once) hit the persistent cache instead. Safe on the
+        # CPU fallback lane too (the stage-5 spotcheck's float32x2
+        # graph is a minutes-long XLA:CPU compile) because Simulation
+        # donates the scan carry on TPU backends only — the cache +
+        # donation combination is the XLA:CPU corruption hazard
+        # (tests/conftest.py, round 6).
         jax.config.update("jax_compilation_cache_dir",
                           os.path.expanduser("~/.cache/jax_fdtd3d"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 5.0)
     except Exception:
         pass
-
-    platform = jax.default_backend()
-    on_tpu = platform in ("tpu", "axon")
     device_kind = jax.devices()[0].device_kind
     try:
         gbps = round(probe_hbm_gbps(), 1) if on_tpu else 0.0
@@ -319,6 +429,15 @@ def run_measurement() -> None:
                 print(f"stage4 float32x2 {dn} failed: {e!r:.300}",
                       file=sys.stderr, flush=True)
                 continue
+    # Stage 5: accuracy spot-check (<=100 steps, VERDICT weak-8) — runs
+    # on every backend; a failed class withholds that dtype's recorded
+    # accuracy string below so stale classes cannot ship next to fresh
+    # throughput numbers. Runs LAST: the f64 reference flips
+    # jax_enable_x64 globally, which must not touch the timed stages.
+    try:
+        spot = accuracy_spotcheck()
+    except Exception as exc:
+        spot = {"error": str(exc)[:300], "ok": False}
     mcells = max(jnp_mc, pallas_mc, bf16_mc)
     best = _maybe_update_best(pallas_mc, jnp_mc, bf16_mc,
                               bf16_n if (bf16_mc >= pallas_mc and bf16_n)
@@ -341,12 +460,15 @@ def run_measurement() -> None:
         "hbm_probe_gbps": gbps,
         "platform": platform,
         # Per-dtype accuracy class: the RECORDED frontier measurements
-        # (BASELINE.md), not re-measured in this window — the headline
-        # bf16 number is a THROUGHPUT mode that fails the repo's own
-        # <=1e-6 accuracy bar; quote the f32 number next to it
-        # wherever the headline is used (VERDICT r4 weak item 2).
+        # (BASELINE.md) — the long-horizon classes are not re-measured
+        # per run, but the <=100-step spot-check above GUARDS them: a
+        # dtype whose spot error breaks its class ceiling has its
+        # recorded string withheld (VERDICT weak-8). The headline bf16
+        # number is a THROUGHPUT mode that fails the repo's own <=1e-6
+        # accuracy bar; quote the f32 number next to it wherever the
+        # headline is used (VERDICT r4 weak item 2).
         "accuracy_class_note": "recorded frontier classes (BASELINE.md),"
-                               " not re-measured per run",
+                               " guarded by accuracy_spotcheck",
         "accuracy_class": {
             "f32": "~6e-6 rel-err vs f64 @1000 steps",
             "bf16": "~1e-1 rel-err vs f64 @1000 steps"
@@ -354,7 +476,34 @@ def run_measurement() -> None:
             "float32x2": "6.7e-8 rel-err vs f64 @1000 steps"
                          " (--dtype float32x2, pallas_packed_ds)",
         },
+        "accuracy_spotcheck": spot,
+        # f32 north-star provenance: MET / HBM-ROOF-PROOF / MISSED —
+        # never a silent miss (only meaningful measured on TPU)
+        "f32_goal": f32_goal_record(pallas_mc, gbps) if on_tpu else
+                    {"status": "NOT-MEASURED", "note": "no TPU backend"},
     }
+    ref_dtype = spot.get("reference_dtype")
+    if ref_dtype and ref_dtype != "float64":
+        # the fallback reference dtype could not be verified against
+        # itself: label its class rather than claiming it was guarded
+        key = {"float32": "f32", "bfloat16": "bf16"}.get(ref_dtype,
+                                                         ref_dtype)
+        out["accuracy_class"][key] += \
+            " (NOT re-verified this window: served as the spotcheck" \
+            " reference, float64 unavailable)"
+    if not spot.get("ok"):
+        for dt_key, spot_key in (("f32", "float32"),
+                                 ("bf16", "bfloat16"),
+                                 ("float32x2", "float32x2")):
+            if spot_key == ref_dtype:
+                continue  # intentionally-absent self-reference row
+            row = spot.get(spot_key)
+            # a missing row otherwise means the spotcheck died before
+            # measuring that dtype: withhold those classes too — an
+            # unmeasured guard guards nothing
+            if row is None or not row.get("ok"):
+                out["accuracy_class"][dt_key] = \
+                    "WITHHELD: accuracy_spotcheck failed this window"
     if n <= 256 and on_tpu:
         # 256^3 timings through the tunnel are readback-dominated:
         # kernel RANKING at this size is noise (BASELINE.md round-4

@@ -75,6 +75,12 @@ from fdtd3d_tpu.ops.sources import waveform
 
 AXES = "xyz"
 
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams between
+# releases; take whichever this jax exposes (shared by every kernel
+# module) so the kernels run on both sides of the rename.
+COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 
 def eligible(static, mesh_axes=None) -> bool:
     """True when the fused kernels cover this configuration.
@@ -504,7 +510,7 @@ def make_family_kernel(static, np_coeffs, family: str, local_shape,
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shape),
         input_output_aliases=aliases,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )
@@ -885,7 +891,7 @@ def plane_corrections(field: str, comp: str, setup, coeffs, inc,
         else:
             val = tfsf_mod._interp_line(inc["Hinc"], zeta - 0.5)
             pol = setup.hhat[component_axis(corr.src)]
-        if abs(pol) < 1e-14:
+        if abs(pol) < tfsf_mod.POL_EPS:
             continue
         gate = None
         m_off = tfsf_mod.YEE_OFFSETS[corr.mask_comp]

@@ -38,7 +38,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from fdtd3d_tpu.layout import CURL_TERMS, component_axis
-from fdtd3d_tpu.ops.pallas3d import _VMEM_LIMIT, _pick_tile
+from fdtd3d_tpu.ops.pallas3d import (COMPILER_PARAMS, _VMEM_LIMIT,
+                                     _pick_tile)
 
 AXES = "xyz"
 
@@ -707,7 +708,7 @@ def make_fused_eh_step(static, mesh_axes=None, mesh_shape=None):
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shape),
         input_output_aliases=aliases,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )

@@ -35,13 +35,39 @@ last block naturally, while phase A's tile-indexed operands pin to
 their final block with writes masked (free under Mosaic's
 revisiting semantics). A jnp post-pass version of this was tried and
 reverted: XLA gave the psi stacks transposed layouts and inserted a
-full stacked-array copy per step (+24 B/cell). Post-kernel E
-modifications (x-slab CPML deltas, TFSF faces, point source) are the
-same thin patches as the fused kernel, applied through
-``pallas3d.PackedView`` scatter-adds so the packed arrays are never
-re-materialized; the kernel's H — computed from pre-patch E — is
-corrected by ``pallas_fused.apply_patch_h_corrections`` over the same
-views.
+full stacked-array copy per step (+24 B/cell).
+
+**Fused x-slab CPML (round 6).** The x-axis slab psi recursion runs
+IN-KERNEL whenever no source patch can touch the x slabs (no sources,
+or every source inside the CPML identity region — ``_sources_interior``,
+always true for standard margins; this includes every sharded config
+the kernel admits). The compact x psi rides as a TILE-ALIGNED stack
+``(k, S, n2, n3)``: storage plane == field plane for the first L =
+ceil(m/T) tiles and field plane − (ntiles − 2L)·T for the last L
+(S = 2·L·T; degenerates to full length on grids with < 2L tiles).
+Interior tiles PIN their block index to the last lo block — same index
+on consecutive iterations means Mosaic keeps the VMEM window, so the
+x psi costs traffic only on the 2L slab tiles — and read full-length
+per-plane b/c/ik profiles that are exactly (0, 0, 1) outside the
+absorber, making the recursion a provable no-op there (psi' = 0·psi +
+0·dfa, delta = (1−1)·dfa + 0) regardless of the pinned block's stale
+values; writes are masked to slab tiles. The E phase consumes the
+backward x-diff it already computes (scratch halo included), the
+lagged H phase the forward diff over fully-corrected new-E scratch —
+so the old E-side post-pass, the H-side post-pass, AND the ``hxs``
+boundary-plane carry all disappear: a CPML step is ONE dispatch.
+Under sharding the identity-profile argument covers the shard edges
+exactly like y/z: an interior shard's slab profiles are identity, so
+the zero-ghost hi-edge diff feeds only no-op recursions and the thin
+post-kernel hi-edge fix stays plain curl.
+
+Non-interior UNSHARDED sources (a point source inside the absorber)
+keep the legacy path: post-kernel E modifications (x-slab CPML deltas,
+TFSF faces, point source) applied as thin patches through
+``pallas3d.PackedView`` scatter-adds, the kernel's H — computed from
+pre-patch E — corrected by ``pallas_fused.apply_patch_h_corrections``,
+and the E-side post-pass reading the previous step's H boundary planes
+from the ``hxs`` carry.
 
 Scope (everything else falls back to ops/pallas_fused.py /
 ops/pallas3d.py / solver.py): 3D, real f32/bf16 storage, slab-fitting
@@ -63,8 +89,11 @@ traffic; only compensated+magnetic-Drude falls back (K residuals are
 not Kahan-treated).
 
 Compensated-mode caveat: the in-kernel updates carry the full Kahan +
-double-single-coefficient treatment, but the thin post-kernel patches
-(x-slab CPML deltas, TFSF faces, point source, H corrections) apply in
+double-single-coefficient treatment (the fused x-slab delta now rides
+INSIDE it, folding into the accumulator before the ca/cb multiply like
+the y/z slabs), but the thin post-kernel patches
+(TFSF faces, point source, H corrections — plus the x-slab deltas on
+the legacy non-interior-source path) apply in
 plain f32 and do not touch the rE/rH residuals — those O(slab/face
 plane) regions keep plain-f32-class rounding. This is a measured
 non-issue at the current accuracy floor (the f32 curl arithmetic's
@@ -100,8 +129,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from fdtd3d_tpu.layout import CURL_TERMS, component_axis
-from fdtd3d_tpu.ops.pallas3d import (PackedPsiView, PackedView,
-                                     _vmem_budget)
+from fdtd3d_tpu.ops.pallas3d import (COMPILER_PARAMS, PackedPsiView,
+                                     PackedView, _vmem_budget)
 
 AXES = "xyz"
 
@@ -181,6 +210,67 @@ def eligible(static, mesh_axes=None) -> bool:
     if static.use_drude_m and static.cfg.compensated:
         return False  # K residuals are not Kahan-treated: jnp covers
     return True
+
+
+def x_slab_layout(m0: int, n1: int, t: int) -> Tuple[int, int]:
+    """(S, L) of the tile-aligned x-psi storage at tile size t:
+    L = ceil(m0/t) slab tiles per side, S = 2*L*t storage planes —
+    full length when the grid has fewer than 2L tiles (every tile then
+    intersects a slab). SINGLE authority for the layout math, shared by
+    the f32 kernel and ops/pallas_packed_ds.py (a drifted copy would
+    silently desynchronize the two kernels' psi storage)."""
+    lt = -(-m0 // t)
+    if n1 // t >= 2 * lt:
+        return 2 * lt * t, lt
+    return n1, lt
+
+
+def x_block_maps(m0: int, n1: int, t: int):
+    """The tile-aligned x-psi addressing bundle for tile size t:
+    (Sx, Lx, two_region, xblk, tile_imap, lag_imap).
+
+    Two-region layout: lo blocks [0, Lx), hi blocks [Lx, 2Lx); interior
+    tiles pin to the last lo block (consecutive identical index =>
+    Mosaic keeps the VMEM window, no traffic). Sx == n1 is the
+    small-grid degenerate where every tile intersects a slab. The index
+    maps clamp exactly like the field maps (pin at the extra final
+    iteration, lag floor at 0)."""
+    sx, lx = x_slab_layout(m0, n1, t)
+    ntiles = n1 // t
+    two_region = sx < n1
+
+    def xblk(tj):
+        if not two_region:
+            return tj
+        return jnp.where(tj >= ntiles - lx, tj - (ntiles - 2 * lx),
+                         jnp.minimum(tj, lx - 1))
+
+    def tile_imap(i):
+        return (0, xblk(jnp.minimum(i, ntiles - 1)), 0, 0)
+
+    def lag_imap(i):
+        return (0, xblk(jnp.maximum(i - 1, 0)), 0, 0)
+
+    return sx, lx, two_region, xblk, tile_imap, lag_imap
+
+
+def pack_psx_rows(arrs, m0: int, sx: int):
+    """Stack compact (2*m0, n2, n3) x-psi rows into one tile-aligned
+    (len(arrs), sx, n2, n3) array: lo planes at [0, m0), hi planes at
+    [sx - m0, sx). SINGLE authority for the plane placement, shared
+    with ops/pallas_packed_ds.py (which passes hi+lo pair rows); the
+    inverse is unpack_psx_stack. The hi slice uses the explicit 2*m0
+    bound because spec-inference eval_shapes pack on GLOBAL shapes,
+    where the compact stack is 2*m0*topology planes."""
+    comp = jnp.stack(arrs).astype(np.float32)
+    st = jnp.zeros((len(arrs), sx) + comp.shape[2:], np.float32)
+    st = st.at[:, :m0].set(comp[:, :m0])
+    return st.at[:, sx - m0:].set(comp[:, m0:2 * m0])
+
+
+def unpack_psx_stack(stack, m0: int, sx: int):
+    """Inverse of pack_psx_rows: tile-aligned stack -> compact rows."""
+    return jnp.concatenate([stack[:, :m0], stack[:, sx - m0:]], axis=1)
 
 
 def psi_rows(static, slabs, family: str) -> Dict[int, List[str]]:
@@ -306,6 +396,24 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     psi_axes_e = sorted(rows_e)
     psi_axes_h = sorted(rows_h)
 
+    # Fused x-slab CPML (module docstring): in scope whenever no source
+    # patch can touch the x slabs — sourceless runs, or every source
+    # strictly inside the CPML identity region (then the TFSF/point
+    # patch curls never overlap an x slab and the patch H-corrections'
+    # F == identity on axis 0 stays exact). Sharded sourced runs
+    # already require _sources_interior via eligible(), so only
+    # UNSHARDED non-interior sources take the legacy post-pass path.
+    src_free = setup is None and not static.cfg.point_source.enabled
+    fuse_x = x_pml and (src_free or _sources_interior(static))
+    m0 = slabs.get(0, 0)
+    rows_x_e = [c for c in e_comps
+                if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
+                ] if fuse_x else []
+    rows_x_h = [c for c in h_comps
+                if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
+                ] if fuse_x else []
+    kxe, kxh = len(rows_x_e), len(rows_x_h)
+
     pairs_e = ["ca", "cb"] + (["kj", "bj"] if drude else [])
     # magnetic Drude K (round 5): the ADE recursion lives entirely in
     # the lagged H phase — old K reads and new K writes both index tile
@@ -353,6 +461,11 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         total += (len(arr_e) + len(arr_h)) * t * plane * 4
         for a in psi_axes_e + psi_axes_h:
             total += 3 * 2 * slabs[a] * 4          # profile packs
+        if fuse_x:
+            # x-psi stacks in + out (one tile-shaped block each) plus
+            # the per-tile full-length profile blocks
+            total += 2 * (kxe + kxh) * t * plane * 4
+            total += 2 * 3 * t * 4
         if 0 in sharded_axes:
             total += nh * plane * fbytes           # xgh
         for a in sharded_axes:
@@ -368,6 +481,11 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     if T == 0:
         return None
     ntiles = n1 // T
+    if fuse_x:
+        (Sx, Lx, x_two_region, _,
+         xpsi_tile_imap, xpsi_lag_imap) = x_block_maps(m0, n1, T)
+    else:
+        Sx, Lx, x_two_region = 0, 0, False
     # Grid runs ntiles + 1 iterations: the extra one exists solely to
     # run phase B for the last tile (whose new-E/old-H live in scratch
     # and whose lagged operand indices land on block ntiles-1
@@ -395,6 +513,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         take(["e_in", "h_in"])
         take([f"psE{a}" for a in psi_axes_e])
         take([f"psH{a}" for a in psi_axes_h])
+        if fuse_x:
+            take(["psxE", "psxH"])
         if drude:
             take(["j_in"])
         if drude_m:
@@ -403,6 +523,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             take(["re_in", "rh_in"])
         take([f"prof_e_{a}" for a in psi_axes_e])
         take([f"prof_h_{a}" for a in psi_axes_h])
+        if fuse_x:
+            take(["prof_ex", "prof_hx"])
         if 0 in sharded_axes:
             take(["xgh"])                    # x neighbor's last H plane
         take([f"ygh{a}" for a in sharded_axes if a != 0])
@@ -412,6 +534,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         take(["e_out", "h_out"])
         take([f"psE{a}_out" for a in psi_axes_e])
         take([f"psH{a}_out" for a in psi_axes_h])
+        if fuse_x:
+            take(["psxE_out", "psxH_out"])
         if drude:
             take(["j_out"])
         if drude_m:
@@ -424,6 +548,16 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         # phase A is real work for i < ntiles; the final iteration only
         # runs phase B (for the last tile) and discards phase A
         valid_a = i < ntiles
+        if fuse_x:
+            # which iterations sit on an x-slab tile (the only ones
+            # whose x-psi block is real — interior iterations pin the
+            # block and must not write it)
+            if x_two_region:
+                in_xslab_e = (i < Lx) | (i >= ntiles - Lx)
+                tl = jnp.maximum(i - 1, 0)
+                in_xslab_h = (tl < Lx) | (tl >= ntiles - Lx)
+            else:
+                in_xslab_e = in_xslab_h = i >= 0  # every tile
 
         h_vals = [idx["h_in"][j].astype(fdt) for j in range(nh)]
         e_vals = [idx["e_in"][j].astype(fdt) for j in range(ne)]
@@ -498,7 +632,24 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                         edge = jnp.zeros_like(bh)
                     ghost = jnp.where(i > 0, bh, edge)
                     full = jnp.concatenate([ghost, h_vals[jd]], axis=0)
-                    term = s * scale_dx(full[1:] - full[:-1])
+                    dfa = scale_dx(full[1:] - full[:-1])
+                    if fuse_x:
+                        # in-kernel x-slab psi: full-tile recursion with
+                        # per-plane profiles that are exactly (b=0, c=0,
+                        # ik=1) outside the absorber — interior tiles
+                        # (pinned psi block, stale values) are provable
+                        # no-ops: psi' = 0, delta = 0
+                        row = rows_x_e.index(c)
+                        pr = idx["prof_ex"]
+                        psi_old = idx["psxE"][row].astype(fdt)
+                        psi_new = pr[0] * psi_old + pr[1] * dfa
+
+                        @pl.when(valid_a & in_xslab_e)
+                        def _(row=row, psi_new=psi_new):
+                            idx["psxE_out"][row] = psi_new.astype(fdt)
+                        term = s * (pr[2] * dfa + psi_new)
+                    else:
+                        term = s * dfa
                 else:
                     dfa = yz_diff(h_vals[jd], a, backward=True,
                                   ghost=(idx[f"ygh{a}"][jd].astype(fdt)
@@ -575,7 +726,24 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             for (a, jd, s) in CURL_TERMS[component_axis(c)]:
                 if a == 0:
                     ext = jnp.concatenate([se_vals[jd], first[jd]], axis=0)
-                    term = s * scale_dx(ext[1:] - ext[:-1])
+                    dfa = scale_dx(ext[1:] - ext[:-1])
+                    if fuse_x:
+                        # lagged x-slab psi over fully-corrected new-E
+                        # scratch; i == 0 writes through the loaded old
+                        # psi (revisited-block rule, as psH below)
+                        row = rows_x_h.index(c)
+                        pr = idx["prof_hx"]
+                        psi_old = idx["psxH"][row].astype(fdt)
+                        psi_new = pr[0] * psi_old + pr[1] * dfa
+
+                        @pl.when(in_xslab_h)
+                        def _(row=row, psi_new=psi_new,
+                              psi_old=psi_old):
+                            idx["psxH_out"][row] = jnp.where(
+                                valid, psi_new, psi_old).astype(fdt)
+                        term = s * (pr[2] * dfa + psi_new)
+                    else:
+                        term = s * dfa
                 else:
                     dfa = yz_diff(se_vals[jd], a, backward=False)
                     if a in slabs and a in static.pml_axes:
@@ -656,6 +824,11 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                             tile_imap) for a in psi_axes_e]
     in_specs += [stack_spec(len(rows_h[a]), psi_last2(a),
                             lag_imap) for a in psi_axes_h]
+    if fuse_x:
+        in_specs += [pl.BlockSpec((kxe, T, n2, n3), xpsi_tile_imap,
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec((kxh, T, n2, n3), xpsi_lag_imap,
+                                  memory_space=pltpu.VMEM)]
     if drude:
         in_specs += [stack_spec(ne, (n2, n3), tile_imap)]     # J in
     if drude_m:
@@ -667,6 +840,15 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         s = [3, 1, 1, 1]
         s[1 + a] = 2 * slabs[a]
         in_specs += [pl.BlockSpec(tuple(s), lambda i: (0, 0, 0, 0),
+                                  memory_space=pltpu.VMEM)]
+    if fuse_x:                     # full-length per-plane x profiles
+        in_specs += [pl.BlockSpec((3, T, 1, 1),
+                                  lambda i: (0, jnp.minimum(i, ntiles - 1),
+                                             0, 0),
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec((3, T, 1, 1),
+                                  lambda i: (0, jnp.maximum(i - 1, 0),
+                                             0, 0),
                                   memory_space=pltpu.VMEM)]
     if 0 in sharded_axes:                                     # xgh
         in_specs += [pl.BlockSpec((nh, 1, n2, n3),
@@ -698,6 +880,11 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                              tile_imap) for a in psi_axes_e]
     out_specs += [stack_spec(len(rows_h[a]), psi_last2(a),
                              lag_imap) for a in psi_axes_h]
+    if fuse_x:
+        out_specs += [pl.BlockSpec((kxe, T, n2, n3), xpsi_tile_imap,
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((kxh, T, n2, n3), xpsi_lag_imap,
+                                   memory_space=pltpu.VMEM)]
     if drude:
         out_specs += [stack_spec(ne, (n2, n3), tile_imap)]
     if drude_m:
@@ -712,6 +899,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                                        np.float32) for a in psi_axes_e]
     out_shape += [jax.ShapeDtypeStruct(_stack_shape(a, len(rows_h[a])),
                                        np.float32) for a in psi_axes_h]
+    if fuse_x:
+        out_shape += [jax.ShapeDtypeStruct((kxe, Sx, n2, n3), np.float32),
+                      jax.ShapeDtypeStruct((kxh, Sx, n2, n3), np.float32)]
     if drude:
         out_shape += [jax.ShapeDtypeStruct((ne, n1, n2, n3), np.float32)]
     if drude_m:
@@ -729,7 +919,10 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     # aliased buffer made XLA insert a defensive full copy; and an
     # UN-aliased H output forced a full while-carry copy per step:
     # both measured at +24 B/cell) -> alias everything.
-    n_psi = len(psi_axes_e) + len(psi_axes_h)
+    # the x-psi stacks follow the same per-block read/write-same-
+    # iteration pattern as the y/z stacks (interior iterations neither
+    # refetch nor write their pinned block) -> donation-safe
+    n_psi = len(psi_axes_e) + len(psi_axes_h) + (2 if fuse_x else 0)
     aliases = {0: 0, 1: 1}
     for j in range(n_psi):
         aliases[2 + j] = 2 + j
@@ -762,12 +955,18 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         # the tile picker models the full footprint against physical
         # VMEM, so let Mosaic use all of it (the 100 MiB scoped limit
         # the two-pass kernels use would just shrink T here)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             vmem_limit_bytes=_VMEM_TOTAL),
         interpret=interpret,
     )
 
     # ---- pack / unpack --------------------------------------------------
+    def _pack_psx(psi_dict, rows):
+        return pack_psx_rows([psi_dict[f"{c}_x"] for c in rows], m0, Sx)
+
+    def _unpack_psx(stack):
+        return unpack_psx_stack(stack, m0, Sx)
+
     def pack(state):
         p = {"E": jnp.stack([state["E"][c] for c in e_comps]),
              "H": jnp.stack([state["H"][c] for c in h_comps]),
@@ -778,7 +977,10 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         for a in psi_axes_h:
             p[f"psH{a}"] = jnp.stack(
                 [state["psi_H"][f"{c}_{AXES[a]}"] for c in rows_h[a]])
-        if x_pml:
+        if fuse_x:
+            p["psxE"] = _pack_psx(state["psi_E"], rows_x_e)
+            p["psxH"] = _pack_psx(state["psi_H"], rows_x_h)
+        elif x_pml:
             p["psxE"] = {k: v for k, v in state["psi_E"].items()
                          if k.endswith("_x")}
             p["psxH"] = {k: v for k, v in state["psi_H"].items()
@@ -806,7 +1008,14 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         for a in psi_axes_h:
             for j, c in enumerate(rows_h[a]):
                 psi_h[f"{c}_{AXES[a]}"] = p[f"psH{a}"][j]
-        if x_pml:
+        if fuse_x:
+            ce = _unpack_psx(p["psxE"])
+            ch = _unpack_psx(p["psxH"])
+            for j, c in enumerate(rows_x_e):
+                psi_e[f"{c}_x"] = ce[j]
+            for j, c in enumerate(rows_x_h):
+                psi_h[f"{c}_x"] = ch[j]
+        elif x_pml:
             psi_e.update(p["psxE"])
             psi_h.update(p["psxH"])
         if psi_e or psi_h:
@@ -828,14 +1037,15 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     from fdtd3d_tpu.ops import pallas_fused
     from fdtd3d_tpu.ops import tfsf as tfsf_mod
 
-    m0 = slabs.get(0, 0)
-    # E-side x_slab_post reads OLD H at the x-boundary regions; H is
+    # LEGACY (non-fused-x) path only: the E-side x_slab_post reads OLD
+    # H at the x-boundary regions; H is
     # donated into the pallas call, so even a pre-call slice of it
     # makes XLA insert a defensive FULL copy of H (measured). Instead
     # the m0+1 boundary planes per side ride in the packed carry
     # ("hxs"): each step slices them off its H OUTPUT (alive, no
     # aliasing conflict) for the NEXT step's post-pass; pack() seeds
-    # them from the initial H.
+    # them from the initial H. With fuse_x the kernel consumes its own
+    # in-VMEM diffs and none of this machinery exists.
     x_src_comps = sorted({
         "H" + AXES[d_axis]
         for c in e_comps
@@ -857,10 +1067,36 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         s[1 + a] = 2 * slabs[a]
         return v.reshape(s)
 
+    def _prof_full_x(coeffs, tag):
+        """FULL-LENGTH per-plane x profiles (identity outside the
+        absorber), streamed as per-tile (3, T, 1, 1) blocks."""
+        v = jnp.stack([coeffs[f"pml_{p}{tag}_x"]
+                       for p in ("b", "c", "ik")]).astype(fdt)
+        return v.reshape(3, n1, 1, 1)
+
     def _vec3(v, a):
         s = [1, 1, 1]
         s[a] = v.shape[0]
         return v.astype(fdt).reshape(s)
+
+    def prepare(coeffs):
+        """Chunk-entry hoist (round 6): the per-step profile packing /
+        wall reshapes are pure functions of the loop-constant coeffs,
+        but as ops INSIDE the scan body they sat on the fixed per-step
+        dispatch floor. make_chunk_runner calls this once per chunk,
+        outside the scan; step() falls back to computing inline when
+        handed raw coeffs (direct callers, paired-complex legs)."""
+        cc = dict(coeffs)
+        for a in psi_axes_e:
+            cc[f"_pk_prof_e{a}"] = _prof_pack(coeffs, "e", a)
+        for a in psi_axes_h:
+            cc[f"_pk_prof_h{a}"] = _prof_pack(coeffs, "h", a)
+        if fuse_x:
+            cc["_pk_prof_ex"] = _prof_full_x(coeffs, "e")
+            cc["_pk_prof_hx"] = _prof_full_x(coeffs, "h")
+        for a, nm in enumerate(("wall_x", "wall_y", "wall_z")):
+            cc[f"_pk_{nm}"] = _vec3(coeffs[nm], a)
+        return cc
 
     def step(pstate, coeffs):
         t = pstate["t"]
@@ -870,7 +1106,7 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                 pstate["inc"], coeffs, t, static.dt, static.omega, setup)
 
         E_arr, H_arr = pstate["E"], pstate["H"]
-        h_slabs = pstate["hxs"] if x_pml else None
+        h_slabs = pstate["hxs"] if (x_pml and not fuse_x) else None
 
         # E-phase halos: each shard needs its LOWER neighbor's boundary
         # plane of OLD H along every sharded axis (backward diffs);
@@ -892,21 +1128,32 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         args = [E_arr, H_arr]
         args += [pstate[f"psE{a}"] for a in psi_axes_e]
         args += [pstate[f"psH{a}"] for a in psi_axes_h]
+        if fuse_x:
+            args += [pstate["psxE"], pstate["psxH"]]
         if drude:
             args += [pstate["J"]]
         if drude_m:
             args += [pstate["K"]]
         if comp:
             args += [pstate["rE"], pstate["rH"]]
-        args += [_prof_pack(coeffs, "e", a) for a in psi_axes_e]
-        args += [_prof_pack(coeffs, "h", a) for a in psi_axes_h]
+        def cg(key, fn, *fa):
+            # prepared (chunk-entry) operand when present, else inline
+            return coeffs[key] if key in coeffs else fn(*fa)
+
+        args += [cg(f"_pk_prof_e{a}", _prof_pack, coeffs, "e", a)
+                 for a in psi_axes_e]
+        args += [cg(f"_pk_prof_h{a}", _prof_pack, coeffs, "h", a)
+                 for a in psi_axes_h]
+        if fuse_x:
+            args += [cg("_pk_prof_ex", _prof_full_x, coeffs, "e"),
+                     cg("_pk_prof_hx", _prof_full_x, coeffs, "h")]
         if 0 in sharded_axes:
             args += [ghosts_x]
         for a in sharded_axes:
             if a != 0:
                 args += [ghosts_yz[a]]
-        args += [_vec3(coeffs["wall_x"], 0), _vec3(coeffs["wall_y"], 1),
-                 _vec3(coeffs["wall_z"], 2)]
+        args += [cg(f"_pk_wall_{AXES[a]}", _vec3,
+                    coeffs[f"wall_{AXES[a]}"], a) for a in range(3)]
         args += [coeffs[k] for k in arr_e]
         args += [coeffs[k] for k in arr_h]
         outs = call(*args)
@@ -920,6 +1167,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         psh = {}
         for a in psi_axes_h:
             psh[a] = outs[p]; p += 1
+        if fuse_x:
+            new_state["psxE"] = outs[p]; p += 1
+            new_state["psxH"] = outs[p]; p += 1
         if drude:
             new_state["J"] = outs[p]; p += 1
         if drude_m:
@@ -929,10 +1179,13 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             new_state["rH"] = outs[p]; p += 1
 
         # ---- E post-passes over the packed view ----------------------
+        # with fuse_x the x-slab CPML ran in-kernel; only source
+        # patches (whose supports sit inside the CPML identity region,
+        # so their H corrections never meet the x psi) remain.
         eview = PackedView(new_E_arr, e_comps)
-        psxE = dict(pstate.get("psxE", {}))
+        psxE = dict(pstate.get("psxE", {})) if not fuse_x else None
         patches: list = []
-        if x_pml:
+        if x_pml and not fuse_x:
             eview, psxE = pallas3d.x_slab_post(
                 static, "E", eview, None, psxE, coeffs, slabs,
                 collect=patches, src_slabs=h_slabs)
@@ -975,8 +1228,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
 
         # ---- H corrections for the E patches -------------------------
         hview = PackedView(new_H_arr, h_comps)
-        psxH = dict(pstate.get("psxH", {}))
-        psi_h_view = PackedPsiView(psh, rows_meta_h, psxH)
+        psxH = dict(pstate.get("psxH", {})) if not fuse_x else None
+        psi_h_view = PackedPsiView(psh, rows_meta_h,
+                                   psxH if psxH is not None else {})
         if patches:
             hview, psi_h_view = pallas_fused.apply_patch_h_corrections(
                 static, hview, psi_h_view, patches, coeffs, slabs,
@@ -984,7 +1238,7 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         if setup is not None:
             new_state["inc"] = tfsf_mod.advance_hinc(
                 new_state["inc"], coeffs, setup)
-        if x_pml:
+        if x_pml and not fuse_x:
             hview, psxH = pallas3d.x_slab_post(
                 static, "H", hview, eview, psi_h_view.extra, coeffs,
                 slabs)
@@ -995,13 +1249,13 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
 
         new_state["E"] = eview.arr
         new_state["H"] = hview.arr
-        if x_pml:
+        if x_pml and not fuse_x:
             new_state["hxs"] = _h_slab_planes(hview.arr)
         for a in psi_axes_e:
             new_state[f"psE{a}"] = pse[a]
         for a in psi_axes_h:
             new_state[f"psH{a}"] = psi_h_view.stacks[a]
-        if x_pml:
+        if x_pml and not fuse_x:
             new_state["psxE"] = psxE
             new_state["psxH"] = psi_h_view.extra
         new_state["t"] = t + 1
@@ -1010,7 +1264,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     step.pack = pack
     step.unpack = unpack
     step.packed = True
+    step.prepare = prepare
     step.diag = {"tile": {"EH": T},
+                 "fused_x": fuse_x,
                  "vmem_block_bytes": {"EH": _block_bytes(T)},
                  "vmem_scratch_bytes": _scratch_bytes(T)}
     return step

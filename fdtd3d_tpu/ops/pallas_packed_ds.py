@@ -34,10 +34,28 @@ the curl accumulator pair at the record's static plane before the
 coefficient multiply — the exact position jnp-ds applies it
 (solver._make_ds_step._half_update). Because the H phase then computes
 H from FULLY source-corrected new-E scratch, no post-hoc H correction
-exists for sources at all; only the x-slab CPML post-pass (whose psi
-spans the tile axis) stays outside, done in pair arithmetic with pair
-patches feeding a ds port of pallas_fused.apply_patch_h_corrections
-restricted to the static axis-0 patches this path produces.
+exists for sources at all.
+
+The x-slab CPML runs IN-KERNEL too (round 6, mirroring
+ops/pallas_packed.py's fused-x scheme): the compact x psi pairs ride
+as a tile-aligned ``(2k, S, n2, n3)`` stack whose interior tiles pin
+their block (no traffic), with full-length per-plane profile pairs
+that are exactly identity outside the absorber. Because sources are
+already in-kernel, the fusion is UNCONDITIONAL — the pair slab
+algebra consumes the same EFT x-differences the curl uses (E phase:
+old-H backward diff incl. the scratch halo; H phase: forward diff
+over fully source- and CPML-corrected new-E scratch), so the former
+pair post-passes (_x_slab_post_ds + the ds patch-correction port) and
+the ``hxs`` boundary-pair carry are GONE: every float32x2 step is one
+fused dispatch. The slab algebra itself is gated per tile by a scalar
+``lax.cond`` — the EFT profile products (~450 flops/cell across the
+four x-curl terms) would otherwise tax every interior tile of a
+kernel that is partially VPU-bound (docs/PERFORMANCE.md round 5).
+Sharding note (advisor r5-1): the same interior-shard
+identity-profile argument that covers the y/z slabs covers x — an
+interior shard's b/c/ik pairs are exactly ((0,0),(0,0),(1,0)), so the
+zero-ghost hi-edge diffs feed only no-op recursions there, and the
+thin post-kernel hi-edge pair fix stays a plain curl term.
 
 EFT compiler hazards: on real TPU the body traces under
 ``ds.no_barriers()`` — Mosaic has no optimization_barrier lowering and
@@ -78,8 +96,10 @@ from jax.experimental.pallas import tpu as pltpu
 from fdtd3d_tpu.layout import CURL_TERMS, component_axis
 from fdtd3d_tpu.ops import ds
 from fdtd3d_tpu.ops import tfsf as tfsf_mod
+from fdtd3d_tpu.ops.pallas3d import COMPILER_PARAMS
 from fdtd3d_tpu.ops.pallas_packed import (_VMEM_TOTAL, _pick_tile_packed,
-                                          psi_rows)
+                                          pack_psx_rows, psi_rows,
+                                          unpack_psx_stack, x_block_maps)
 
 AXES = "xyz"
 
@@ -121,7 +141,9 @@ def _corr_records(static, family: str):
             continue
         pol = (setup.ehat if corr.src[0] == "E" else
                setup.hhat)[component_axis(corr.src)]
-        if abs(pol) < 1e-14:
+        if abs(pol) < tfsf_mod.POL_EPS:
+            # same projection + threshold as record_term_ds: stack_terms
+            # relies on this pre-filter to assume non-None terms
             continue
         if corr.plane < 0 or corr.plane >= static.grid_shape[corr.axis]:
             continue
@@ -159,255 +181,6 @@ def _cut_pair(pair, lo, hi, axis):
 
 def _neg_pair(pair):
     return -pair[0], -pair[1]
-
-
-def _pad_pair(pair, pad):
-    return jnp.pad(pair[0], pad), jnp.pad(pair[1], pad)
-
-
-# ---------------------------------------------------------------------------
-# x-slab CPML post-pass in ds (mirror of pallas3d.slab_post, axis 0)
-# ---------------------------------------------------------------------------
-
-
-def _x_slab_post_ds(static, family, arr, comps, src_slab_pairs, psx,
-                    coeffs, m, iv_pair, collect=None):
-    """CPML x-slab psi recursion + delta onto the pair kernel output.
-
-    ``arr``: packed (2k, n1, n2, n3); ``src_slab_pairs`` maps each
-    source comp to ((lo_h, lo_l), (hi_h, hi_l)) pre-sliced m+1-plane
-    boundary regions (the E pass reads the previous step's H planes
-    carried in the packed state — the H input was donated into the
-    kernel); ``psx``: dict key -> (hi, lo) compact psi pairs.
-    ``collect`` receives (comp, start, (dh, dl)) pair patches for the
-    H correction. Unsharded only (this kernel's scope).
-    """
-    mode = static.mode
-    upd = mode.e_components if family == "E" else mode.h_components
-    tag = "e" if family == "E" else "h"
-    k = len(comps)
-    idx = {c: j for j, c in enumerate(comps)}
-    n1 = arr.shape[1]              # shard-LOCAL x extent
-
-    def prof(name):
-        return (coeffs[f"pml_slab_{name}{tag}_x"],
-                coeffs[f"pml_slab_{name}{tag}lo_x"])
-
-    bx = prof("b")
-    cx = prof("c")
-    ikx = prof("ik")
-
-    def r3(vpair, lo, hi):
-        shape = [hi - lo, 1, 1]
-        return (vpair[0][lo:hi].reshape(shape),
-                vpair[1][lo:hi].reshape(shape))
-
-    def pad1(pair, lo_side):
-        pad = [(1, 0) if lo_side else (0, 1), (0, 0), (0, 0)]
-        return _pad_pair(pair, pad)
-
-    for c in upd:
-        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
-            if a != 0:
-                continue
-            d = ("H" if family == "E" else "E") + AXES[d_axis]
-            if d not in src_slab_pairs:
-                continue
-            f_lo, f_hi = src_slab_pairs[d]
-            if family == "E":   # backward diff on slabs [0,m)/[n1-m,n1)
-                d_lo = _ds_sub_scale(_cut_pair(f_lo, 0, m, 0),
-                                     pad1(_cut_pair(f_lo, 0, m - 1, 0),
-                                          True), iv_pair)
-                d_hi = _ds_sub_scale(_cut_pair(f_hi, 1, m + 1, 0),
-                                     _cut_pair(f_hi, 0, m, 0), iv_pair)
-            else:               # forward diff
-                d_lo = _ds_sub_scale(_cut_pair(f_lo, 1, m + 1, 0),
-                                     _cut_pair(f_lo, 0, m, 0), iv_pair)
-                d_hi = _ds_sub_scale(pad1(_cut_pair(f_hi, 2, m + 1, 0),
-                                          False),
-                                     _cut_pair(f_hi, 1, m + 1, 0),
-                                     iv_pair)
-            key = f"{c}_x"
-            psi = psx[key]
-            p_lo = ds.add_ff(
-                *ds.mul_ff(*r3(bx, 0, m), *_cut_pair(psi, 0, m, 0)),
-                *ds.mul_ff(*r3(cx, 0, m), *d_lo))
-            p_hi = ds.add_ff(
-                *ds.mul_ff(*r3(bx, m, 2 * m),
-                           *_cut_pair(psi, m, 2 * m, 0)),
-                *ds.mul_ff(*r3(cx, m, 2 * m), *d_hi))
-            psx[key] = (jnp.concatenate([p_lo[0], p_hi[0]], axis=0),
-                        jnp.concatenate([p_lo[1], p_hi[1]], axis=0))
-
-            def delta(side_p, side_d, p0, p1):
-                ikm1 = ds.add_f(*r3(ikx, p0, p1), np.float32(-1.0))
-                v = ds.add_ff(*ds.mul_ff(*ikm1, *side_d), *side_p)
-                return v if s > 0 else _neg_pair(v)
-
-            dl_pair = delta(p_lo, d_lo, 0, m)
-            dh_pair = delta(p_hi, d_hi, m, 2 * m)
-            if family == "E":
-                wx = coeffs["wall_x"]
-                dl_pair = (dl_pair[0] * wx[:m].reshape(m, 1, 1),
-                           dl_pair[1] * wx[:m].reshape(m, 1, 1))
-                dh_pair = (dh_pair[0] * wx[n1 - m:].reshape(m, 1, 1),
-                           dh_pair[1] * wx[n1 - m:].reshape(m, 1, 1))
-                ca_ax = component_axis(c)
-                for a2 in (1, 2):
-                    if a2 != ca_ax:
-                        w = coeffs[f"wall_{AXES[a2]}"]
-                        shape = [1, 1, 1]
-                        shape[a2] = w.shape[0]
-                        w = w.reshape(shape)
-                        dl_pair = (dl_pair[0] * w, dl_pair[1] * w)
-                        dh_pair = (dh_pair[0] * w, dh_pair[1] * w)
-            cb = (coeffs[("cb_" if family == "E" else "db_") + c],
-                  coeffs[("cb_" if family == "E" else "db_") + c + "_lo"])
-            if jnp.ndim(cb[0]) == 3:       # material grid: slab slices
-                cb_lo_s = (cb[0][:m], cb[1][:m])
-                cb_hi_s = (cb[0][n1 - m:], cb[1][n1 - m:])
-            else:
-                cb_lo_s = cb_hi_s = cb
-            add_lo = ds.mul_ff(*dl_pair, *cb_lo_s)
-            add_hi = ds.mul_ff(*dh_pair, *cb_hi_s)
-            if family == "H":
-                add_lo = _neg_pair(add_lo)
-                add_hi = _neg_pair(add_hi)
-            sl_lo = (slice(0, m), slice(None), slice(None))
-            sl_hi = (slice(n1 - m, n1), slice(None), slice(None))
-            arr = _pair_add_at(arr, idx[c], k, sl_lo, *add_lo)
-            arr = _pair_add_at(arr, idx[c], k, sl_hi, *add_hi)
-            if collect is not None:
-                full = [1] * 3
-                full[1] = arr.shape[2]
-                full[2] = arr.shape[3]
-                collect.append((c, 0, (
-                    jnp.broadcast_to(add_lo[0], (m, full[1], full[2])),
-                    jnp.broadcast_to(add_lo[1], (m, full[1], full[2])))))
-                collect.append((c, n1 - m, (
-                    jnp.broadcast_to(add_hi[0], (m, full[1], full[2])),
-                    jnp.broadcast_to(add_hi[1], (m, full[1], full[2])))))
-    return arr, psx
-
-
-def _apply_x_patch_h_ds(static, h_arr, h_comps, psh_stacks, rows_h,
-                        patches, coeffs, slabs, iv_pair,
-                        mesh_axes=None, mesh_shape=None):
-    """Correct the kernel's pair-H for the x-slab E patches (ds port of
-    pallas_fused.apply_patch_h_corrections restricted to the static
-    axis-0 patches this path produces; the TFSF/point sources need no
-    correction here — they were applied in-kernel before the H phase).
-    Shard-local throughout; on a sharded transverse axis the in-patch
-    forward diff's hi plane receives the upper shard's first patch
-    plane by ppermute (zeros arrive at the global edge), in pairs.
-    """
-    nh = len(h_comps)
-    n_x = h_arr.shape[1]           # shard-LOCAL x extent
-    mesh_axes = mesh_axes or {}
-    mesh_shape = mesh_shape or {}
-
-    def slab_f_pair(a, length):
-        v = ds.add_ff(coeffs[f"pml_ikh_{AXES[a]}"],
-                      coeffs[f"pml_ikhlo_{AXES[a]}"],
-                      coeffs[f"pml_ch_{AXES[a]}"],
-                      coeffs[f"pml_chlo_{AXES[a]}"])
-        shape = [1, 1, 1]
-        shape[a] = length
-        return v[0].reshape(shape), v[1].reshape(shape)
-
-    for jc, c in enumerate(h_comps):
-        db = (coeffs[f"db_{c}"], coeffs[f"db_{c}_lo"])
-        for (a, d_axis, s) in CURL_TERMS[component_axis(c)]:
-            d = "E" + AXES[d_axis]
-            for (pc, start, delta) in patches:
-                if pc != d:
-                    continue
-                klen = delta[0].shape[0]
-                if a == 0:
-                    # forward diff along the patch normal: k+1 planes
-                    # from start-1, zero ghost beyond the patch
-                    pad = [(1, 1), (0, 0), (0, 0)]
-                    vp = _pad_pair(delta, pad)
-                    w = _ds_sub_scale(_cut_pair(vp, 1, klen + 2, 0),
-                                      _cut_pair(vp, 0, klen + 1, 0),
-                                      iv_pair)
-                    pstart = start - 1
-                    lo_clip = max(0, -pstart)
-                    hi_clip = min(klen + 1, n_x - pstart)
-                    if hi_clip <= lo_clip:
-                        continue
-                    w = _cut_pair(w, lo_clip, hi_clip, 0)
-                    pstart += lo_clip
-                    plen = hi_clip - lo_clip
-                    dacc = w if s > 0 else _neg_pair(w)
-                    sl = (slice(pstart, pstart + plen),
-                          slice(None), slice(None))
-                else:
-                    # in-patch forward diff along a (zero ghost at the
-                    # global hi edge: the kernel's PEC convention)
-                    n_a = delta[0].shape[a]
-                    pad = [(0, 0)] * 3
-                    pad[a] = (0, 1)
-                    shifted = _pad_pair(_cut_pair(delta, 1, n_a, a), pad)
-                    if mesh_axes.get(a):
-                        # sharded transverse axis: the local hi plane's
-                        # forward neighbor is the UPPER shard's first
-                        # patch plane (pair ppermute; zeros at the
-                        # global edge keep the PEC convention)
-                        name = mesh_axes[a]
-                        n_sh = mesh_shape[name]
-                        first = _cut_pair(delta, 0, 1, a)
-                        perm = [(r + 1, r) for r in range(n_sh - 1)]
-                        nxt = (lax.ppermute(first[0], name, perm),
-                               lax.ppermute(first[1], name, perm))
-                        hi_sl = [slice(None)] * 3
-                        hi_sl[a] = slice(n_a - 1, n_a)
-                        hi_sl = tuple(hi_sl)
-                        shifted = tuple(
-                            s.at[hi_sl].set(v)
-                            for s, v in zip(shifted, nxt))
-                    w = _ds_sub_scale(shifted, delta, iv_pair)
-                    if a in slabs and a in static.pml_axes:
-                        f = slab_f_pair(a, n_a)
-                        dacc = ds.mul_ff(*f, *w)
-                        # stored psi' correction at the slab overlap:
-                        # the kernel's psi_H recursion consumed the
-                        # pre-patch dfa; psi' += c_prof * dW there
-                        if c in rows_h.get(a, []):
-                            m = slabs[a]
-                            row = rows_h[a].index(c)
-                            cp = (coeffs[f"pml_slab_ch_{AXES[a]}"],
-                                  coeffs[f"pml_slab_chlo_{AXES[a]}"])
-                            shape = [1, 1, 1]
-                            shape[a] = m
-                            add_lo = ds.mul_ff(
-                                cp[0][:m].reshape(shape),
-                                cp[1][:m].reshape(shape),
-                                *_cut_pair(w, 0, m, a))
-                            add_hi = ds.mul_ff(
-                                cp[0][m:].reshape(shape),
-                                cp[1][m:].reshape(shape),
-                                *_cut_pair(w, n_a - m, n_a, a))
-                            add = (jnp.concatenate(
-                                       [add_lo[0], add_hi[0]], axis=a),
-                                   jnp.concatenate(
-                                       [add_lo[1], add_hi[1]], axis=a))
-                            bsl = [slice(None)] * 3
-                            bsl[0] = slice(start, start + klen)
-                            kk = psh_stacks[a].shape[0] // 2
-                            psh_stacks[a] = _pair_add_at(
-                                psh_stacks[a], row, kk, tuple(bsl),
-                                add[0], add[1])
-                        dacc = dacc if s > 0 else _neg_pair(dacc)
-                    else:
-                        dacc = w if s > 0 else _neg_pair(w)
-                    sl = (slice(start, start + klen),
-                          slice(None), slice(None))
-                db_s = (db[0][sl], db[1][sl]) \
-                    if jnp.ndim(db[0]) == 3 else db
-                fix = _neg_pair(ds.mul_ff(db_s[0], db_s[1], *dacc))
-                h_arr = _pair_add_at(h_arr, jc, nh, sl, fix[0], fix[1])
-    return h_arr, psh_stacks
 
 
 # ---------------------------------------------------------------------------
@@ -476,6 +249,18 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     psi_axes_e = sorted(rows_e)
     psi_axes_h = sorted(rows_h)
 
+    # Fused x-slab CPML (module docstring): UNCONDITIONAL when x has
+    # PML — the sources are in-kernel already, so no patch can postdate
+    # the H phase's view of E.
+    rows_x_e = [c for c in e_comps
+                if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
+                ] if x_pml else []
+    rows_x_h = [c for c in h_comps
+                if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
+                ] if x_pml else []
+    kxe, kxh = len(rows_x_e), len(rows_x_h)
+    m0 = slabs.get(0, 0)
+
     # ---- static source records ------------------------------------------
     recs_e = _corr_records(static, "E")
     recs_h = _corr_records(static, "H")
@@ -530,6 +315,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             total += 2 * nh * t * plane * 4     # K in + out
         for a in psi_axes_e + psi_axes_h:
             total += 6 * 2 * slabs[a] * 4       # profile packs
+        if x_pml:
+            # x-psi pair stacks in + out, plus per-tile profile blocks
+            total += 2 * 2 * (kxe + kxh) * t * plane * 4
+            total += 2 * 6 * t * 4
         total += 2 * k0e * plane * 4 + 2 * k0h * plane * 4
         total += 2 * (k1e + k1h) * t * n3 * 4
         total += 2 * (k2e + k2h) * t * n2 * 4
@@ -556,7 +345,14 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     if T == 0:
         return None
     ntiles = n1 // T
-    m0 = slabs.get(0, 0)
+    if x_pml:
+        # shared tile-aligned x-psi addressing (single authority:
+        # ops/pallas_packed.x_block_maps — the f32 kernel uses the
+        # same bundle, so the two layouts cannot drift)
+        (Sx, Lx, x_two_region, _,
+         xpsi_tile_imap, xpsi_lag_imap) = x_block_maps(m0, n1, T)
+    else:
+        Sx, Lx, x_two_region = 0, 0, False
 
     bar_ctx = contextlib.nullcontext if interpret else ds.no_barriers
 
@@ -574,12 +370,16 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         take(["e_in", "h_in"])
         take([f"psE{a}" for a in psi_axes_e])
         take([f"psH{a}" for a in psi_axes_h])
+        if x_pml:
+            take(["psxE", "psxH"])
         if drude:
             take(["j_in"])
         if drude_m:
             take(["k_in"])
         take([f"prof_e_{a}" for a in psi_axes_e])
         take([f"prof_h_{a}" for a in psi_axes_h])
+        if x_pml:
+            take(["prof_ex", "prof_hx"])
         if k0e:
             take(["c0e"])
         if k1e:
@@ -608,6 +408,8 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         take(["e_out", "h_out"])
         take([f"psE{a}_out" for a in psi_axes_e])
         take([f"psH{a}_out" for a in psi_axes_h])
+        if x_pml:
+            take(["psxE_out", "psxH_out"])
         if drude:
             take(["j_out"])
         if drude_m:
@@ -625,6 +427,37 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         el_v = [idx["e_in"][ne + j] for j in range(ne)]
         hh_v = [idx["h_in"][j] for j in range(nh)]
         hl_v = [idx["h_in"][nh + j] for j in range(nh)]
+
+        if x_pml and x_two_region:
+            in_xslab_e = (i < Lx) | (i >= ntiles - Lx)
+            lag_t = jnp.maximum(i - 1, 0)
+            in_xslab_h = (lag_t < Lx) | (lag_t >= ntiles - Lx)
+        elif x_pml:
+            in_xslab_e = in_xslab_h = i >= 0    # every tile is a slab tile
+
+        def x_slab_pair(dfa, psi_old, pr, in_slab):
+            """Full-tile x-slab pair recursion, gated per tile by a
+            scalar ``lax.cond``: interior tiles (pinned psi block) skip
+            the EFT profile products entirely — they would be exact
+            no-ops there (identity profile pairs ((0,0),(0,0),(1,0)))
+            but cost ~450 flops/cell on a partially VPU-bound kernel
+            (module docstring). Returns (term_pair, psi_new_pair); the
+            false branch passes dfa / psi_old through unchanged."""
+            def slab(dp):
+                p_new = ds.add_ff(
+                    *ds.mul_ff(pr[0], pr[3], *psi_old),
+                    *ds.mul_ff(pr[1], pr[4], *dp))
+                t_ = ds.add_ff(*ds.mul_ff(pr[2], pr[5], *dp), *p_new)
+                return t_[0], t_[1], p_new[0], p_new[1]
+
+            def plain(dp):
+                return dp[0], dp[1], psi_old[0], psi_old[1]
+
+            if not x_two_region:
+                th_, tl_, pnh, pnl = slab(dfa)
+            else:
+                th_, tl_, pnh, pnl = lax.cond(in_slab, slab, plain, dfa)
+            return (th_, tl_), (pnh, pnl)
 
         def cpair(key):
             """ca/cb/da/db as (hi, lo): embedded scalars or streamed
@@ -767,7 +600,21 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                     gl_ = jnp.where(i > 0, idx["shh"][nh + jd], el_g)
                     fh = jnp.concatenate([gh_, hh_v[jd]], axis=0)
                     fl = jnp.concatenate([gl_, hl_v[jd]], axis=0)
-                    term = ds_diff((fh[1:], fl[1:]), (fh[:-1], fl[:-1]))
+                    dfa = ds_diff((fh[1:], fl[1:]), (fh[:-1], fl[:-1]))
+                    if x_pml:
+                        row = rows_x_e.index(c)
+                        pr = idx["prof_ex"]
+                        psi_old = (idx["psxE"][row],
+                                   idx["psxE"][kxe + row])
+                        term, pn = x_slab_pair(dfa, psi_old, pr,
+                                               in_xslab_e)
+
+                        @pl.when(valid_a & in_xslab_e)
+                        def _(row=row, pn=pn):
+                            idx["psxE_out"][row] = pn[0]
+                            idx["psxE_out"][kxe + row] = pn[1]
+                    else:
+                        term = dfa
                     if s < 0:
                         term = _neg_pair(term)
                 else:
@@ -850,7 +697,26 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                 if a == 0:
                     fh = jnp.concatenate([se_h[jd], first[jd][0]], axis=0)
                     fl = jnp.concatenate([se_l[jd], first[jd][1]], axis=0)
-                    term = ds_diff((fh[1:], fl[1:]), (fh[:-1], fl[:-1]))
+                    dfa = ds_diff((fh[1:], fl[1:]), (fh[:-1], fl[:-1]))
+                    if x_pml:
+                        # lagged x-slab pair psi over fully source- and
+                        # CPML-corrected new-E scratch; i == 0 writes
+                        # through the loaded old psi pair
+                        row = rows_x_h.index(c)
+                        pr = idx["prof_hx"]
+                        psi_old = (idx["psxH"][row],
+                                   idx["psxH"][kxh + row])
+                        term, pn = x_slab_pair(dfa, psi_old, pr,
+                                               in_xslab_h)
+
+                        @pl.when(in_xslab_h)
+                        def _(row=row, pn=pn, psi_old=psi_old):
+                            idx["psxH_out"][row] = jnp.where(
+                                valid, pn[0], psi_old[0])
+                            idx["psxH_out"][kxh + row] = jnp.where(
+                                valid, pn[1], psi_old[1])
+                    else:
+                        term = dfa
                     if s < 0:
                         term = _neg_pair(term)
                 else:
@@ -927,6 +793,12 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                  for a in psi_axes_e]
     in_specs += [stack_spec(2 * len(rows_h[a]), psi_last2(a), lag_imap)
                  for a in psi_axes_h]
+
+    if x_pml:
+        in_specs += [pl.BlockSpec((2 * kxe, T, n2, n3), xpsi_tile_imap,
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec((2 * kxh, T, n2, n3), xpsi_lag_imap,
+                                  memory_space=pltpu.VMEM)]
     if drude:
         in_specs += [stack_spec(ne, (n2, n3), tile_imap)]     # J in
     if drude_m:
@@ -935,6 +807,15 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         s = [6, 1, 1, 1]
         s[1 + a] = 2 * slabs[a]
         in_specs += [pl.BlockSpec(tuple(s), pin_imap,
+                                  memory_space=pltpu.VMEM)]
+    if x_pml:                      # full-length per-plane x profiles
+        in_specs += [pl.BlockSpec((6, T, 1, 1),
+                                  lambda i: (0, jnp.minimum(i, ntiles - 1),
+                                             0, 0),
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec((6, T, 1, 1),
+                                  lambda i: (0, jnp.maximum(i - 1, 0),
+                                             0, 0),
                                   memory_space=pltpu.VMEM)]
     if k0e:
         in_specs += [pl.BlockSpec((2 * k0e, 1, n2, n3), pin_imap,
@@ -998,6 +879,11 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                   for a in psi_axes_e]
     out_specs += [stack_spec(2 * len(rows_h[a]), psi_last2(a), lag_imap)
                   for a in psi_axes_h]
+    if x_pml:
+        out_specs += [pl.BlockSpec((2 * kxe, T, n2, n3), xpsi_tile_imap,
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((2 * kxh, T, n2, n3), xpsi_lag_imap,
+                                   memory_space=pltpu.VMEM)]
     if drude:
         out_specs += [stack_spec(ne, (n2, n3), tile_imap)]
     if drude_m:
@@ -1011,6 +897,11 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     out_shape += [jax.ShapeDtypeStruct(
         _stack_shape(a, 2 * len(rows_h[a])), np.float32)
         for a in psi_axes_h]
+    if x_pml:
+        out_shape += [jax.ShapeDtypeStruct((2 * kxe, Sx, n2, n3),
+                                           np.float32),
+                      jax.ShapeDtypeStruct((2 * kxh, Sx, n2, n3),
+                                           np.float32)]
     if drude:
         out_shape += [jax.ShapeDtypeStruct((ne, n1, n2, n3),
                                            np.float32)]
@@ -1018,7 +909,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         out_shape += [jax.ShapeDtypeStruct((nh, n1, n2, n3),
                                            np.float32)]
 
-    n_psi = len(psi_axes_e) + len(psi_axes_h)
+    # x-psi stacks follow the y/z stacks' read/write-same-iteration
+    # pattern (pinned interior blocks neither refetch nor write) ->
+    # donation-safe like the rest
+    n_psi = len(psi_axes_e) + len(psi_axes_h) + (2 if x_pml else 0)
     aliases = {0: 0, 1: 1}
     for j in range(n_psi):
         aliases[2 + j] = 2 + j
@@ -1043,23 +937,22 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         out_shape=tuple(out_shape),
         input_output_aliases=aliases,
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             vmem_limit_bytes=_VMEM_TOTAL),
         interpret=interpret,
     )
 
     # ---- pack / unpack --------------------------------------------------
-    x_src_comps = sorted({
-        "H" + AXES[d_axis]
-        for c in e_comps
-        for (a, d_axis, s) in CURL_TERMS[component_axis(c)] if a == 0})
+    def _pack_psx(hi_dict, lo_dict, rows):
+        """Compact x-psi pairs -> one tile-aligned (2k, Sx, n2, n3)
+        stack, hi rows then lo rows (plane placement is the shared
+        pack_psx_rows — the f32 kernel's exact layout)."""
+        return pack_psx_rows([hi_dict[f"{c}_x"] for c in rows]
+                             + [lo_dict[f"{c}_x"] for c in rows],
+                             m0, Sx)
 
-    def _h_slab_pairs(H):
-        return {d: ((H[h_comps.index(d), :m0 + 1],
-                     H[nh + h_comps.index(d), :m0 + 1]),
-                    (H[h_comps.index(d), n1 - m0 - 1:],
-                     H[nh + h_comps.index(d), n1 - m0 - 1:]))
-                for d in x_src_comps}
+    def _unpack_psx(stack):
+        return unpack_psx_stack(stack, m0, Sx)
 
     def pack(state):
         p = {"E": jnp.stack([state["E"][c] for c in e_comps]
@@ -1078,13 +971,10 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                 + [state["lopsi_H"][f"{c}_{AXES[a]}"]
                    for c in rows_h[a]])
         if x_pml:
-            p["psxE"] = {k: (state["psi_E"][k], state["lopsi_E"][k])
-                         for k in state.get("psi_E", {})
-                         if k.endswith("_x")}
-            p["psxH"] = {k: (state["psi_H"][k], state["lopsi_H"][k])
-                         for k in state.get("psi_H", {})
-                         if k.endswith("_x")}
-            p["hxs"] = _h_slab_pairs(p["H"])
+            p["psxE"] = _pack_psx(state["psi_E"], state["lopsi_E"],
+                                  rows_x_e)
+            p["psxH"] = _pack_psx(state["psi_H"], state["lopsi_H"],
+                                  rows_x_h)
         if drude:
             p["J"] = jnp.stack([state["J"][c] for c in e_comps])
         if drude_m:
@@ -1113,12 +1003,14 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                 psi_h[f"{c}_{AXES[a]}"] = p[f"psH{a}"][j]
                 lo_h[f"{c}_{AXES[a]}"] = p[f"psH{a}"][kk + j]
         if x_pml:
-            for k, (hi, lo) in p["psxE"].items():
-                psi_e[k] = hi
-                lo_e[k] = lo
-            for k, (hi, lo) in p["psxH"].items():
-                psi_h[k] = hi
-                lo_h[k] = lo
+            ce = _unpack_psx(p["psxE"])
+            ch = _unpack_psx(p["psxH"])
+            for j, c in enumerate(rows_x_e):
+                psi_e[f"{c}_x"] = ce[j]
+                lo_e[f"{c}_x"] = ce[kxe + j]
+            for j, c in enumerate(rows_x_h):
+                psi_h[f"{c}_x"] = ch[j]
+                lo_h[f"{c}_x"] = ch[kxh + j]
         if psi_e or psi_h:
             state["psi_E"] = psi_e
             state["psi_H"] = psi_h
@@ -1134,6 +1026,48 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
 
     # ---- the step -------------------------------------------------------
     from fdtd3d_tpu.ops.sources import waveform_ds
+
+    def _prof_pack(coeffs, tag, a):
+        v = jnp.stack(
+            [coeffs[f"pml_slab_{p}{tag}_{AXES[a]}"]
+             for p in ("b", "c", "ik")]
+            + [coeffs[f"pml_slab_{p}{tag}lo_{AXES[a]}"]
+               for p in ("b", "c", "ik")]).astype(fdt)
+        s = [6, 1, 1, 1]
+        s[1 + a] = 2 * slabs[a]
+        return v.reshape(s)
+
+    def _prof_full_x(coeffs, tag):
+        """FULL-LENGTH per-plane x profile pairs (b, c, ik hi then lo;
+        exactly ((0,0),(0,0),(1,0)) outside the absorber), streamed as
+        per-tile (6, T, 1, 1) blocks."""
+        v = jnp.stack(
+            [coeffs[f"pml_{p}{tag}_x"] for p in ("b", "c", "ik")]
+            + [coeffs[f"pml_{p}{tag}lo_x"]
+               for p in ("b", "c", "ik")]).astype(fdt)
+        return v.reshape(6, n1, 1, 1)
+
+    def _vec3_key(coeffs, name, a):
+        s = [1, 1, 1]
+        s[a] = coeffs[name].shape[0]
+        return coeffs[name].astype(fdt).reshape(s)
+
+    def prepare(coeffs):
+        """Chunk-entry hoist of the loop-invariant operand packing
+        (profile stacks, wall reshapes) — see
+        pallas_packed.make_packed_eh_step's prepare."""
+        cc = dict(coeffs)
+        for a in psi_axes_e:
+            cc[f"_pk_prof_e{a}"] = _prof_pack(coeffs, "e", a)
+        for a in psi_axes_h:
+            cc[f"_pk_prof_h{a}"] = _prof_pack(coeffs, "h", a)
+        if x_pml:
+            cc["_pk_prof_ex"] = _prof_full_x(coeffs, "e")
+            cc["_pk_prof_hx"] = _prof_full_x(coeffs, "h")
+        for a in range(3):
+            cc[f"_pk_wall_{AXES[a]}"] = _vec3_key(coeffs,
+                                                  f"wall_{AXES[a]}", a)
+        return cc
 
     def step(pstate, coeffs):
         t = pstate["t"]
@@ -1167,11 +1101,17 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             out = {0: [], 1: [], 2: []}
             locs = {0: [], 1: [], 2: []}
             for corr in recs:
-                # never None: _corr_records pre-filtered |pol| < 1e-14
-                # with the same projection record_term_ds uses
-                th, tl = tfsf_mod.record_term_ds(
+                term = tfsf_mod.record_term_ds(
                     corr, setup, coeffs, inc_for,
                     static.mode.active_axes, static.dx)
+                # invariant: _corr_records pre-filtered |pol| <
+                # tfsf.POL_EPS with the same projection record_term_ds
+                # applies, so a None here means the two filters diverged
+                assert term is not None, \
+                    f"record_term_ds returned None for pre-filtered " \
+                    f"record {corr} — _corr_records and record_term_ds " \
+                    f"must share tfsf.POL_EPS"
+                th, tl = term
                 loc, own = loc_own(corr.axis, corr.plane)
                 if own is not None:
                     # fold normal-axis ownership into the term (exact
@@ -1216,23 +1156,24 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         args = [pstate["E"], pstate["H"]]
         args += [pstate[f"psE{a}"] for a in psi_axes_e]
         args += [pstate[f"psH{a}"] for a in psi_axes_h]
+        if x_pml:
+            args += [pstate["psxE"], pstate["psxH"]]
         if drude:
             args += [pstate["J"]]
         if drude_m:
             args += [pstate["K"]]
 
-        def _prof_pack(tag, a):
-            v = jnp.stack(
-                [coeffs[f"pml_slab_{p}{tag}_{AXES[a]}"]
-                 for p in ("b", "c", "ik")]
-                + [coeffs[f"pml_slab_{p}{tag}lo_{AXES[a]}"]
-                   for p in ("b", "c", "ik")]).astype(fdt)
-            s = [6, 1, 1, 1]
-            s[1 + a] = 2 * slabs[a]
-            return v.reshape(s)
+        def cg(key, fn, *fa):
+            # prepared (chunk-entry) operand when present, else inline
+            return coeffs[key] if key in coeffs else fn(coeffs, *fa)
 
-        args += [_prof_pack("e", a) for a in psi_axes_e]
-        args += [_prof_pack("h", a) for a in psi_axes_h]
+        args += [cg(f"_pk_prof_e{a}", _prof_pack, "e", a)
+                 for a in psi_axes_e]
+        args += [cg(f"_pk_prof_h{a}", _prof_pack, "h", a)
+                 for a in psi_axes_h]
+        if x_pml:
+            args += [cg("_pk_prof_ex", _prof_full_x, "e"),
+                     cg("_pk_prof_hx", _prof_full_x, "h")]
         st_e, iv_e = stack_terms(recs_e, inc_e, psrc) \
             if (recs_e or psrc) else ({}, None)
         st_h, iv_h = stack_terms(recs_h, inc, False) \
@@ -1262,13 +1203,8 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                                [(r, r + 1) for r in range(n_sh - 1)])
             args.append(gh_)
 
-        def _vec3(v, a):
-            s = [1, 1, 1]
-            s[a] = v.shape[0]
-            return v.astype(fdt).reshape(s)
-
-        args += [_vec3(coeffs["wall_x"], 0), _vec3(coeffs["wall_y"], 1),
-                 _vec3(coeffs["wall_z"], 2)]
+        args += [cg(f"_pk_wall_{AXES[a]}", _vec3_key,
+                    f"wall_{AXES[a]}", a) for a in range(3)]
         for k in arr_pair_e + arr_pair_h:
             args += [coeffs[k], coeffs[f"{k}_lo"]]
         args += [coeffs[k] for k in arr_plain_e + arr_plain_h]
@@ -1282,6 +1218,9 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         psh_stacks = {}
         for a in psi_axes_h:
             psh_stacks[a] = outs[p]; p += 1
+        if x_pml:
+            new_state["psxE"] = outs[p]; p += 1
+            new_state["psxH"] = outs[p]; p += 1
         if drude:
             new_state["J"] = outs[p]; p += 1
         if drude_m:
@@ -1292,11 +1231,14 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         # local hi edge; on a sharded axis the true neighbor plane is
         # the UPPER neighbor's first new-E pair plane — ppermute it and
         # add the missing -db*s*E_next/dx contribution on the one edge
-        # plane. Uses the PRE-x-slab-patch kernel output (the x-patch H
-        # correction handles patch effects separately), mirroring the
-        # f32 kernel. Interior-shard slab profiles are identity, so no
-        # psi term needs fixing; at the global hi edge ppermute
-        # delivers zeros and the fix vanishes (one SPMD program).
+        # plane. The plain-curl fix is EXACT for every slab axis (x
+        # included, now that its psi runs in-kernel) by the interior-
+        # shard identity-profile argument: only non-edge shards have a
+        # wrong-ghost diff, and there every slab profile pair is
+        # exactly identity, so the wrong diff fed only no-op psi
+        # recursions and identity F factors. At the global hi edge
+        # ppermute delivers zeros and the fix vanishes (one SPMD
+        # program).
         for a in sharded_axes:
             name = mesh_axes[a]
             n_sh = mesh_shape[name]
@@ -1322,34 +1264,6 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
                     new_H = _pair_add_at(new_H, jc, nh, sl_hi,
                                          fix[0], fix[1])
 
-        if x_pml:
-            psxE = dict(pstate["psxE"])
-            psxH = dict(pstate["psxH"])
-            patches: list = []
-            new_E, psxE = _x_slab_post_ds(
-                static, "E", new_E, e_comps, pstate["hxs"], psxE,
-                coeffs, m0, iv_pair, collect=patches)
-            if patches:
-                new_H, psh_stacks = _apply_x_patch_h_ds(
-                    static, new_H, h_comps, psh_stacks, rows_h,
-                    patches, coeffs, slabs, iv_pair,
-                    mesh_axes, mesh_shape)
-            e_slabs = {d: ((new_E[e_comps.index(d), :m0 + 1],
-                            new_E[ne + e_comps.index(d), :m0 + 1]),
-                           (new_E[e_comps.index(d), n1 - m0 - 1:],
-                            new_E[ne + e_comps.index(d),
-                                  n1 - m0 - 1:]))
-                       for d in sorted({
-                           "E" + AXES[d_axis]
-                           for c in h_comps
-                           for (a, d_axis, s)
-                           in CURL_TERMS[component_axis(c)] if a == 0})}
-            new_H, psxH = _x_slab_post_ds(
-                static, "H", new_H, h_comps, e_slabs, psxH, coeffs,
-                m0, iv_pair)
-            new_state["psxE"] = psxE
-            new_state["psxH"] = psxH
-            new_state["hxs"] = _h_slab_pairs(new_H)
         for a in psi_axes_h:
             new_state[f"psH{a}"] = psh_stacks[a]
         new_state["E"] = new_E
@@ -1360,7 +1274,9 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     step.pack = pack
     step.unpack = unpack
     step.packed = True
+    step.prepare = prepare
     step.diag = {"tile": {"EH": T},
+                 "fused_x": x_pml,
                  "vmem_block_bytes": {"EH": _block_bytes(T)},
                  "vmem_scratch_bytes": _scratch_bytes(T)}
     return step

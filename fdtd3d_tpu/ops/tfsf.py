@@ -52,6 +52,16 @@ from fdtd3d_tpu.ops.sources import waveform
 
 _TAIL = 24  # absorbing-tail length on the incident line, cells
 
+# Polarization-projection cutoff: a correction whose ehat/hhat projection
+# is below this is identically zero physics (an exact geometric zero
+# blurred by the basis construction's f64 rounding) and is dropped. The
+# SINGLE authority for that threshold — corrections_for/record_term_ds
+# here, pallas3d.plane_corrections, and the packed-ds kernel's static
+# record filter (pallas_packed_ds._corr_records) must all agree, or a
+# record could be pre-filtered by one layer and then crash or silently
+# vanish in another (advisor finding r5-2).
+POL_EPS = 1e-14
+
 
 @dataclasses.dataclass(frozen=True)
 class Correction:
@@ -334,7 +344,7 @@ def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
             # Hinc samples live at half positions on the line.
             val = _interp_line(inc["Hinc"], zeta - 0.5)
             pol = setup.hhat[component_axis(corr.src)]
-        if abs(pol) < 1e-14:
+        if abs(pol) < POL_EPS:
             continue
         gate = _corr_gate(corr, setup, gs, active_axes, val.dtype)
         term = jnp.asarray(corr.sign * pol / dx, rdt) * gate * val
@@ -419,7 +429,7 @@ def record_term_ds(corr: Correction, setup: TfsfSetup, coeffs, inc,
         vh, vl = _interp_line_ds(inc["Hinc"], inc["Hinc_lo"],
                                  ds.add_f(zh, zl, np.float32(-0.5)))
         pol = setup.hhat[component_axis(corr.src)]
-    if abs(pol) < 1e-14:
+    if abs(pol) < POL_EPS:
         return None
     ch, cl = ds.from_f64(np.float64(corr.sign) * pol / dx)
     th, tl = ds.mul_ff(vh, vl, ch, cl)

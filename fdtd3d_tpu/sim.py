@@ -54,6 +54,7 @@ class Simulation:
         self._pstate = None
         self._dstate = None
         self._dstate_ids: List[int] = []
+        self._packed_specs = None
         self.static: StaticSetup = build_static(cfg)
         # Topology must be known BEFORE coeffs/state: the CPML psi slab
         # layout (solver.slab_axes) is per-shard.
@@ -84,30 +85,8 @@ class Simulation:
 
         self._mesh_axes = mesh_axes
         self._mesh_shape = mesh_shape
-        self._runner = make_chunk_runner(self.static, mesh_axes, mesh_shape)
-        # Packed-carry plumbing: pack/unpack are per-shard functions, so
-        # under a mesh they run inside shard_map with specs inferred
-        # from the packed pytree's ranks (stacked 4D leaves shard their
-        # trailing three dims; 3D leaves shard all dims; vectors and
-        # scalars replicate).
-        self._pack_fn = getattr(self._runner, "pack", None)
-        self._unpack_fn = getattr(self._runner, "unpack", None)
-        self._packed_specs = None
-        if self.mesh is not None and self._pack_fn is not None:
-            packed_shapes = jax.eval_shape(self._runner.pack, state_shapes)
-            self._packed_specs = pmesh.packed_specs(packed_shapes, topo)
-            self._pack_fn = jax.jit(_shard_map_compat(
-                self._runner.pack, self.mesh,
-                in_specs=(self._state_specs,),
-                out_specs=self._packed_specs))
-            self._unpack_fn = jax.jit(_shard_map_compat(
-                self._runner.unpack, self.mesh,
-                in_specs=(self._packed_specs,),
-                out_specs=self._state_specs))
-        # "pallas"/"pallas_fused" when fused kernels are engaged, else "jnp"
-        self.step_kind: str = getattr(self._runner, "kind", "jnp")
-        # kernel diagnostics (x-tile size, VMEM block bytes) or None (jnp)
-        self.step_diag = getattr(self._runner, "diag", None)
+        self._bind_runner(make_chunk_runner(self.static, mesh_axes,
+                                            mesh_shape))
         if cfg.require_pallas and self.step_kind in ("jnp", "jnp_ds"):
             import jax as _jax
             from fdtd3d_tpu.ops import pallas3d
@@ -139,6 +118,40 @@ class Simulation:
             self.cfg.parallel, self.static.grid_shape,
             self.static.mode.active_axes,
             n_devices=len(devices or jax.devices()))
+
+    def _bind_runner(self, runner):
+        """Adopt a chunk runner: (re)build the pack/unpack plumbing.
+
+        Packed-carry plumbing: pack/unpack are per-shard functions, so
+        under a mesh they run inside shard_map with specs inferred from
+        the packed pytree's ranks (stacked 4D leaves shard their
+        trailing three dims; 3D leaves shard all dims; vectors and
+        scalars replicate). The spec TREE depends only on the carry
+        structure, not the kernel tile, so a VMEM-ladder rebuild
+        (_vmem_fallback) reuses the one computed at init.
+        """
+        self._runner = runner
+        self._pack_fn = getattr(runner, "pack", None)
+        self._unpack_fn = getattr(runner, "unpack", None)
+        if self.mesh is not None and self._pack_fn is not None:
+            if getattr(self, "_packed_specs", None) is None:
+                state_shapes = jax.eval_shape(
+                    lambda: init_state(self.static))
+                packed_shapes = jax.eval_shape(runner.pack, state_shapes)
+                self._packed_specs = pmesh.packed_specs(packed_shapes,
+                                                        self.topology)
+            self._pack_fn = jax.jit(_shard_map_compat(
+                runner.pack, self.mesh,
+                in_specs=(self._state_specs,),
+                out_specs=self._packed_specs))
+            self._unpack_fn = jax.jit(_shard_map_compat(
+                runner.unpack, self.mesh,
+                in_specs=(self._packed_specs,),
+                out_specs=self._state_specs))
+        # "pallas"/"pallas_fused" when fused kernels are engaged, else "jnp"
+        self.step_kind: str = getattr(runner, "kind", "jnp")
+        # kernel diagnostics (x-tile size, VMEM block bytes) or None (jnp)
+        self.step_diag = getattr(runner, "diag", None)
 
     # -- state representation ---------------------------------------------
 
@@ -188,7 +201,7 @@ class Simulation:
 
     # -- stepping ----------------------------------------------------------
 
-    def _chunk_fn(self, n: int, carry):
+    def _chunk_fn(self, n: int):
         """AOT-compile the n-step chunk (cached per n).
 
         Compilation happens here, explicitly, for every path — so (a)
@@ -196,8 +209,11 @@ class Simulation:
         failure of the packed kernel is caught before any donated
         buffer is consumed, letting the VMEM-budget fallback ladder
         rebuild at a smaller tile and recompile with the live carry
-        intact. Runtime failures of the compiled executable propagate
-        untouched (retrying them with donated inputs would be unsound).
+        intact (re-read via _carry() each attempt: the rebuild may
+        have re-packed it — the x-psi carry layout is tile-aligned,
+        ops/pallas_packed.py). Runtime failures of the compiled
+        executable propagate untouched (retrying them with donated
+        inputs would be unsound).
         """
         while n not in self._compiled:
             fn = functools.partial(self._runner, n=n)
@@ -208,9 +224,22 @@ class Simulation:
                                        in_specs=(st_specs,
                                                  self._coeff_specs),
                                        out_specs=st_specs)
-            jitted = jax.jit(fn, donate_argnums=0)
+            # Donate the carry on REAL hardware only (it kills XLA's
+            # defensive/carry copies — docs/PERFORMANCE.md). On the CPU
+            # backend donation is a measured hazard instead of a win:
+            # persistent-cache-DESERIALIZED XLA:CPU executables with
+            # donated buffers mis-execute on this jax build, writing
+            # into buffers other live arrays occupy (reproduced round 6
+            # as nondeterministic corruption of a previously-run sim's
+            # fields, on the unmodified round-5 kernels too; 6/6 clean
+            # with donation off, warm cache, same workload). CPU runs
+            # are tests/interpret-mode only, where the copies cost
+            # nothing that matters.
+            donate = jax.default_backend() in ("tpu", "axon")
+            jitted = jax.jit(fn, donate_argnums=0 if donate else ())
             try:
-                compiled = jitted.lower(carry, self.coeffs).compile()
+                compiled = jitted.lower(self._carry(),
+                                        self.coeffs).compile()
             except Exception as exc:
                 self._vmem_fallback(exc)   # next rung, or re-raise
                 continue
@@ -232,8 +261,9 @@ class Simulation:
             # chunks (the dict form rebuilds lazily via .state)
             self._pstate = self._pack_fn(self._sstate)
             self._sstate = None
-        carry = self._carry()
-        fn = self._chunk_fn(n_steps, carry)
+        fn = self._chunk_fn(n_steps)
+        carry = self._carry()   # after _chunk_fn: a VMEM-ladder rebuild
+        #                         may have re-packed the carry
         if self.clock is not None:
             self.block_until_ready()
             t0 = time.perf_counter()
@@ -265,9 +295,11 @@ class Simulation:
         The tunneled backend surfaces Mosaic VMEM overflows as opaque
         remote-compile errors, so any compile exception of a packed
         runner walks the ladder; rungs that re-pick a tile >= the one
-        that just failed are skipped (no doomed recompiles). The packed
-        carry layout does not depend on the tile, so the live state
-        stays valid across the rebuild.
+        that just failed are skipped (no doomed recompiles). The
+        x-psi stacks of the packed carry are TILE-ALIGNED (round 6),
+        so the rebuild MUST route the live carry through the dict form
+        (old runner's unpack, new runner's pack — the tail of this
+        function); every other leaf is tile-independent.
         """
         from fdtd3d_tpu import log as _log
         from fdtd3d_tpu.ops import pallas_packed
@@ -308,9 +340,19 @@ class Simulation:
             f"budget). The VMEM-temporaries model is calibrated for "
             f"v5e — see ops/pallas_packed.py. Original error: "
             f"{str(exc)[:200]}")
-        self._runner = runner
-        self.step_diag = getattr(self._runner, "diag", None)
+        # The packed carry's x-psi stacks are TILE-ALIGNED (round 6,
+        # ops/pallas_packed.py), so a different tile means a different
+        # carry layout: route the live carry through the dict form —
+        # old runner's unpack, new runner's pack.
+        sstate = None
+        if self._pstate is not None:
+            sstate = self._unpack_fn(self._pstate)
+            self._pstate = None
+            self._dstate = None
+        self._bind_runner(runner)
         self._compiled.clear()
+        if sstate is not None:
+            self._pstate = self._pack_fn(sstate)
 
     def run(self, time_steps: Optional[int] = None,
             on_interval: Optional[Callable] = None,

@@ -1058,12 +1058,21 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     convert once per run with ``run_chunk.pack`` / ``run_chunk.unpack``
     (Simulation keeps the packed carry across chunks so the conversion
     cost is paid once, not per chunk).
+
+    Steps exposing ``prepare`` (the packed kernels) get it called ONCE
+    per chunk, outside the scan: the per-step profile stacks / wall
+    reshapes are loop-invariant, and hoisting them off the scan body
+    shaves the fixed per-step dispatch floor instead of trusting XLA's
+    loop-invariant code motion with them (round 6).
     """
     step = make_step(static, mesh_axes, mesh_shape)
+    prep = getattr(step, "prepare", None)
 
     def run_chunk(state, coeffs, n: int):
+        cc = prep(coeffs) if prep is not None else coeffs
+
         def body(s, _):
-            return step(s, coeffs), None
+            return step(s, cc), None
         out, _ = jax.lax.scan(body, state, None, length=n)
         return out
 

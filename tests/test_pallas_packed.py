@@ -96,6 +96,26 @@ def test_packed_point_source_drude_materials_parity():
                                           radius=3)))
 
 
+def test_packed_fused_x_engages_and_legacy_path_parity():
+    """Round 6: with sources inside the CPML identity region (or no
+    sources) the x-slab CPML runs IN-KERNEL (diag fused_x=True, no hxs
+    carry); a point source INSIDE the absorber fails the interior
+    condition and keeps the legacy post-pass path — both must match
+    the jnp step."""
+    j, p = _parity(pml=PmlConfig(size=(3, 3, 3)),
+                   point_source=PointSourceConfig(
+                       enabled=True, component="Ez", position=(8, 8, 8)))
+    assert p.step_diag["fused_x"] is True
+    assert "hxs" not in p._pstate
+
+    j2, p2 = _parity(pml=PmlConfig(size=(3, 3, 3)),
+                     point_source=PointSourceConfig(
+                         enabled=True, component="Ez",
+                         position=(2, 8, 8)))  # x=2 < npml: in-absorber
+    assert p2.step_diag["fused_x"] is False
+    assert "hxs" in p2._pstate
+
+
 def test_packed_uneven_tiles():
     """Non-power-of-two x extent (12 -> T=4, 3 tiles): exercises the
     lagged index maps and the last-tile jnp H pass on an odd tiling."""

@@ -1,10 +1,20 @@
-"""Weak-scaling harness smoke on the 8-device virtual CPU mesh."""
+"""Weak-scaling harness smoke + planner invariants on the CPU mesh.
+
+The 8-device virtual mesh cannot measure bandwidth, but it CAN pin the
+planner's accounting (VERDICT weak-4): under weak scaling — constant
+per-device tile, growing mesh — the per-chip state and the per-chip
+halo-exchange traffic must be CONSTANT once the set of sharded axes
+stops changing (each sharded axis contributes 2 x planes x tile^2 x
+itemsize regardless of how many shards it has). plan() is pure host
+math, so the invariant is assertable up to pod scale without devices.
+"""
 
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
+import numpy as np  # noqa: E402
 from weak_scaling import run_point  # noqa: E402
 
 
@@ -15,7 +25,61 @@ def test_weak_scaling_points_run():
     assert r8["global_size"] != r1["global_size"], "workload must grow"
     assert r8["mcells_per_s"] > 0 and r1["mcells_per_s"] > 0
     # per-device local volume is constant (weak scaling)
-    import numpy as np
     v1 = np.prod(r1["global_size"]) / r1["n_devices"]
     v8 = np.prod(r8["global_size"]) / r8["n_devices"]
     assert v1 == v8
+
+
+def _plan_for(tile: int, n_devices: int):
+    from fdtd3d_tpu.config import ParallelConfig, PmlConfig, SimConfig
+    from fdtd3d_tpu.parallel.mesh import choose_topology
+    from fdtd3d_tpu.plan import plan
+
+    # same sizing rule tools/weak_scaling.run_point uses
+    probe = choose_topology(n_devices, (tile * n_devices,) * 3, (0, 1, 2))
+    size = tuple(tile * p for p in probe)
+    cfg = SimConfig(
+        scheme="3D", size=size, time_steps=4, dx=1e-3,
+        courant_factor=0.5, wavelength=32e-3,
+        pml=PmlConfig(size=(min(10, tile // 4),) * 3),
+        parallel=ParallelConfig(topology="auto", n_devices=n_devices))
+    return plan(cfg, n_devices=n_devices)
+
+
+def test_halo_traffic_invariant_under_weak_scaling():
+    """plan.py's per-chip halo bytes/step must be constant under weak
+    scaling once all three axes shard (8 -> 64 -> 512 chips), and must
+    equal the hand formula: per sharded axis, 2 directions x
+    _halo_planes curl-term planes x tile^2 x itemsize (VERDICT weak-4).
+    """
+    from fdtd3d_tpu.plan import _halo_planes
+    from fdtd3d_tpu.solver import build_static
+    from fdtd3d_tpu.config import SimConfig
+
+    tile = 16
+    plans = {n: _plan_for(tile, n) for n in (8, 64, 512)}
+    # all-axes-sharded topologies: identical local shape and halo bytes
+    for n, p in plans.items():
+        assert all(t > 1 for t in p.topology), (n, p.topology)
+        assert p.local_shape == (tile, tile, tile)
+    halos = {n: p.halo_bytes_per_step for n, p in plans.items()}
+    assert len(set(halos.values())) == 1, halos
+
+    # hand formula cross-check against the mode's curl-term counts
+    mode = build_static(SimConfig(scheme="3D", size=(16, 16, 16),
+                                  time_steps=1)).mode
+    expect = sum(2 * _halo_planes(mode, a) * tile * tile * 4
+                 for a in range(3))
+    assert halos[8] == expect, (halos[8], expect)
+
+    # per-chip state is constant under weak scaling too
+    hbm = {n: p.hbm_per_chip for n, p in plans.items()}
+    assert len(set(hbm.values())) == 1, hbm
+
+
+def test_plan_matches_live_run_topology():
+    """The planner's chosen topology agrees with what the live 8-device
+    run resolves (the accounting is about THAT decomposition)."""
+    r8 = run_point(8, tile=16, steps=4)
+    p8 = _plan_for(16, 8)
+    assert tuple(r8["topology"]) == p8.topology

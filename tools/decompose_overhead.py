@@ -7,13 +7,20 @@ candidate costs with controlled contrasts, all on the real chip:
 
   * chunk-length sweep (same total steps, different scan chunking) —
     per-dispatch + readback overhead vs per-scan-iteration cost;
-  * pml=10 vs pml=0 at fixed grid — the slab_post patch passes, psi
-    stacks, and hxs carry cost;
+  * pml=10 vs pml=0 at fixed grid — the CPML cost (round 6: with the
+    fused-x kernel this is pure in-kernel slab algebra + psi stack
+    traffic; the slab_post patch chain and hxs carry are gone);
   * volume sweep at fixed config — fit t_step = a + b*cells: `a` is
     the per-step floor (sequencer/DMA-setup/fusion overheads), `b`
     the marginal bandwidth cost (1/b vs the HBM probe = how
     bandwidth-bound the marginal cell is);
-  * f32 vs bf16 at the largest common grid.
+  * f32 vs bf16 at the largest common grid;
+  * packed-ds (float32x2) attribution at 320-512^3 (round 6): the same
+    fit, read against BOTH roofs — marginal bandwidth at 96 B/cell vs
+    the HBM probe, and implied VPU f32 throughput at ~900 flops/cell —
+    and a named binding constraint ("HBM" / "VPU" / "overhead") so the
+    next EFT-prune or tiling decision is justified by a measurement,
+    not a guess (docs/PERFORMANCE.md round-6 section).
 
 Prints one JSON blob; paste the table into docs/PERFORMANCE.md.
 """
@@ -99,7 +106,74 @@ def main():
     out["ms_per_step_512_bf16"] = round(time_chunk(sb, 30) / 30 * 1e3, 3)
     del sb
 
+    # 5. packed-ds attribution (round 6): which roof binds the
+    # accuracy-mode kernel — HBM (96 B/cell pair traffic), VPU (~900
+    # f32 flops/cell of EFT arithmetic), or the fixed per-step floor.
+    ds_attribution(out)
+
     print(json.dumps(out), flush=True)
+
+
+# EFT flops per cell of the ds kernel body (module-docstring class
+# estimate, round 5: 1615 Mcells/s x ~900 flops/cell ~ 1.5 TFLOP/s).
+DS_FLOPS_PER_CELL = 900.0
+DS_BYTES_PER_CELL = 96.0
+
+
+def ds_attribution(out):
+    """Fit t = a + b*cells for the packed-ds kernel over 320-512^3 and
+    NAME its binding constraint. Degrades gracefully (partial sizes ->
+    partial record; never throws)."""
+    vols = {}
+    for n in (512, 448, 384, 320):
+        try:
+            s = _mk(n, 10, dtype="float32x2", steps=120)
+            if s.step_kind != "pallas_packed_ds":
+                raise RuntimeError(f"step_kind {s.step_kind}")
+            vols[n] = time_chunk(s, 30) / 30
+            del s
+        except Exception as e:
+            out.setdefault("ds_size_failures", {})[n] = repr(e)[:160]
+    out["ds_s_per_step_by_n"] = {k: round(v, 6) for k, v in vols.items()}
+    if len(vols) < 3:
+        out["ds_binding_constraint"] = "UNMEASURED (need >=3 sizes)"
+        return
+    import numpy as np
+    ns = np.array(sorted(vols))
+    cells = ns.astype(np.float64) ** 3
+    ts = np.array([vols[int(n)] for n in ns])
+    b, a = np.polyfit(cells, ts, 1)
+    out["ds_fit_overhead_ms"] = round(a * 1e3, 3)
+    out["ds_fit_marginal_ns_per_cell"] = round(b * 1e9, 4)
+    marg_gbps = DS_BYTES_PER_CELL / b / 1e9
+    out["ds_marginal_gbps_at_96B"] = round(marg_gbps, 1)
+    out["ds_implied_vpu_tflops"] = round(DS_FLOPS_PER_CELL / b / 1e12, 2)
+    # attribution: overhead if the fixed floor still dominates the
+    # mid-size step; else HBM if the marginal cell moves >=70% of the
+    # same-window probe; else the VPU is what's left absorbing the
+    # marginal time (the EFT arithmetic).
+    mid = int(ns[len(ns) // 2])
+    overhead_frac = a / vols[mid] if vols[mid] > 0 else 0.0
+    out["ds_overhead_frac_at_mid"] = round(float(overhead_frac), 3)
+    probe = out.get("hbm_probe_gbps") or -1.0
+    if overhead_frac >= 0.5:
+        out["ds_binding_constraint"] = "overhead"
+        out["ds_remediation"] = ("shrink the fixed per-step cost: "
+                                 "fewer operands / longer chunks")
+    elif probe > 0 and marg_gbps >= 0.7 * probe:
+        out["ds_binding_constraint"] = "HBM"
+        out["ds_remediation"] = ("traffic work: temporal blocking or "
+                                 "narrower psi/coeff streams; EFT "
+                                 "prunes would not help")
+    else:
+        out["ds_binding_constraint"] = "VPU"
+        out["ds_remediation"] = ("EFT prunes: drop lo-word propagation "
+                                 "through terms provably below the "
+                                 "hi-word readout floor "
+                                 "(docs/PERFORMANCE.md round-6 list)")
+    if probe <= 0:
+        out["ds_binding_note"] = ("HBM probe unreliable this window: "
+                                  "HBM vs VPU split is indicative only")
 
 
 if __name__ == "__main__":
