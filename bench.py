@@ -45,8 +45,20 @@ ATTEMPT_TIMEOUT_S = 2400
 
 
 def measure(n: int, steps: int, use_pallas, repeats: int = 3,
-            dtype: str = "float32", require_kind: str = "") -> float:
+            dtype: str = "float32", require_kind: str = "",
+            stats: dict = None) -> float:
     """Mcells/s for one path. Import jax lazily: the parent never does.
+
+    ``stats``: optional dict filled with the StepClock summary of the
+    timed chunks (incl. the p50/p95/max per-chunk Mcells/s percentiles)
+    — embedded in the BENCH json for the headline stages. When
+    FDTD3D_BENCH_TELEMETRY is set, every stage also appends its
+    flight-recorder JSONL (per-chunk health counters + provenance) to
+    that path, delimited by run_start/run_end records per stage —
+    NOTE: the sink's per-chunk scalar readback then lands inside this
+    function's timed window (~180 ms/chunk through the tunnel), so
+    telemetry-on numbers are for diagnosis, not headline scoring
+    (stats carries telemetry_enabled=True to mark them).
 
     ``steps`` is the CHUNK length of one timed advance(). It matters a
     lot: the tunnel charges a fixed ~180 ms per dispatch+readback
@@ -59,7 +71,7 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3,
     import jax
     import numpy as np
 
-    from fdtd3d_tpu.config import PmlConfig, SimConfig
+    from fdtd3d_tpu.config import OutputConfig, PmlConfig, SimConfig
     from fdtd3d_tpu.sim import Simulation
 
     cfg = SimConfig(
@@ -67,34 +79,70 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3,
         courant_factor=0.5, wavelength=32e-3,
         pml=PmlConfig(size=(10, 10, 10)),
         dtype=dtype, use_pallas=use_pallas,
+        output=OutputConfig(
+            profile=True,
+            telemetry_path=os.environ.get("FDTD3D_BENCH_TELEMETRY")
+            or None),
     )
     sim = Simulation(cfg)
-    if require_kind and sim.step_kind != require_kind:
-        # a silent fallback (e.g. jnp-ds at ~140 Mcells/s) must not be
-        # reported as the kernel's number — raise so the caller's
-        # grid-size ladder treats it like any other failed attempt
-        raise RuntimeError(
-            f"stage requires step_kind {require_kind}, got "
-            f"{sim.step_kind}")
-    # Warm up: compile AND force one real device->host readback (async
-    # dispatch through the device tunnel can make a bare block_until_ready
-    # return before execution — measured 0.3ms for 50 steps without this).
-    # sample() reads ONE element of the live carry — with the packed
-    # kernel engaged, sim.state[...] would unpack full volumes inside
-    # the timing window (~10% inflation at 256^3).
-    sim.advance(steps)
-    sim.sample("Ez", (n // 2, n // 2, n // 2))
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
+    snk = sim.telemetry
+    # suppress the warm-up chunk's telemetry record (first tunnel
+    # dispatch + executable upload is orders slower): it would sit in
+    # the recording's first half and trip telemetry_report's >10%
+    # throughput-drift flag on every stage; re-attached below
+    sim.telemetry = None
+    try:
+        if require_kind and sim.step_kind != require_kind:
+            # a silent fallback (e.g. jnp-ds at ~140 Mcells/s) must not
+            # be reported as the kernel's number — raise so the
+            # caller's grid-size ladder treats it like any other
+            # failed attempt
+            raise RuntimeError(
+                f"stage requires step_kind {require_kind}, got "
+                f"{sim.step_kind}")
+        # Warm up: compile AND force one real device->host readback
+        # (async dispatch through the device tunnel can make a bare
+        # block_until_ready return before execution — measured 0.3ms
+        # for 50 steps without this). sample() reads ONE element of
+        # the live carry — with the packed kernel engaged,
+        # sim.state[...] would unpack full volumes inside the timing
+        # window (~10% inflation at 256^3).
         sim.advance(steps)
-        sim.block_until_ready()
         sim.sample("Ez", (n // 2, n // 2, n // 2))
-        best = min(best, time.perf_counter() - t0)
+        if sim.clock is not None:
+            # the warm-up chunk must not pollute the percentiles either
+            sim.clock.records.clear()
+        sim.telemetry = snk
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            sim.advance(steps)
+            sim.block_until_ready()
+            sim.sample("Ez", (n // 2, n // 2, n // 2))
+            best = min(best, time.perf_counter() - t0)
 
-    for comp, v in sim.fields().items():
-        assert np.isfinite(v).all(), f"{comp} not finite"
-    return (n ** 3) * steps / best / 1e6
+        for comp, v in sim.fields().items():
+            assert np.isfinite(v).all(), f"{comp} not finite"
+        if stats is not None:
+            stats.clear()
+            stats.update(sim.clock.summary())
+            stats["n"] = n
+            stats["dtype"] = dtype
+            if cfg.output.telemetry_path:
+                # flag it: with the sink on, advance()'s per-chunk
+                # scalar readback (~180 ms tunnel round-trip) lands in
+                # THIS function's outer timed window, deflating the
+                # recorded Mcells/s — a diagnosis posture, not a
+                # headline-scoring one
+                stats["telemetry_enabled"] = True
+        return (n ** 3) * steps / best / 1e6
+    finally:
+        # every exit (incl. the retry ladder's exceptions) must end the
+        # recording with its run_end record and release the fd — even
+        # when the warm-up failed before the sink was re-attached
+        if sim.telemetry is None:
+            sim.telemetry = snk
+        sim.close_telemetry()
 
 
 def probe_hbm_gbps() -> float:
@@ -333,8 +381,10 @@ def run_measurement() -> None:
     else:
         n, steps = 64, 10
     t_stage1 = time.time()
-    jnp_mc = measure(n, steps, use_pallas=False)
-    pallas_mc = measure(n, steps, use_pallas=True) if on_tpu else 0.0
+    jnp_stats, f32_stats, bf16_stats, ds_stats = {}, {}, {}, {}
+    jnp_mc = measure(n, steps, use_pallas=False, stats=jnp_stats)
+    pallas_mc = measure(n, steps, use_pallas=True,
+                        stats=f32_stats) if on_tpu else 0.0
     stage1_s = time.time() - t_stage1
     # Stage 2: the 256^3 pallas timing itself is the 512^3 go/no-go —
     # a direct measurement of THIS window's speed, unlike the HBM probe.
@@ -347,9 +397,11 @@ def run_measurement() -> None:
     if on_tpu and pallas_mc >= GATE_MCELLS_512 and \
             stage1_s < STAGE1_BUDGET_S:
         try:
-            jnp_512 = measure(512, 30, use_pallas=False)
+            jnp_512 = measure(512, 30, use_pallas=False,
+                              stats=jnp_stats)
             try:
-                pallas_512 = measure(512, 90, use_pallas=True)
+                pallas_512 = measure(512, 90, use_pallas=True,
+                                     stats=f32_stats)
             except Exception:
                 # retry ladder: two-pass at the raised budget (unless
                 # the caller pinned one), then two-pass at the default
@@ -362,10 +414,12 @@ def run_measurement() -> None:
                     if saved["FDTD3D_VMEM_BUDGET_MB"] is None:
                         os.environ["FDTD3D_VMEM_BUDGET_MB"] = "86"
                     try:
-                        pallas_512 = measure(512, 90, use_pallas=True)
+                        pallas_512 = measure(512, 90, use_pallas=True,
+                                             stats=f32_stats)
                     except Exception:
                         os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
-                        pallas_512 = measure(512, 90, use_pallas=True)
+                        pallas_512 = measure(512, 90, use_pallas=True,
+                                             stats=f32_stats)
                 finally:
                     for k, v in saved.items():
                         if v is None:
@@ -387,7 +441,8 @@ def run_measurement() -> None:
     if on_tpu and pallas_mc >= GATE_MCELLS_512:
         if n >= 512:
             try:
-                f32_640 = measure(640, 120, use_pallas=True)
+                f32_640 = measure(640, 120, use_pallas=True,
+                                  stats=f32_stats)
                 if f32_640 > pallas_mc:
                     pallas_mc, n = f32_640, 640
             except Exception as e:
@@ -400,7 +455,8 @@ def run_measurement() -> None:
                 # the fixed ~180 ms round-trip tax is still ~3 ms/step
                 # at 60; session-3 close-out, 2026-07-31
                 bf16_mc = measure(bn, 90 if bn == 512 else 120,
-                                  use_pallas=True, dtype="bfloat16")
+                                  use_pallas=True, dtype="bfloat16",
+                                  stats=bf16_stats)
                 bf16_n = bn
                 break
             except Exception as e:
@@ -422,7 +478,8 @@ def run_measurement() -> None:
             try:
                 ds_mc = measure(dn, 60, use_pallas=True,
                                 dtype="float32x2",
-                                require_kind="pallas_packed_ds")
+                                require_kind="pallas_packed_ds",
+                                stats=ds_stats)
                 ds_n = dn
                 break
             except Exception as e:
@@ -459,6 +516,14 @@ def run_measurement() -> None:
         "float32x2_n": ds_n,
         "hbm_probe_gbps": gbps,
         "platform": platform,
+        # Per-chunk Mcells/s percentiles (StepClock.summary) of the
+        # last successful stage per dtype: the in-run variance a single
+        # best-of-repeats number hides (tunnel throttling mid-stage
+        # shows as a p50/max gap).
+        "chunk_stats": {k: v for k, v in
+                        (("jnp", jnp_stats), ("f32", f32_stats),
+                         ("bf16", bf16_stats), ("float32x2", ds_stats))
+                        if v},
         # Per-dtype accuracy class: the RECORDED frontier measurements
         # (BASELINE.md) — the long-horizon classes are not re-measured
         # per run, but the <=100-step spot-check above GUARDS them: a

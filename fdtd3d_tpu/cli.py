@@ -190,6 +190,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler (XProf/TensorBoard) trace "
                         "of the run to DIR: per-step HLO timeline incl. "
                         "halo collectives vs stencil compute")
+    g.add_argument("--telemetry", metavar="PATH", default=None,
+                   help="flight recorder: append schema-versioned JSONL "
+                        "records (per-chunk in-graph health counters, "
+                        "wall time, run provenance, VMEM-ladder events) "
+                        "to PATH; summarize with "
+                        "tools/telemetry_report.py")
 
     g = p.add_argument_group("planning")
     g.add_argument("--dry-run", action=argparse.BooleanOptionalAction, default=False,
@@ -327,7 +333,8 @@ def args_to_config(args) -> SimConfig:
             checkpoint_backend=args.checkpoint_backend,
             norms_every=args.norms_every, metrics_every=args.metrics_every,
             log_level=args.log_level,
-            profile=args.profile, check_finite=args.check_finite),
+            profile=args.profile, check_finite=args.check_finite,
+            telemetry_path=args.telemetry),
         ntff=NtffConfig(
             enabled=args.ntff, frequency=args.ntff_frequency,
             every=args.ntff_every, start=args.ntff_start,
@@ -449,9 +456,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "--dry-run with --topology auto needs --num-devices N "
                 "(the plan depends on the chip count you are sizing for)")
         p_ = plan_mod.plan(cfg, n_devices=args.num_devices or 1)
-        print(f"dry run: scheme={cfg.scheme} global={cfg.grid_shape} "
-              f"steps={cfg.time_steps} dtype={cfg.dtype}")
-        print(p_.report())
+        from fdtd3d_tpu.log import log as _plan_log
+        # all_ranks=True skips log()'s jax.process_index() rank gate:
+        # --dry-run is a planning-only command that must not initialize
+        # the (possibly absent/fragile) backend just to print
+        _plan_log(f"dry run: scheme={cfg.scheme} global={cfg.grid_shape} "
+                  f"steps={cfg.time_steps} dtype={cfg.dtype}",
+                  all_ranks=True)
+        _plan_log(p_.report(), all_ranks=True)
         return 0
 
     if args.coordinator_address or args.num_processes or \
@@ -468,127 +480,144 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fdtd3d_tpu.sim import Simulation  # deferred: jax init is slow
     set_level(cfg.output.log_level)
     sim = Simulation(cfg)
-    if args.load_checkpoint:
-        sim.restore(args.load_checkpoint)
-        log(f"restored checkpoint {args.load_checkpoint} at t={sim.t}")
-    if cfg.output.save_materials:
-        io.write_materials(sim)
-    import jax
-    log(f"fdtd3d-tpu: scheme={cfg.scheme} size={cfg.grid_shape} "
-        f"steps={cfg.time_steps} dt={cfg.dt:.3e}s "
-        f"topology={sim.topology} devices={jax.device_count()}")
-    # engaged-path observability (VERDICT r2 item 7): which kernel
-    # actually runs, its x-tile size, and the VMEM working set.
-    line = f"step_kind={sim.step_kind}"
-    if sim.step_diag:
-        tiles = ",".join(f"{k}:{v}"
-                         for k, v in sim.step_diag["tile"].items())
-        vmem = ",".join(
-            f"{k}:{v / 1048576:.1f}MiB"
-            for k, v in sim.step_diag["vmem_block_bytes"].items())
-        line += f" tile=[{tiles}] vmem_block=[{vmem}]"
-    log(line)
+    # ONE try/finally from construction (which opens the telemetry
+    # sink and writes run_start) to the end: EVERY exit — config
+    # errors before the run, a NaN blow-up's FloatingPointError
+    # mid-run, IO failures after it — must end the recording with
+    # its run_end record (first_unhealthy_t) and release the fd.
+    try:
+        if args.load_checkpoint:
+            sim.restore(args.load_checkpoint)
+            log(f"restored checkpoint {args.load_checkpoint} at t={sim.t}")
+        if cfg.output.save_materials:
+            io.write_materials(sim)
+        import jax
+        log(f"fdtd3d-tpu: scheme={cfg.scheme} size={cfg.grid_shape} "
+            f"steps={cfg.time_steps} dt={cfg.dt:.3e}s "
+            f"topology={sim.topology} devices={jax.device_count()}")
+        # engaged-path observability (VERDICT r2 item 7): which kernel
+        # actually runs, its x-tile size, and the VMEM working set.
+        line = f"step_kind={sim.step_kind}"
+        if sim.step_diag:
+            tiles = ",".join(f"{k}:{v}"
+                             for k, v in sim.step_diag["tile"].items())
+            vmem = ",".join(
+                f"{k}:{v / 1048576:.1f}MiB"
+                for k, v in sim.step_diag["vmem_block_bytes"].items())
+            line += f" tile=[{tiles}] vmem_block=[{vmem}]"
+        log(line)
 
-    # NTFF: resolve cadence defaults and build the collector (reference
-    # --ntff-* surface; running DFT sampled between compute chunks).
-    ntff_col = None
-    ntff_every = ntff_start = 0
-    if cfg.ntff.enabled:
-        # Multi-process-capable: sampling accumulates device-side and is
-        # collective (every rank runs on_interval); the pattern is
-        # evaluated from the allgathered accumulators on rank 0.
-        from fdtd3d_tpu.ntff import NtffCollector
-        freq, ntff_every, ntff_start = resolve_ntff_cadence(cfg)
-        box = None
-        if cfg.ntff.box_lo is not None or cfg.ntff.box_hi is not None:
-            if cfg.ntff.box_lo is None or cfg.ntff.box_hi is None:
-                raise SystemExit(
-                    "--ntff-box-lo and --ntff-box-hi must be given "
-                    "together")
-            box = (cfg.ntff.box_lo, cfg.ntff.box_hi)
-        ntff_col = NtffCollector(sim, frequency=freq, box=box,
-                                 margin=cfg.ntff.margin)
+        # NTFF: resolve cadence defaults and build the collector (reference
+        # --ntff-* surface; running DFT sampled between compute chunks).
+        ntff_col = None
+        ntff_every = ntff_start = 0
+        if cfg.ntff.enabled:
+            # Multi-process-capable: sampling accumulates device-side and is
+            # collective (every rank runs on_interval); the pattern is
+            # evaluated from the allgathered accumulators on rank 0.
+            from fdtd3d_tpu.ntff import NtffCollector
+            freq, ntff_every, ntff_start = resolve_ntff_cadence(cfg)
+            box = None
+            if cfg.ntff.box_lo is not None or cfg.ntff.box_hi is not None:
+                if cfg.ntff.box_lo is None or cfg.ntff.box_hi is None:
+                    raise SystemExit(
+                        "--ntff-box-lo and --ntff-box-hi must be given "
+                        "together")
+                box = (cfg.ntff.box_lo, cfg.ntff.box_hi)
+            ntff_col = NtffCollector(sim, frequency=freq, box=box,
+                                     margin=cfg.ntff.margin)
 
-    t0 = time.time()
-    # gcd, not min: with cadences 10 and 3, chunking by 3 would never land
-    # on a multiple of 10 and those dumps would silently be skipped.
-    import math
-    interval = 0
-    for v in (cfg.output.save_res, cfg.output.norms_every,
-              cfg.output.checkpoint_every, cfg.output.metrics_every,
-              ntff_every):
-        if v:
-            interval = math.gcd(interval, v)
+        t0 = time.time()
+        # gcd, not min: with cadences 10 and 3, chunking by 3 would never land
+        # on a multiple of 10 and those dumps would silently be skipped.
+        import math
+        interval = 0
+        for v in (cfg.output.save_res, cfg.output.norms_every,
+                  cfg.output.checkpoint_every, cfg.output.metrics_every,
+                  ntff_every):
+            if v:
+                interval = math.gcd(interval, v)
 
-    def on_interval(s):
-        if ntff_col is not None and s.t >= ntff_start and \
-                s.t % ntff_every == 0:
-            ntff_col.sample()
-        # metrics BEFORE norms: when both cadences land on one step,
-        # field_norms reuses the full metrics pass via diag's per-step
-        # cache instead of launching its own max reductions.
-        if cfg.output.metrics_every and \
-                s.t % cfg.output.metrics_every == 0:
-            import jax
-            rec = diag.metrics(s)   # collective gathers: ALL ranks
-            if jax.process_index() == 0:
+        from fdtd3d_tpu import telemetry as _telemetry
+
+        def on_interval(s):
+            if ntff_col is not None and s.t >= ntff_start and \
+                    s.t % ntff_every == 0:
+                with _telemetry.span("ntff-sample"):
+                    ntff_col.sample()
+            # metrics BEFORE norms: when both cadences land on one step,
+            # field_norms reuses the full metrics pass via diag's per-step
+            # cache instead of launching its own max reductions.
+            if cfg.output.metrics_every and \
+                    s.t % cfg.output.metrics_every == 0:
+                import jax
+                rec = diag.metrics(s)   # collective gathers: ALL ranks
+                if jax.process_index() == 0:
+                    import os
+                    os.makedirs(cfg.output.save_dir, exist_ok=True)
+                    with open(os.path.join(cfg.output.save_dir,
+                                           "metrics.jsonl"), "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+            if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
+                norms = diag.field_norms(s)   # collective: ALL ranks
+                txt = " ".join(f"{k}={v:.4e}"
+                               for k, v in sorted(norms.items()))
+                log(f"[t={s.t}] {txt}")  # rank-0-only inside log()
+            if cfg.output.save_res and s.t % cfg.output.save_res == 0:
+                with _telemetry.span("io-dump"):
+                    io.write_outputs(s, s.t)
+            if cfg.output.checkpoint_every and \
+                    s.t % cfg.output.checkpoint_every == 0:
                 import os
                 os.makedirs(cfg.output.save_dir, exist_ok=True)
-                with open(os.path.join(cfg.output.save_dir,
-                                       "metrics.jsonl"), "a") as f:
-                    f.write(json.dumps(rec) + "\n")
-        if cfg.output.norms_every and s.t % cfg.output.norms_every == 0:
-            norms = diag.field_norms(s)   # collective: ALL ranks
-            txt = " ".join(f"{k}={v:.4e}"
-                           for k, v in sorted(norms.items()))
-            log(f"[t={s.t}] {txt}")  # rank-0-only inside log()
-        if cfg.output.save_res and s.t % cfg.output.save_res == 0:
-            io.write_outputs(s, s.t)
-        if cfg.output.checkpoint_every and \
-                s.t % cfg.output.checkpoint_every == 0:
-            import os
-            os.makedirs(cfg.output.save_dir, exist_ok=True)
-            ext = ".npz" if cfg.output.checkpoint_backend == "npz" else ""
-            s.checkpoint(os.path.join(cfg.output.save_dir,
-                                      f"ckpt_t{s.t:06d}{ext}"),
-                         backend=cfg.output.checkpoint_backend)
+                ext = ".npz" if cfg.output.checkpoint_backend == "npz" else ""
+                with _telemetry.span("checkpoint"):
+                    s.checkpoint(os.path.join(cfg.output.save_dir,
+                                              f"ckpt_t{s.t:06d}{ext}"),
+                                 backend=cfg.output.checkpoint_backend)
 
-    # After a checkpoint restore, run only the REMAINING steps so the
-    # resumed run ends at the same t as the uninterrupted one.
-    remaining = max(0, cfg.time_steps - sim.t) if args.load_checkpoint \
-        else cfg.time_steps
-    import contextlib
+        # After a checkpoint restore, run only the REMAINING steps so the
+        # resumed run ends at the same t as the uninterrupted one.
+        remaining = max(0, cfg.time_steps - sim.t) if args.load_checkpoint \
+            else cfg.time_steps
+        import contextlib
 
-    from fdtd3d_tpu import profiling
-    tracer = profiling.trace(args.trace) if args.trace \
-        else contextlib.nullcontext()
-    with tracer:
-        sim.run(time_steps=remaining,
-                on_interval=on_interval if interval else None,
-                interval=interval)
-        sim.block_until_ready()
-    if ntff_col is not None:
-        if ntff_col.n_samples > 0:
-            import jax
-            _ = ntff_col.acc  # collective gather: ALL ranks participate
-            if jax.process_index() == 0:
-                path = write_ntff_pattern(ntff_col, cfg)
-                log(f"ntff: {ntff_col.n_samples} samples -> {path}")
-        else:
-            from fdtd3d_tpu.log import warn
-            warn(f"ntff: no samples collected (first sample at "
-                 f"step {ntff_start}, every {ntff_every}, run ends at "
-                 f"{cfg.time_steps}) — no pattern written")
-    dt_wall = time.time() - t0
-    cells = 1.0
-    for a in sim.static.mode.active_axes:
-        cells *= cfg.grid_shape[a]
-    mcps = cells * cfg.time_steps / dt_wall / 1e6
-    if sim.clock is not None:
-        log(f"profile: {sim.clock.report()}")
-    log(f"done: {cfg.time_steps} steps in {dt_wall:.2f}s "
-        f"({mcps:.1f} Mcells/s)")
-    return 0
+        from fdtd3d_tpu import profiling
+        tracer = profiling.trace(args.trace) if args.trace \
+            else contextlib.nullcontext()
+        with tracer:
+            sim.run(time_steps=remaining,
+                    on_interval=on_interval if interval else None,
+                    interval=interval)
+            sim.block_until_ready()
+        if ntff_col is not None:
+            if ntff_col.n_samples > 0:
+                import jax
+                _ = ntff_col.acc  # collective gather: ALL ranks participate
+                if jax.process_index() == 0:
+                    path = write_ntff_pattern(ntff_col, cfg)
+                    log(f"ntff: {ntff_col.n_samples} samples -> {path}")
+            else:
+                from fdtd3d_tpu.log import warn
+                warn(f"ntff: no samples collected (first sample at "
+                     f"step {ntff_start}, every {ntff_every}, run ends at "
+                     f"{cfg.time_steps}) — no pattern written")
+        dt_wall = time.time() - t0
+        cells = 1.0
+        for a in sim.static.mode.active_axes:
+            cells *= cfg.grid_shape[a]
+        mcps = cells * cfg.time_steps / dt_wall / 1e6
+        if sim.clock is not None:
+            log(f"profile: {sim.clock.report()}")
+        log(f"done: {cfg.time_steps} steps in {dt_wall:.2f}s "
+            f"({mcps:.1f} Mcells/s)")
+        return 0
+    finally:
+        if sim.telemetry is not None:
+            n_rec = sim.telemetry.n_records
+            sim.close_telemetry()
+            log(f"telemetry: {n_rec + 1} records -> "
+                f"{cfg.output.telemetry_path}")
 
 
 if __name__ == "__main__":

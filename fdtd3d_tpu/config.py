@@ -207,10 +207,20 @@ class OutputConfig:
     # aggregated in sim.clock (reference Clock compute-share timing,
     # SURVEY.md §5.1).
     profile: bool = False
-    # NaN/Inf tripwire over the whole state pytree after every advance()
-    # chunk (profiling.assert_finite; reference ASSERT posture, §5.2).
-    # Independent of log_level so it can guard production runs.
+    # NaN/Inf tripwire after every advance() chunk. Implemented by the
+    # IN-GRAPH health counters (fdtd3d_tpu/telemetry.py): one fused
+    # reduction inside the compiled chunk + one scalar readback, never
+    # a host-side pass over the full pytree (the paired-complex path's
+    # legs are reduced in-graph too). Independent of log_level so it
+    # can guard production runs.
     check_finite: bool = False
+    # Flight-recorder JSONL (fdtd3d_tpu/telemetry.py): when set, every
+    # advance() chunk appends a schema-versioned record (in-graph
+    # health counters, wall time, throughput) to this path, after a
+    # run_start provenance record; VMEM-ladder downgrades are recorded
+    # as ladder_downgrade events. CLI flag: --telemetry PATH.
+    # Summarize with tools/telemetry_report.py.
+    telemetry_path: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -25,6 +25,50 @@ from fdtd3d_tpu import materials, physics
 from fdtd3d_tpu.layout import component_axis
 
 
+def div_e_parts(e_state, e_comps, active, inv_dx, cast=None):
+    """Discrete interior div·E residual parts -> (sumsq, count, linf).
+
+    The Yee update conserves the discrete divergence of D exactly in
+    source-free uniform regions; growth flags a stencil/coefficient bug
+    or an unaccounted source. The backward difference of each E
+    component along its own axis lands on integer cells. PEC walls
+    carry surface charge (nonzero div there is physics), so the
+    residual is measured on interior cells only — which also makes
+    this shard_map-safe: the per-shard boundary planes (where the
+    backward difference would need a halo) are exactly the excluded
+    ones, so under a mesh the caller psums (sumsq, count) / pmaxes
+    linf and gets a slightly undersampled but never-wrong global
+    residual. ``cast``: optional compute dtype applied to each field
+    before differencing (skip for complex fields). Shared by
+    _device_metrics and telemetry.make_health_fn.
+    """
+    div = None
+    for c in e_comps:
+        a = component_axis(c)
+        if a not in active:
+            continue
+        arr = e_state[c]
+        if cast is not None and arr.dtype != cast:
+            arr = arr.astype(cast)
+        pad = [(0, 0)] * 3
+        pad[a] = (1, 0)
+        shifted = jnp.pad(
+            jax.lax.slice_in_dim(arr, 0, arr.shape[a] - 1, axis=a), pad)
+        d = (arr - shifted) * inv_dx
+        div = d if div is None else div + d
+    if div is None:
+        z = jnp.zeros((), jnp.float32)
+        return z, jnp.ones((), jnp.float32), z
+    sl = [slice(None)] * 3
+    for a in active:
+        sl[a] = slice(1, -1)
+    interior = jnp.abs(div[tuple(sl)])
+    count = float(np.prod(interior.shape))
+    sumsq = jnp.sum(jnp.square(interior)).astype(jnp.float32)
+    return sumsq, jnp.full((), count, jnp.float32), \
+        jnp.max(interior).astype(jnp.float32)
+
+
 def _energy_weights(sim):
     """eps/mu weight arrays per component, device-resident and sharded
     like their field, built once and cached on the sim."""
@@ -96,41 +140,16 @@ def _device_metrics(sim) -> Dict[str, jnp.ndarray]:
                         planes).astype(jnp.float32)
             out["energy"] = energy
             # Discrete divergence residual of E (charge-free health
-            # metric): the Yee update conserves the discrete divergence
-            # of D exactly in source-free uniform regions; growth flags
-            # a stencil/coefficient bug or an unaccounted source. The
-            # backward difference of each E component along its own
-            # axis lands on integer cells. PEC walls carry surface
-            # charge (nonzero div there is physics) — measured on
-            # interior cells only.
-            div = None
+            # metric) — definition + physics note in div_e_parts, which
+            # telemetry.make_health_fn shares.
             e_scale = jnp.zeros((), jnp.float32)
             for c in e_comps:
-                a = component_axis(c)
-                out_max = out[f"max_{c}"]
-                e_scale = jnp.maximum(e_scale,
-                                      out_max.astype(jnp.float32))
-                if a not in active:
-                    continue
-                arr = state["E"][c].astype(cdt)
-                pad = [(0, 0)] * 3
-                pad[a] = (1, 0)
-                shifted = jnp.pad(
-                    jax.lax.slice_in_dim(arr, 0, arr.shape[a] - 1,
-                                         axis=a), pad)
-                d = (arr - shifted) * inv_dx
-                div = d if div is None else div + d
-            if div is None:
-                out["div_l2"] = jnp.zeros((), jnp.float32)
-                out["div_linf"] = jnp.zeros((), jnp.float32)
-            else:
-                sl = [slice(None)] * 3
-                for a in active:
-                    sl[a] = slice(1, -1)
-                interior = jnp.abs(div[tuple(sl)])
-                out["div_l2"] = jnp.sqrt(
-                    jnp.mean(jnp.square(interior))).astype(jnp.float32)
-                out["div_linf"] = jnp.max(interior).astype(jnp.float32)
+                e_scale = jnp.maximum(
+                    e_scale, out[f"max_{c}"].astype(jnp.float32))
+            sumsq, count, linf = div_e_parts(state["E"], e_comps,
+                                             active, inv_dx, cast=cdt)
+            out["div_l2"] = jnp.sqrt(sumsq / count)
+            out["div_linf"] = linf
             out["e_scale"] = e_scale
             return out
 

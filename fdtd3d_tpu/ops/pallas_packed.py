@@ -131,6 +131,7 @@ from jax.experimental.pallas import tpu as pltpu
 from fdtd3d_tpu.layout import CURL_TERMS, component_axis
 from fdtd3d_tpu.ops.pallas3d import (COMPILER_PARAMS, PackedPsiView,
                                      PackedView, _vmem_budget)
+from fdtd3d_tpu.telemetry import named as _named
 
 AXES = "xyz"
 
@@ -1118,8 +1119,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             n_sh = mesh_shape[name]
             n_a = (n1, n2, n3)[a]
             plane = lax.slice_in_dim(H_arr, n_a - 1, n_a, axis=1 + a)
-            gh = lax.ppermute(plane, name,
-                              [(r, r + 1) for r in range(n_sh - 1)])
+            with _named("halo-exchange"):
+                gh = lax.ppermute(plane, name,
+                                  [(r, r + 1) for r in range(n_sh - 1)])
             if a == 0:
                 ghosts_x = gh
             else:
@@ -1156,7 +1158,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
                     coeffs[f"wall_{AXES[a]}"], a) for a in range(3)]
         args += [coeffs[k] for k in arr_e]
         args += [coeffs[k] for k in arr_h]
-        outs = call(*args)
+        with _named("packed-kernel"):
+            outs = call(*args)
 
         p = 0
         new_E_arr = outs[p]; p += 1
@@ -1186,16 +1189,20 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         psxE = dict(pstate.get("psxE", {})) if not fuse_x else None
         patches: list = []
         if x_pml and not fuse_x:
-            eview, psxE = pallas3d.x_slab_post(
-                static, "E", eview, None, psxE, coeffs, slabs,
-                collect=patches, src_slabs=h_slabs)
+            with _named("cpml"):
+                eview, psxE = pallas3d.x_slab_post(
+                    static, "E", eview, None, psxE, coeffs, slabs,
+                    collect=patches, src_slabs=h_slabs)
         if setup is not None:
-            eview = pallas3d.tfsf_patch(static, "E", eview, coeffs,
-                                        new_state["inc"],
-                                        collect=patches)
+            with _named("tfsf"):
+                eview = pallas3d.tfsf_patch(static, "E", eview, coeffs,
+                                            new_state["inc"],
+                                            collect=patches)
         if static.cfg.point_source.enabled:
-            eview = pallas3d.point_source_patch(static, eview, coeffs, t,
-                                                collect=patches)
+            with _named("source"):
+                eview = pallas3d.point_source_patch(static, eview,
+                                                    coeffs, t,
+                                                    collect=patches)
 
         # ---- sharded hi-edge H fix -----------------------------------
         # the kernel's forward diffs used the PEC zero ghost at each
@@ -1210,8 +1217,9 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
             n_sh = mesh_shape[name]
             n_a = (n1, n2, n3)[a]
             first = lax.slice_in_dim(new_E_arr, 0, 1, axis=1 + a)
-            nxt = lax.ppermute(first, name,
-                               [(r + 1, r) for r in range(n_sh - 1)])
+            with _named("halo-exchange"):
+                nxt = lax.ppermute(first, name,
+                                   [(r + 1, r) for r in range(n_sh - 1)])
             for jc, c in enumerate(h_comps):
                 for (aa, jd, sg) in CURL_TERMS[component_axis(c)]:
                     if aa != a or ("E" + AXES[jd]) not in e_comps:

@@ -96,6 +96,7 @@ from jax.experimental.pallas import tpu as pltpu
 from fdtd3d_tpu.layout import CURL_TERMS, component_axis
 from fdtd3d_tpu.ops import ds
 from fdtd3d_tpu.ops import tfsf as tfsf_mod
+from fdtd3d_tpu.telemetry import named as _named
 from fdtd3d_tpu.ops.pallas3d import COMPILER_PARAMS
 from fdtd3d_tpu.ops.pallas_packed import (_VMEM_TOTAL, _pick_tile_packed,
                                           pack_psx_rows, psi_rows,
@@ -1199,8 +1200,9 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             n_a = (n1, n2, n3)[a]
             plane = lax.slice_in_dim(pstate["H"], n_a - 1, n_a,
                                      axis=1 + a)
-            gh_ = lax.ppermute(plane, name,
-                               [(r, r + 1) for r in range(n_sh - 1)])
+            with _named("halo-exchange"):
+                gh_ = lax.ppermute(plane, name,
+                                   [(r, r + 1) for r in range(n_sh - 1)])
             args.append(gh_)
 
         args += [cg(f"_pk_wall_{AXES[a]}", _vec3_key,
@@ -1208,7 +1210,8 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         for k in arr_pair_e + arr_pair_h:
             args += [coeffs[k], coeffs[f"{k}_lo"]]
         args += [coeffs[k] for k in arr_plain_e + arr_plain_h]
-        outs = call(*args)
+        with _named("packed-kernel"):
+            outs = call(*args)
 
         p = 0
         new_E = outs[p]; p += 1
@@ -1244,8 +1247,9 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
             n_sh = mesh_shape[name]
             n_a = (n1, n2, n3)[a]
             first = lax.slice_in_dim(new_E, 0, 1, axis=1 + a)
-            nxt = lax.ppermute(first, name,
-                               [(r + 1, r) for r in range(n_sh - 1)])
+            with _named("halo-exchange"):
+                nxt = lax.ppermute(first, name,
+                                   [(r + 1, r) for r in range(n_sh - 1)])
             sl_hi = [slice(None)] * 3
             sl_hi[a] = slice(n_a - 1, n_a)
             sl_hi = tuple(sl_hi)

@@ -50,7 +50,9 @@ def _neighbor_plane(plane: jnp.ndarray, axis_name: Optional[str],
         perm = [(i, i + 1) for i in range(n_shards - 1)]
     else:
         perm = [(i + 1, i) for i in range(n_shards - 1)]
-    return lax.ppermute(plane, axis_name, perm)
+    from fdtd3d_tpu.telemetry import named
+    with named("halo-exchange"):
+        return lax.ppermute(plane, axis_name, perm)
 
 
 def _pad_plane(arr: jnp.ndarray, axis: int, lo: bool) -> jnp.ndarray:

@@ -29,6 +29,7 @@ from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass
@@ -60,22 +61,39 @@ class StepClock:
         return sum(r.seconds for r in self.records)
 
     def summary(self) -> Dict[str, float]:
+        """Aggregate + per-chunk Mcells/s percentiles (p50/p95/max).
+
+        The percentiles are the long-run health view a single mean
+        hides: a throughput regression confined to a few chunks (tunnel
+        throttling, a VMEM-ladder downgrade mid-run) shows up as a
+        p95/max gap while the mean barely moves. bench.py embeds this
+        dict in the BENCH json; telemetry run_end records derive the
+        same numbers from the per-chunk JSONL."""
         if not self.records:
             return {"steps": 0, "seconds": 0.0, "mcells_per_s": 0.0,
-                    "best_mcells_per_s": 0.0}
+                    "best_mcells_per_s": 0.0, "chunks": 0,
+                    "p50_mcells_per_s": 0.0, "p95_mcells_per_s": 0.0,
+                    "max_mcells_per_s": 0.0}
+        rates = np.asarray([r.mcells_per_s for r in self.records])
         return {
             "steps": self.total_steps,
             "seconds": self.total_seconds,
+            "chunks": len(self.records),
             "mcells_per_s": (sum(r.cells * r.steps for r in self.records)
                              / self.total_seconds / 1e6),
             "best_mcells_per_s": max(r.mcells_per_s for r in self.records),
+            "p50_mcells_per_s": float(np.percentile(rates, 50)),
+            "p95_mcells_per_s": float(np.percentile(rates, 95)),
+            "max_mcells_per_s": float(rates.max()),
         }
 
     def report(self) -> str:
         s = self.summary()
         return (f"{s['steps']} steps in {s['seconds']:.3f}s — "
-                f"{s['mcells_per_s']:.1f} Mcells/s "
-                f"(best chunk {s['best_mcells_per_s']:.1f})")
+                f"{s['mcells_per_s']:.1f} Mcells/s over {s['chunks']} "
+                f"chunks (p50 {s['p50_mcells_per_s']:.1f} / p95 "
+                f"{s['p95_mcells_per_s']:.1f} / max "
+                f"{s['max_mcells_per_s']:.1f})")
 
 
 @contextlib.contextmanager

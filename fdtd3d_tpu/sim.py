@@ -25,6 +25,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from fdtd3d_tpu import profiling
+from fdtd3d_tpu import telemetry as _telemetry
 from fdtd3d_tpu.config import SimConfig
 from fdtd3d_tpu.parallel import mesh as pmesh
 from fdtd3d_tpu.solver import (StaticSetup, build_coeffs, build_static,
@@ -85,8 +86,15 @@ class Simulation:
 
         self._mesh_axes = mesh_axes
         self._mesh_shape = mesh_shape
+        # Flight recorder (fdtd3d_tpu/telemetry.py): the in-graph
+        # health counters ride the chunk whenever a telemetry sink OR
+        # the finite tripwire wants them — both then cost one fused
+        # reduction per chunk + one scalar readback, never a host pass.
+        self._health_on = bool(cfg.output.telemetry_path) \
+            or cfg.output.check_finite
         self._bind_runner(make_chunk_runner(self.static, mesh_axes,
-                                            mesh_shape))
+                                            mesh_shape,
+                                            health=self._health_on))
         if cfg.require_pallas and self.step_kind in ("jnp", "jnp_ds"):
             import jax as _jax
             from fdtd3d_tpu.ops import pallas3d
@@ -112,6 +120,16 @@ class Simulation:
         self._check_finite = cfg.output.check_finite
         self._cells = float(np.prod([cfg.grid_shape[a]
                                      for a in self.static.mode.active_axes]))
+        # Host-side mirror of the step counter: chunk telemetry must
+        # not spend a device readback on t (advance() has a ≤1-scalar-
+        # readback budget); restore() re-syncs it from the checkpoint.
+        self._t_host = 0
+        self._chunk_idx = 0
+        self.telemetry: Optional[_telemetry.TelemetrySink] = None
+        if cfg.output.telemetry_path:
+            self.telemetry = _telemetry.TelemetrySink(
+                cfg.output.telemetry_path,
+                run_meta=_telemetry.provenance(self))
 
     def _resolve_topology(self, devices):
         return pmesh.resolve_topology(
@@ -150,6 +168,8 @@ class Simulation:
                 out_specs=self._state_specs))
         # "pallas"/"pallas_fused" when fused kernels are engaged, else "jnp"
         self.step_kind: str = getattr(runner, "kind", "jnp")
+        # whether run_chunk returns (state, in-graph health counters)
+        self._runner_health: bool = getattr(runner, "health", False)
         # kernel diagnostics (x-tile size, VMEM block bytes) or None (jnp)
         self.step_diag = getattr(runner, "diag", None)
 
@@ -218,12 +238,18 @@ class Simulation:
         while n not in self._compiled:
             fn = functools.partial(self._runner, n=n)
             if self.mesh is not None:
+                from jax.sharding import PartitionSpec as P
                 st_specs = self._packed_specs \
                     if self._packed_specs is not None else self._state_specs
+                out_specs = st_specs
+                if self._runner_health:
+                    # health counters come out psum/pmax-replicated
+                    out_specs = (st_specs,
+                                 {k: P() for k in _telemetry.HEALTH_KEYS})
                 fn = _shard_map_compat(fn, self.mesh,
                                        in_specs=(st_specs,
                                                  self._coeff_specs),
-                                       out_specs=st_specs)
+                                       out_specs=out_specs)
             # Donate the carry on REAL hardware only (it kills XLA's
             # defensive/carry copies — docs/PERFORMANCE.md). On the CPU
             # backend donation is a measured hazard instead of a win:
@@ -238,8 +264,9 @@ class Simulation:
             donate = jax.default_backend() in ("tpu", "axon")
             jitted = jax.jit(fn, donate_argnums=0 if donate else ())
             try:
-                compiled = jitted.lower(self._carry(),
-                                        self.coeffs).compile()
+                with _telemetry.span("compile"):
+                    compiled = jitted.lower(self._carry(),
+                                            self.coeffs).compile()
             except Exception as exc:
                 self._vmem_fallback(exc)   # next rung, or re-raise
                 continue
@@ -250,8 +277,12 @@ class Simulation:
         """Advance n_steps inside one compiled scan.
 
         With OutputConfig.profile the chunk is timed sync-to-sync into
-        self.clock; with OutputConfig.check_finite the whole state pytree
-        is NaN/Inf-guarded after the chunk (raises FloatingPointError).
+        self.clock. The flight recorder (OutputConfig.telemetry_path /
+        check_finite) rides the same compiled call: the chunk returns
+        the in-graph health counters, read back as ONE scalar tuple —
+        a telemetry record is appended per chunk, and a tripped
+        non-finite flag raises FloatingPointError naming the chunk and
+        the first-bad-step bound.
         """
         if n_steps <= 0:
             return self
@@ -259,27 +290,75 @@ class Simulation:
         if getattr(self._runner, "packed", False) and self._pstate is None:
             # enter the packed representation once; it persists across
             # chunks (the dict form rebuilds lazily via .state)
-            self._pstate = self._pack_fn(self._sstate)
+            with _telemetry.span("pack"):
+                self._pstate = self._pack_fn(self._sstate)
             self._sstate = None
         fn = self._chunk_fn(n_steps)
         carry = self._carry()   # after _chunk_fn: a VMEM-ladder rebuild
         #                         may have re-packed the carry
-        if self.clock is not None:
+        timed = self.clock is not None or self.telemetry is not None
+        wall = 0.0
+        if timed:
             self.block_until_ready()
             t0 = time.perf_counter()
-            carry = fn(carry, self.coeffs)
-            self.block_until_ready_on(carry)
-            self.clock.record(n_steps, time.perf_counter() - t0,
-                              self._cells)
+        with _telemetry.span("chunk"):
+            out = fn(carry, self.coeffs)
+        health = None
+        if self._runner_health:
+            carry, health = out
         else:
-            carry = fn(carry, self.coeffs)
+            carry = out
+        if timed:
+            self.block_until_ready_on(carry)
+            wall = time.perf_counter() - t0
+            if self.clock is not None:
+                self.clock.record(n_steps, wall, self._cells)
+        # ≤1 extra readback per chunk: the scalar health tuple. AFTER
+        # the wall capture — through a device tunnel the readback
+        # round-trip is ~180 ms (bench.py) and is host overhead, not
+        # simulation work; recording it would inflate wall_s/Mcells/s.
+        hv = _telemetry.readback(health) if health is not None else None
         if self._pstate is not None:
             self._pstate = carry
             self._dstate = None
         else:
             self._sstate = carry
-        if self._check_finite:
+        t_prev = self._t_host
+        self._t_host = t_prev + n_steps
+        self._chunk_idx += 1
+        if self.telemetry is not None and hv is not None:
+            self.telemetry.emit_chunk(
+                chunk=self._chunk_idx, t=self._t_host, steps=n_steps,
+                wall_s=wall, cells=self._cells, health=hv,
+                vmem_rung=int(getattr(self, "_vmem_rung", 0)))
+        if hv is not None:
+            if not hv["finite"] and self._check_finite:
+                # name the components host-side only AFTER the in-graph
+                # flag tripped (the per-chunk path never pays this pass)
+                bad = [k for k, ok in
+                       profiling.finite_check(self.state).items()
+                       if not ok]
+                names = ", ".join(sorted(bad)) if bad else "unknown"
+                raise FloatingPointError(
+                    f"non-finite field values tripped the in-graph "
+                    f"health counters in chunk {self._chunk_idx}: "
+                    f"first bad step in ({t_prev}, {self._t_host}]; "
+                    f"components: {names} (check the Courant factor / "
+                    f"Drude stability bound)")
+        elif self._check_finite:
+            # no in-graph counters on this runner: legacy host pass
             profiling.assert_finite(self._carry(), context=f"t={self.t}")
+        return self
+
+    def close_telemetry(self):
+        """Emit the run_end summary record and close the sink
+        (idempotent; a sim without telemetry is a no-op)."""
+        if self.telemetry is None:
+            return self
+        w = self.telemetry.wall_total
+        mcps = (self._cells * self.telemetry.steps_total / w / 1e6) \
+            if w > 0 else 0.0
+        self.telemetry.close(t=self._t_host, mcells_per_s=mcps)
         return self
 
     # Budget rungs for the packed kernel's VMEM-model fallback: the
@@ -308,6 +387,11 @@ class Simulation:
             raise exc
         kind = self.step_kind
         failed_tile = ((self.step_diag or {}).get("tile") or {}).get("EH")
+        # the budget IN EFFECT before this fallback (None = the
+        # kernel's own model pick) — captured before the loop because
+        # skipped rungs (tile-check `continue`) were never in effect
+        rung0 = getattr(self, "_vmem_rung", 0)
+        old_mb = self._VMEM_LADDER_MB[rung0 - 1] if rung0 > 0 else None
         while True:
             rung = getattr(self, "_vmem_rung", 0)
             if rung >= len(self._VMEM_LADDER_MB):
@@ -321,8 +405,11 @@ class Simulation:
             # release the global so unrelated sims are unaffected
             pallas_packed._RUNTIME_BUDGET = nxt
             try:
-                runner = make_chunk_runner(self.static, self._mesh_axes,
-                                           self._mesh_shape)
+                with _telemetry.span("vmem-ladder-rebuild"):
+                    runner = make_chunk_runner(self.static,
+                                               self._mesh_axes,
+                                               self._mesh_shape,
+                                               health=self._health_on)
             finally:
                 pallas_packed._RUNTIME_BUDGET = None
             if getattr(runner, "kind", None) != kind:
@@ -340,6 +427,15 @@ class Simulation:
             f"budget). The VMEM-temporaries model is calibrated for "
             f"v5e — see ops/pallas_packed.py. Original error: "
             f"{str(exc)[:200]}")
+        if self.telemetry is not None:
+            # structured event so post-mortems can see the silent perf
+            # cliff (the print above scrolls away; this persists)
+            self.telemetry.emit(
+                "ladder_downgrade", t=int(self._t_host),
+                old_budget_mb=old_mb,
+                new_budget_mb=nxt >> 20,
+                old_tile=failed_tile, new_tile=new_tile,
+                vmem_rung=int(self._vmem_rung))
         # The packed carry's x-psi stacks are TILE-ALIGNED (round 6,
         # ops/pallas_packed.py), so a different tile means a different
         # carry layout: route the live carry through the dict form —
@@ -517,6 +613,7 @@ class Simulation:
             # as the friendly guards, not orbax shape errors
             self._check_ckpt_meta(io.read_orbax_meta(path))
             self.state = io.load_checkpoint_orbax(path, self.state)
+            self._t_host = self.t  # re-sync the telemetry step mirror
             return self
         loaded, extra = io.load_checkpoint(path)
         self._check_ckpt_meta(extra)
@@ -533,4 +630,5 @@ class Simulation:
                                           self.mesh)
         else:
             self.state = jax.tree.map(jnp.asarray, loaded)
+        self._t_host = self.t  # re-sync the telemetry step mirror
         return self

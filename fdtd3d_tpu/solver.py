@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fdtd3d_tpu import materials, physics
+from fdtd3d_tpu.telemetry import named as _named
 from fdtd3d_tpu.config import SimConfig
 from fdtd3d_tpu.layout import CURL_TERMS, component_axis
 from fdtd3d_tpu.ops import cpml, tfsf
@@ -567,25 +568,29 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                 else:
                     dfa = diff(src[d], a) * inv_dx
                 if a in slabs:
-                    key = f"{c}_{AXES[a]}"
-                    psi, dl, dh = _slab_delta(a, tag, s, dfa,
-                                              state[psi_key][key], coeffs,
-                                              slabs[a])
-                    new_psi[psi_key][key] = psi
-                    # The delta is an acc-level correction (it carries the
-                    # curl sign s already): fold it in before ca/cb.
-                    acc_fix = _pad_slab(dl, dh, a, dfa.shape[a], slabs[a])
-                    acc = acc_fix if acc is None else acc + acc_fix
-                    term = dfa
+                    with _named("cpml"):
+                        key = f"{c}_{AXES[a]}"
+                        psi, dl, dh = _slab_delta(a, tag, s, dfa,
+                                                  state[psi_key][key],
+                                                  coeffs, slabs[a])
+                        new_psi[psi_key][key] = psi
+                        # The delta is an acc-level correction (it
+                        # carries the curl sign s already): fold it in
+                        # before ca/cb.
+                        acc_fix = _pad_slab(dl, dh, a, dfa.shape[a],
+                                            slabs[a])
+                        acc = acc_fix if acc is None else acc + acc_fix
+                        term = dfa
                 elif a in static.pml_axes:
-                    ax = AXES[a]
-                    b = _bcast1d(coeffs[f"pml_b{tag}_{ax}"], a)
-                    cc = _bcast1d(coeffs[f"pml_c{tag}_{ax}"], a)
-                    ik = _bcast1d(coeffs[f"pml_ik{tag}_{ax}"], a)
-                    key = f"{c}_{ax}"
-                    psi = b * state[psi_key][key] + cc * dfa
-                    new_psi[psi_key][key] = psi
-                    term = ik * dfa + psi
+                    with _named("cpml"):
+                        ax = AXES[a]
+                        b = _bcast1d(coeffs[f"pml_b{tag}_{ax}"], a)
+                        cc = _bcast1d(coeffs[f"pml_c{tag}_{ax}"], a)
+                        ik = _bcast1d(coeffs[f"pml_ik{tag}_{ax}"], a)
+                        key = f"{c}_{ax}"
+                        psi = b * state[psi_key][key] + cc * dfa
+                        new_psi[psi_key][key] = psi
+                        term = ik * dfa + psi
                 else:
                     term = dfa
                 acc = s * term if acc is None else acc + s * term
@@ -610,13 +615,16 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
 
         # 1. incident line E advance (Einc -> t^{n+1}); see tfsf.py timing.
         if setup is not None:
-            new_state["inc"] = tfsf.advance_einc(
-                state["inc"], coeffs, t, static.dt, static.omega, setup)
+            with _named("tfsf"):
+                new_state["inc"] = tfsf.advance_einc(
+                    state["inc"], coeffs, t, static.dt, static.omega,
+                    setup)
             state = dict(state, inc=new_state["inc"])
 
         # 2. E family
         compensated = static.cfg.compensated
-        acc_e = _half_update("E", state, coeffs, new_psi)
+        with _named("E-update"):
+            acc_e = _half_update("E", state, coeffs, new_psi)
         new_E = {}
         new_rE: Dict[str, Any] = {}
         new_J: Dict[str, Any] = {}
@@ -628,11 +636,13 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                 new_J[c] = j_new
                 acc = acc - j_new
             if ps.enabled and ps.component == c:
-                mask = point_mask(coeffs["gx"], coeffs["gy"], coeffs["gz"],
-                                  ps.position, mode.active_axes)
-                wf = waveform(ps.waveform, t, 0.5, static.omega,
-                              static.dt, static.real_dtype)
-                acc = acc + ps.amplitude * wf * mask.astype(acc.dtype)
+                with _named("source"):
+                    mask = point_mask(coeffs["gx"], coeffs["gy"],
+                                      coeffs["gz"], ps.position,
+                                      mode.active_axes)
+                    wf = waveform(ps.waveform, t, 0.5, static.omega,
+                                  static.dt, static.real_dtype)
+                    acc = acc + ps.amplitude * wf * mask.astype(acc.dtype)
             if compensated:
                 # Kahan: E' = E + u with u = (ca-1)E + cb*acc in
                 # double-single coefficients, feeding back the stored
@@ -669,12 +679,14 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
 
         # 3. incident line H advance (Hinc -> t^{n+3/2})
         if setup is not None:
-            new_state["inc"] = tfsf.advance_hinc(new_state["inc"], coeffs,
-                                                 setup)
+            with _named("tfsf"):
+                new_state["inc"] = tfsf.advance_hinc(new_state["inc"],
+                                                     coeffs, setup)
             state = dict(state, inc=new_state["inc"])
 
         # 4. H family (dual of step 2: mu0 mu dH/dt = -curl E - K)
-        acc_h = _half_update("H", state, coeffs, new_psi)
+        with _named("H-update"):
+            acc_h = _half_update("H", state, coeffs, new_psi)
         new_H = {}
         new_rH: Dict[str, Any] = {}
         new_K: Dict[str, Any] = {}
@@ -890,7 +902,8 @@ def _make_ds_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                 state["inc"], coeffs, t, static.dt, static.omega, setup)
             state = dict(state, inc=new_state["inc"])
 
-        acc_e = _half_update("E", state, coeffs, new_psi)
+        with _named("E-update"):
+            acc_e = _half_update("E", state, coeffs, new_psi)
         new_E, new_lo, new_J = {}, {}, {}
         for c in mode.e_components:
             ah, al = acc_e[c]
@@ -934,7 +947,8 @@ def _make_ds_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                                                  coeffs, setup)
             state = dict(state, inc=new_state["inc"])
 
-        acc_h = _half_update("H", state, coeffs, new_psi)
+        with _named("H-update"):
+            acc_h = _half_update("H", state, coeffs, new_psi)
         new_H, new_loH, new_K = {}, {}, {}
         for c in mode.h_components:
             ah, al = acc_h[c]
@@ -1042,15 +1056,26 @@ def _make_paired_complex_step(static: StaticSetup, mesh_axes=None,
             return (a + 1j * b).astype(cdtype)
         return jax.tree.map(join, re, im)
 
+    def health_view(s):
+        # in-graph dict-form views for the flight recorder
+        # (telemetry.make_health_fn combines the two real legs): the
+        # LEG pack/unpack are pure jax even though the top-level
+        # complex<->paired conversion routes through host numpy
+        if leg_unpack is not None:
+            return [leg_unpack(s["re"]), leg_unpack(s["im"])]
+        return [s["re"], s["im"]]
+
     step.pack = pack
     step.unpack = unpack
     step.packed = True
+    step.health_view = health_view
     step.kind = "complex2x_" + getattr(step_re, "kind", "jnp")
     step.diag = getattr(step_re, "diag", None)
     return step
 
 
-def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
+def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
+                      health: bool = False):
     """scan-over-steps runner: run_chunk(state, coeffs, n) with static n.
 
     When the packed kernel is engaged (``run_chunk.packed``), the scan
@@ -1064,9 +1089,31 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
     reshapes are loop-invariant, and hoisting them off the scan body
     shaves the fixed per-step dispatch floor instead of trusting XLA's
     loop-invariant code motion with them (round 6).
+
+    ``health=True`` (the flight recorder, fdtd3d_tpu/telemetry.py):
+    run_chunk returns ``(state, health_dict)`` with the health counters
+    computed IN-GRAPH from the chunk's final state — one fused
+    reduction appended to the scan, no separate dispatch and no host
+    pass. Packed carries are unpacked in-graph (pack/unpack are pure
+    jax); steps exposing ``health_view`` (the paired-complex path,
+    whose top-level unpack routes through host numpy) instead supply
+    their own in-graph list of dict-form views. ``run_chunk.health``
+    reports whether the counters are actually wired.
     """
     step = make_step(static, mesh_axes, mesh_shape)
     prep = getattr(step, "prepare", None)
+
+    health_fn = None
+    if health:
+        from fdtd3d_tpu import telemetry
+        view = getattr(step, "health_view", None)
+        if view is None:
+            if getattr(step, "packed", False):
+                view = lambda s: [step.unpack(s)]  # noqa: E731
+            else:
+                view = lambda s: [s]  # noqa: E731
+        hfn = telemetry.make_health_fn(static, mesh_axes)
+        health_fn = lambda s: hfn(view(s))  # noqa: E731
 
     def run_chunk(state, coeffs, n: int):
         cc = prep(coeffs) if prep is not None else coeffs
@@ -1074,8 +1121,11 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         def body(s, _):
             return step(s, cc), None
         out, _ = jax.lax.scan(body, state, None, length=n)
+        if health_fn is not None:
+            return out, health_fn(out)
         return out
 
+    run_chunk.health = health_fn is not None
     run_chunk.kind = getattr(step, "kind", "jnp")
     run_chunk.diag = getattr(step, "diag", None)
     if getattr(step, "packed", False):

@@ -1,0 +1,426 @@
+"""In-graph flight recorder: health counters, trace spans, telemetry sink.
+
+The production-observability layer (ROADMAP north star; the GPU-port
+literature in PAPERS.md treats in-run profiling/health instrumentation of
+the stencil/CPML/halo phases as a first-class subsystem):
+
+* **Health counters** — ``make_health_fn`` builds ONE fused reduction
+  over the solver state (total EM energy, interior div·E residual,
+  max|E|, max|H|, a non-finite flag) that ``solver.make_chunk_runner``
+  appends to the scanned chunk's outputs. Monitoring therefore costs one
+  in-graph pass over the final state per chunk plus ≤1 scalar-tuple
+  readback (``readback``) — never a host-side sweep of the full pytree
+  (the pre-round-7 ``OutputConfig.check_finite`` posture). The packed
+  Pallas carries are unpacked IN-GRAPH (their pack/unpack are pure jax)
+  so every step path reports the same counters.
+
+* **Named trace spans** — ``span`` (host-side
+  ``jax.profiler.TraceAnnotation``) and ``named`` (trace-time
+  ``jax.named_scope``) give XProf timelines domain names: compile,
+  chunk dispatch, halo exchange, CPML, source injection, VMEM-ladder
+  rebuilds, NTFF/IO. See docs/OBSERVABILITY.md for the full name table.
+
+* **Structured sink** — ``TelemetrySink`` appends schema-versioned JSONL
+  records (run provenance, per-chunk health + wall time, VMEM-ladder
+  downgrades) that ``Simulation.advance``, the CLI (``--telemetry``)
+  and ``bench.py`` all feed; ``tools/telemetry_report.py`` summarizes a
+  file into step-time percentiles, throughput trend and the first
+  unhealthy step.
+
+Counter definitions (all f32 scalars, reduced over every rank):
+
+``energy``
+    0.5 * Σ cell·(ε₀|E|² + μ₀|H|²) — VACUUM-weighted (no material
+    grids: this is a cheap in-scan trend/health metric; the
+    material-weighted energy remains ``diag.metrics``).
+``div_l2`` / interior residual
+    RMS of the discrete div E over interior cells (diag.div_e_parts;
+    under shard_map each shard's own boundary planes are excluded —
+    slight undersampling at shard seams, never a wrong value).
+``max_e`` / ``max_h``
+    max over components of max|comp| (paired-complex runs reduce each
+    real leg and take the max — within √2 of the true complex modulus).
+``nonfinite``
+    1.0 when ANY inexact leaf of the state pytree holds a NaN/Inf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import subprocess
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+
+HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
+               "nonfinite")
+
+# Span names as they appear in XProf (docs/OBSERVABILITY.md keeps the
+# one-line description of each). Host-side spans (TraceAnnotation):
+HOST_SPANS = ("compile", "chunk", "pack", "vmem-ladder-rebuild",
+              "ntff-sample", "io-dump", "checkpoint", "telemetry-readback")
+# In-graph scopes (named_scope; prefixed fdtd3d/ in the HLO metadata):
+GRAPH_SPANS = ("E-update", "H-update", "cpml", "halo-exchange", "source",
+               "tfsf", "packed-kernel", "health")
+
+
+def span(name: str):
+    """Host-side trace span: wraps ``jax.profiler.TraceAnnotation`` so
+    XProf timelines show compile/dispatch/IO phases in domain terms.
+    Returns a context manager; a backend without the profiler API
+    degrades to a no-op."""
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(f"fdtd3d/{name}")
+    except Exception:  # pragma: no cover - profiler API missing
+        return contextlib.nullcontext()
+
+
+def named(name: str):
+    """In-graph scope: ``jax.named_scope`` so the ops of a solver phase
+    (CPML, halo exchange, source injection ...) carry a domain name in
+    the HLO metadata XProf groups by."""
+    import jax
+    return jax.named_scope(f"fdtd3d/{name}")
+
+
+# --------------------------------------------------------------------------
+# health counters (in-graph)
+# --------------------------------------------------------------------------
+
+def make_health_fn(static, mesh_axes=None):
+    """Build the fused health reduction: states -> dict of f32 scalars.
+
+    ``states`` is a SEQUENCE of dict-form state pytrees (one normally;
+    the paired-complex path passes its two real legs) — the counters
+    combine across them (energies add; the complex energy is exactly
+    re² + im²). Runs inside the jitted chunk (and inside shard_map on a
+    mesh: local reductions are finished with psum/pmax over the mesh
+    axis names, so every rank returns the GLOBAL scalars).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from fdtd3d_tpu import diag, physics
+
+    mode = static.mode
+    e_comps = tuple(mode.e_components)
+    h_comps = tuple(mode.h_components)
+    active = tuple(mode.active_axes)
+    cell = float(static.dx ** mode.ndim)
+    inv_dx = 1.0 / static.dx
+    cdt = static.compute_dtype
+    axis_names = tuple(n for n in (mesh_axes or {}).values()
+                       if n is not None)
+
+    def _one(state):
+        out: Dict[str, Any] = {}
+        esum = jnp.zeros((), jnp.float32)
+        hsum = jnp.zeros((), jnp.float32)
+        mx = {"E": jnp.zeros((), jnp.float32),
+              "H": jnp.zeros((), jnp.float32)}
+        for grp, comps in (("E", e_comps), ("H", h_comps)):
+            for c in comps:
+                av = jnp.abs(state[grp][c]).astype(jnp.float32)
+                mx[grp] = jnp.maximum(mx[grp], jnp.max(av))
+                # two-level reduction (diag._device_metrics rationale):
+                # per-x-plane partials bound the f32 error ~eps*sqrt(N)
+                planes = jnp.sum(jnp.square(av), axis=(1, 2))
+                s = jnp.sum(planes)
+                if grp == "E":
+                    esum = esum + s
+                else:
+                    hsum = hsum + s
+        out["energy"] = 0.5 * cell * (physics.EPS0 * esum
+                                      + physics.MU0 * hsum)
+        # cast rule: complex leaves stay complex (CPU native-complex
+        # runs); REAL leaves never get a complex cast even when the
+        # compute dtype is complex — the paired-complex path's legs
+        # are real precisely because the backend lacks complex ops,
+        # so injecting astype(complex64) here would break the very
+        # runs the paired path exists for. Real legs upcast to the
+        # real compute dtype (bf16 storage -> f32).
+        if jnp.iscomplexobj(jax.tree.leaves(state["E"])[0]):
+            cast = None
+        elif jnp.issubdtype(jnp.dtype(cdt), jnp.complexfloating):
+            cast = static.real_dtype
+        else:
+            cast = cdt
+        sumsq, count, linf = diag.div_e_parts(state["E"], e_comps,
+                                              active, inv_dx, cast=cast)
+        out["_div_sumsq"], out["_div_count"], out["_div_linf"] = \
+            sumsq, count, linf
+        out["max_e"], out["max_h"] = mx["E"], mx["H"]
+        ok = jnp.bool_(True)
+        for leaf in jax.tree.leaves(state):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(
+                    leaf.dtype, jnp.inexact):
+                ok = jnp.logical_and(ok, jnp.isfinite(leaf).all())
+        out["_ok"] = ok
+        return out
+
+    def health(states: Sequence) -> Dict[str, Any]:
+        with named("health"):
+            parts = [_one(s) for s in states]
+            acc = parts[0]
+            for p in parts[1:]:
+                acc = {
+                    "energy": acc["energy"] + p["energy"],
+                    "_div_sumsq": acc["_div_sumsq"] + p["_div_sumsq"],
+                    "_div_count": acc["_div_count"],  # same interior
+                    "_div_linf": jnp.maximum(acc["_div_linf"],
+                                             p["_div_linf"]),
+                    "max_e": jnp.maximum(acc["max_e"], p["max_e"]),
+                    "max_h": jnp.maximum(acc["max_h"], p["max_h"]),
+                    "_ok": jnp.logical_and(acc["_ok"], p["_ok"]),
+                }
+            if axis_names:
+                acc["energy"] = lax.psum(acc["energy"], axis_names)
+                acc["_div_sumsq"] = lax.psum(acc["_div_sumsq"],
+                                             axis_names)
+                acc["_div_count"] = lax.psum(acc["_div_count"],
+                                             axis_names)
+                acc["_div_linf"] = lax.pmax(acc["_div_linf"], axis_names)
+                acc["max_e"] = lax.pmax(acc["max_e"], axis_names)
+                acc["max_h"] = lax.pmax(acc["max_h"], axis_names)
+                acc["_ok"] = lax.pmin(acc["_ok"].astype(jnp.float32),
+                                      axis_names) > 0.5
+            return {
+                "energy": acc["energy"],
+                "div_l2": jnp.sqrt(acc["_div_sumsq"]
+                                   / jnp.maximum(acc["_div_count"], 1.0)),
+                "div_linf": acc["_div_linf"],
+                "max_e": acc["max_e"],
+                "max_h": acc["max_h"],
+                "nonfinite": 1.0 - acc["_ok"].astype(jnp.float32),
+            }
+
+    return health
+
+
+def readback(health) -> Dict[str, float]:
+    """ONE device->host transfer of the scalar health tuple -> floats.
+
+    This is the per-chunk readback budget in its entirety: a handful of
+    f32 scalars (plus ``finite`` derived host-side), never a field
+    array. tests/test_telemetry.py counts calls through here."""
+    import jax
+    with span("telemetry-readback"):
+        vals = jax.device_get(health)
+    out = {k: float(np.asarray(v)) for k, v in vals.items()}
+    out["finite"] = out.pop("nonfinite", 0.0) == 0.0
+    return out
+
+
+# --------------------------------------------------------------------------
+# provenance + schema
+# --------------------------------------------------------------------------
+
+_git_sha_cache: Optional[str] = None
+
+
+def git_sha() -> str:
+    """Repo HEAD sha (short), cached; 'unknown' outside a git checkout."""
+    global _git_sha_cache
+    if _git_sha_cache is None:
+        try:
+            _git_sha_cache = subprocess.run(
+                ["git", "rev-parse", "--short=12", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _git_sha_cache = "unknown"
+    return _git_sha_cache
+
+
+def provenance(sim=None) -> Dict[str, Any]:
+    """Run provenance for the run_start record: git sha, jax version,
+    platform/device, topology, dtype, engaged kernel + VMEM-ladder rung."""
+    import jax
+    rec: Dict[str, Any] = {
+        "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "git_sha": git_sha(),
+        "jax_version": jax.__version__,
+        "platform": jax.default_backend(),
+    }
+    try:
+        rec["device_kind"] = jax.devices()[0].device_kind
+    except Exception:
+        rec["device_kind"] = "unknown"
+    if sim is not None:
+        cfg = sim.cfg
+        rec.update(
+            scheme=cfg.scheme,
+            grid=list(cfg.grid_shape),
+            dtype=cfg.dtype,
+            topology=list(sim.topology),
+            step_kind=sim.step_kind,
+            vmem_rung=int(getattr(sim, "_vmem_rung", 0)),
+        )
+        if sim.step_diag:
+            rec["tile"] = dict(sim.step_diag.get("tile") or {})
+    return rec
+
+
+# Required keys (and accepted types) per record type. Extra keys are
+# always allowed — the schema version only bumps when a REQUIRED key
+# changes meaning or disappears.
+_NUM = (int, float)
+_OPT_NUM = (int, float, type(None))
+RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
+    "run_start": {
+        "wall_time": (str,), "git_sha": (str,), "jax_version": (str,),
+        "platform": (str,),
+    },
+    # counters are _OPT_NUM: a non-finite device value (the unhealthy
+    # runs the recorder exists for) is written as null — NaN/Infinity
+    # literals are not JSON (RFC 8259) and break strict consumers
+    "chunk": {
+        "chunk": (int,), "t": (int,), "steps": (int,),
+        "wall_s": _NUM, "mcells_per_s": _NUM,
+        "energy": _OPT_NUM, "div_l2": _OPT_NUM, "div_linf": _OPT_NUM,
+        "max_e": _OPT_NUM, "max_h": _OPT_NUM, "finite": (bool,),
+        "vmem_rung": (int,),
+    },
+    "ladder_downgrade": {
+        "t": (int,), "old_budget_mb": _OPT_NUM, "new_budget_mb": _NUM,
+        "old_tile": _OPT_NUM, "new_tile": _OPT_NUM, "vmem_rung": (int,),
+    },
+    "run_end": {
+        "t": (int,), "steps": (int,), "wall_s": _NUM,
+        "mcells_per_s": _NUM, "first_unhealthy_t": _OPT_NUM,
+    },
+}
+
+
+def validate_record(rec: Dict[str, Any]) -> None:
+    """Raise ValueError when a record violates the v1 schema."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"record is not an object: {rec!r}")
+    if rec.get("v") != SCHEMA_VERSION:
+        raise ValueError(f"record schema version {rec.get('v')!r} != "
+                         f"{SCHEMA_VERSION}")
+    rtype = rec.get("type")
+    if rtype not in RECORD_SCHEMA:
+        raise ValueError(f"unknown record type {rtype!r}")
+    for key, types in RECORD_SCHEMA[rtype].items():
+        if key not in rec:
+            raise ValueError(f"{rtype} record missing {key!r}: {rec}")
+        v = rec[key]
+        # bool is an int subclass: only accept it where bool is listed
+        if isinstance(v, bool) and bool not in types:
+            raise ValueError(f"{rtype}.{key} is bool, expected "
+                             f"{types}: {rec}")
+        if not isinstance(v, types):
+            raise ValueError(f"{rtype}.{key} has type "
+                             f"{type(v).__name__}, expected {types}")
+
+
+# --------------------------------------------------------------------------
+# the sink
+# --------------------------------------------------------------------------
+
+class TelemetrySink:
+    """Append-only JSONL writer for the flight recorder.
+
+    Rank 0 writes; every other rank's sink is a validating no-op (the
+    health reductions themselves are collective, so all ranks still
+    execute them). Records are validated at write time — a malformed
+    record is a bug here, not in the reader. The file is opened in
+    append mode so several runs (bench stages) can share one path, each
+    delimited by its own run_start/run_end pair."""
+
+    def __init__(self, path: str, run_meta: Optional[Dict] = None):
+        self.path = path
+        self._fh = None
+        self.n_records = 0
+        self.steps_total = 0
+        self.wall_total = 0.0
+        self.first_unhealthy_t: Optional[int] = None
+        self._closed = False
+        is_writer = True
+        try:
+            import jax
+            is_writer = jax.process_index() == 0
+        except Exception:
+            pass
+        if is_writer:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(path, "a")
+        if run_meta is not None:
+            self.emit("run_start", **run_meta)
+
+    def emit(self, rec_type: str, **fields) -> Dict[str, Any]:
+        # non-finite counters -> null: NaN/Infinity literals are not
+        # JSON and would break strict readers on exactly the unhealthy
+        # runs this recorder exists to capture (the `finite` flag
+        # carries the health state)
+        fields = {k: (None if isinstance(v, float)
+                      and not np.isfinite(v) else v)
+                  for k, v in fields.items()}
+        rec = {"v": SCHEMA_VERSION, "type": rec_type, **fields}
+        validate_record(rec)
+        if rec_type == "chunk":
+            self.steps_total += rec["steps"]
+            self.wall_total += rec["wall_s"]
+            if not rec["finite"] and self.first_unhealthy_t is None:
+                # bound, not exact: the counters are per-chunk, so the
+                # first bad step lies in (t - steps, t]
+                self.first_unhealthy_t = rec["t"]
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        self.n_records += 1
+        return rec
+
+    def emit_chunk(self, chunk: int, t: int, steps: int, wall_s: float,
+                   cells: float, health: Dict[str, Any],
+                   vmem_rung: int = 0) -> Dict[str, Any]:
+        """Per-chunk record from a readback() dict + wall timing."""
+        mcps = cells * steps / wall_s / 1e6 if wall_s > 0 else 0.0
+        return self.emit(
+            "chunk", chunk=chunk, t=t, steps=steps,
+            wall_s=float(wall_s), mcells_per_s=float(mcps),
+            energy=health["energy"], div_l2=health["div_l2"],
+            div_linf=health["div_linf"],
+            max_e=health["max_e"], max_h=health["max_h"],
+            finite=bool(health["finite"]), vmem_rung=int(vmem_rung))
+
+    def close(self, t: int = 0, **extra) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        mcps = extra.pop("mcells_per_s", None)
+        if mcps is None:
+            mcps = 0.0
+        self.emit("run_end", t=int(t), steps=self.steps_total,
+                  wall_s=self.wall_total, mcells_per_s=float(mcps),
+                  first_unhealthy_t=self.first_unhealthy_t, **extra)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def read_jsonl(path: str):
+    """Parse + validate a telemetry JSONL file -> list of records."""
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"{path}:{i + 1}: not JSON: {exc}")
+            validate_record(rec)
+            out.append(rec)
+    return out

@@ -1,0 +1,52 @@
+"""Lint guard: no bare ``print(`` in fdtd3d_tpu/ outside log.py.
+
+Round 3 routed every user-facing message through the one-switch leveled
+logger (fdtd3d_tpu/log.py: ``--log-level``, rank-0 gating); a stray
+print() reintroduces scattered, unsilenceable, every-rank output. This
+tier-1 guard makes the decision structural (ISSUE 2 satellite).
+"""
+
+import os
+import re
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "fdtd3d_tpu")
+
+# log.py IS the print wrapper — the single allowed call site.
+ALLOWED = {"log.py"}
+
+# a call site: "print(" not preceded by a word char or dot (so
+# pprint(, x.print( and docstring prose mentioning print() with a
+# preceding backtick/quote still need the line-level filters below)
+_CALL = re.compile(r"(?<![\w.])print\(")
+
+
+def _code_lines(path):
+    """-> [(lineno, code)] with strings and # comments stripped via the
+    tokenizer, so docstring prose mentioning print() never trips."""
+    import tokenize
+    from collections import defaultdict
+    lines = defaultdict(str)
+    with open(path, "rb") as f:
+        for tok in tokenize.tokenize(f.readline):
+            if tok.type in (tokenize.STRING, tokenize.COMMENT):
+                continue
+            lines[tok.start[0]] += tok.string
+    return sorted(lines.items())
+
+
+def test_no_bare_print_outside_log():
+    offenders = []
+    for root, _dirs, files in os.walk(PKG):
+        for fname in files:
+            if not fname.endswith(".py") or fname in ALLOWED:
+                continue
+            path = os.path.join(root, fname)
+            for lineno, tok in _code_lines(path):
+                if _CALL.search(tok):
+                    rel = os.path.relpath(path, PKG)
+                    offenders.append(f"{rel}:{lineno}: {tok.strip()}")
+    assert not offenders, (
+        "bare print() outside fdtd3d_tpu/log.py — route through "
+        "log.log()/log.warn() (one-switch logging, round 3):\n"
+        + "\n".join(offenders))

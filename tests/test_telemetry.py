@@ -1,0 +1,482 @@
+"""Flight recorder (fdtd3d_tpu/telemetry.py): in-graph health counters,
+structured JSONL sink, trace spans, and the observability satellites.
+
+The load-bearing claims under test (ISSUE 2 acceptance):
+
+* a tiny CPU run with telemetry emits schema-valid per-chunk JSONL
+  (energy, div·E residual, max|E|/|H|, finite flag, wall time,
+  provenance);
+* the counters are computed IN-GRAPH: advance() performs NO full-field
+  host transfer and ≤1 extra scalar-tuple readback per chunk;
+* the non-finite tripwire works on the PACKED path and raises
+  FloatingPointError naming the chunk + the first-bad-step bound;
+* VMEM-ladder downgrades produce a structured ladder_downgrade event;
+* telemetry costs ≤2% throughput on a chunked run (in-graph reduction
+  amortized over the chunk);
+* StepClock gains p50/p95/max per-chunk percentiles.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import telemetry
+from fdtd3d_tpu.config import (OutputConfig, ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig)
+from fdtd3d_tpu.sim import Simulation
+
+BASE3D = dict(scheme="3D", size=(16, 16, 16), time_steps=8, dx=1e-3,
+              courant_factor=0.4, wavelength=8e-3)
+
+
+def _cfg3d(tmp_path=None, **kw):
+    out = kw.pop("output", {})
+    if tmp_path is not None:
+        out.setdefault("telemetry_path",
+                       str(tmp_path / "telemetry.jsonl"))
+    return SimConfig(
+        **BASE3D,
+        pml=PmlConfig(size=(3, 3, 3)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(8, 8, 8)),
+        output=OutputConfig(**out), **kw)
+
+
+# -------------------------------------------------------------------------
+# JSONL schema + contents
+# -------------------------------------------------------------------------
+
+def test_telemetry_jsonl_schema_and_contents(tmp_path):
+    cfg = _cfg3d(tmp_path)
+    sim = Simulation(cfg)
+    sim.advance(4)
+    sim.advance(4)
+    sim.close_telemetry()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)  # validates
+    types = [r["type"] for r in recs]
+    assert types == ["run_start", "chunk", "chunk", "run_end"]
+    start = recs[0]
+    # provenance: git sha, jax version, platform, topology, dtype
+    assert start["jax_version"] == jax.__version__
+    assert start["platform"] == jax.default_backend()
+    assert start["topology"] == [1, 1, 1]
+    assert start["dtype"] == "float32"
+    assert start["grid"] == [16, 16, 16]
+    assert start["step_kind"] == sim.step_kind
+    assert start["vmem_rung"] == 0
+    for i, c in enumerate(recs[1:3]):
+        assert c["chunk"] == i + 1
+        assert c["steps"] == 4
+        assert c["t"] == 4 * (i + 1)
+        assert c["wall_s"] > 0.0
+        assert c["mcells_per_s"] > 0.0
+        assert c["finite"] is True
+        for k in ("energy", "div_l2", "div_linf", "max_e", "max_h"):
+            assert np.isfinite(c[k]), k
+    # the source has injected energy by chunk 2
+    assert recs[2]["energy"] > 0.0
+    assert recs[2]["max_e"] > 0.0
+    end = recs[3]
+    assert end["steps"] == 8 and end["t"] == 8
+    assert end["first_unhealthy_t"] is None
+    # the in-graph counters must agree with diag's independent device
+    # pass (vacuum materials, so the energy weighting coincides)
+    from fdtd3d_tpu import diag
+    m = diag.metrics(sim)
+    chunk = recs[2]
+    assert chunk["energy"] == pytest.approx(m["energy"], rel=1e-4)
+    assert chunk["div_l2"] == pytest.approx(m["div_l2"], rel=1e-4)
+    assert chunk["div_linf"] == pytest.approx(m["div_linf"], rel=1e-4)
+    assert chunk["max_e"] == pytest.approx(
+        max(v for k, v in m.items() if k.startswith("max_E")), rel=1e-5)
+    assert chunk["max_h"] == pytest.approx(
+        max(v for k, v in m.items() if k.startswith("max_H")), rel=1e-5)
+
+
+def test_validate_record_rejects_malformed():
+    with pytest.raises(ValueError, match="version"):
+        telemetry.validate_record({"v": 99, "type": "chunk"})
+    with pytest.raises(ValueError, match="unknown record type"):
+        telemetry.validate_record({"v": 1, "type": "nope"})
+    with pytest.raises(ValueError, match="missing"):
+        telemetry.validate_record({"v": 1, "type": "chunk", "chunk": 1})
+
+
+# -------------------------------------------------------------------------
+# in-graph guarantee: no full-field host transfer, ≤1 scalar readback
+# -------------------------------------------------------------------------
+
+def test_advance_readback_is_one_scalar_tuple(tmp_path, monkeypatch):
+    cfg = _cfg3d(tmp_path)
+    sim = Simulation(cfg)
+    sim.advance(3)  # compile the n=3 chunk outside the counting window
+
+    calls = []
+    real_get = jax.device_get
+
+    def counting_get(tree):
+        sizes = [int(np.size(x)) for x in jax.tree.leaves(tree)]
+        calls.append(sizes)
+        return real_get(tree)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    sim.advance(3)
+    monkeypatch.undo()
+    # exactly ONE device_get — the health scalar tuple — and every leaf
+    # of it is a scalar (no field array ever crosses to host)
+    assert len(calls) == 1, f"device_get calls: {calls}"
+    assert all(s == 1 for s in calls[0]), calls[0]
+    assert len(calls[0]) == len(telemetry.HEALTH_KEYS)
+
+
+def test_no_health_graph_without_telemetry():
+    """Default path: no counters wired, no sink (and therefore no
+    readback branch — advance() leaves the chunk output untouched)."""
+    sim = Simulation(_cfg3d())
+    assert sim._runner_health is False
+    assert sim.telemetry is None
+
+
+# -------------------------------------------------------------------------
+# non-finite tripwire (packed path included)
+# -------------------------------------------------------------------------
+
+def _nan_trip(sim):
+    sim.advance(4)  # healthy chunk passes
+    bad = np.full(sim.state["E"]["Ez"].shape, np.nan, np.float32)
+    sim.set_field("Ez", bad)
+    with pytest.raises(FloatingPointError) as ei:
+        sim.advance(4)
+    msg = str(ei.value)
+    # names the chunk and bounds the first bad step
+    assert "chunk 2" in msg, msg
+    assert "(4, 8]" in msg, msg
+    assert "Ez" in msg, msg
+
+
+def test_nan_tripwire_jnp(tmp_path):
+    cfg = _cfg3d(tmp_path, output={"check_finite": True})
+    sim = Simulation(cfg)
+    _nan_trip(sim)
+    sim.close_telemetry()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    chunks = [r for r in recs if r["type"] == "chunk"]
+    assert [c["finite"] for c in chunks] == [True, False]
+    # the record of the unhealthy chunk is written BEFORE the raise
+    assert recs[-1]["first_unhealthy_t"] == 8
+
+
+def test_nan_tripwire_packed_pallas():
+    """ISSUE 2 satellite: inject a NaN mid-run on the PACKED path and
+    assert the in-graph flag trips with the chunk + step bound."""
+    cfg = SimConfig(
+        **BASE3D, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+        output=OutputConfig(check_finite=True))
+    sim = Simulation(cfg)
+    assert sim.step_kind == "pallas_packed", sim.step_kind
+    assert sim._runner_health is True
+    _nan_trip(sim)
+
+
+# -------------------------------------------------------------------------
+# ladder_downgrade event
+# -------------------------------------------------------------------------
+
+def test_ladder_downgrade_event(tmp_path, monkeypatch):
+    """Force one rung of the VMEM ladder and check the structured event
+    lands in the JSONL next to the (still-present) stderr warning."""
+    cfg = _cfg3d(tmp_path)
+    sim = Simulation(cfg)
+    sim.step_kind = "pallas_packed"   # enter the ladder's guard
+    sim.step_diag = {"tile": {"EH": 8}}
+
+    import fdtd3d_tpu.solver as solver_mod
+
+    def fake_runner(static, mesh_axes, mesh_shape, health=False):
+        r = lambda state, coeffs, n: state  # noqa: E731
+        r.kind = "pallas_packed"
+        r.diag = {"tile": {"EH": 4}}
+        r.health = False
+        return r
+
+    monkeypatch.setattr(solver_mod, "make_chunk_runner", fake_runner)
+    monkeypatch.setattr("fdtd3d_tpu.sim.make_chunk_runner", fake_runner)
+    sim._vmem_fallback(RuntimeError("mosaic vmem overflow"))
+    sim.close_telemetry()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    ev = [r for r in recs if r["type"] == "ladder_downgrade"]
+    assert len(ev) == 1
+    assert ev[0]["old_budget_mb"] is None       # first rung: model pick
+    assert ev[0]["new_budget_mb"] == Simulation._VMEM_LADDER_MB[0]
+    assert ev[0]["old_tile"] == 8 and ev[0]["new_tile"] == 4
+    assert ev[0]["vmem_rung"] == 1
+
+
+# -------------------------------------------------------------------------
+# overhead guard (≤2% on a chunked run) + StepClock percentiles
+# -------------------------------------------------------------------------
+
+def _chunk_cost(static, coeffs, state, n_steps, health):
+    """XLA cost-model (flops, bytes accessed) of one compiled chunk."""
+    import functools
+
+    from fdtd3d_tpu.solver import make_chunk_runner
+    runner = make_chunk_runner(static, health=health)
+    compiled = jax.jit(functools.partial(runner, n=n_steps)).lower(
+        state, coeffs).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):  # older jax returns [dict]
+        ca = ca[0]
+    return float(ca["flops"]), float(ca["bytes accessed"])
+
+
+def test_telemetry_overhead_guard():
+    """The ≤2% overhead guarantee, asserted deterministically.
+
+    Wall-clock at the 2% level is unmeasurable on a loaded CI box
+    (chunk times here swing 3x between iterations — the slow-lane
+    test below takes the measured route on quiet machines/TPU). The
+    deterministic form uses XLA's cost model on the SAME compiled
+    chunk executables Simulation runs:
+
+    1. the health counters add a FIXED per-chunk cost — one reduction
+       over the final state, NOT a per-step term: the cost model
+       (which counts the scan body once, independent of trip count —
+       asserted below so the arithmetic stays honest) reports the same
+       extra bytes/flops for a 16-step and a 128-step chunk;
+    2. that fixed cost is ≤ 0.16 step-equivalents in bytes (what
+       bounds this HBM-bound stencil) AND flops — so for every chunk
+       of ≥ 8 steps the overhead is ≤ 2%, production chunks are
+       60-120+ steps (bench stages; Simulation.run defaults to the
+       WHOLE horizon in one scan) where it is ≤ 0.3%. The model
+       over-counts fused temporaries; measured wall cost of the
+       reduction is even lower (~0.008 chunk-equivalents at 48³x64,
+       slow-lane test below).
+    """
+    import jax.numpy as jnp
+
+    from fdtd3d_tpu.solver import build_coeffs, build_static, init_state
+    cfg = SimConfig(scheme="3D", size=(32, 32, 32), time_steps=128,
+                    dx=1e-3, courant_factor=0.4, wavelength=8e-3,
+                    pml=PmlConfig(size=(4, 4, 4)))
+    st = build_static(cfg)
+    coeffs = jax.tree.map(jnp.asarray, build_coeffs(st))
+    state = init_state(st)
+    f16, b16 = _chunk_cost(st, coeffs, state, 16, health=False)
+    f16h, b16h = _chunk_cost(st, coeffs, state, 16, health=True)
+    f128h, b128h = _chunk_cost(st, coeffs, state, 128, health=True)
+    # invariant the arithmetic relies on: the model counts the scan
+    # body once, so a chunk's cost ~= one step's cost and the health
+    # extra is per-CHUNK, not per-step
+    assert b128h == pytest.approx(b16h, rel=0.01), \
+        "cost model scales with trip count; rederive the bound"
+    # ≤ 0.16 step-equivalents => ≤2% for every chunk of ≥8 steps
+    extra_b, extra_f = b16h - b16, f16h - f16
+    assert extra_b <= 0.16 * b16, \
+        f"health reduction costs {extra_b / b16:.3f} step-equivalents " \
+        f"of bytes (> 0.16): >2% at 8-step chunks"
+    assert extra_f <= 0.16 * f16, \
+        f"health reduction costs {extra_f / f16:.3f} step-equivalents " \
+        f"of flops (> 0.16)"
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_guard_wallclock(tmp_path):
+    """Measured form of the ≤2% guard for quiet machines / the chip
+    lane: interleaved min-of-N chunk timings (load drift hits both
+    sims instead of whichever ran second), one re-measure, and a small
+    absolute epsilon for timer noise."""
+    n, steps, repeats = 48, 64, 7
+    base = dict(scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
+                courant_factor=0.4, wavelength=8e-3,
+                pml=PmlConfig(size=(4, 4, 4)))
+    off = Simulation(SimConfig(**base))
+    on = Simulation(SimConfig(
+        **base, output=OutputConfig(
+            telemetry_path=str(tmp_path / "t.jsonl"))))
+    off.advance(steps)  # warm-up/compile outside the timing
+    on.advance(steps)
+
+    def timed(sim):
+        sim.block_until_ready()
+        t0 = time.perf_counter()
+        sim.advance(steps)
+        sim.block_until_ready()
+        return time.perf_counter() - t0
+
+    def pair():
+        t_off = t_on = float("inf")
+        for _ in range(repeats):
+            t_off = min(t_off, timed(off))
+            t_on = min(t_on, timed(on))
+        return t_off, t_on
+
+    t_off, t_on = pair()
+    if t_on > t_off * 1.02 + 0.002:  # one retry before failing
+        t_off, t_on = pair()
+    on.close_telemetry()
+    assert t_on <= t_off * 1.02 + 0.002, \
+        f"telemetry overhead {t_on / t_off - 1:.1%} " \
+        f"(on {t_on * 1e3:.1f}ms vs off {t_off * 1e3:.1f}ms)"
+
+
+def test_step_clock_percentiles():
+    from fdtd3d_tpu.profiling import StepClock
+    clk = StepClock()
+    for sec in (1.0, 2.0, 4.0):
+        clk.record(10, sec, 1e6)  # 10, 5, 2.5 Mcells/s chunks
+    s = clk.summary()
+    assert s["chunks"] == 3
+    assert s["p50_mcells_per_s"] == pytest.approx(5.0)
+    assert s["max_mcells_per_s"] == pytest.approx(10.0)
+    assert s["p95_mcells_per_s"] == pytest.approx(
+        float(np.percentile([10.0, 5.0, 2.5], 95)))
+    rep = clk.report()
+    assert "p50" in rep and "p95" in rep and "max" in rep
+    empty = StepClock().summary()
+    assert empty["p50_mcells_per_s"] == 0.0
+
+
+# -------------------------------------------------------------------------
+# CLI smoke + report tool
+# -------------------------------------------------------------------------
+
+def test_cli_telemetry_smoke(tmp_path, capsys):
+    """ISSUE 2 satellite: CLI --telemetry on a tiny 3D case; every
+    record validates against the schema."""
+    from fdtd3d_tpu import cli
+    path = str(tmp_path / "flight.jsonl")
+    rc = cli.main(["--3d", "--same-size", "12", "--time-steps", "6",
+                   "--use-pml", "--pml-size", "3",
+                   "--point-source", "Ez",
+                   "--metrics-every", "3",   # forces chunked advance
+                   "--save-dir", str(tmp_path),
+                   "--telemetry", path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "telemetry:" in out
+    recs = telemetry.read_jsonl(path)  # validates every record
+    types = [r["type"] for r in recs]
+    assert types[0] == "run_start" and types[-1] == "run_end"
+    assert types.count("chunk") == 2  # 6 steps at interval 3
+
+
+def test_report_tool(tmp_path):
+    cfg = _cfg3d(tmp_path)
+    sim = Simulation(cfg)
+    for _ in range(4):
+        sim.advance(2)
+    sim.close_telemetry()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(root, "tools", "telemetry_report.py")
+    proc = subprocess.run(
+        [sys.executable, tool, cfg.output.telemetry_path],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    assert "Mcells/s" in proc.stdout
+    assert "healthy: finite throughout" in proc.stdout
+    tr = _load_report_tool()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    summaries = [tr.summarize_run(r) for r in tr.split_runs(recs)]
+    assert summaries[0]["chunks"] == 4
+    assert summaries[0]["complete"] is True
+    assert summaries[0]["first_unhealthy_t"] is None
+
+
+def _load_report_tool():
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        os.path.join(root, "tools", "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_report_tool_first_unhealthy(tmp_path):
+    """An unhealthy run's summary carries the first-bad-step bound."""
+    tr = _load_report_tool()
+    cfg = _cfg3d(tmp_path, output={"check_finite": False})
+    sim = Simulation(cfg)
+    sim.advance(4)
+    sim.set_field("Ez", np.full(sim.state["E"]["Ez"].shape, np.nan,
+                                np.float32))
+    sim.advance(4)  # check_finite off: records, does not raise
+    sim.close_telemetry()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    s = tr.summarize_run(tr.split_runs(recs)[0])
+    assert s["first_unhealthy_t"] == 8
+    assert s["first_unhealthy_bound"] == [4, 8]
+
+
+# -------------------------------------------------------------------------
+# sharded + paired-complex coverage
+# -------------------------------------------------------------------------
+
+def _cfg2d(tmp_path, **kw):
+    # 2D keeps the compile cheap (tier-1 wall budget); the collective /
+    # paired-leg health plumbing is scheme-independent
+    return SimConfig(
+        scheme="2D_TMz", size=(32, 32, 1), time_steps=8, dx=1e-3,
+        courant_factor=0.4, wavelength=10e-3,
+        pml=PmlConfig(size=(4, 4, 0)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(16, 16, 0)),
+        output=OutputConfig(
+            telemetry_path=str(tmp_path / "telemetry.jsonl")), **kw)
+
+
+def test_health_counters_sharded_match_single(tmp_path):
+    """Counters psum/pmax to GLOBAL values under shard_map: a sharded
+    run must report the same energy/max as the single-device run."""
+    k1 = _cfg2d(tmp_path)
+    s1 = Simulation(k1)
+    s1.advance(8)
+    s1.close_telemetry()
+    r1 = [r for r in telemetry.read_jsonl(k1.output.telemetry_path)
+          if r["type"] == "chunk"][-1]
+    p2 = tmp_path / "sharded"
+    p2.mkdir()
+    k2 = _cfg2d(p2, parallel=ParallelConfig(topology="manual",
+                                            manual_topology=(2, 2, 1)))
+    s2 = Simulation(k2)
+    assert s2.mesh is not None
+    s2.advance(8)
+    s2.close_telemetry()
+    r2 = [r for r in telemetry.read_jsonl(k2.output.telemetry_path)
+          if r["type"] == "chunk"][-1]
+    assert r2["energy"] == pytest.approx(r1["energy"], rel=1e-4)
+    assert r2["max_e"] == pytest.approx(r1["max_e"], rel=1e-5)
+    assert r2["max_h"] == pytest.approx(r1["max_h"], rel=1e-5)
+    assert r2["finite"] is True
+
+
+def test_check_finite_paired_complex(tmp_path, monkeypatch):
+    """The paired-complex path reduces its legs in-graph (health_view);
+    the tripwire still works there."""
+    monkeypatch.setenv("FDTD3D_FORCE_PAIRED_COMPLEX", "1")
+    cfg = _cfg2d(tmp_path, complex_fields=True)
+    cfg.output.check_finite = True
+    sim = Simulation(cfg)
+    assert sim.step_kind.startswith("complex2x")
+    assert sim._runner_health is True
+    sim.advance(4)  # healthy (packs the real legs, compiles the chunk)
+    # the health reduction must not inject complex ops into the chunk:
+    # the legs are real precisely because the backend may lack complex
+    # arithmetic (the CPU test would otherwise mask an astype(c64))
+    hlo = sim._compiled[4].as_text()
+    assert "c64[" not in hlo and "c128[" not in hlo, \
+        "complex ops in the paired-real chunk graph"
+    bad = np.full(np.asarray(sim.state["E"]["Ez"]).shape, np.nan,
+                  np.complex64)
+    sim.set_field("Ez", bad)
+    with pytest.raises(FloatingPointError, match="chunk 2"):
+        sim.advance(4)

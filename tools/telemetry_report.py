@@ -1,0 +1,176 @@
+"""Summarize a flight-recorder JSONL (fdtd3d_tpu/telemetry.py).
+
+Usage:
+    python tools/telemetry_report.py PATH [--json]
+
+Validates every record against the versioned schema, then prints, per
+run (a run = one run_start..run_end span; a file may hold several —
+bench stages append):
+
+* provenance (git sha, jax version, platform, grid, dtype, kernel)
+* step-time percentiles: per-chunk wall seconds p50/p95/max and the
+  equivalent Mcells/s p50/p95/max
+* throughput trend: first-half vs second-half mean Mcells/s (a drift
+  >10% is flagged — tunnel throttling, thermal, or a ladder downgrade)
+* health: the first unhealthy step bound (non-finite flag), final
+  energy, max div·E residual
+* VMEM-ladder downgrade events
+
+``--json`` emits the same summary as one JSON object per run instead
+of text (for dashboards / the driver).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
+
+from fdtd3d_tpu import telemetry  # noqa: E402
+
+
+def split_runs(records):
+    """Group a validated record list into runs at run_start markers."""
+    runs, cur = [], None
+    for rec in records:
+        if rec["type"] == "run_start":
+            if cur:
+                runs.append(cur)
+            cur = [rec]
+        else:
+            if cur is None:
+                cur = []  # tolerate a truncated head
+            cur.append(rec)
+    if cur:
+        runs.append(cur)
+    return runs
+
+
+def _pct(vals, q):
+    return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
+
+
+def summarize_run(run):
+    """One run's record list -> summary dict."""
+    start = next((r for r in run if r["type"] == "run_start"), {})
+    end = next((r for r in run if r["type"] == "run_end"), None)
+    chunks = [r for r in run if r["type"] == "chunk"]
+    ladder = [r for r in run if r["type"] == "ladder_downgrade"]
+    out = {
+        "provenance": {k: start.get(k) for k in
+                       ("git_sha", "jax_version", "platform",
+                        "device_kind", "scheme", "grid", "dtype",
+                        "topology", "step_kind", "wall_time")
+                       if k in start},
+        "chunks": len(chunks),
+        "complete": end is not None,
+        "ladder_downgrades": ladder,
+    }
+    if not chunks:
+        return out
+    walls = [c["wall_s"] for c in chunks]
+    rates = [c["mcells_per_s"] for c in chunks]
+    out["steps"] = sum(c["steps"] for c in chunks)
+    out["wall_s"] = sum(walls)
+    out["wall_s_per_chunk"] = {"p50": _pct(walls, 50),
+                               "p95": _pct(walls, 95),
+                               "max": float(max(walls))}
+    out["mcells_per_s"] = {"p50": _pct(rates, 50), "p95": _pct(rates, 95),
+                           "max": float(max(rates))}
+    half = len(rates) // 2
+    if half >= 1:
+        first = float(np.mean(rates[:half]))
+        second = float(np.mean(rates[half:]))
+        out["throughput_trend"] = {
+            "first_half_mcells_per_s": first,
+            "second_half_mcells_per_s": second,
+            "drift": (second - first) / first if first > 0 else 0.0,
+        }
+    # first unhealthy step BOUND: counters are per-chunk, so the first
+    # bad step lies in (t - steps, t] of the first non-finite chunk
+    bad = next((c for c in chunks if not c["finite"]), None)
+    out["first_unhealthy_t"] = None if bad is None else bad["t"]
+    if bad is not None:
+        out["first_unhealthy_bound"] = [bad["t"] - bad["steps"], bad["t"]]
+    # counters are null in unhealthy chunks (non-finite -> null in the
+    # sink, since NaN literals are not JSON)
+    out["final_energy"] = chunks[-1]["energy"]
+    divs = [c["div_l2"] for c in chunks if c["div_l2"] is not None]
+    out["max_div_l2"] = float(max(divs)) if divs else None
+    return out
+
+
+def format_text(summaries) -> str:
+    lines = []
+    for i, s in enumerate(summaries):
+        p = s["provenance"]
+        lines.append(f"run {i + 1}: {p.get('scheme', '?')} "
+                     f"{p.get('grid', '?')} {p.get('dtype', '?')} "
+                     f"kernel={p.get('step_kind', '?')} "
+                     f"platform={p.get('platform', '?')} "
+                     f"sha={p.get('git_sha', '?')} "
+                     f"jax={p.get('jax_version', '?')}")
+        if not s["chunks"]:
+            lines.append("  (no chunk records)")
+            continue
+        w, r = s["wall_s_per_chunk"], s["mcells_per_s"]
+        lines.append(f"  {s['steps']} steps / {s['chunks']} chunks in "
+                     f"{s['wall_s']:.3f}s"
+                     + ("" if s["complete"] else "  [NO run_end: "
+                        "truncated run]"))
+        lines.append(f"  chunk wall s   p50 {w['p50']:.4f}  "
+                     f"p95 {w['p95']:.4f}  max {w['max']:.4f}")
+        lines.append(f"  Mcells/s       p50 {r['p50']:.1f}  "
+                     f"p95 {r['p95']:.1f}  max {r['max']:.1f}")
+        t = s.get("throughput_trend")
+        if t:
+            flag = "  <-- DRIFT >10%" if abs(t["drift"]) > 0.10 else ""
+            lines.append(f"  trend          first half "
+                         f"{t['first_half_mcells_per_s']:.1f} -> second "
+                         f"half {t['second_half_mcells_per_s']:.1f} "
+                         f"({t['drift']:+.1%}){flag}")
+        if s["first_unhealthy_t"] is not None:
+            lo, hi = s["first_unhealthy_bound"]
+            lines.append(f"  UNHEALTHY: non-finite flag first tripped at "
+                         f"t={s['first_unhealthy_t']} (first bad step in "
+                         f"({lo}, {hi}])")
+        else:
+            fe = s["final_energy"]
+            dv = s["max_div_l2"]
+            lines.append(
+                f"  healthy: finite throughout; final energy "
+                + (f"{fe:.3e} J" if fe is not None else "n/a")
+                + ", max div_l2 "
+                + (f"{dv:.3e}" if dv is not None else "n/a"))
+        for d in s["ladder_downgrades"]:
+            lines.append(f"  LADDER DOWNGRADE at t={d['t']}: tile "
+                         f"{d['old_tile']} -> {d['new_tile']} "
+                         f"(budget {d['old_budget_mb']} -> "
+                         f"{d['new_budget_mb']} MiB)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a fdtd3d flight-recorder JSONL")
+    ap.add_argument("path")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON summary object per run")
+    args = ap.parse_args(argv)
+    records = telemetry.read_jsonl(args.path)  # validates every record
+    summaries = [summarize_run(r) for r in split_runs(records)]
+    if args.json:
+        print(json.dumps(summaries, indent=1))
+    else:
+        print(format_text(summaries))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
