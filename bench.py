@@ -74,6 +74,18 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3,
     from fdtd3d_tpu.config import OutputConfig, PmlConfig, SimConfig
     from fdtd3d_tpu.sim import Simulation
 
+    # FDTD3D_BENCH_PROFILE=DIR: capture a per-stage jax.profiler trace
+    # under DIR/<path>_<dtype>_<n>/ (the device-trace lane; attribute
+    # it with tools/trace_attribution.py). The path tag keeps the jnp
+    # and pallas stages at one grid size in separate dirs — the parser
+    # reads the newest capture per dir, so sharing one would shadow
+    # the first stage. Crash-safe: sim.close() in the finally below
+    # finalizes the capture on every exit, and the capture itself
+    # degrades to a warned skip when the backend has no profiler — no
+    # crash, no partial artifact.
+    prof_root = os.environ.get("FDTD3D_BENCH_PROFILE") or None
+    prof_tag = f"{'jnp' if use_pallas is False else 'pallas'}_" \
+               f"{dtype}_{n}"
     cfg = SimConfig(
         scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
         courant_factor=0.5, wavelength=32e-3,
@@ -82,7 +94,9 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3,
         output=OutputConfig(
             profile=True,
             telemetry_path=os.environ.get("FDTD3D_BENCH_TELEMETRY")
-            or None),
+            or None,
+            profile_dir=os.path.join(prof_root, prof_tag)
+            if prof_root else None),
     )
     sim = Simulation(cfg)
     snk = sim.telemetry
@@ -138,11 +152,12 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3,
         return (n ** 3) * steps / best / 1e6
     finally:
         # every exit (incl. the retry ladder's exceptions) must end the
-        # recording with its run_end record and release the fd — even
-        # when the warm-up failed before the sink was re-attached
+        # recording with its run_end record, release the fd AND
+        # finalize any live device-trace capture — even when the
+        # warm-up failed before the sink was re-attached
         if sim.telemetry is None:
             sim.telemetry = snk
-        sim.close_telemetry()
+        sim.close()
 
 
 def probe_hbm_gbps() -> float:
@@ -374,6 +389,11 @@ def run_measurement() -> None:
         gbps = round(probe_hbm_gbps(), 1) if on_tpu else 0.0
     except Exception:
         gbps = -1.0
+    # stamp the probe into telemetry provenance: every stage's
+    # run_start record then carries the same-window calibration
+    # (schema v2), so a JSONL reader can tell weather from regression
+    from fdtd3d_tpu import telemetry as _telemetry
+    _telemetry.set_hbm_probe(gbps)
     # Stage 1: 256^3 both paths — always completes, always yields a
     # number (the tunneled chip throttles ~20x between sessions).
     if on_tpu:
@@ -581,10 +601,49 @@ def run_measurement() -> None:
         out["best_known_n"] = best.get("n")
         out["best_known_hbm_probe_gbps"] = best.get("hbm_probe_gbps")
         out["best_known_session"] = best.get("session")
+    # Perf-regression sentinel (round 7): every artifact carries its
+    # own verdict vs BENCH_BEST + the BENCH_r* history, so a >10%
+    # per-path cliff can never ship silently — it is flagged in the
+    # very JSON line the driver records (and on stderr). Window-
+    # normalized by the same-window HBM probes; standalone gate:
+    # tools/perf_sentinel.py (non-zero exit on regression).
+    try:
+        sentinel = _load_sentinel()
+        root = os.path.dirname(os.path.abspath(__file__))
+        out["perf_sentinel"] = sentinel.check_artifact(
+            out, best=_load_best(),
+            history=sentinel.load_history(
+                os.path.join(root, "BENCH_r*.json")))
+        for msg in out["perf_sentinel"]["regressions"]:
+            print(f"PERF SENTINEL REGRESSION: {msg}",
+                  file=sys.stderr, flush=True)
+    except Exception as exc:  # the sentinel must never kill the bench
+        out["perf_sentinel"] = {"status": "ERROR",
+                                "error": str(exc)[:200]}
     print(json.dumps(out), flush=True)
 
 
+def _load_sentinel():
+    """tools/perf_sentinel.py as a module (tools/ is not a package)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "perf_sentinel.py")
+    spec = importlib.util.spec_from_file_location("perf_sentinel", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main() -> None:
+    # `python bench.py --profile DIR` routes the per-stage device-trace
+    # lane (same as FDTD3D_BENCH_PROFILE=DIR) into the child process.
+    if "--profile" in sys.argv:
+        i = sys.argv.index("--profile")
+        if i + 1 >= len(sys.argv):
+            print(json.dumps({"error": "--profile needs a DIR"}),
+                  flush=True)
+            sys.exit(2)
+        os.environ["FDTD3D_BENCH_PROFILE"] = sys.argv[i + 1]
     last_err = "no attempt ran"
     for attempt in range(RETRIES + 1):
         if attempt > 0:
@@ -607,10 +666,11 @@ def main() -> None:
             continue
         if proc.returncode == 0:
             # surface the child's stage-failure diagnostics (stage3/
-            # stage4 degrade gracefully to 0.0 in the JSON — without
-            # this the reason never reaches the operator)
+            # stage4 degrade gracefully to 0.0 in the JSON) and the
+            # perf sentinel's regression flags — without this relay
+            # neither reaches the operator
             for ln in (proc.stderr or "").splitlines():
-                if "failed" in ln:
+                if "failed" in ln or "PERF SENTINEL" in ln:
                     print(ln, file=sys.stderr, flush=True)
             for line in proc.stdout.splitlines():
                 line = line.strip()

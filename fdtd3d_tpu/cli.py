@@ -181,15 +181,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "norms, divergence residual) to "
                         "save_dir/metrics.jsonl every N steps")
     g.add_argument("--log-level", type=int, default=1)
-    g.add_argument("--profile", action=argparse.BooleanOptionalAction, default=False,
-                   help="time every compute chunk (StepClock) and print a "
-                        "throughput summary at the end")
+    g.add_argument("--profile", nargs="?", const=True, default=False,
+                   metavar="DIR",
+                   help="time every compute chunk (StepClock) and print "
+                        "a throughput summary at the end; with DIR, also "
+                        "capture a jax.profiler device trace there "
+                        "(crash-safe, finalized on every exit; attribute "
+                        "it with tools/trace_attribution.py; degrades to "
+                        "a clean skip when no profiler is available)")
+    # compat: --profile was a BooleanOptionalAction before round 7, so
+    # command files saved by earlier builds may contain --no-profile;
+    # replay must keep working (hidden from --help and from
+    # save_cmd_file, which skips SUPPRESS'd actions)
+    g.add_argument("--no-profile", dest="profile", action="store_const",
+                   const=False, help=argparse.SUPPRESS)
     g.add_argument("--check-finite", action=argparse.BooleanOptionalAction, default=False,
                    help="NaN/Inf tripwire over the state after each chunk")
     g.add_argument("--trace", metavar="DIR", default=None,
-                   help="write a jax.profiler (XProf/TensorBoard) trace "
-                        "of the run to DIR: per-step HLO timeline incl. "
-                        "halo collectives vs stencil compute")
+                   help="legacy alias for --profile DIR (kept for saved "
+                        "command files)")
     g.add_argument("--telemetry", metavar="PATH", default=None,
                    help="flight recorder: append schema-versioned JSONL "
                         "records (per-chunk in-graph health counters, "
@@ -333,8 +343,13 @@ def args_to_config(args) -> SimConfig:
             checkpoint_backend=args.checkpoint_backend,
             norms_every=args.norms_every, metrics_every=args.metrics_every,
             log_level=args.log_level,
-            profile=args.profile, check_finite=args.check_finite,
-            telemetry_path=args.telemetry),
+            profile=bool(args.profile), check_finite=args.check_finite,
+            telemetry_path=args.telemetry,
+            # --profile DIR routes the device-trace lane; --trace is
+            # the legacy alias (saved command files)
+            profile_dir=(args.profile
+                         if isinstance(args.profile, str) else None)
+            or args.trace),
         ntff=NtffConfig(
             enabled=args.ntff, frequency=args.ntff_frequency,
             every=args.ntff_every, start=args.ntff_start,
@@ -387,7 +402,10 @@ def save_cmd_file(args, path: str):
     lines = []
     for action in parser._actions:
         if not action.option_strings or action.dest in (
-                "help", "cmd_from_file", "save_cmd_to_file"):
+                "help", "cmd_from_file", "save_cmd_to_file") or \
+                action.help == argparse.SUPPRESS:
+            # SUPPRESS'd actions are compat aliases (--no-profile):
+            # re-emitting them would mis-serialize the shared dest
             continue
         val = getattr(args, action.dest, None)
         if val is None:
@@ -578,18 +596,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         # After a checkpoint restore, run only the REMAINING steps so the
         # resumed run ends at the same t as the uninterrupted one.
+        # (The device-trace lane — --profile DIR / --trace — is wired
+        # through Simulation: capture starts at the first advance and
+        # the finally below finalizes it on EVERY exit.)
         remaining = max(0, cfg.time_steps - sim.t) if args.load_checkpoint \
             else cfg.time_steps
-        import contextlib
-
-        from fdtd3d_tpu import profiling
-        tracer = profiling.trace(args.trace) if args.trace \
-            else contextlib.nullcontext()
-        with tracer:
-            sim.run(time_steps=remaining,
-                    on_interval=on_interval if interval else None,
-                    interval=interval)
-            sim.block_until_ready()
+        sim.run(time_steps=remaining,
+                on_interval=on_interval if interval else None,
+                interval=interval)
+        sim.block_until_ready()
         if ntff_col is not None:
             if ntff_col.n_samples > 0:
                 import jax
@@ -613,9 +628,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"({mcps:.1f} Mcells/s)")
         return 0
     finally:
+        # finalizes BOTH observability lanes on every exit: the
+        # device-trace capture (a crash mid-capture must still leave a
+        # parseable trace directory, never a partial artifact) and the
+        # telemetry sink's run_end record.
+        n_rec = sim.telemetry.n_records if sim.telemetry is not None \
+            else 0
+        sim.close()
         if sim.telemetry is not None:
-            n_rec = sim.telemetry.n_records
-            sim.close_telemetry()
             log(f"telemetry: {n_rec + 1} records -> "
                 f"{cfg.output.telemetry_path}")
 

@@ -221,6 +221,14 @@ class OutputConfig:
     # as ladder_downgrade events. CLI flag: --telemetry PATH.
     # Summarize with tools/telemetry_report.py.
     telemetry_path: Optional[str] = None
+    # Device-trace lane (round 7): when set, Simulation starts a
+    # jax.profiler capture into this directory at the first advance()
+    # and finalizes it in Simulation.close() — crash-safe via the
+    # callers' try/finally, degrade-to-skip when no profiler/chip is
+    # available (profiling.TraceCapture). CLI flag: --profile DIR;
+    # bench: FDTD3D_BENCH_PROFILE. Attribute the capture back onto the
+    # named solver sections with tools/trace_attribution.py.
+    profile_dir: Optional[str] = None
 
 
 @dataclasses.dataclass

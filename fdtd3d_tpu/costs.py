@@ -1,0 +1,490 @@
+"""Static per-section cost ledger (performance attribution, round 7).
+
+Answers "*where inside the step* does the time go" — the question every
+perf round so far answered by hand-running one-off scripts
+(tools/measure_r3.py / measure_r4.py / decompose_overhead.py) on a
+chip window. The ledger needs NO chip: it traces the very chunk
+runner ``Simulation`` executes (``solver.make_chunk_runner``), walks
+the jaxpr — the 1:1 precursor of the unoptimized HLO — and charges
+every equation's flops and bytes to the named section
+(``telemetry.GRAPH_SPANS``) its ``jax.named_scope`` stack carries:
+E-update / H-update / cpml / halo-exchange / source / tfsf /
+packed-kernel / health / prepare. Deterministic on CPU, so tier-1
+asserts the attribution coverage (≥95% of per-step flops AND bytes)
+for all four step kinds (tests/test_costs.py).
+
+Cost model (recorded in the ledger's ``model`` field):
+
+* flops: per output element, weighted per primitive (transcendental 10,
+  sqrt/div 4, elementwise 1, reductions count their input); integer
+  index arithmetic counts 0. Inside a ``pallas_call`` the kernel-body
+  flops are multiplied by the grid size.
+* bytes: every equation charges operand + result bytes — the UNFUSED
+  upper bound (XLA fuses elementwise chains, so absolute bytes
+  overstate HBM traffic; the per-section SHARES are the signal, and
+  the known fused-path truth — e.g. 48 B/cell for the f32 packed
+  kernel — comes from the pallas_call rule below). A ``pallas_call``
+  charges its operands/results ONCE (the kernels stream each volume
+  once per step); its body's VMEM traffic is not HBM and counts 0.
+* control flow: the chunk's step scan counts its body ONCE (the ledger
+  is per-step); other scans multiply by their trip count; ``cond``
+  takes its most expensive branch; ``while`` bodies count once.
+
+The roofline lane divides per-step bytes by a measured HBM GB/s (the
+``bench.probe_hbm_gbps`` calibration, recorded in ``BENCH_BEST.json``
+and telemetry v2 run_start records) into a modeled step time and
+Mcells/s. ``tools/trace_attribution.py`` merges this modeled view with
+measured device-trace time; ``tools/perf_sentinel.py`` diffs ledgers
+across commits to flag per-section cost growth.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+LEDGER_VERSION = 1
+LEDGER_SCHEMA = "fdtd3d-cost-ledger"
+
+# The four production step kinds the ledger covers (ISSUE 3 acceptance;
+# the jnp_ds / fused / complex2x variants trace too, via kind=None).
+STEP_KINDS = ("jnp", "pallas", "pallas_packed", "pallas_packed_ds")
+
+# flop weight per output element, by primitive name
+_TRANSCENDENTAL = frozenset((
+    "exp", "exp2", "expm1", "log", "log1p", "sin", "cos", "tan", "asin",
+    "acos", "atan", "atan2", "sinh", "cosh", "tanh", "erf", "erfc",
+    "erf_inv", "logistic", "pow"))
+_SQRTLIKE = frozenset(("sqrt", "rsqrt", "cbrt", "div", "rem"))
+_REDUCES = frozenset((
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+    "reduce_precision", "cumsum", "cumlogsumexp", "cummax", "cummin"))
+_ZERO_FLOP = frozenset((
+    "broadcast_in_dim", "reshape", "squeeze", "transpose", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "convert_element_type", "rev", "iota", "copy", "gather",
+    "bitcast_convert_type", "stop_gradient", "device_put", "select_n",
+    "get", "swap", "masked_load", "masked_swap", "addupdate",
+    "broadcast", "split", "expand_dims", "real", "imag", "complex",
+    "ppermute", "psum", "pmax", "pmin", "all_gather", "axis_index"))
+
+# recursed (never costed directly): higher-order primitives, keyed by
+# the param holding their inner jaxpr(s)
+_INNER_JAXPR_PARAMS = ("jaxpr", "call_jaxpr", "fun_jaxpr", "body_jaxpr")
+
+_SCOPE_RE = re.compile(r"fdtd3d/([\w-]+)")
+
+
+def _aval_bytes(aval) -> int:
+    dt = getattr(aval, "dtype", None)
+    if dt is None:
+        return 0
+    try:
+        return int(aval.size) * int(dt.itemsize)
+    except Exception:
+        return 0
+
+
+def _aval_size(aval) -> int:
+    try:
+        return int(aval.size)
+    except Exception:
+        return 0
+
+
+def _is_inexact(aval) -> bool:
+    import numpy as np
+    dt = getattr(aval, "dtype", None)
+    return dt is not None and np.issubdtype(dt, np.inexact)
+
+
+def _eqn_flops(eqn) -> float:
+    """Flop estimate for one LEAF equation (no inner jaxpr)."""
+    name = eqn.primitive.name
+    if name in _ZERO_FLOP:
+        return 0.0
+    out_elems = sum(_aval_size(v.aval) for v in eqn.outvars)
+    in_elems = sum(_aval_size(v.aval) for v in eqn.invars
+                   if hasattr(v, "aval"))
+    if not any(_is_inexact(v.aval) for v in
+               list(eqn.outvars) + [v for v in eqn.invars
+                                    if hasattr(v, "aval")]):
+        return 0.0  # pure integer index arithmetic is not FLOPs
+    if name == "dot_general":
+        dims = eqn.params["dimension_numbers"][0]
+        lhs = eqn.invars[0].aval
+        k = 1
+        for d in dims[0]:
+            k *= lhs.shape[d]
+        return 2.0 * out_elems * k
+    if name in _REDUCES:
+        return float(in_elems)
+    if name == "integer_pow":
+        return 3.0 * out_elems
+    if name in _TRANSCENDENTAL:
+        return 10.0 * out_elems
+    if name in _SQRTLIKE:
+        return 4.0 * out_elems
+    return float(out_elems)
+
+
+def _eqn_bytes(eqn) -> float:
+    """Operand+result bytes for one leaf equation (unfused bound)."""
+    total = sum(_aval_bytes(v.aval) for v in eqn.invars
+                if hasattr(v, "aval"))
+    total += sum(_aval_bytes(v.aval) for v in eqn.outvars)
+    return float(total)
+
+
+def _section_of(stack: str) -> str:
+    from fdtd3d_tpu.telemetry import GRAPH_SPANS
+    last = None
+    for m in _SCOPE_RE.finditer(stack):
+        if m.group(1) in GRAPH_SPANS:
+            last = m.group(1)   # innermost scope wins
+    return last or "unattributed"
+
+
+class _Acc:
+    """Per-section (flops, bytes) accumulators, per-step + per-chunk."""
+
+    def __init__(self, n_steps: int):
+        self.n_steps = n_steps
+        self.step: Dict[str, list] = {}
+        self.chunk: Dict[str, list] = {}
+        self.step_scan_seen = False
+
+    def add(self, in_step: bool, section: str, flops: float,
+            bytes_: float):
+        tgt = self.step if in_step else self.chunk
+        cell = tgt.setdefault(section, [0.0, 0.0])
+        cell[0] += flops
+        cell[1] += bytes_
+
+
+def _merge(acc: _Acc, other: _Acc):
+    for in_step, src in ((True, other.step), (False, other.chunk)):
+        for sec, (f, b) in src.items():
+            acc.add(in_step, sec, f, b)
+    acc.step_scan_seen = acc.step_scan_seen or other.step_scan_seen
+
+
+def _walk(acc: _Acc, jaxpr, prefix: str, mult: float, in_step: bool,
+          count_bytes: bool):
+    """Recursive jaxpr walk; charges each leaf eqn to its section."""
+    import math
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        stack = f"{prefix}/{eqn.source_info.name_stack}"
+        if name == "scan":
+            inner = eqn.params["jaxpr"].jaxpr
+            length = int(eqn.params.get("length", 1))
+            if not in_step and not acc.step_scan_seen \
+                    and length == acc.n_steps:
+                # THE step scan: its body is the per-step cost
+                acc.step_scan_seen = True
+                _walk(acc, inner, stack, mult, True, count_bytes)
+            else:
+                _walk(acc, inner, stack, mult * length, in_step,
+                      count_bytes)
+            continue
+        if name == "cond":
+            # charge the most expensive branch (the per-tile slab
+            # algebra in the ds kernel is a cond; identity branches
+            # must not dilute it)
+            best = None
+            for br in eqn.params["branches"]:
+                sub = _Acc(acc.n_steps)
+                _walk(sub, br.jaxpr, stack, mult, in_step, count_bytes)
+                cost = sum(f + b for f, b in
+                           list(sub.step.values())
+                           + list(sub.chunk.values()))
+                if best is None or cost > best[0]:
+                    best = (cost, sub)
+            if best is not None:
+                _merge(acc, best[1])
+            continue
+        if name == "while":
+            body = eqn.params["body_jaxpr"].jaxpr
+            _walk(acc, body, stack, mult, in_step, count_bytes)
+            continue
+        if name == "pallas_call":
+            grid = getattr(eqn.params.get("grid_mapping"), "grid", ()) \
+                or ()
+            gp = float(math.prod(int(g) for g in grid)) or 1.0
+            # kernel-body flops x grid invocations; VMEM ref traffic
+            # inside the body is NOT HBM and counts 0 bytes — the HBM
+            # charge is the call's operands/results, once per step
+            _walk(acc, eqn.params["jaxpr"], stack, mult * gp, in_step,
+                  False)
+            if count_bytes:
+                acc.add(in_step, _section_of(stack), 0.0,
+                        mult * _eqn_bytes(eqn))
+            continue
+        inner = None
+        for p in _INNER_JAXPR_PARAMS:
+            if p in eqn.params:
+                inner = eqn.params[p]
+                break
+        if inner is not None:
+            inner = getattr(inner, "jaxpr", inner)
+            _walk(acc, inner, stack, mult, in_step, count_bytes)
+            continue
+        flops = mult * _eqn_flops(eqn)
+        bytes_ = mult * _eqn_bytes(eqn) if count_bytes else 0.0
+        if flops or bytes_:
+            acc.add(in_step, _section_of(stack), flops, bytes_)
+
+
+# --------------------------------------------------------------------------
+# forcing a step kind (CPU-deterministic; mirrors the bench/measure knobs)
+# --------------------------------------------------------------------------
+
+_KIND_ENV = {
+    "jnp": {},
+    "pallas": {"FDTD3D_NO_PACKED": "1", "FDTD3D_NO_FUSED": "1"},
+    "pallas_packed": {},
+    "pallas_packed_ds": {},
+}
+
+
+@contextlib.contextmanager
+def _forced_env(kind: Optional[str]):
+    keys = ("FDTD3D_NO_PACKED", "FDTD3D_NO_FUSED", "FDTD3D_FORCE_FUSED")
+    saved = {k: os.environ.get(k) for k in keys}
+    try:
+        if kind is not None:
+            for k in keys:
+                os.environ.pop(k, None)
+            os.environ.update(_KIND_ENV[kind])
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def config_for_kind(kind: str, n: int = 16, pml: int = 3,
+                    time_steps: int = 8):
+    """Canonical small probe config whose step engages ``kind`` on CPU
+    (point source + CPML so the source/cpml sections are exercised)."""
+    from fdtd3d_tpu.config import (PmlConfig, PointSourceConfig,
+                                   SimConfig)
+    if kind not in STEP_KINDS:
+        raise ValueError(f"unknown step kind {kind!r}; one of "
+                         f"{STEP_KINDS}")
+    return SimConfig(
+        scheme="3D", size=(n, n, n), time_steps=time_steps, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(pml, pml, pml)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(n // 2,) * 3),
+        dtype="float32x2" if kind == "pallas_packed_ds" else "float32",
+        use_pallas=kind != "jnp")
+
+
+# --------------------------------------------------------------------------
+# the ledger
+# --------------------------------------------------------------------------
+
+def chunk_ledger(cfg, n_steps: int = 8,
+                 hbm_gbps: Optional[float] = None,
+                 kind: Optional[str] = None) -> Dict[str, Any]:
+    """Trace cfg's chunk runner and attribute per-step flops/bytes.
+
+    ``kind`` forces one of STEP_KINDS via the same environment knobs
+    the measurement tools use (and raises if the forced kind did not
+    engage — a silent fallback would attribute the wrong graph).
+    Pure tracing: no compile, no device execution, CPU-deterministic.
+    """
+    import jax
+
+    from fdtd3d_tpu import telemetry
+    from fdtd3d_tpu.solver import (build_coeffs, build_static,
+                                   init_state, make_chunk_runner)
+
+    with _forced_env(kind):
+        static = build_static(cfg)
+        runner = make_chunk_runner(static, health=True)
+    if kind is not None and runner.kind != kind:
+        raise RuntimeError(
+            f"requested step kind {kind!r} but the runner engaged "
+            f"{runner.kind!r} (config out of the kernel's scope?)")
+
+    coeffs_np = build_coeffs(static)
+    coeffs_sh = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(getattr(a, "shape", ()),
+                                       getattr(a, "dtype", type(a))),
+        coeffs_np)
+    state_sh = jax.eval_shape(lambda: init_state(static))
+    if getattr(runner, "packed", False):
+        state_sh = jax.eval_shape(runner.pack, state_sh)
+
+    closed = jax.make_jaxpr(lambda s, c: runner(s, c, n=n_steps))(
+        state_sh, coeffs_sh)
+    acc = _Acc(n_steps)
+    _walk(acc, closed.jaxpr, "", 1.0, False, True)
+    if not acc.step_scan_seen:
+        raise RuntimeError("step scan (length == n_steps) not found in "
+                           "the chunk jaxpr; cannot split per-step "
+                           "from per-chunk cost")
+
+    def _table(src: Dict[str, list]) -> Dict[str, Dict[str, float]]:
+        tf = sum(f for f, _ in src.values()) or 1.0
+        tb = sum(b for _, b in src.values()) or 1.0
+        return {sec: {"flops": f, "bytes": b,
+                      "flops_frac": round(f / tf, 6),
+                      "bytes_frac": round(b / tb, 6)}
+                for sec, (f, b) in sorted(src.items())}
+
+    step_f = sum(f for f, _ in acc.step.values())
+    step_b = sum(b for _, b in acc.step.values())
+    un_f, un_b = acc.step.get("unattributed", (0.0, 0.0))
+    cells = 1.0
+    for a in static.mode.active_axes:
+        cells *= static.grid_shape[a]
+    ledger: Dict[str, Any] = {
+        "schema": LEDGER_SCHEMA,
+        "ledger_version": LEDGER_VERSION,
+        "step_kind": runner.kind,
+        "scheme": cfg.scheme,
+        "grid": list(cfg.grid_shape),
+        "dtype": cfg.dtype,
+        "cells": int(cells),
+        "n_steps": int(n_steps),
+        "sections": _table(acc.step),
+        "per_chunk_sections": _table(acc.chunk),
+        "per_step": {
+            "flops": step_f,
+            "bytes": step_b,
+            "coverage_flops": (step_f - un_f) / step_f if step_f else 1.0,
+            "coverage_bytes": (step_b - un_b) / step_b if step_b else 1.0,
+            "flops_per_cell": step_f / cells,
+            "bytes_per_cell": step_b / cells,
+        },
+        "model": ("jaxpr-walk: unfused byte upper bound; pallas_call "
+                  "operands counted once; step scan body counted once "
+                  "(per-step); cond takes its max branch"),
+    }
+    gbps = hbm_gbps if hbm_gbps is not None else telemetry.get_hbm_probe()
+    if gbps and gbps > 0:
+        t_step = step_b / (gbps * 1e9)
+        ledger["roofline"] = {
+            "hbm_gbps": float(gbps),
+            "modeled_step_ms": t_step * 1e3,
+            "modeled_mcells_per_s": cells / t_step / 1e6,
+            "arith_intensity_flops_per_byte": step_f / step_b
+            if step_b else 0.0,
+        }
+    else:
+        ledger["roofline"] = None
+    return ledger
+
+
+def validate_ledger(led: Dict[str, Any]) -> None:
+    """Raise ValueError when a dict is not a valid v1 cost ledger."""
+    if not isinstance(led, dict):
+        raise ValueError(f"ledger is not an object: {type(led)}")
+    if led.get("schema") != LEDGER_SCHEMA:
+        raise ValueError(f"ledger schema {led.get('schema')!r} != "
+                         f"{LEDGER_SCHEMA!r}")
+    if led.get("ledger_version") != LEDGER_VERSION:
+        raise ValueError(f"ledger version {led.get('ledger_version')!r} "
+                         f"!= {LEDGER_VERSION}")
+    for key, typ in (("step_kind", str), ("scheme", str), ("grid", list),
+                     ("dtype", str), ("n_steps", int),
+                     ("sections", dict), ("per_chunk_sections", dict),
+                     ("per_step", dict)):
+        if not isinstance(led.get(key), typ):
+            raise ValueError(f"ledger.{key} missing or not {typ.__name__}")
+    ps = led["per_step"]
+    for key in ("flops", "bytes", "coverage_flops", "coverage_bytes"):
+        if not isinstance(ps.get(key), (int, float)):
+            raise ValueError(f"ledger.per_step.{key} missing")
+    for cov in ("coverage_flops", "coverage_bytes"):
+        if not 0.0 <= ps[cov] <= 1.0:
+            raise ValueError(f"ledger.per_step.{cov} out of [0,1]: "
+                             f"{ps[cov]}")
+    for sec, row in led["sections"].items():
+        if not isinstance(row, dict) or \
+                not isinstance(row.get("flops"), (int, float)) or \
+                not isinstance(row.get("bytes"), (int, float)):
+            raise ValueError(f"ledger.sections[{sec!r}] malformed: "
+                             f"{row!r}")
+
+
+def _best_hbm_gbps() -> Optional[float]:
+    """Default roofline calibration: BENCH_BEST.json's recorded probe."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_BEST.json")
+    try:
+        with open(path) as f:
+            g = json.load(f).get("hbm_probe_gbps")
+        return float(g) if g and g > 0 else None
+    except Exception:
+        return None
+
+
+def main(argv=None) -> int:
+    """``python -m fdtd3d_tpu.costs``: emit one ledger as JSON."""
+    import argparse
+
+    from fdtd3d_tpu.log import report
+
+    ap = argparse.ArgumentParser(
+        description="static per-section cost ledger (flops/bytes "
+                    "attribution + HBM roofline; no chip needed)")
+    ap.add_argument("--kind", choices=STEP_KINDS + ("auto",),
+                    default="auto",
+                    help="step kind to trace (auto: whatever the "
+                         "config engages on this backend)")
+    ap.add_argument("--same-size", type=int, default=64, metavar="N",
+                    help="cubic grid edge (default 64)")
+    ap.add_argument("--pml-size", type=int, default=8)
+    ap.add_argument("--dtype", default=None,
+                    choices=["float32", "float64", "bfloat16",
+                             "float32x2"],
+                    help="override the kind's canonical dtype")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="chunk length used for the trace (the ledger "
+                         "is per-step; this only sets the scan length)")
+    ap.add_argument("--hbm-gbps", type=float, default=None,
+                    help="HBM bandwidth for the roofline lane "
+                         "(default: BENCH_BEST.json's recorded probe)")
+    ap.add_argument("--out", metavar="PATH", default=None,
+                    help="also write the ledger JSON to PATH")
+    args = ap.parse_args(argv)
+
+    kind = None if args.kind == "auto" else args.kind
+    cfg = config_for_kind(kind or "jnp", n=args.same_size,
+                          pml=args.pml_size, time_steps=args.steps)
+    if kind is None:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, use_pallas=None)
+    if args.dtype:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, dtype=args.dtype)
+    gbps = args.hbm_gbps if args.hbm_gbps is not None else \
+        _best_hbm_gbps()
+    led = chunk_ledger(cfg, n_steps=args.steps, hbm_gbps=gbps, kind=kind)
+    validate_ledger(led)
+    txt = json.dumps(led, indent=1)
+    if args.out:
+        d = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(d, exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(txt + "\n")
+    report(txt)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -41,3 +41,15 @@ def log(msg: str, level: int = 1, all_ranks: bool = False) -> None:
 def warn(msg: str) -> None:
     """Warnings always print (to stderr), at any level."""
     print(f"WARNING: {msg}", file=sys.stderr, flush=True)
+
+
+def report(msg: str = "") -> None:
+    """A tool's PRIMARY stdout product (summaries, JSON lines).
+
+    Unlike :func:`log` this never consults the level or the rank gate:
+    a report is the output the caller asked for, not progress chatter.
+    Exists so the no-bare-print lint (tests/test_lint_no_print.py,
+    which covers tools/ too) can keep ``print`` call sites structural:
+    log() for progress, warn() for stderr, report() for product.
+    """
+    print(msg, flush=True)

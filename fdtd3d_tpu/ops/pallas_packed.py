@@ -1103,8 +1103,10 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         t = pstate["t"]
         new_state = dict(pstate)
         if setup is not None:
-            new_state["inc"] = tfsf_mod.advance_einc(
-                pstate["inc"], coeffs, t, static.dt, static.omega, setup)
+            with _named("tfsf"):
+                new_state["inc"] = tfsf_mod.advance_einc(
+                    pstate["inc"], coeffs, t, static.dt, static.omega,
+                    setup)
 
         E_arr, H_arr = pstate["E"], pstate["H"]
         h_slabs = pstate["hxs"] if (x_pml and not fuse_x) else None
@@ -1240,20 +1242,25 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
         psi_h_view = PackedPsiView(psh, rows_meta_h,
                                    psxH if psxH is not None else {})
         if patches:
-            hview, psi_h_view = pallas_fused.apply_patch_h_corrections(
-                static, hview, psi_h_view, patches, coeffs, slabs,
-                mesh_axes, mesh_shape)
+            with _named("H-update"):
+                hview, psi_h_view = \
+                    pallas_fused.apply_patch_h_corrections(
+                        static, hview, psi_h_view, patches, coeffs,
+                        slabs, mesh_axes, mesh_shape)
         if setup is not None:
-            new_state["inc"] = tfsf_mod.advance_hinc(
-                new_state["inc"], coeffs, setup)
+            with _named("tfsf"):
+                new_state["inc"] = tfsf_mod.advance_hinc(
+                    new_state["inc"], coeffs, setup)
         if x_pml and not fuse_x:
-            hview, psxH = pallas3d.x_slab_post(
-                static, "H", hview, eview, psi_h_view.extra, coeffs,
-                slabs)
-            psi_h_view.extra = psxH
+            with _named("cpml"):
+                hview, psxH = pallas3d.x_slab_post(
+                    static, "H", hview, eview, psi_h_view.extra, coeffs,
+                    slabs)
+                psi_h_view.extra = psxH
         if setup is not None:
-            hview = pallas3d.tfsf_patch(static, "H", hview, coeffs,
-                                        new_state["inc"])
+            with _named("tfsf"):
+                hview = pallas3d.tfsf_patch(static, "H", hview, coeffs,
+                                            new_state["inc"])
 
         new_state["E"] = eview.arr
         new_state["H"] = hview.arr

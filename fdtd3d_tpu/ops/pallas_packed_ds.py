@@ -1076,11 +1076,12 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         inc = pstate.get("inc")
         inc_e = None
         if setup is not None:
-            inc = tfsf_mod.advance_einc(inc, coeffs, t, static.dt,
-                                        static.omega, setup)
-            inc_e = inc                       # Einc^{n+1}, Hinc^{n+1/2}
-            inc = tfsf_mod.advance_hinc(inc, coeffs, setup)
-            new_state["inc"] = inc            # Einc^{n+1}, Hinc^{n+3/2}
+            with _named("tfsf"):
+                inc = tfsf_mod.advance_einc(inc, coeffs, t, static.dt,
+                                            static.omega, setup)
+                inc_e = inc                   # Einc^{n+1}, Hinc^{n+1/2}
+                inc = tfsf_mod.advance_hinc(inc, coeffs, setup)
+                new_state["inc"] = inc        # Einc^{n+1}, Hinc^{n+3/2}
 
         def plane_shape(a):
             s = [n1, n2, n3]
@@ -1175,10 +1176,11 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         if x_pml:
             args += [cg("_pk_prof_ex", _prof_full_x, "e"),
                      cg("_pk_prof_hx", _prof_full_x, "h")]
-        st_e, iv_e = stack_terms(recs_e, inc_e, psrc) \
-            if (recs_e or psrc) else ({}, None)
-        st_h, iv_h = stack_terms(recs_h, inc, False) \
-            if recs_h else ({}, None)
+        with _named("source"):
+            st_e, iv_e = stack_terms(recs_e, inc_e, psrc) \
+                if (recs_e or psrc) else ({}, None)
+            st_h, iv_h = stack_terms(recs_h, inc, False) \
+                if recs_h else ({}, None)
         for a, k in ((0, k0e), (1, k1e), (2, k2e)):
             if k:
                 args.append(st_e[a])

@@ -14,6 +14,12 @@ Here:
 * ``trace()`` — context manager around ``jax.profiler.trace`` producing
   a TensorBoard/XProf trace with the compute/collective breakdown (the
   modern equivalent of the reference's compute-vs-share printout).
+* ``TraceCapture`` / ``device_trace()`` — the crash-safe device-trace
+  lane (round 7): explicit start/stop so ``Simulation.close()`` (held
+  in try/finally by the CLI and bench) finalizes the capture on every
+  exit, degrading to a warned no-op when no profiler/chip is present.
+  Wiring: ``OutputConfig.profile_dir`` / CLI ``--profile DIR`` /
+  ``FDTD3D_BENCH_PROFILE``; parse with ``tools/trace_attribution.py``.
 * ``assert_finite`` / ``finite_check`` — NaN/Inf tripwires over the
   whole state pytree (the functional stand-in for the reference's
   ASSERT; races are structurally absent in JAX). Wiring:
@@ -102,6 +108,69 @@ def trace(log_dir: str):
     timeline incl. the ppermute halo collectives vs stencil compute."""
     with jax.profiler.trace(log_dir):
         yield
+
+
+class TraceCapture:
+    """Crash-safe ``jax.profiler`` capture with degrade-to-skip.
+
+    The device-trace lane of the attribution layer (round 7): start()
+    begins a jax.profiler trace into ``log_dir``; stop() finalizes it.
+    Both are idempotent, and BOTH degrade to a warned no-op when the
+    profiler is unavailable or the backend refuses to trace (no chip,
+    tunneled backend without profiler support) — a simulation must
+    never die because its observability could not attach
+    (``ok`` reports whether a capture is actually live). Callers hold
+    stop() in a try/finally so a crash mid-capture still finalizes the
+    trace directory (the same guarantee the telemetry sink gives its
+    run_end record); ``Simulation.close()`` and the CLI/bench wrappers
+    do exactly that. Parse the result with
+    ``tools/trace_attribution.py``.
+    """
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self.ok = False
+        self._failed = False
+
+    def start(self) -> bool:
+        if self.ok or self._failed:
+            return self.ok
+        from fdtd3d_tpu import log as _log
+        try:
+            import os
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self.ok = True
+        except Exception as exc:  # degrade: no profiler / no chip
+            self._failed = True
+            _log.warn(f"device-trace capture unavailable "
+                      f"({str(exc)[:120]}); continuing without a trace")
+        return self.ok
+
+    def stop(self) -> None:
+        if not self.ok:
+            return
+        self.ok = False
+        from fdtd3d_tpu import log as _log
+        try:
+            jax.profiler.stop_trace()
+            _log.log(f"device trace -> {self.log_dir} (attribute with "
+                     f"tools/trace_attribution.py)")
+        except Exception as exc:  # pragma: no cover - backend hiccup
+            self._failed = True
+            _log.warn(f"device-trace stop failed ({str(exc)[:120]})")
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """try/finally wrapper around TraceCapture: the capture is always
+    finalized (or cleanly skipped), even when the block raises."""
+    cap = TraceCapture(log_dir)
+    cap.start()
+    try:
+        yield cap
+    finally:
+        cap.stop()
 
 
 def finite_check(state) -> Dict[str, bool]:

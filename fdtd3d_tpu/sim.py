@@ -130,6 +130,14 @@ class Simulation:
             self.telemetry = _telemetry.TelemetrySink(
                 cfg.output.telemetry_path,
                 run_meta=_telemetry.provenance(self))
+        # Device-trace lane (round 7): capture starts lazily at the
+        # first advance() (so construction-time failures never leave a
+        # dangling profiler session) and is finalized by close() —
+        # callers hold that in try/finally. Degrades to a warned no-op
+        # without a profiler (profiling.TraceCapture).
+        self.tracer: Optional[profiling.TraceCapture] = None
+        if cfg.output.profile_dir:
+            self.tracer = profiling.TraceCapture(cfg.output.profile_dir)
 
     def _resolve_topology(self, devices):
         return pmesh.resolve_topology(
@@ -286,6 +294,8 @@ class Simulation:
         """
         if n_steps <= 0:
             return self
+        if self.tracer is not None:
+            self.tracer.start()   # idempotent; degrades to a no-op
         self._adopt_dict_edits()
         if getattr(self._runner, "packed", False) and self._pstate is None:
             # enter the packed representation once; it persists across
@@ -360,6 +370,16 @@ class Simulation:
             if w > 0 else 0.0
         self.telemetry.close(t=self._t_host, mcells_per_s=mcps)
         return self
+
+    def close(self):
+        """Finalize every observability lane: stop the device-trace
+        capture (if one is live) and close the telemetry sink. Safe to
+        call on every exit path — both halves are idempotent — and the
+        CLI/bench hold it in try/finally so a crash mid-run still
+        finalizes the trace directory and the run_end record."""
+        if self.tracer is not None:
+            self.tracer.stop()
+        return self.close_telemetry()
 
     # Budget rungs for the packed kernel's VMEM-model fallback: the
     # model's Mosaic-temporaries constant is calibrated on one v5e

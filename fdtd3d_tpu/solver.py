@@ -621,55 +621,60 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                     setup)
             state = dict(state, inc=new_state["inc"])
 
-        # 2. E family
+        # 2. E family (the whole family — curl accumulation AND the
+        # ca/cb coefficient application — sits inside the E-update
+        # scope so the cost ledger (fdtd3d_tpu/costs.py) can attribute
+        # the full family cost; cpml/source sub-scopes nest inside and
+        # win the attribution for their ops)
         compensated = static.cfg.compensated
-        with _named("E-update"):
-            acc_e = _half_update("E", state, coeffs, new_psi)
         new_E = {}
         new_rE: Dict[str, Any] = {}
         new_J: Dict[str, Any] = {}
-        for c in mode.e_components:
-            acc = acc_e[c]
-            if static.use_drude:
-                j_new = coeffs[f"kj_{c}"] * state["J"][c] \
-                    + coeffs[f"bj_{c}"] * state["E"][c]
-                new_J[c] = j_new
-                acc = acc - j_new
-            if ps.enabled and ps.component == c:
-                with _named("source"):
-                    mask = point_mask(coeffs["gx"], coeffs["gy"],
-                                      coeffs["gz"], ps.position,
-                                      mode.active_axes)
-                    wf = waveform(ps.waveform, t, 0.5, static.omega,
-                                  static.dt, static.real_dtype)
-                    acc = acc + ps.amplitude * wf * mask.astype(acc.dtype)
-            if compensated:
-                # Kahan: E' = E + u with u = (ca-1)E + cb*acc in
-                # double-single coefficients, feeding back the stored
-                # residual of the previous step's add. (XLA does not
-                # reassociate floats, so (t-old)-y is the true rounding
-                # error, not zero.)
-                old = state["E"][c]
-                u = (coeffs[f"ca_{c}"] - 1.0) * old \
-                    + coeffs[f"cb_{c}"] * acc \
-                    + (coeffs[f"ca_{c}_lo"] * old
-                       + coeffs[f"cb_{c}_lo"] * acc)
-                y = u - state["rE"][c].astype(u.dtype)
-                e = old + y
-                r = (e - old) - y
-            else:
-                e = coeffs[f"ca_{c}"] * state["E"][c] \
-                    + coeffs[f"cb_{c}"] * acc
-            # PEC walls: zero tangential E on the walls of transverse axes.
-            for a in mode.active_axes:
-                if a != component_axis(c):
-                    w = _bcast1d(coeffs[f"wall_{AXES[a]}"], a)
-                    e = e * w
-                    if compensated:
-                        r = r * w
-            new_E[c] = e.astype(static.field_dtype)
-            if compensated:
-                new_rE[c] = r.astype(jnp.bfloat16)
+        with _named("E-update"):
+            acc_e = _half_update("E", state, coeffs, new_psi)
+            for c in mode.e_components:
+                acc = acc_e[c]
+                if static.use_drude:
+                    j_new = coeffs[f"kj_{c}"] * state["J"][c] \
+                        + coeffs[f"bj_{c}"] * state["E"][c]
+                    new_J[c] = j_new
+                    acc = acc - j_new
+                if ps.enabled and ps.component == c:
+                    with _named("source"):
+                        mask = point_mask(coeffs["gx"], coeffs["gy"],
+                                          coeffs["gz"], ps.position,
+                                          mode.active_axes)
+                        wf = waveform(ps.waveform, t, 0.5, static.omega,
+                                      static.dt, static.real_dtype)
+                        acc = acc + ps.amplitude * wf \
+                            * mask.astype(acc.dtype)
+                if compensated:
+                    # Kahan: E' = E + u with u = (ca-1)E + cb*acc in
+                    # double-single coefficients, feeding back the stored
+                    # residual of the previous step's add. (XLA does not
+                    # reassociate floats, so (t-old)-y is the true
+                    # rounding error, not zero.)
+                    old = state["E"][c]
+                    u = (coeffs[f"ca_{c}"] - 1.0) * old \
+                        + coeffs[f"cb_{c}"] * acc \
+                        + (coeffs[f"ca_{c}_lo"] * old
+                           + coeffs[f"cb_{c}_lo"] * acc)
+                    y = u - state["rE"][c].astype(u.dtype)
+                    e = old + y
+                    r = (e - old) - y
+                else:
+                    e = coeffs[f"ca_{c}"] * state["E"][c] \
+                        + coeffs[f"cb_{c}"] * acc
+                # PEC walls: zero tangential E on transverse-axis walls.
+                for a in mode.active_axes:
+                    if a != component_axis(c):
+                        w = _bcast1d(coeffs[f"wall_{AXES[a]}"], a)
+                        e = e * w
+                        if compensated:
+                            r = r * w
+                new_E[c] = e.astype(static.field_dtype)
+                if compensated:
+                    new_rE[c] = r.astype(jnp.bfloat16)
         new_state["E"] = new_E
         if compensated:
             new_state["rE"] = new_rE
@@ -685,31 +690,31 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
             state = dict(state, inc=new_state["inc"])
 
         # 4. H family (dual of step 2: mu0 mu dH/dt = -curl E - K)
-        with _named("H-update"):
-            acc_h = _half_update("H", state, coeffs, new_psi)
         new_H = {}
         new_rH: Dict[str, Any] = {}
         new_K: Dict[str, Any] = {}
-        for c in mode.h_components:
-            acc = acc_h[c]
-            if static.use_drude_m:
-                k_new = coeffs[f"km_{c}"] * state["K"][c] \
-                    + coeffs[f"bm_{c}"] * state["H"][c]
-                new_K[c] = k_new
-                acc = acc + k_new
-            if compensated:
-                old = state["H"][c]
-                u = (coeffs[f"da_{c}"] - 1.0) * old \
-                    - coeffs[f"db_{c}"] * acc \
-                    + (coeffs[f"da_{c}_lo"] * old
-                       - coeffs[f"db_{c}_lo"] * acc)
-                y = u - state["rH"][c].astype(u.dtype)
-                h = old + y
-                new_rH[c] = ((h - old) - y).astype(jnp.bfloat16)
-            else:
-                h = coeffs[f"da_{c}"] * state["H"][c] \
-                    - coeffs[f"db_{c}"] * acc
-            new_H[c] = h.astype(static.field_dtype)
+        with _named("H-update"):
+            acc_h = _half_update("H", state, coeffs, new_psi)
+            for c in mode.h_components:
+                acc = acc_h[c]
+                if static.use_drude_m:
+                    k_new = coeffs[f"km_{c}"] * state["K"][c] \
+                        + coeffs[f"bm_{c}"] * state["H"][c]
+                    new_K[c] = k_new
+                    acc = acc + k_new
+                if compensated:
+                    old = state["H"][c]
+                    u = (coeffs[f"da_{c}"] - 1.0) * old \
+                        - coeffs[f"db_{c}"] * acc \
+                        + (coeffs[f"da_{c}_lo"] * old
+                           - coeffs[f"db_{c}_lo"] * acc)
+                    y = u - state["rH"][c].astype(u.dtype)
+                    h = old + y
+                    new_rH[c] = ((h - old) - y).astype(jnp.bfloat16)
+                else:
+                    h = coeffs[f"da_{c}"] * state["H"][c] \
+                        - coeffs[f"db_{c}"] * acc
+                new_H[c] = h.astype(static.field_dtype)
         new_state["H"] = new_H
         if compensated:
             new_state["rH"] = new_rH
@@ -898,44 +903,48 @@ def _make_ds_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
                    "lopsi_E": dict(state.get("lopsi_E", {})),
                    "lopsi_H": dict(state.get("lopsi_H", {}))}
         if setup is not None:
-            new_state["inc"] = tfsf.advance_einc(
-                state["inc"], coeffs, t, static.dt, static.omega, setup)
+            with _named("tfsf"):
+                new_state["inc"] = tfsf.advance_einc(
+                    state["inc"], coeffs, t, static.dt, static.omega,
+                    setup)
             state = dict(state, inc=new_state["inc"])
 
+        new_E, new_lo, new_J = {}, {}, {}
         with _named("E-update"):
             acc_e = _half_update("E", state, coeffs, new_psi)
-        new_E, new_lo, new_J = {}, {}, {}
-        for c in mode.e_components:
-            ah, al = acc_e[c]
-            if static.use_drude:
-                j_new = coeffs[f"kj_{c}"] * state["J"][c] \
-                    + coeffs[f"bj_{c}"] * state["E"][c]
-                new_J[c] = j_new
-                ah, al = _ds.add_f(ah, al, -j_new)
-            if ps.enabled and ps.component == c:
-                from fdtd3d_tpu.ops.sources import waveform_ds
-                mask = point_mask(coeffs["gx"], coeffs["gy"],
-                                  coeffs["gz"], ps.position,
-                                  mode.active_axes)
-                wh, wl = waveform_ds(ps.waveform, t, 0.5, static.omega,
-                                     static.dt)
-                amph, ampl = _ds.from_f64(np.float64(ps.amplitude))
-                wh, wl = _ds.mul_ff(wh, wl, jnp.float32(amph),
-                                    jnp.float32(ampl))
-                m = mask.astype(ah.dtype)
-                ah, al = _ds.add_ff(ah, al, wh * m, wl * m)
-            t1 = _ds.mul_ff(state["E"][c], state["loE"][c],
-                            coeffs[f"ca_{c}"], coeffs[f"ca_{c}_lo"])
-            t2 = _ds.mul_ff(ah, al,
-                            coeffs[f"cb_{c}"], coeffs[f"cb_{c}_lo"])
-            eh, el = _ds.add_ff(*t1, *t2)
-            for a in mode.active_axes:     # PEC walls: exact 0/1 mask
-                if a != component_axis(c):
-                    w = _bcast1d(coeffs[f"wall_{AXES[a]}"], a)
-                    eh = eh * w
-                    el = el * w
-            new_E[c] = eh
-            new_lo[c] = el
+            for c in mode.e_components:
+                ah, al = acc_e[c]
+                if static.use_drude:
+                    j_new = coeffs[f"kj_{c}"] * state["J"][c] \
+                        + coeffs[f"bj_{c}"] * state["E"][c]
+                    new_J[c] = j_new
+                    ah, al = _ds.add_f(ah, al, -j_new)
+                if ps.enabled and ps.component == c:
+                    with _named("source"):
+                        from fdtd3d_tpu.ops.sources import waveform_ds
+                        mask = point_mask(coeffs["gx"], coeffs["gy"],
+                                          coeffs["gz"], ps.position,
+                                          mode.active_axes)
+                        wh, wl = waveform_ds(ps.waveform, t, 0.5,
+                                             static.omega, static.dt)
+                        amph, ampl = _ds.from_f64(
+                            np.float64(ps.amplitude))
+                        wh, wl = _ds.mul_ff(wh, wl, jnp.float32(amph),
+                                            jnp.float32(ampl))
+                        m = mask.astype(ah.dtype)
+                        ah, al = _ds.add_ff(ah, al, wh * m, wl * m)
+                t1 = _ds.mul_ff(state["E"][c], state["loE"][c],
+                                coeffs[f"ca_{c}"], coeffs[f"ca_{c}_lo"])
+                t2 = _ds.mul_ff(ah, al,
+                                coeffs[f"cb_{c}"], coeffs[f"cb_{c}_lo"])
+                eh, el = _ds.add_ff(*t1, *t2)
+                for a in mode.active_axes:  # PEC walls: exact 0/1 mask
+                    if a != component_axis(c):
+                        w = _bcast1d(coeffs[f"wall_{AXES[a]}"], a)
+                        eh = eh * w
+                        el = el * w
+                new_E[c] = eh
+                new_lo[c] = el
         new_state["E"] = new_E
         new_state["loE"] = new_lo
         if static.use_drude:
@@ -943,27 +952,28 @@ def _make_ds_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         state = dict(state, E=new_E, loE=new_lo)
 
         if setup is not None:
-            new_state["inc"] = tfsf.advance_hinc(new_state["inc"],
-                                                 coeffs, setup)
+            with _named("tfsf"):
+                new_state["inc"] = tfsf.advance_hinc(new_state["inc"],
+                                                     coeffs, setup)
             state = dict(state, inc=new_state["inc"])
 
+        new_H, new_loH, new_K = {}, {}, {}
         with _named("H-update"):
             acc_h = _half_update("H", state, coeffs, new_psi)
-        new_H, new_loH, new_K = {}, {}, {}
-        for c in mode.h_components:
-            ah, al = acc_h[c]
-            if static.use_drude_m:
-                k_new = coeffs[f"km_{c}"] * state["K"][c] \
-                    + coeffs[f"bm_{c}"] * state["H"][c]
-                new_K[c] = k_new
-                ah, al = _ds.add_f(ah, al, k_new)
-            t1 = _ds.mul_ff(state["H"][c], state["loH"][c],
-                            coeffs[f"da_{c}"], coeffs[f"da_{c}_lo"])
-            t2 = _ds.mul_ff(ah, al,
-                            coeffs[f"db_{c}"], coeffs[f"db_{c}_lo"])
-            hh, hl = _ds.sub_ff(*t1, *t2)
-            new_H[c] = hh
-            new_loH[c] = hl
+            for c in mode.h_components:
+                ah, al = acc_h[c]
+                if static.use_drude_m:
+                    k_new = coeffs[f"km_{c}"] * state["K"][c] \
+                        + coeffs[f"bm_{c}"] * state["H"][c]
+                    new_K[c] = k_new
+                    ah, al = _ds.add_f(ah, al, k_new)
+                t1 = _ds.mul_ff(state["H"][c], state["loH"][c],
+                                coeffs[f"da_{c}"], coeffs[f"da_{c}_lo"])
+                t2 = _ds.mul_ff(ah, al,
+                                coeffs[f"db_{c}"], coeffs[f"db_{c}_lo"])
+                hh, hl = _ds.sub_ff(*t1, *t2)
+                new_H[c] = hh
+                new_loH[c] = hl
         new_state["H"] = new_H
         new_state["loH"] = new_loH
         if static.use_drude_m:
@@ -1116,13 +1126,22 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
         health_fn = lambda s: hfn(view(s))  # noqa: E731
 
     def run_chunk(state, coeffs, n: int):
-        cc = prep(coeffs) if prep is not None else coeffs
+        if prep is not None:
+            # "prepare" scope: per-chunk loop-invariant packing, so the
+            # cost ledger can split it from the per-step scan body
+            with _named("prepare"):
+                cc = prep(coeffs)
+        else:
+            cc = coeffs
 
         def body(s, _):
             return step(s, cc), None
         out, _ = jax.lax.scan(body, state, None, length=n)
         if health_fn is not None:
-            return out, health_fn(out)
+            # the scope covers the in-graph unpack of packed carries
+            # too (view(s) runs before make_health_fn's own scope)
+            with _named("health"):
+                return out, health_fn(out)
         return out
 
     run_chunk.health = health_fn is not None

@@ -55,7 +55,12 @@ from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
-SCHEMA_VERSION = 1
+# v2 (round 7): run_start gains required device_kind + hbm_gbps
+# provenance (BENCH_BEST already carried both; the JSONL now does too)
+# and the "attribution" record type (tools/trace_attribution.py) joins
+# the schema. v1 files still read/validate (READ_VERSIONS).
+SCHEMA_VERSION = 2
+READ_VERSIONS = (1, 2)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -64,9 +69,13 @@ HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
 # one-line description of each). Host-side spans (TraceAnnotation):
 HOST_SPANS = ("compile", "chunk", "pack", "vmem-ladder-rebuild",
               "ntff-sample", "io-dump", "checkpoint", "telemetry-readback")
-# In-graph scopes (named_scope; prefixed fdtd3d/ in the HLO metadata):
+# In-graph scopes (named_scope; prefixed fdtd3d/ in the HLO metadata).
+# These are ALSO the attribution sections of the cost ledger
+# (fdtd3d_tpu/costs.py) and the trace parser
+# (tools/trace_attribution.py): every HLO op whose name stack carries
+# one of them is charged to that section.
 GRAPH_SPANS = ("E-update", "H-update", "cpml", "halo-exchange", "source",
-               "tfsf", "packed-kernel", "health")
+               "tfsf", "packed-kernel", "health", "prepare")
 
 
 def span(name: str):
@@ -224,6 +233,22 @@ def readback(health) -> Dict[str, float]:
 
 _git_sha_cache: Optional[str] = None
 
+# Same-window HBM streaming-probe calibration (bench.probe_hbm_gbps):
+# recorded in every run_start so a reader can tell a solver regression
+# from tunnel weather without cross-referencing the BENCH artifact.
+# None = not probed this process; -1.0 = probed but readback-dominated.
+_hbm_probe_gbps: Optional[float] = None
+
+
+def set_hbm_probe(gbps: Optional[float]) -> None:
+    """Record this process's HBM probe result (GB/s) for provenance."""
+    global _hbm_probe_gbps
+    _hbm_probe_gbps = None if gbps is None else float(gbps)
+
+
+def get_hbm_probe() -> Optional[float]:
+    return _hbm_probe_gbps
+
 
 def git_sha() -> str:
     """Repo HEAD sha (short), cached; 'unknown' outside a git checkout."""
@@ -254,6 +279,9 @@ def provenance(sim=None) -> Dict[str, Any]:
         rec["device_kind"] = jax.devices()[0].device_kind
     except Exception:
         rec["device_kind"] = "unknown"
+    # same-window HBM probe calibration (set_hbm_probe; null when the
+    # process never probed — CLI runs, tests)
+    rec["hbm_gbps"] = _hbm_probe_gbps
     if sim is not None:
         cfg = sim.cfg
         rec.update(
@@ -278,6 +306,16 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
     "run_start": {
         "wall_time": (str,), "git_sha": (str,), "jax_version": (str,),
         "platform": (str,),
+        # v2 additions (skipped when validating a v1 record):
+        "device_kind": (str,), "hbm_gbps": _OPT_NUM,
+    },
+    # v2: one merged measured-vs-modeled attribution artifact per trace
+    # capture (tools/trace_attribution.py). `sections` maps section
+    # name -> {measured_ms?, modeled_*}; `source` names the trace dir
+    # or "ledger-only".
+    "attribution": {
+        "source": (str,), "sections": (dict,),
+        "measured_total_ms": _OPT_NUM, "coverage_bytes": _OPT_NUM,
     },
     # counters are _OPT_NUM: a non-finite device value (the unhealthy
     # runs the recorder exists for) is written as null — NaN/Infinity
@@ -300,27 +338,39 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
 }
 
 
+# keys/record types that exist only from schema v2 on: skipped (keys)
+# or rejected (types) when validating a v1 record, so v1 files written
+# by earlier builds keep reading cleanly.
+_V2_ONLY_KEYS = {"run_start": ("device_kind", "hbm_gbps")}
+_V2_ONLY_TYPES = ("attribution",)
+
+
 def validate_record(rec: Dict[str, Any]) -> None:
-    """Raise ValueError when a record violates the v1 schema."""
+    """Raise ValueError when a record violates its declared schema
+    version (writers emit v2; v1 files remain readable)."""
     if not isinstance(rec, dict):
         raise ValueError(f"record is not an object: {rec!r}")
-    if rec.get("v") != SCHEMA_VERSION:
-        raise ValueError(f"record schema version {rec.get('v')!r} != "
-                         f"{SCHEMA_VERSION}")
+    v = rec.get("v")
+    if v not in READ_VERSIONS:
+        raise ValueError(f"record schema version {v!r} not in "
+                         f"{READ_VERSIONS}")
     rtype = rec.get("type")
-    if rtype not in RECORD_SCHEMA:
+    if rtype not in RECORD_SCHEMA or \
+            (v == 1 and rtype in _V2_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
+        if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):
+            continue
         if key not in rec:
             raise ValueError(f"{rtype} record missing {key!r}: {rec}")
-        v = rec[key]
+        val = rec[key]
         # bool is an int subclass: only accept it where bool is listed
-        if isinstance(v, bool) and bool not in types:
+        if isinstance(val, bool) and bool not in types:
             raise ValueError(f"{rtype}.{key} is bool, expected "
                              f"{types}: {rec}")
-        if not isinstance(v, types):
+        if not isinstance(val, types):
             raise ValueError(f"{rtype}.{key} has type "
-                             f"{type(v).__name__}, expected {types}")
+                             f"{type(val).__name__}, expected {types}")
 
 
 # --------------------------------------------------------------------------

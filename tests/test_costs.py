@@ -1,0 +1,136 @@
+"""Static cost ledger (fdtd3d_tpu/costs.py): per-section attribution.
+
+ISSUE 3 acceptance, asserted deterministically on CPU (pure tracing,
+no compile, no chip): the ledger attributes >= 95% of per-step flops
+AND bytes to named sections for all four step kinds (jnp, pallas,
+pallas_packed, pallas_packed_ds), the schema validates, and the
+roofline lane turns an HBM GB/s calibration into a modeled step time.
+"""
+
+import json
+
+import pytest
+
+from fdtd3d_tpu import costs, telemetry
+
+KINDS = costs.STEP_KINDS
+
+
+@pytest.fixture(scope="module")
+def ledgers():
+    """One traced ledger per step kind (module-scoped: tracing the
+    packed kernels is the expensive part of this file)."""
+    out = {}
+    for kind in KINDS:
+        cfg = costs.config_for_kind(kind)
+        out[kind] = costs.chunk_ledger(cfg, n_steps=8, kind=kind)
+    return out
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_ledger_validates(ledgers, kind):
+    led = ledgers[kind]
+    costs.validate_ledger(led)
+    assert led["step_kind"] == kind
+    # json round-trip clean (the artifact is a file format)
+    costs.validate_ledger(json.loads(json.dumps(led)))
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_ledger_coverage_95(ledgers, kind):
+    """THE acceptance bar: >= 95% of per-step flops and bytes land on
+    named sections (not 'unattributed') for every step kind."""
+    ps = ledgers[kind]["per_step"]
+    assert ps["coverage_flops"] >= 0.95, \
+        f"{kind}: only {ps['coverage_flops']:.1%} of flops attributed"
+    assert ps["coverage_bytes"] >= 0.95, \
+        f"{kind}: only {ps['coverage_bytes']:.1%} of bytes attributed"
+    assert ps["flops"] > 0 and ps["bytes"] > 0
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_ledger_sections_are_named_spans(ledgers, kind):
+    led = ledgers[kind]
+    for sec in led["sections"]:
+        assert sec in telemetry.GRAPH_SPANS + ("unattributed",), sec
+    # fractions sum to ~1 within each table
+    for table in (led["sections"], led["per_chunk_sections"]):
+        if table:
+            assert sum(r["bytes_frac"] for r in table.values()) == \
+                pytest.approx(1.0, abs=1e-3)
+
+
+def test_ledger_expected_sections(ledgers):
+    """The probe config (CPML + point source) must surface the
+    physically-expected sections per kind."""
+    assert {"E-update", "H-update", "cpml", "source"} <= \
+        set(ledgers["jnp"]["sections"])
+    assert "packed-kernel" in ledgers["pallas_packed"]["sections"]
+    assert "packed-kernel" in ledgers["pallas_packed_ds"]["sections"]
+    # two-pass kernels attribute their family kernels to E/H-update
+    assert {"E-update", "H-update"} <= set(ledgers["pallas"]["sections"])
+    # the health reduction is per-chunk, never per-step
+    for kind in KINDS:
+        assert "health" in ledgers[kind]["per_chunk_sections"]
+        assert "health" not in ledgers[kind]["sections"]
+
+
+def test_ds_flops_exceed_f32(ledgers):
+    """The double-single kernel's EFT arithmetic must show up: more
+    flops per cell than the plain-f32 packed kernel."""
+    f32 = ledgers["pallas_packed"]["per_step"]["flops_per_cell"]
+    ds = ledgers["pallas_packed_ds"]["per_step"]["flops_per_cell"]
+    assert ds > 2.0 * f32
+
+
+def test_roofline_lane():
+    cfg = costs.config_for_kind("jnp")
+    led = costs.chunk_ledger(cfg, n_steps=8, kind="jnp", hbm_gbps=500.0)
+    r = led["roofline"]
+    assert r is not None and r["hbm_gbps"] == 500.0
+    ps = led["per_step"]
+    assert r["modeled_step_ms"] == pytest.approx(
+        ps["bytes"] / (500.0 * 1e9) * 1e3)
+    assert r["modeled_mcells_per_s"] == pytest.approx(
+        led["cells"] / (ps["bytes"] / (500.0 * 1e9)) / 1e6)
+    # no calibration -> no roofline, never a fabricated one
+    led2 = costs.chunk_ledger(cfg, n_steps=8, kind="jnp", hbm_gbps=None)
+    telemetry.set_hbm_probe(None)
+    assert led2["roofline"] is None or \
+        led2["roofline"]["hbm_gbps"] > 0  # (env-set probe tolerated)
+
+
+def test_forced_kind_mismatch_raises():
+    """A config outside the forced kernel's scope must raise, not
+    silently ledger the fallback graph."""
+    import dataclasses
+    cfg = dataclasses.replace(costs.config_for_kind("pallas_packed"),
+                              use_pallas=False)
+    with pytest.raises(RuntimeError, match="step kind"):
+        costs.chunk_ledger(cfg, kind="pallas_packed")
+
+
+def test_validate_ledger_rejects_malformed(ledgers):
+    with pytest.raises(ValueError, match="schema"):
+        costs.validate_ledger({"schema": "nope"})
+    bad = json.loads(json.dumps(ledgers["jnp"]))
+    bad["per_step"]["coverage_bytes"] = 1.7
+    with pytest.raises(ValueError, match="out of"):
+        costs.validate_ledger(bad)
+    bad2 = json.loads(json.dumps(ledgers["jnp"]))
+    del bad2["sections"]
+    with pytest.raises(ValueError, match="sections"):
+        costs.validate_ledger(bad2)
+
+
+def test_costs_cli(tmp_path, capsys):
+    out = tmp_path / "ledger.json"
+    rc = costs.main(["--kind", "jnp", "--same-size", "16",
+                     "--pml-size", "3", "--hbm-gbps", "600",
+                     "--out", str(out)])
+    assert rc == 0
+    led = json.loads(out.read_text())
+    costs.validate_ledger(led)
+    assert led["roofline"]["hbm_gbps"] == 600.0
+    # the CLI's stdout IS the ledger (log.report)
+    assert json.loads(capsys.readouterr().out)["step_kind"] == "jnp"

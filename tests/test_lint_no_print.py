@@ -1,16 +1,21 @@
-"""Lint guard: no bare ``print(`` in fdtd3d_tpu/ outside log.py.
+"""Lint guard: no bare ``print(`` in fdtd3d_tpu/ or tools/ outside
+log.py.
 
 Round 3 routed every user-facing message through the one-switch leveled
 logger (fdtd3d_tpu/log.py: ``--log-level``, rank-0 gating); a stray
 print() reintroduces scattered, unsilenceable, every-rank output. This
 tier-1 guard makes the decision structural (ISSUE 2 satellite).
+Round 7 extends the guard to tools/: a tool's primary stdout product
+(reports, JSON lines) goes through the shared ``log.report()`` helper
+and progress/warnings through ``log.log()``/``log.warn()`` — argparse
+``--help`` output is argparse's own and never a bare print call site.
 """
 
 import os
-import re
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "fdtd3d_tpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = (os.path.join(ROOT, "fdtd3d_tpu"),
+             os.path.join(ROOT, "tools"))
 
 # log.py IS the print wrapper — the single allowed call site.
 ALLOWED = {"log.py"}
@@ -18,6 +23,8 @@ ALLOWED = {"log.py"}
 # a call site: "print(" not preceded by a word char or dot (so
 # pprint(, x.print( and docstring prose mentioning print() with a
 # preceding backtick/quote still need the line-level filters below)
+import re
+
 _CALL = re.compile(r"(?<![\w.])print\(")
 
 
@@ -37,16 +44,17 @@ def _code_lines(path):
 
 def test_no_bare_print_outside_log():
     offenders = []
-    for root, _dirs, files in os.walk(PKG):
-        for fname in files:
-            if not fname.endswith(".py") or fname in ALLOWED:
-                continue
-            path = os.path.join(root, fname)
-            for lineno, tok in _code_lines(path):
-                if _CALL.search(tok):
-                    rel = os.path.relpath(path, PKG)
-                    offenders.append(f"{rel}:{lineno}: {tok.strip()}")
+    for scan_root in SCAN_DIRS:
+        for root, _dirs, files in os.walk(scan_root):
+            for fname in files:
+                if not fname.endswith(".py") or fname in ALLOWED:
+                    continue
+                path = os.path.join(root, fname)
+                for lineno, tok in _code_lines(path):
+                    if _CALL.search(tok):
+                        rel = os.path.relpath(path, ROOT)
+                        offenders.append(f"{rel}:{lineno}: {tok.strip()}")
     assert not offenders, (
         "bare print() outside fdtd3d_tpu/log.py — route through "
-        "log.log()/log.warn() (one-switch logging, round 3):\n"
-        + "\n".join(offenders))
+        "log.log()/log.warn()/log.report() (one-switch logging, "
+        "rounds 3+7):\n" + "\n".join(offenders))

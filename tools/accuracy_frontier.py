@@ -23,6 +23,9 @@ import sys
 import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from fdtd3d_tpu.log import report  # noqa: E402
 
 CHILD = r"""
 import json, sys, time
@@ -96,8 +99,8 @@ def main():
         info = run_child(dt, args.n, args.steps, out)
         info["npz"] = out
         results[dt] = info
-        print(f"ran {dt}: {info['mcells']} Mcells/s "
-              f"({info['step_kind']})", flush=True)
+        report(f"ran {dt}: {info['mcells']} Mcells/s "
+               f"({info['step_kind']})")
 
     ref = np.load(results["float64"]["npz"])
     comps = ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz")
@@ -112,8 +115,8 @@ def main():
         table.append({"dtype": dt, "rel_err_vs_f64": float(f"{rel:.3e}"),
                       "mcells": info["mcells"],
                       "step_kind": info["step_kind"]})
-    print(json.dumps({"n": args.n, "steps": args.steps,
-                      "frontier": table}, indent=1))
+    report(json.dumps({"n": args.n, "steps": args.steps,
+                       "frontier": table}, indent=1))
 
 
 if __name__ == "__main__":

@@ -47,6 +47,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from fdtd3d_tpu.log import report  # noqa: E402
+
 
 def build_compiled(n: int, topo_name: str, dtype: str = "float32"):
     import numpy as np
@@ -186,7 +188,7 @@ def main():
     out = {"topology": args.topo, "n": args.n, "dtype": args.dtype,
            "step_kind": kind}
     out.update(analyze(txt))
-    print(json.dumps(out), flush=True)
+    report(json.dumps(out))
 
 
 if __name__ == "__main__":

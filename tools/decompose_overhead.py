@@ -35,6 +35,8 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from fdtd3d_tpu.log import report  # noqa: E402
+
 
 def _mk(n, pml, dtype="float32", steps=400):
     from fdtd3d_tpu.config import PmlConfig, SimConfig
@@ -111,7 +113,7 @@ def main():
     # f32 flops/cell of EFT arithmetic), or the fixed per-step floor.
     ds_attribution(out)
 
-    print(json.dumps(out), flush=True)
+    report(json.dumps(out))
 
 
 # EFT flops per cell of the ds kernel body (module-docstring class

@@ -17,6 +17,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fdtd3d_tpu.log import report  # noqa: E402
+
 
 def measure(scheme, size, steps, pml, repeats=3):
     import numpy as np
@@ -59,16 +61,15 @@ def main():
         gbps = round(probe_hbm_gbps(), 1)
     except Exception:
         gbps = -1.0
-    print(json.dumps({"hbm_probe_gbps": gbps}), flush=True)
+    report(json.dumps({"hbm_probe_gbps": gbps}))
     for (scheme, size, steps, pml) in [
             ("2D_TMz", (4096, 4096, 1), 50, (10, 10, 0)),
             ("1D_EzHy", (1 << 20, 1, 1), 200, (16, 0, 0))]:
         try:
-            print(json.dumps(measure(scheme, size, steps, pml)),
-                  flush=True)
+            report(json.dumps(measure(scheme, size, steps, pml)))
         except Exception as e:
-            print(json.dumps({"scheme": scheme, "error": str(e)[:300]}),
-                  flush=True)
+            report(json.dumps({"scheme": scheme,
+                               "error": str(e)[:300]}))
 
 
 if __name__ == "__main__":

@@ -1,4 +1,13 @@
-"""Round-3 same-window measurement sweep (VERDICT.md round-2 item 2).
+"""LEGACY (round 7): round-3 same-window measurement sweep.
+
+Kept runnable for reproducing BASELINE.md's round-3 table, but the
+blessed way to decompose step time is now the attribution layer:
+``python -m fdtd3d_tpu.costs`` (static per-section flops/bytes ledger,
+no chip needed) + CLI/bench ``--profile DIR`` with
+``tools/trace_attribution.py`` (measured device-trace time per
+section), gated by ``tools/perf_sentinel.py``.
+
+Round-3 same-window measurement sweep (VERDICT.md round-2 item 2).
 
 Measures, in ONE session so the tunnel calibration is shared:
   * HBM streaming probe (tunnel-health calibration)
@@ -22,8 +31,11 @@ OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "measure_r3.json")
 
 
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+
 def log(rec):
-    print(json.dumps(rec), flush=True)
+    report(json.dumps(rec))
 
 
 def measure(n, steps, use_pallas, dtype="float32", pml_axes="xyz",
@@ -69,6 +81,8 @@ def jnp_readback(sim, n):
 
 def main():
     import jax
+
+    warn("LEGACY tool: prefer the round-7 attribution layer — python -m fdtd3d_tpu.costs, --profile DIR + tools/trace_attribution.py, tools/perf_sentinel.py")
 
     try:
         jax.config.update("jax_compilation_cache_dir",

@@ -1,4 +1,13 @@
-"""Round-4 same-window measurement sweep (VERDICT.md round-3 items 1/5).
+"""LEGACY (round 7): round-4 same-window measurement sweep.
+
+Kept runnable for reproducing BASELINE.md's round-4 kernel table, but
+the blessed way to decompose step time is now the attribution layer:
+``python -m fdtd3d_tpu.costs`` (static per-section flops/bytes ledger,
+no chip needed) + CLI/bench ``--profile DIR`` with
+``tools/trace_attribution.py`` (measured device-trace time per
+section), gated by ``tools/perf_sentinel.py``.
+
+Round-4 same-window measurement sweep (VERDICT.md round-3 items 1/5).
 
 Measures, in ONE session so the tunnel calibration is shared:
   * HBM streaming probe (tunnel-health calibration)
@@ -27,8 +36,11 @@ KNOB_VARS = ("FDTD3D_NO_PACKED", "FDTD3D_NO_FUSED", "FDTD3D_FORCE_FUSED",
              "FDTD3D_VMEM_BUDGET_MB")
 
 
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+
 def log(rec):
-    print(json.dumps(rec), flush=True)
+    report(json.dumps(rec))
 
 
 def measure(n, steps, use_pallas, dtype="float32", pml_axes="xyz",
@@ -71,6 +83,8 @@ def measure(n, steps, use_pallas, dtype="float32", pml_axes="xyz",
 
 def main():
     import jax
+
+    warn("LEGACY tool: prefer the round-7 attribution layer — python -m fdtd3d_tpu.costs, --profile DIR + tools/trace_attribution.py, tools/perf_sentinel.py")
 
     try:
         jax.config.update("jax_compilation_cache_dir",

@@ -1,0 +1,291 @@
+"""Perf-regression sentinel: gate BENCH artifacts against the record.
+
+    python tools/perf_sentinel.py CURRENT.json [--best BENCH_BEST.json]
+        [--history "BENCH_r*.json"] [--ledger CUR --ledger-ref REF]
+        [--threshold 0.10] [--json]
+
+Compares the current bench artifact's per-path throughput
+(f32-packed / jnp / bf16 / float32x2) against the best on record
+(BENCH_BEST.json and the BENCH_r*.json history) and flags any path
+that dropped more than ``threshold`` (default 10%). Exit code is
+non-zero on a regression so CI and the driver can gate on it;
+``bench.py`` invokes the same check in-process and embeds the verdict
+in its JSON artifact — a perf cliff can never ship silently.
+
+Tunnel weather (BASELINE.md: the tunneled chip throttles ~20x between
+sessions) is separated from real regressions by the same-window HBM
+probe both artifacts carry: the reference throughput is scaled by
+``min(1, cur_probe/ref_probe)`` before comparing, and when either
+probe is unreliable (<= 0) a drop is reported INCONCLUSIVE (warned,
+exit 0) instead of regressed — a throttled window must not cry wolf,
+and the nightly healthy-window run still catches the cliff.
+
+With ``--ledger``/``--ledger-ref`` (fdtd3d_tpu/costs.py artifacts) the
+sentinel also diffs the static per-section cost model: per-step bytes
+or flops growth beyond the threshold in any section IS a regression
+outright — the ledger is deterministic, weather is no excuse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
+
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+# current-artifact key -> list of keys a reference record may use
+PATHS = {
+    "f32_packed": ("pallas_mcells", ("f32_pallas_mcells",
+                                     "pallas_mcells")),
+    "jnp": ("jnp_mcells", ("jnp_mcells",)),
+    "bf16": ("bf16_mcells", ("bf16_mcells",)),
+    "float32x2": ("float32x2_mcells", ("float32x2_mcells",)),
+}
+
+# grid-size keys per path (current artifact / reference records).
+# Throughput grows with grid size on the tunneled chip (fixed per-step
+# overheads amortize: 256^3 underestimates by up to ~4x, bench.py's
+# own f32_note), so a current run measured on a SMALLER grid than the
+# reference — e.g. a throttled window that never passed the 512^3 gate
+# — must not be called a regression.
+PATH_N_KEYS = {
+    "f32_packed": ("f32_n",),
+    "jnp": ("f32_n",),          # jnp stages share the f32 grid ladder
+    "bf16": ("bf16_n", "n"),
+    "float32x2": ("float32x2_n",),
+}
+
+
+def _get_num(rec: Optional[Dict], keys) -> Optional[float]:
+    for k in keys if isinstance(keys, (tuple, list)) else (keys,):
+        v = (rec or {}).get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def load_history(pattern: str) -> List[Dict[str, Any]]:
+    """BENCH_r*.json files -> list of bench-artifact dicts. The driver
+    wraps each round's artifact as {"tail": "<json line>", ...}; raw
+    artifact dicts pass through unchanged."""
+    out = []
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except Exception:
+            continue
+        if isinstance(rec, dict) and isinstance(rec.get("tail"), str):
+            tail = rec["tail"].strip()
+            if tail.startswith("{"):
+                try:
+                    rec = json.loads(tail)
+                except Exception:
+                    continue
+            else:
+                continue
+        if isinstance(rec, dict) and "error" not in rec:
+            out.append(rec)
+    return out
+
+
+def check_artifact(current: Dict[str, Any],
+                   best: Optional[Dict[str, Any]] = None,
+                   history: Optional[List[Dict[str, Any]]] = None,
+                   threshold: float = 0.10) -> Dict[str, Any]:
+    """Per-path throughput verdicts. Pure (no IO): bench.py calls this
+    in-process on the artifact it is about to print."""
+    history = history or []
+    platform = current.get("platform")
+    verdict: Dict[str, Any] = {"threshold": threshold, "paths": {},
+                               "regressions": [], "inconclusive": []}
+    if platform not in ("tpu", "axon"):
+        # CPU fallback-lane numbers are a different machine class; a
+        # "drop" vs the TPU record would be meaningless
+        verdict["status"] = "SKIPPED"
+        verdict["note"] = f"platform {platform!r} is not the TPU the " \
+                          f"record was set on"
+        return verdict
+    cur_probe = _get_num(current, "hbm_probe_gbps")
+    for path, (cur_key, ref_keys) in PATHS.items():
+        cur = _get_num(current, cur_key)
+        # strongest reference on record: BENCH_BEST or any history round
+        ref = None
+        ref_probe = None
+        for rec in ([best] if best else []) + history:
+            v = _get_num(rec, ref_keys)
+            if v is not None and (ref is None or v > ref):
+                ref = v
+                ref_probe = _get_num(rec, "hbm_probe_gbps")
+        row: Dict[str, Any] = {"current": cur, "reference": ref}
+        cur_n = _get_num(current, PATH_N_KEYS[path])
+        ref_n = max((v for rec in ([best] if best else []) + history
+                     for v in [_get_num(rec, PATH_N_KEYS[path])]
+                     if v is not None), default=None)
+        if cur is None or ref is None:
+            row["verdict"] = "NOT-MEASURED" if cur is None else "NO-REF"
+        else:
+            scale = 1.0
+            normalized = cur_probe is not None and ref_probe is not None
+            if normalized:
+                scale = min(1.0, cur_probe / ref_probe)
+            allowed = ref * scale * (1.0 - threshold)
+            row["allowed_min"] = round(allowed, 1)
+            row["window_scale"] = round(scale, 3)
+            if cur >= allowed:
+                row["verdict"] = "OK"
+            elif cur_n is not None and ref_n is not None \
+                    and cur_n < ref_n:
+                # smaller measured grid than the reference's (the
+                # window never passed the bigger-grid gate): the drop
+                # is the fixed-overhead amortization gap, not the code
+                row["verdict"] = "INCONCLUSIVE"
+                row["grids"] = [cur_n, ref_n]
+                verdict["inconclusive"].append(
+                    f"{path}: {cur:.1f} vs ref {ref:.1f} Mcells/s but "
+                    f"measured at {cur_n:.0f}^3 vs the reference's "
+                    f"{ref_n:.0f}^3 — smaller grids underread the chip")
+            elif normalized:
+                row["verdict"] = "REGRESSION"
+                verdict["regressions"].append(
+                    f"{path}: {cur:.1f} < {allowed:.1f} Mcells/s "
+                    f"(ref {ref:.1f}, window scale {scale:.2f}, "
+                    f"threshold {threshold:.0%})")
+            else:
+                # no probe pair: cannot separate tunnel weather from a
+                # real cliff — warn, do not gate
+                row["verdict"] = "INCONCLUSIVE"
+                verdict["inconclusive"].append(
+                    f"{path}: {cur:.1f} vs ref {ref:.1f} Mcells/s but "
+                    f"no same-window HBM probe pair to normalize")
+        verdict["paths"][path] = row
+    verdict["status"] = "REGRESSION" if verdict["regressions"] else (
+        "INCONCLUSIVE" if verdict["inconclusive"] else "OK")
+    return verdict
+
+
+def check_ledgers(current: Dict[str, Any], reference: Dict[str, Any],
+                  threshold: float = 0.10) -> Dict[str, Any]:
+    """Static cost diff: per-step totals + per-section growth. The
+    ledgers are deterministic, so growth past the threshold is a
+    regression outright (no weather normalization)."""
+    from fdtd3d_tpu import costs
+    costs.validate_ledger(current)
+    costs.validate_ledger(reference)
+    out: Dict[str, Any] = {"threshold": threshold, "regressions": [],
+                           "sections": {}}
+    if current.get("step_kind") != reference.get("step_kind"):
+        out["status"] = "SKIPPED"
+        out["note"] = (f"step kinds differ: {current.get('step_kind')} "
+                       f"vs {reference.get('step_kind')}")
+        return out
+    cur_cells = float(current.get("cells") or 1)
+    ref_cells = float(reference.get("cells") or 1)
+    for metric in ("flops", "bytes"):
+        # per-CELL so 16^3-fixture and 64^3-CLI ledgers compare
+        cur_t = current["per_step"][metric] / cur_cells
+        ref_t = reference["per_step"][metric] / ref_cells
+        growth = cur_t / ref_t - 1.0 if ref_t > 0 else 0.0
+        out[f"per_step_{metric}_per_cell_growth"] = round(growth, 4)
+        if growth > threshold:
+            out["regressions"].append(
+                f"per-step {metric}/cell grew {growth:+.1%} "
+                f"({ref_t:.1f} -> {cur_t:.1f})")
+    for sec, cur_row in current["sections"].items():
+        ref_row = reference["sections"].get(sec)
+        if ref_row is None:
+            out["sections"][sec] = {"verdict": "NEW"}
+            continue
+        row = {}
+        for metric in ("flops", "bytes"):
+            cur_v = cur_row[metric] / cur_cells
+            ref_v = ref_row[metric] / ref_cells
+            if ref_v <= 0:
+                continue
+            growth = cur_v / ref_v - 1.0
+            row[f"{metric}_growth"] = round(growth, 4)
+            # small sections wiggle; only gate ones that matter (>2%
+            # of the step) so a reshuffled epsilon can't fail the build
+            if growth > threshold and \
+                    ref_row[f"{metric}_frac"] > 0.02:
+                out["regressions"].append(
+                    f"section {sec}: {metric}/cell grew {growth:+.1%}")
+        out["sections"][sec] = row
+    out["status"] = "REGRESSION" if out["regressions"] else "OK"
+    return out
+
+
+def main(argv=None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(
+        description="flag >threshold per-path throughput drops and "
+                    "per-section static-cost growth vs the record")
+    ap.add_argument("current", help="current bench artifact JSON (the "
+                                    "one line bench.py prints)")
+    ap.add_argument("--best", default=os.path.join(root,
+                                                   "BENCH_BEST.json"))
+    ap.add_argument("--history",
+                    default=os.path.join(root, "BENCH_r*.json"),
+                    help="glob of prior-round bench artifacts")
+    ap.add_argument("--ledger", default=None,
+                    help="current cost ledger (fdtd3d_tpu.costs) JSON")
+    ap.add_argument("--ledger-ref", default=None,
+                    help="reference cost ledger to diff against")
+    ap.add_argument("--threshold", type=float, default=0.10)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    with open(args.current) as f:
+        current = json.load(f)
+    if isinstance(current.get("tail"), str):  # driver-wrapped artifact
+        current = json.loads(current["tail"])
+    best = None
+    try:
+        with open(args.best) as f:
+            best = json.load(f)
+    except Exception:
+        pass
+    verdict: Dict[str, Any] = {
+        "throughput": check_artifact(current, best,
+                                     load_history(args.history),
+                                     threshold=args.threshold)}
+    if args.ledger and args.ledger_ref:
+        with open(args.ledger) as f:
+            led_cur = json.load(f)
+        with open(args.ledger_ref) as f:
+            led_ref = json.load(f)
+        verdict["ledger"] = check_ledgers(led_cur, led_ref,
+                                          threshold=args.threshold)
+    regressions = verdict["throughput"]["regressions"] \
+        + verdict.get("ledger", {}).get("regressions", [])
+    verdict["status"] = "REGRESSION" if regressions else \
+        verdict["throughput"]["status"]
+    if args.json:
+        report(json.dumps(verdict, indent=1))
+    else:
+        report(f"perf sentinel: {verdict['status']} "
+               f"(threshold {args.threshold:.0%})")
+        for path, row in verdict["throughput"]["paths"].items():
+            cur = row.get("current")
+            ref = row.get("reference")
+            report(f"  {path:10s} {row['verdict']:13s} "
+                   + (f"{cur:9.1f} vs ref {ref:9.1f} Mcells/s"
+                      if cur is not None and ref is not None else ""))
+        if "ledger" in verdict:
+            report(f"  ledger: {verdict['ledger']['status']}")
+    for msg in regressions:
+        warn(f"perf sentinel: {msg}")
+    for msg in verdict["throughput"]["inconclusive"]:
+        warn(f"perf sentinel (inconclusive): {msg}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
 
 from fdtd3d_tpu import telemetry  # noqa: E402
+from fdtd3d_tpu.log import report  # noqa: E402
 
 
 def split_runs(records):
@@ -166,9 +167,9 @@ def main(argv=None) -> int:
     records = telemetry.read_jsonl(args.path)  # validates every record
     summaries = [summarize_run(r) for r in split_runs(records)]
     if args.json:
-        print(json.dumps(summaries, indent=1))
+        report(json.dumps(summaries, indent=1))
     else:
-        print(format_text(summaries))
+        report(format_text(summaries))
     return 0
 
 

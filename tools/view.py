@@ -19,6 +19,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from fdtd3d_tpu import io  # noqa: E402
+from fdtd3d_tpu.log import report  # noqa: E402
 
 
 def view(path: str, axis: str, index: int | None) -> str:
@@ -50,7 +51,7 @@ def main():
                     help="cut plane index (default: center)")
     args = ap.parse_args()
     for path in args.paths:
-        print(view(path, args.axis, args.index))
+        report(view(path, args.axis, args.index))
 
 
 if __name__ == "__main__":

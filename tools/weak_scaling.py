@@ -20,7 +20,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from fdtd3d_tpu.log import report  # noqa: E402
 
 
 def run_point(n_devices: int, tile: int, steps: int, use_pallas=None):
@@ -82,7 +89,7 @@ def main():
         if base is None:
             base = rec["mcells_per_s_per_device"]
         rec["efficiency_vs_1"] = rec["mcells_per_s_per_device"] / base
-        print(json.dumps(rec), flush=True)
+        report(json.dumps(rec))
 
 
 if __name__ == "__main__":
