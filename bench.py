@@ -44,9 +44,41 @@ BACKOFF_S = 20
 ATTEMPT_TIMEOUT_S = 2400
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _no_temporal(flag: bool):
+    """Pin FDTD3D_NO_TEMPORAL=1 for one stage: the legacy f32/bf16
+    packed stages keep measuring the round-6 single-step kernel (their
+    numbers feed BENCH_BEST / the sentinel's f32_packed reference),
+    while the round-8 temporal-blocked stages measure the new kernel
+    explicitly via require_kind."""
+    if not flag:
+        yield
+        return
+    saved = os.environ.get("FDTD3D_NO_TEMPORAL")
+    os.environ["FDTD3D_NO_TEMPORAL"] = "1"
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop("FDTD3D_NO_TEMPORAL", None)
+        else:
+            os.environ["FDTD3D_NO_TEMPORAL"] = saved
+
+
 def measure(n: int, steps: int, use_pallas, repeats: int = 3,
             dtype: str = "float32", require_kind: str = "",
-            stats: dict = None) -> float:
+            stats: dict = None, no_temporal: bool = False) -> float:
+    with _no_temporal(no_temporal):
+        return _measure(n, steps, use_pallas, repeats, dtype,
+                        require_kind, stats)
+
+
+def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
+             dtype: str = "float32", require_kind: str = "",
+             stats: dict = None) -> float:
     """Mcells/s for one path. Import jax lazily: the parent never does.
 
     ``stats``: optional dict filled with the StepClock summary of the
@@ -84,8 +116,9 @@ def measure(n: int, steps: int, use_pallas, repeats: int = 3,
     # degrades to a warned skip when the backend has no profiler — no
     # crash, no partial artifact.
     prof_root = os.environ.get("FDTD3D_BENCH_PROFILE") or None
-    prof_tag = f"{'jnp' if use_pallas is False else 'pallas'}_" \
-               f"{dtype}_{n}"
+    path_tag = "jnp" if use_pallas is False else (
+        "pallas_tb" if require_kind == "pallas_packed_tb" else "pallas")
+    prof_tag = f"{path_tag}_{dtype}_{n}"
     cfg = SimConfig(
         scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
         courant_factor=0.5, wavelength=32e-3,
@@ -265,37 +298,65 @@ def accuracy_spotcheck(n: int = 32, steps: int = 60) -> dict:
             row = {"error": str(exc)[:200], "ok": False}
         ok = ok and row["ok"]
         out[dtype] = row
+    # Round 8: when a default row ran the temporal-blocked kernel
+    # (pallas_packed_tb is the sourceless hot path on TPU now), re-run
+    # that dtype with the production escape hatch pinned so the
+    # single-step kernel's numerics stay guarded too — the odd-step
+    # tail and every fallback config still run it. The inverse is not
+    # forced: a window where dispatch fell back (CPU jnp, thin-tile
+    # VMEM) records the fallback kind, and interpret-mode tb parity is
+    # tier-1's job (tests/test_pallas_packed_tb.py).
+    for dtype, bound in bounds.items():
+        if out.get(dtype, {}).get("step_kind") != "pallas_packed_tb":
+            continue
+        try:
+            with _no_temporal(True):
+                got, kind = run(dtype)
+            rel = float(np.abs(got - ref).max()) / (scale + 1e-300)
+            row = {"rel_err": float(f"{rel:.3e}"), "bound": bound,
+                   "step_kind": kind, "ok": bool(rel < bound)}
+        except Exception as exc:
+            row = {"error": str(exc)[:200], "ok": False}
+        ok = ok and row["ok"]
+        out[f"{dtype}_single_step"] = row
     out["ok"] = ok
     return out
 
 
 # f32 north-star provenance (round 6): the goal is 1e4 Mcells/s on the
 # accuracy-bearing f32 packed path. A miss must carry its reason in the
-# artifact: either the same-window HBM roof (probe GB/s / 48 B per
-# cell) is itself below the goal AND the kernel runs at >= 85% of that
-# probe (the window, not the kernel, is the limit), or the record says
-# MISSED outright — never a silent gap next to a bf16 headline.
+# artifact: either the same-window HBM roof (probe GB/s / the kernel's
+# B-per-cell floor) is itself below the goal AND the kernel runs at
+# >= 85% of that probe (the window, not the kernel, is the limit), or
+# the record says MISSED outright — never a silent gap next to a bf16
+# headline. Round 8: the temporal-blocked kernel's record recomputes
+# the same provenance against ITS 24 B/cell roof (two steps per pass).
 F32_GOAL_MCELLS = 1e4
 F32_BYTES_PER_CELL = 48.0
+TB_BYTES_PER_CELL = 24.0
 
 
-def f32_goal_record(pallas_mc: float, gbps: float) -> dict:
+def f32_goal_record(pallas_mc: float, gbps: float,
+                    bytes_per_cell: float = F32_BYTES_PER_CELL) -> dict:
+    bpc = bytes_per_cell
+    tag = f"{bpc:.0f}B"
     rec = {"goal_mcells": F32_GOAL_MCELLS,
-           "f32_mcells": round(pallas_mc, 1)}
+           "f32_mcells": round(pallas_mc, 1),
+           "bytes_per_cell": bpc}
     if pallas_mc >= F32_GOAL_MCELLS:
         rec["status"] = "MET"
         return rec
-    kernel_gbps = pallas_mc * 1e6 * F32_BYTES_PER_CELL / 1e9
-    rec["kernel_gbps_at_48B"] = round(kernel_gbps, 1)
+    kernel_gbps = pallas_mc * 1e6 * bpc / 1e9
+    rec[f"kernel_gbps_at_{tag}"] = round(kernel_gbps, 1)
     if gbps and gbps > 0:
-        roof_mcells = gbps * 1e9 / F32_BYTES_PER_CELL / 1e6
+        roof_mcells = gbps * 1e9 / bpc / 1e6
         frac = kernel_gbps / gbps
         rec["hbm_probe_gbps"] = gbps
-        rec["hbm_roof_mcells_at_48B"] = round(roof_mcells, 1)
+        rec[f"hbm_roof_mcells_at_{tag}"] = round(roof_mcells, 1)
         rec["kernel_frac_of_probe"] = round(frac, 3)
         if roof_mcells < F32_GOAL_MCELLS and frac >= 0.85:
             rec["status"] = "HBM-ROOF-PROOF"
-            rec["note"] = ("this window's HBM roof x 48 B/cell is "
+            rec["note"] = (f"this window's HBM roof x {tag}/cell is "
                            "below the goal and the kernel runs at "
                            ">=85% of the same-window probe: the "
                            "window, not the kernel, is the limit")
@@ -331,26 +392,41 @@ def _load_best():
         return None
 
 
-def _maybe_update_best(pallas_mc, jnp_mc, bf16_mc, n, gbps, device_kind):
+def _maybe_update_best(pallas_mc, jnp_mc, bf16_mc, n, gbps, device_kind,
+                       bf16_n=0, tb_mc=0.0, tb_bf16_mc=0.0, tb_n=0,
+                       tb_bf16_n=0):
     """Keep BENCH_BEST.json = the best session on record (+calibration)."""
     best = _load_best()
-    cur = max(pallas_mc, jnp_mc, bf16_mc)
+    cur = max(pallas_mc, jnp_mc, bf16_mc, tb_mc, tb_bf16_mc)
     try:
         best_val = float(best.get("best_known_mcells", 0)) if best else 0.0
     except (TypeError, ValueError):
         best_val = 0.0  # malformed record: overwrite with a fresh one
     if best is not None and cur <= best_val:
         return best
-    path = "pallas-bf16" if cur == bf16_mc else (
-        "pallas" if pallas_mc >= jnp_mc else "jnp")
+    # the recorded "n" must be the grid the WINNING path actually ran
+    # at (the paths keep separate grid ladders)
+    if cur == tb_bf16_mc and tb_bf16_mc > 0:
+        path, rec_n = "pallas-tb-bf16", (tb_bf16_n or n)
+    elif cur == tb_mc and tb_mc > 0:
+        path, rec_n = "pallas-tb", (tb_n or n)
+    elif cur == bf16_mc:
+        path, rec_n = "pallas-bf16", (bf16_n or n)
+    else:
+        path = "pallas" if pallas_mc >= jnp_mc else "jnp"
+        rec_n = n
     new = {
         "comment": (best or {}).get("comment", ""),
         "best_known_mcells": round(cur, 1),
-        "n": n,
+        "n": rec_n,
         "path": path,
         "jnp_mcells": round(jnp_mc, 1),
         "f32_pallas_mcells": round(pallas_mc, 1),
         "bf16_mcells": round(bf16_mc, 1),
+        "tb_mcells": round(tb_mc, 1),
+        "tb_bf16_mcells": round(tb_bf16_mc, 1),
+        "tb_n": tb_n,
+        "tb_bf16_n": tb_bf16_n,
         "hbm_probe_gbps": gbps,
         "session": time.strftime("%Y-%m-%d %H:%M:%S"),
         "device_kind": device_kind,
@@ -403,7 +479,11 @@ def run_measurement() -> None:
     t_stage1 = time.time()
     jnp_stats, f32_stats, bf16_stats, ds_stats = {}, {}, {}, {}
     jnp_mc = measure(n, steps, use_pallas=False, stats=jnp_stats)
-    pallas_mc = measure(n, steps, use_pallas=True,
+    # no_temporal=True on every legacy packed stage: these numbers feed
+    # BENCH_BEST and the sentinel's f32_packed/bf16 references, so they
+    # must keep measuring the round-6 single-step kernel; the round-8
+    # temporal-blocked kernel gets its own stage (3c) below.
+    pallas_mc = measure(n, steps, use_pallas=True, no_temporal=True,
                         stats=f32_stats) if on_tpu else 0.0
     stage1_s = time.time() - t_stage1
     # Stage 2: the 256^3 pallas timing itself is the 512^3 go/no-go —
@@ -421,7 +501,7 @@ def run_measurement() -> None:
                               stats=jnp_stats)
             try:
                 pallas_512 = measure(512, 90, use_pallas=True,
-                                     stats=f32_stats)
+                                     no_temporal=True, stats=f32_stats)
             except Exception:
                 # retry ladder: two-pass at the raised budget (unless
                 # the caller pinned one), then two-pass at the default
@@ -435,10 +515,12 @@ def run_measurement() -> None:
                         os.environ["FDTD3D_VMEM_BUDGET_MB"] = "86"
                     try:
                         pallas_512 = measure(512, 90, use_pallas=True,
+                                             no_temporal=True,
                                              stats=f32_stats)
                     except Exception:
                         os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
                         pallas_512 = measure(512, 90, use_pallas=True,
+                                             no_temporal=True,
                                              stats=f32_stats)
                 finally:
                     for k, v in saved.items():
@@ -462,7 +544,7 @@ def run_measurement() -> None:
         if n >= 512:
             try:
                 f32_640 = measure(640, 120, use_pallas=True,
-                                  stats=f32_stats)
+                                  no_temporal=True, stats=f32_stats)
                 if f32_640 > pallas_mc:
                     pallas_mc, n = f32_640, 640
             except Exception as e:
@@ -476,13 +558,43 @@ def run_measurement() -> None:
                 # at 60; session-3 close-out, 2026-07-31
                 bf16_mc = measure(bn, 90 if bn == 512 else 120,
                                   use_pallas=True, dtype="bfloat16",
-                                  stats=bf16_stats)
+                                  no_temporal=True, stats=bf16_stats)
                 bf16_n = bn
                 break
             except Exception as e:
                 print(f"stage3 bf16 {bn} failed: {e!r:.300}",
                       file=sys.stderr, flush=True)
                 continue
+    # Stage 3c (round 8): the TEMPORAL-BLOCKED packed kernel — two Yee
+    # steps per HBM pass, ~24 B/cell f32 / ~12 bf16 — per dtype at the
+    # grid the legacy stage settled on. require_kind: a silent fallback
+    # to the single-step kernel (tile too thin for the ~2x ring
+    # scratch) must fail the stage, not report the old kernel's number
+    # under the new name. Even step counts on purpose (no tail step in
+    # the timed chunks).
+    tb_mc, tb_n = 0.0, 0
+    tb_bf16_mc, tb_bf16_n = 0.0, 0
+    tb_stats, tb_bf16_stats = {}, {}
+    if on_tpu and pallas_mc >= GATE_MCELLS_512:
+        try:
+            tb_mc = measure(n, 90 if n >= 512 else 120, use_pallas=True,
+                            require_kind="pallas_packed_tb",
+                            stats=tb_stats)
+            tb_n = n
+        except Exception as e:
+            print(f"stage3c tb f32 {n} failed: {e!r:.300}",
+                  file=sys.stderr, flush=True)
+        if bf16_n:
+            try:
+                tb_bf16_mc = measure(
+                    bf16_n, 90 if bf16_n == 512 else 120,
+                    use_pallas=True, dtype="bfloat16",
+                    require_kind="pallas_packed_tb",
+                    stats=tb_bf16_stats)
+                tb_bf16_n = bf16_n
+            except Exception as e:
+                print(f"stage3c tb bf16 {bf16_n} failed: {e!r:.300}",
+                      file=sys.stderr, flush=True)
     # Stage 4: float32x2 on the packed-ds kernel (round 5) — the
     # accuracy mode's throughput (96 B/cell pair traffic + ~10x EFT
     # flops; ops/pallas_packed_ds.py). Smaller grids than f32: the
@@ -515,12 +627,19 @@ def run_measurement() -> None:
         spot = accuracy_spotcheck()
     except Exception as exc:
         spot = {"error": str(exc)[:300], "ok": False}
-    mcells = max(jnp_mc, pallas_mc, bf16_mc)
-    best = _maybe_update_best(pallas_mc, jnp_mc, bf16_mc,
-                              bf16_n if (bf16_mc >= pallas_mc and bf16_n)
-                              else n, gbps,
-                              device_kind) if on_tpu else None
-    best_n = bf16_n if (bf16_mc == mcells and bf16_n) else n
+    mcells = max(jnp_mc, pallas_mc, bf16_mc, tb_mc, tb_bf16_mc)
+    best = _maybe_update_best(pallas_mc, jnp_mc, bf16_mc, n, gbps,
+                              device_kind, bf16_n=bf16_n,
+                              tb_mc=tb_mc, tb_bf16_mc=tb_bf16_mc,
+                              tb_n=tb_n,
+                              tb_bf16_n=tb_bf16_n) if on_tpu else None
+    best_n = n
+    if bf16_mc == mcells and bf16_n:
+        best_n = bf16_n
+    elif tb_bf16_mc == mcells and tb_bf16_n:
+        best_n = tb_bf16_n
+    elif tb_mc == mcells and tb_n:
+        best_n = tb_n
     out = {
         "metric": f"Mcells/s/chip (3D Yee + CPML, {best_n}^3, "
                   f"{device_kind})",
@@ -532,6 +651,13 @@ def run_measurement() -> None:
         "jnp_mcells": round(jnp_mc, 1),
         "bf16_mcells": round(bf16_mc, 1),
         "bf16_n": bf16_n,
+        # round-8 temporal-blocked kernel (two steps per HBM pass):
+        # its own keys so the sentinel tracks it as a first-class path
+        # without polluting the single-step kernel's history
+        "tb_mcells": round(tb_mc, 1),
+        "tb_n": tb_n,
+        "tb_bf16_mcells": round(tb_bf16_mc, 1),
+        "tb_bf16_n": tb_bf16_n,
         "float32x2_mcells": round(ds_mc, 1),
         "float32x2_n": ds_n,
         "hbm_probe_gbps": gbps,
@@ -542,7 +668,9 @@ def run_measurement() -> None:
         # shows as a p50/max gap).
         "chunk_stats": {k: v for k, v in
                         (("jnp", jnp_stats), ("f32", f32_stats),
-                         ("bf16", bf16_stats), ("float32x2", ds_stats))
+                         ("bf16", bf16_stats), ("f32_tb", tb_stats),
+                         ("bf16_tb", tb_bf16_stats),
+                         ("float32x2", ds_stats))
                         if v},
         # Per-dtype accuracy class: the RECORDED frontier measurements
         # (BASELINE.md) — the long-horizon classes are not re-measured
@@ -566,6 +694,16 @@ def run_measurement() -> None:
         # never a silent miss (only meaningful measured on TPU)
         "f32_goal": f32_goal_record(pallas_mc, gbps) if on_tpu else
                     {"status": "NOT-MEASURED", "note": "no TPU backend"},
+        # round-8 temporal-blocked provenance: the SAME goal recomputed
+        # against the blocked kernel's 24 B/cell roof (two steps per
+        # HBM pass) — MET / HBM-ROOF-PROOF / MISSED, never silent
+        "tb_goal": (f32_goal_record(
+                        tb_mc, gbps, bytes_per_cell=TB_BYTES_PER_CELL)
+                    if on_tpu and tb_n else
+                    {"status": "NOT-MEASURED",
+                     "note": "no TPU backend" if not on_tpu else
+                             "stage 3c did not produce a tb number "
+                             "this window"}),
     }
     ref_dtype = spot.get("reference_dtype")
     if ref_dtype and ref_dtype != "float64":

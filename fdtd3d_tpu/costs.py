@@ -49,9 +49,11 @@ from typing import Any, Dict, Optional, Tuple
 LEDGER_VERSION = 1
 LEDGER_SCHEMA = "fdtd3d-cost-ledger"
 
-# The four production step kinds the ledger covers (ISSUE 3 acceptance;
-# the jnp_ds / fused / complex2x variants trace too, via kind=None).
-STEP_KINDS = ("jnp", "pallas", "pallas_packed", "pallas_packed_ds")
+# The production step kinds the ledger covers (ISSUE 3 acceptance, +
+# the round-8 temporal-blocked kernel; the jnp_ds / fused / complex2x
+# variants trace too, via kind=None).
+STEP_KINDS = ("jnp", "pallas", "pallas_packed", "pallas_packed_tb",
+              "pallas_packed_ds")
 
 # flop weight per output element, by primitive name
 _TRANSCENDENTAL = frozenset((
@@ -248,14 +250,19 @@ def _walk(acc: _Acc, jaxpr, prefix: str, mult: float, in_step: bool,
 _KIND_ENV = {
     "jnp": {},
     "pallas": {"FDTD3D_NO_PACKED": "1", "FDTD3D_NO_FUSED": "1"},
-    "pallas_packed": {},
+    # the temporal-blocked kernel outranks pallas_packed in the round-8
+    # dispatch, so ledgering the single-step kernel needs the same
+    # escape hatch production uses
+    "pallas_packed": {"FDTD3D_NO_TEMPORAL": "1"},
+    "pallas_packed_tb": {},
     "pallas_packed_ds": {},
 }
 
 
 @contextlib.contextmanager
 def _forced_env(kind: Optional[str]):
-    keys = ("FDTD3D_NO_PACKED", "FDTD3D_NO_FUSED", "FDTD3D_FORCE_FUSED")
+    keys = ("FDTD3D_NO_PACKED", "FDTD3D_NO_FUSED", "FDTD3D_FORCE_FUSED",
+            "FDTD3D_NO_TEMPORAL")
     saved = {k: os.environ.get(k) for k in keys}
     try:
         if kind is not None:
@@ -327,14 +334,32 @@ def chunk_ledger(cfg, n_steps: int = 8,
     if getattr(runner, "packed", False):
         state_sh = jax.eval_shape(runner.pack, state_sh)
 
+    # Multi-step kernels (pallas_packed_tb advances steps_per_call=2
+    # steps per scan iteration): the step scan's length is
+    # n_steps // spc and its body carries spc steps of cost — matched
+    # at the shorter length, then normalized to PER-STEP below so tb
+    # ledgers compare against single-step ones (the "roofline moved"
+    # gate in tests/test_costs.py divides the two).
+    spc = int(getattr(runner, "steps_per_call", 1))
+    if n_steps % spc:
+        raise ValueError(
+            f"n_steps={n_steps} is not a multiple of the runner's "
+            f"steps_per_call={spc}: the tail steps would blur the "
+            f"per-step/per-chunk split — trace an even horizon")
+
     closed = jax.make_jaxpr(lambda s, c: runner(s, c, n=n_steps))(
         state_sh, coeffs_sh)
-    acc = _Acc(n_steps)
+    acc = _Acc(n_steps // spc)
     _walk(acc, closed.jaxpr, "", 1.0, False, True)
     if not acc.step_scan_seen:
-        raise RuntimeError("step scan (length == n_steps) not found in "
-                           "the chunk jaxpr; cannot split per-step "
-                           "from per-chunk cost")
+        raise RuntimeError("step scan (length == n_steps / "
+                           "steps_per_call) not found in the chunk "
+                           "jaxpr; cannot split per-step from "
+                           "per-chunk cost")
+    if spc > 1:
+        for cell in acc.step.values():
+            cell[0] /= spc
+            cell[1] /= spc
 
     def _table(src: Dict[str, list]) -> Dict[str, Dict[str, float]]:
         tf = sum(f for f, _ in src.values()) or 1.0
@@ -359,6 +384,7 @@ def chunk_ledger(cfg, n_steps: int = 8,
         "dtype": cfg.dtype,
         "cells": int(cells),
         "n_steps": int(n_steps),
+        "steps_per_call": spc,
         "sections": _table(acc.step),
         "per_chunk_sections": _table(acc.chunk),
         "per_step": {

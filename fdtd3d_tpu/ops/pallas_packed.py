@@ -319,13 +319,18 @@ _RUNTIME_BUDGET: "int | None" = None
 
 
 def _pick_tile_packed(n1: int, plane_cells: int, block_bytes_at,
-                      scratch_bytes_at) -> int:
+                      scratch_bytes_at,
+                      temps_f32_per_cell: "int | None" = None) -> int:
     """Largest divisor T (with >= 2 tiles) fitting physical VMEM.
 
     Footprint model: 2*blocks (Mosaic double-buffers every operand
-    window) + scratch carry + measured per-tile temporaries.
+    window) + scratch carry + measured per-tile temporaries
+    (``temps_f32_per_cell`` lets the temporal-blocked kernel supply its
+    own, larger, calibration constant — ops/pallas_packed_tb.py).
     """
     import os
+    if temps_f32_per_cell is None:
+        temps_f32_per_cell = _TEMPS_F32_PER_CELL
     env_budget = _vmem_budget() if os.environ.get(
         "FDTD3D_VMEM_BUDGET_MB") else None
     if _RUNTIME_BUDGET is not None:
@@ -342,7 +347,7 @@ def _pick_tile_packed(n1: int, plane_cells: int, block_bytes_at,
             if need <= env_budget:
                 return t
             continue
-        need += _TEMPS_F32_PER_CELL * 4 * t * plane_cells
+        need += temps_f32_per_cell * 4 * t * plane_cells
         if need <= _VMEM_TOTAL - _VMEM_MARGIN:
             return t
     # not even T=1 fits the footprint model: dispatch falls back to the
@@ -351,8 +356,15 @@ def _pick_tile_packed(n1: int, plane_cells: int, block_bytes_at,
     return 0
 
 
-def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
-    """One-pallas-call pipelined leapfrog step, or None if out of scope."""
+def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None,
+                        force_tile=None):
+    """One-pallas-call pipelined leapfrog step, or None if out of scope.
+
+    ``force_tile`` pins the x-tile size instead of running the VMEM
+    picker: the temporal-blocked kernel (ops/pallas_packed_tb.py) uses
+    it to build its odd-step-count tail at ITS tile so both steps share
+    one packed-carry layout (the x-psi stacks are tile-aligned).
+    """
     from fdtd3d_tpu import solver as solver_mod
 
     if not eligible(static, mesh_axes):
@@ -478,7 +490,12 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None):
     def _scratch_bytes(t: int) -> int:
         return (ne + nh) * t * n2 * n3 * 4 + nh * n2 * n3 * 4
 
-    T = _pick_tile_packed(n1, n2 * n3, _block_bytes, _scratch_bytes)
+    if force_tile is not None:
+        if n1 % force_tile != 0 or n1 // force_tile < 2:
+            return None
+        T = force_tile
+    else:
+        T = _pick_tile_packed(n1, n2 * n3, _block_bytes, _scratch_bytes)
     if T == 0:
         return None
     ntiles = n1 // T

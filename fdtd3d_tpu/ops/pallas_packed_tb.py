@@ -1,0 +1,774 @@
+"""Temporal-blocked packed kernel: TWO Yee steps per HBM pass.
+
+Round 8 (docs/PERFORMANCE.md round-8 section). The round-5 overhead
+decomposition showed the packed step's marginal cell already runs at
+~72% of the same-window HBM probe, i.e. the round-6 kernel sits near
+the 48 B/cell Yee floor — the one remaining fusion lever below it is
+reusing state ACROSS TIME STEPS within one grid pass. This kernel
+deepens ops/pallas_packed.py's software pipeline from two phases to
+four: at grid iteration i it computes
+
+    phase A:  E(t+1) on tile i        (from HBM E(t), H(t))
+    phase B:  H(t+1) on tile i-1      (from VMEM ring scratch)
+    phase C:  E(t+2) on tile i-2      (from VMEM ring scratch)
+    phase D:  H(t+2) on tile i-3      (written to HBM)
+
+so the grid runs ntiles + 3 iterations (three drain iterations) and
+HBM field traffic is
+
+    read E(3) + H(3); write E(3) + H(3)  =  12 volumes PER 2 STEPS
+    = ~24 B/cell/step f32, ~12 B/cell/step bf16,
+
+half the single-step packed kernel's 48/24, plus the fixed
+per-dispatch floor amortized over two steps. The intermediate
+generation t+1 never touches HBM: it lives in VMEM ring buffers
+(new-E ring depth 2, new-H ring depth 2, second-step new-E depth 1,
+old-H depth 1 + one halo plane), rotated at the end of each iteration.
+The ring values that a drain-phase consumer would read before their
+producer ran are masked to the PEC zero ghost exactly like the
+single-step kernel's pipeline edges.
+
+**CPML runs twice in-kernel.** The y/z slab psi recursion and the
+round-6 tile-aligned x-psi stacks advance TWO generations per pass:
+phase A/B compute psi(t+1) into small ring scratch (never HBM), phase
+C/D run the second recursion over them and write psi(t+2) at the
+lagged block indices. The x stacks keep the round-6 layout
+(``pallas_packed.x_block_maps`` — interior tiles pin their block and
+read identity profiles, so the recursion is a provable no-op there)
+with lag-2/lag-3 output maps; writes are masked to slab tiles.
+
+**In-kernel point source.** A mid-block source injection cannot be
+post-patched (it must propagate through the second step's curls), so
+the point source rides IN-KERNEL: both E phases add
+``amplitude * waveform(t[+1]) * mask`` to their accumulator before the
+ca/cb application, with the mask built from broadcasted iotas against
+the static source position and the (traced) tile offset — exactly the
+jnp step's term, evaluated at the right tile. Eligibility still
+requires ``_sources_interior`` (the ISSUE-8 gate): inside the CPML
+identity region the in-kernel x-psi recursions provably never see the
+injection, keeping the fused-x argument intact. TFSF is out of scope
+(the incident-line machinery has no in-kernel port yet) and falls back
+to ``pallas_packed``.
+
+Scope (everything else falls back to ops/pallas_packed.py): 3D, real
+f32/bf16 storage, UNSHARDED (two steps per pass need two ghost planes
+per neighbor — a halo-depth change left for a later round),
+slab-fitting CPML on any axes, scalar material coefficients only (a
+material grid would need each coefficient streamed at two tile lags;
+fall back), no Drude/metamaterial ADE, no compensated mode, no
+double-single. ``FDTD3D_NO_TEMPORAL=1`` is the escape hatch that
+forces the round-6 kernel bit-for-bit (solver.make_step).
+
+The step object advances TWO steps per call: ``step.steps_per_call ==
+2`` and ``step.tail_step`` is a single-step ``pallas_packed`` step
+built at THE SAME tile (``force_tile=T``) so odd step counts run
+``n//2`` blocked passes plus one trailing single step on the identical
+packed-carry layout (solver.make_chunk_runner).
+
+VMEM: the ring scratch is ~3x the single-step kernel's (field rings:
+2 E(t+1) + 1 E(t+2) + 2 H(t+1) + 1 H(t) tiles vs 2 tiles + 1 plane),
+modeled exactly by ``_scratch_bytes`` below; the tile picker
+(`pallas_packed._pick_tile_packed`, shared so the VMEM-ladder runtime
+budget applies here too) therefore lands on a smaller tile than the
+single-step kernel at the same grid. Dispatch falls back to
+``pallas_packed`` when the budgeted tile is too thin (T == 0, or T == 1
+while the single-step kernel affords >= 4 — mirroring the measured
+fused-vs-two-pass tile heuristic). The Mosaic-temporaries constant
+(~40 f32/cell-plane) is an UNCALIBRATED scale-up of the single-step
+kernel's measured 25; the first chip window should re-calibrate it.
+
+Donation-safety: every aliased array's block j is read at iteration j
+(E/H/psi_E at the tile map; psi_H/x-psi-H at lag 1, i.e. j+1) and
+written only at iteration j+2 (E family) or j+3 (H family) — reads
+always precede writes, and each array enters the call exactly once.
+Out-blocks at pipeline edges are revisited with writes MASKED
+(``pl.when``): under persist-until-change semantics the window flushes
+the last valid write; under flush-every-iteration the masked visits
+flush stale window bytes over HBM blocks that are never re-read (the
+in-maps are monotone and fetch each block before its first out visit)
+and the final valid write lands last. Structural test:
+tests/test_pallas_packed_tb.py::test_tb_donation_fetch_before_write.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fdtd3d_tpu.layout import CURL_TERMS, component_axis
+from fdtd3d_tpu.ops import pallas_packed as _pk
+from fdtd3d_tpu.ops.pallas3d import COMPILER_PARAMS
+from fdtd3d_tpu.telemetry import named as _named
+
+AXES = "xyz"
+
+# Mosaic per-tile temporaries model (f32 per cell x tile plane): the
+# four-phase body holds roughly 1.6x the single-step kernel's live
+# values; 40 is a conservative scale-up of its MEASURED 25 — not yet
+# calibrated on hardware (re-run the 128^3/512^3 pass/fail probe of
+# ops/pallas_packed.py's comment on the first chip window).
+_TEMPS_F32_PER_CELL_TB = 40
+
+
+def eligible(static, mesh_axes=None) -> bool:
+    """Temporal-blocked scope: a strict subset of the packed kernel's
+    (module docstring). The dispatch falls back to ``pallas_packed``
+    outside it, so this must never admit a config the kernel cannot
+    advance two exact steps for in one pass."""
+    if not _pk.eligible(static, mesh_axes):
+        return False
+    if static.topology != (1, 1, 1):
+        return False          # two-step halos need depth-2 ghosts
+    if static.use_drude or static.use_drude_m:
+        return False          # ADE currents: not temporally blocked
+    if static.cfg.compensated:
+        return False          # Kahan residuals would double traffic
+    if static.tfsf_setup is not None:
+        return False          # no in-kernel incident-line port yet
+    if static.cfg.point_source.enabled \
+            and not _pk._sources_interior(static):
+        return False          # in-absorber injection: legacy path only
+    return True
+
+
+def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
+    """Two-steps-per-pass pipelined step, or None if out of scope."""
+    from fdtd3d_tpu import solver as solver_mod
+
+    if not eligible(static, mesh_axes):
+        return None
+    slabs = solver_mod.slab_axes(static)
+    for a in static.pml_axes:
+        if a not in slabs:
+            return None       # thin-grid full-length psi: not covered
+    np_coeffs = solver_mod.build_coeffs(static)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    x_pml = 0 in static.pml_axes
+
+    mode = static.mode
+    n1, n2, n3 = static.grid_shape
+    inv_dx = np.float32(1.0 / static.dx)
+    fdt = jnp.float32
+    fst = static.field_dtype
+    fbytes = np.dtype(fst).itemsize
+    e_comps = list(mode.e_components)
+    h_comps = list(mode.h_components)
+    ne, nh = len(e_comps), len(h_comps)
+
+    rows_e = _pk.psi_rows(static, slabs, "E")
+    rows_h = _pk.psi_rows(static, slabs, "H")
+    psi_axes_e = sorted(rows_e)
+    psi_axes_h = sorted(rows_h)
+
+    # scalar coefficients only (eligibility falls back on grids)
+    for c in e_comps:
+        for p in ("ca", "cb"):
+            if np.ndim(np_coeffs[f"{p}_{c}"]) == 3:
+                return None
+    for c in h_comps:
+        for p in ("da", "db"):
+            if np.ndim(np_coeffs[f"{p}_{c}"]) == 3:
+                return None
+
+    # fused x-slab CPML is MANDATORY here whenever x has a PML: a
+    # two-step pass admits no post-kernel psi recursion. Eligibility
+    # already guarantees the fuse condition (sourceless or interior
+    # sources), mirroring pallas_packed's fuse_x gate.
+    ps = static.cfg.point_source
+    src_on = bool(ps.enabled)
+    fuse_x = x_pml
+    m0 = slabs.get(0, 0)
+    rows_x_e = [c for c in e_comps
+                if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
+                ] if fuse_x else []
+    rows_x_h = [c for c in h_comps
+                if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
+                ] if fuse_x else []
+    kxe, kxh = len(rows_x_e), len(rows_x_h)
+
+    def _stack_shape(a: int, k: int):
+        s = [k, n1, n2, n3]
+        s[1 + a] = 2 * slabs[a]
+        return tuple(s)
+
+    def _psi_block_cells(a: int, t: int) -> int:
+        s = _stack_shape(a, 1)
+        return t * s[2] * s[3]
+
+    def _block_bytes(t: int) -> int:
+        plane = n2 * n3
+        total = 0
+        total += 2 * ne * t * plane * fbytes       # E in + out
+        total += 2 * nh * t * plane * fbytes       # H in + out
+        for (axes, rows) in ((psi_axes_e, rows_e), (psi_axes_h, rows_h)):
+            for a in axes:                         # psi stacks in + out
+                total += 2 * len(rows[a]) * _psi_block_cells(a, t) * 4
+        if fuse_x:
+            total += 2 * (kxe + kxh) * t * plane * 4   # x-psi in + out
+            total += 4 * 3 * t * 4                 # prof_ex(2)/prof_hx(2)
+        for a in psi_axes_e + psi_axes_h:
+            total += 3 * 2 * slabs[a] * 4          # y/z profile packs
+        total += (2 * t + n2 + n3) * 4             # walls (x twice)
+        if src_on:
+            total += 2 * 4                         # waveform pair
+        return total
+
+    def _scratch_bytes(t: int) -> int:
+        plane = n2 * n3
+        total = 0
+        total += 3 * ne * t * plane * 4            # E1 ring x2 + E2
+        total += 3 * nh * t * plane * 4            # H1 ring x2 + H0
+        total += nh * plane * 4                    # H0 halo plane
+        for (axes, rows) in ((psi_axes_e, rows_e), (psi_axes_h, rows_h)):
+            for a in axes:                         # psi(t+1) rings x2
+                total += 2 * len(rows[a]) * _psi_block_cells(a, t) * 4
+        if fuse_x:
+            total += 2 * (kxe + kxh) * t * plane * 4   # x-psi rings
+        return total
+
+    T = _pk._pick_tile_packed(
+        n1, n2 * n3, _block_bytes, _scratch_bytes,
+        temps_f32_per_cell=_TEMPS_F32_PER_CELL_TB)
+    if T == 0:
+        return None
+
+    # odd-step tail at the SAME tile => identical packed-carry layout
+    # (the x-psi stacks are tile-aligned); it also supplies pack/unpack
+    # and the chunk-entry prepare() for both kernels.
+    tail = _pk.make_packed_eh_step(static, mesh_axes, mesh_shape,
+                                   force_tile=T)
+    if tail is None:
+        return None
+    tail.kind = "pallas_packed"
+    if T == 1:
+        # too thin: the deep pipeline at T=1 multiplies per-iteration
+        # setup cost and ring-rotation VPU work; if the single-step
+        # kernel affords a healthy tile, take its 48 B/cell instead
+        # (mirrors the measured fused-vs-two-pass tile>=4 heuristic).
+        free = _pk.make_packed_eh_step(static, mesh_axes, mesh_shape)
+        if free is not None and free.diag["tile"]["EH"] >= 4:
+            return None
+
+    ntiles = n1 // T
+    if fuse_x:
+        (Sx, Lx, x_two_region, xblk, xpsi_tile_imap,
+         _) = _pk.x_block_maps(m0, n1, T)
+    else:
+        Sx, Lx, x_two_region, xblk = 0, 0, False, None
+
+    src_pos = tuple(int(v) for v in ps.position) if src_on else None
+
+    # ---- the kernel -----------------------------------------------------
+    def kernel(*refs):
+        idx = {}
+        pos = 0
+
+        def take(names):
+            nonlocal pos
+            for nm in names:
+                idx[nm] = refs[pos]
+                pos += 1
+
+        take(["e_in", "h_in"])
+        take([f"psE{a}" for a in psi_axes_e])
+        take([f"psH{a}" for a in psi_axes_h])
+        if fuse_x:
+            take(["psxE", "psxH"])
+        take([f"prof_e_{a}" for a in psi_axes_e])
+        take([f"prof_h_{a}" for a in psi_axes_h])
+        if fuse_x:
+            take(["prof_ex", "prof_ex2", "prof_hx", "prof_hx2"])
+        if src_on:
+            take(["src"])
+        take(["wall_x", "wall_x2", "wall_y", "wall_z"])
+        take(["e_out", "h_out"])
+        take([f"psE{a}_out" for a in psi_axes_e])
+        take([f"psH{a}_out" for a in psi_axes_h])
+        if fuse_x:
+            take(["psxE_out", "psxH_out"])
+        take(["se1a", "se1b", "se2", "sh0", "sh1a", "sh1b", "sh0h"])
+        take([f"spe1a_{a}" for a in psi_axes_e])
+        take([f"spe1b_{a}" for a in psi_axes_e])
+        take([f"sph1a_{a}" for a in psi_axes_h])
+        take([f"sph1b_{a}" for a in psi_axes_h])
+        if fuse_x:
+            take(["sxe1a", "sxe1b", "sxh1a", "sxh1b"])
+
+        i = pl.program_id(0)
+        # Phases A (E(t+1), tile i) and B (H(t+1), tile i-1) write only
+        # VMEM rings, so they need no write mask: out-of-range ring
+        # values are masked at their CONSUMERS (the jnp.where ghosts
+        # below). Phases C/D write HBM blocks and mask with pl.when.
+        valid_a = i < ntiles                       # E(t+1) tile i
+        valid_c = (i >= 2) & (i <= ntiles + 1)     # E(t+2) tile i-2
+        valid_d = i >= 3                           # H(t+2) tile i-3
+        tl2 = jnp.minimum(jnp.maximum(i - 2, 0), ntiles - 1)
+        tl3 = jnp.maximum(i - 3, 0)
+        if fuse_x:
+            if x_two_region:
+                def in_slab(tj):
+                    return (tj < Lx) | (tj >= ntiles - Lx)
+            else:
+                def in_slab(tj):
+                    return tj >= 0                 # every tile
+            in_xslab_c = in_slab(tl2)
+            in_xslab_d = in_slab(tl3)
+
+        def yz_diff(f, axis, backward):
+            zero = jnp.zeros_like(lax.slice_in_dim(f, 0, 1, axis=axis))
+            if backward:
+                body = lax.slice_in_dim(f, 0, f.shape[axis] - 1,
+                                        axis=axis)
+                return (f - jnp.concatenate([zero, body],
+                                            axis=axis)) * inv_dx
+            body = lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
+            return (jnp.concatenate([body, zero], axis=axis) - f) \
+                * inv_dx
+
+        def slab_term(dfa, psi, tag, a, s):
+            """CPML slab recursion (ops/pallas_packed.py's form, value-
+            returning): -> (new compact psi, full accumulator term)."""
+            m = slabs[a]
+            pr = idx[f"prof_{tag}_{a}"]
+            b, cc, ik = pr[0], pr[1], pr[2]
+            cut = lambda f, lo, hi: lax.slice_in_dim(f, lo, hi, axis=a)  # noqa: E731
+            nloc = dfa.shape[a]
+            d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
+            p_lo = (cut(b, 0, m) * cut(psi, 0, m)
+                    + cut(cc, 0, m) * d_lo)
+            p_hi = (cut(b, m, 2 * m) * cut(psi, m, 2 * m)
+                    + cut(cc, m, 2 * m) * d_hi)
+            dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
+            dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+            mid = list(dfa.shape)
+            mid[a] = nloc - 2 * m
+            delta = jnp.concatenate(
+                [dl, jnp.zeros(mid, fdt), dh], axis=a)
+            return jnp.concatenate([p_lo, p_hi], axis=a), s * dfa + delta
+
+        def coef(key):
+            return fdt(float(np_coeffs[key]))
+
+        def src_term(c, tile_lo, step_j):
+            """In-kernel point source: amplitude*waveform at the right
+            tile offset (module docstring); zero off-component."""
+            if not src_on or c != ps.component:
+                return None
+            px, py, pz = src_pos
+            gx = lax.broadcasted_iota(jnp.int32, (T, n2, n3), 0) \
+                + tile_lo * T
+            gy = lax.broadcasted_iota(jnp.int32, (T, n2, n3), 1)
+            gz = lax.broadcasted_iota(jnp.int32, (T, n2, n3), 2)
+            mask = ((gx == px) & (gy == py) & (gz == pz)).astype(fdt)
+            return idx["src"][step_j:step_j + 1] * mask
+
+        def wall_mask(e, c, wall_x_vals):
+            ca_ax = component_axis(c)
+            if ca_ax != 0:
+                e = e * wall_x_vals
+            for a2 in (1, 2):
+                if a2 != ca_ax:
+                    e = e * idx[f"wall_{AXES[a2]}"][:].astype(fdt)
+            return e
+
+        def e_update(h_tiles, h_ghosts, e_old, psi_get, psx_get,
+                     prof_x_name, wall_x_name, tile_lo, step_j):
+            """One E-family update over one tile. Returns
+            (new e comps, {a: [new psi rows]}, [new x-psi rows])."""
+            new_psi: Dict[int, list] = {a: [None] * len(rows_e[a])
+                                        for a in psi_axes_e}
+            new_psx = [None] * kxe
+            out = []
+            for jc, c in enumerate(e_comps):
+                acc = None
+                for (a, jd, s) in CURL_TERMS[component_axis(c)]:
+                    if a == 0:
+                        full = jnp.concatenate(
+                            [h_ghosts[jd], h_tiles[jd]], axis=0)
+                        dfa = (full[1:] - full[:-1]) * inv_dx
+                        if fuse_x:
+                            row = rows_x_e.index(c)
+                            pr = idx[prof_x_name]
+                            psi_new = pr[0] * psx_get(row) + pr[1] * dfa
+                            new_psx[row] = psi_new
+                            term = s * (pr[2] * dfa + psi_new)
+                        else:
+                            term = s * dfa
+                    else:
+                        dfa = yz_diff(h_tiles[jd], a, backward=True)
+                        if a in slabs and a in static.pml_axes:
+                            row = rows_e[a].index(c)
+                            psi_new, term = slab_term(
+                                dfa, psi_get(a, row), "e", a, s)
+                            new_psi[a][row] = psi_new
+                        else:
+                            term = s * dfa
+                    acc = term if acc is None else acc + term
+                sv = src_term(c, tile_lo, step_j)
+                if sv is not None:
+                    acc = acc + sv
+                e = coef(f"ca_{c}") * e_old[jc] + coef(f"cb_{c}") * acc
+                out.append(wall_mask(
+                    e, c, idx[wall_x_name][:].astype(fdt)))
+            return out, new_psi, new_psx
+
+        def h_update(e_tiles, e_firsts, h_old, psi_get, psx_get,
+                     prof_x_name):
+            """One H-family update over one tile (dual of e_update)."""
+            new_psi: Dict[int, list] = {a: [None] * len(rows_h[a])
+                                        for a in psi_axes_h}
+            new_psx = [None] * kxh
+            out = []
+            for jc, c in enumerate(h_comps):
+                acc = None
+                for (a, jd, s) in CURL_TERMS[component_axis(c)]:
+                    if a == 0:
+                        ext = jnp.concatenate(
+                            [e_tiles[jd], e_firsts[jd]], axis=0)
+                        dfa = (ext[1:] - ext[:-1]) * inv_dx
+                        if fuse_x:
+                            row = rows_x_h.index(c)
+                            pr = idx[prof_x_name]
+                            psi_new = pr[0] * psx_get(row) + pr[1] * dfa
+                            new_psx[row] = psi_new
+                            term = s * (pr[2] * dfa + psi_new)
+                        else:
+                            term = s * dfa
+                    else:
+                        dfa = yz_diff(e_tiles[jd], a, backward=False)
+                        if a in slabs and a in static.pml_axes:
+                            row = rows_h[a].index(c)
+                            psi_new, term = slab_term(
+                                dfa, psi_get(a, row), "h", a, s)
+                            new_psi[a][row] = psi_new
+                        else:
+                            term = s * dfa
+                    acc = term if acc is None else acc + term
+                out.append(coef(f"da_{c}") * h_old[jc]
+                           - coef(f"db_{c}") * acc)
+            return out, new_psi, new_psx
+
+        # ---- phase A: E(t+1) on tile i -------------------------------
+        h_vals = [idx["h_in"][j].astype(fdt) for j in range(nh)]
+        e_vals = [idx["e_in"][j].astype(fdt) for j in range(ne)]
+        gha = [jnp.where(i > 0, idx["sh0h"][j],
+                         jnp.zeros_like(idx["sh0h"][j]))
+               for j in range(nh)]
+        e1, psiE1, psxE1 = e_update(
+            h_vals, gha, e_vals,
+            lambda a, row: idx[f"psE{a}"][row].astype(fdt),
+            (lambda row: idx["psxE"][row].astype(fdt)) if fuse_x
+            else None,
+            "prof_ex", "wall_x", i, 0)
+
+        # ---- phase B: H(t+1) on tile i-1 (ring scratch) --------------
+        e1_prev = [idx["se1a"][j] for j in range(ne)]   # E1[i-1]
+        h0_prev = [idx["sh0"][j] for j in range(nh)]    # H(t)[i-1]
+        firsts1 = [jnp.where(valid_a, e1[j][0:1],
+                             jnp.zeros_like(e1[j][0:1]))
+                   for j in range(ne)]
+        h1, psiH1, psxH1 = h_update(
+            e1_prev, firsts1, h0_prev,
+            lambda a, row: idx[f"psH{a}"][row].astype(fdt),
+            (lambda row: idx["psxH"][row].astype(fdt)) if fuse_x
+            else None,
+            "prof_hx")
+
+        # ---- phase C: E(t+2) on tile i-2 -> HBM ----------------------
+        e1_old = [idx["se1b"][j] for j in range(ne)]    # E1[i-2]
+        h1_prev = [idx["sh1a"][j] for j in range(nh)]   # H1[i-2]
+        ghc = [jnp.where(i > 2, idx["sh1b"][j][-1:],
+                         jnp.zeros_like(idx["sh1b"][j][-1:]))
+               for j in range(nh)]
+        e2, psiE2, psxE2 = e_update(
+            h1_prev, ghc, e1_old,
+            lambda a, row: idx[f"spe1b_{a}"][row],
+            (lambda row: idx["sxe1b"][row]) if fuse_x else None,
+            "prof_ex2", "wall_x2", tl2, 1)
+        for jc in range(ne):
+            @pl.when(valid_c)
+            def _(jc=jc):
+                idx["e_out"][jc] = e2[jc].astype(fst)
+        for a in psi_axes_e:
+            for row in range(len(rows_e[a])):
+                @pl.when(valid_c)
+                def _(a=a, row=row):
+                    idx[f"psE{a}_out"][row] = psiE2[a][row].astype(fdt)
+        if fuse_x:
+            for row in range(kxe):
+                @pl.when(valid_c & in_xslab_c)
+                def _(row=row):
+                    idx["psxE_out"][row] = psxE2[row].astype(fdt)
+
+        # ---- phase D: H(t+2) on tile i-3 -> HBM ----------------------
+        h1_old = [idx["sh1b"][j] for j in range(nh)]    # H1[i-3]
+        e2_prev = [idx["se2"][j] for j in range(ne)]    # E2[i-3]
+        firsts2 = [jnp.where(valid_c, e2[j][0:1],
+                             jnp.zeros_like(e2[j][0:1]))
+                   for j in range(ne)]
+        h2, psiH2, psxH2 = h_update(
+            e2_prev, firsts2, h1_old,
+            lambda a, row: idx[f"sph1b_{a}"][row],
+            (lambda row: idx["sxh1b"][row]) if fuse_x else None,
+            "prof_hx2")
+        for jc in range(nh):
+            @pl.when(valid_d)
+            def _(jc=jc):
+                idx["h_out"][jc] = h2[jc].astype(fst)
+        for a in psi_axes_h:
+            for row in range(len(rows_h[a])):
+                @pl.when(valid_d)
+                def _(a=a, row=row):
+                    idx[f"psH{a}_out"][row] = psiH2[a][row].astype(fdt)
+        if fuse_x:
+            for row in range(kxh):
+                @pl.when(valid_d & in_xslab_d)
+                def _(row=row):
+                    idx["psxH_out"][row] = psxH2[row].astype(fdt)
+
+        # ---- phase R: rotate the rings for the next iteration --------
+        # (the "a" slots were read into values above, so the b <- a,
+        # a <- fresh order is race-free)
+        for j in range(ne):
+            idx["se1b"][j] = e1_prev[j]
+            idx["se1a"][j] = e1[j]
+            idx["se2"][j] = e2[j]
+        for j in range(nh):
+            idx["sh1b"][j] = h1_prev[j]
+            idx["sh1a"][j] = h1[j]
+            idx["sh0"][j] = h_vals[j]
+            idx["sh0h"][j] = h_vals[j][-1:]
+        for a in psi_axes_e:
+            prev = [idx[f"spe1a_{a}"][row]
+                    for row in range(len(rows_e[a]))]
+            for row in range(len(rows_e[a])):
+                idx[f"spe1b_{a}"][row] = prev[row]
+                idx[f"spe1a_{a}"][row] = psiE1[a][row]
+        for a in psi_axes_h:
+            prev = [idx[f"sph1a_{a}"][row]
+                    for row in range(len(rows_h[a]))]
+            for row in range(len(rows_h[a])):
+                idx[f"sph1b_{a}"][row] = prev[row]
+                idx[f"sph1a_{a}"][row] = psiH1[a][row]
+        if fuse_x:
+            prev = [idx["sxe1a"][row] for row in range(kxe)]
+            for row in range(kxe):
+                idx["sxe1b"][row] = prev[row]
+                idx["sxe1a"][row] = psxE1[row]
+            prev = [idx["sxh1a"][row] for row in range(kxh)]
+            for row in range(kxh):
+                idx["sxh1b"][row] = prev[row]
+                idx["sxh1a"][row] = psxH1[row]
+
+    # ---- specs ----------------------------------------------------------
+    def stack_spec(k, last2, imap):
+        return pl.BlockSpec((k, T, last2[0], last2[1]), imap,
+                            memory_space=pltpu.VMEM)
+
+    def tile_imap(i):
+        return (0, jnp.minimum(i, ntiles - 1), 0, 0)
+
+    def lag1_imap(i):
+        # clamped at BOTH ends: the tb grid runs ntiles + 3 iterations
+        # (vs the single-step kernel's ntiles + 1), so an unclamped
+        # max(i-1, 0) would hand Mosaic out-of-range block indices on
+        # the last two (drain) iterations. Pinning to the last block
+        # keeps the window (no refetch) and the phase consuming it is
+        # masked there.
+        return (0, jnp.minimum(jnp.maximum(i - 1, 0), ntiles - 1), 0, 0)
+
+    def lag2_imap(i):
+        return (0, jnp.minimum(jnp.maximum(i - 2, 0), ntiles - 1), 0, 0)
+
+    def lag3_imap(i):
+        return (0, jnp.maximum(i - 3, 0), 0, 0)
+
+    def psi_last2(a):
+        s = _stack_shape(a, 1)
+        return (s[2], s[3])
+
+    if fuse_x:
+        def xpsi_lag1_imap(i):
+            # clamped like lag1_imap (pallas_packed.x_block_maps's own
+            # lag map is sized for the ntiles+1 grid, not ntiles+3)
+            return (0, xblk(jnp.minimum(jnp.maximum(i - 1, 0),
+                                        ntiles - 1)), 0, 0)
+
+        def xpsi_lag2_imap(i):
+            return (0, xblk(jnp.minimum(jnp.maximum(i - 2, 0),
+                                        ntiles - 1)), 0, 0)
+
+        def xpsi_lag3_imap(i):
+            return (0, xblk(jnp.maximum(i - 3, 0)), 0, 0)
+
+    in_specs = [
+        stack_spec(ne, (n2, n3), tile_imap),                  # E in
+        stack_spec(nh, (n2, n3), tile_imap),                  # H in
+    ]
+    in_specs += [stack_spec(len(rows_e[a]), psi_last2(a),
+                            tile_imap) for a in psi_axes_e]
+    in_specs += [stack_spec(len(rows_h[a]), psi_last2(a),
+                            lag1_imap) for a in psi_axes_h]
+    if fuse_x:
+        in_specs += [pl.BlockSpec((kxe, T, n2, n3), xpsi_tile_imap,
+                                  memory_space=pltpu.VMEM),
+                     pl.BlockSpec((kxh, T, n2, n3), xpsi_lag1_imap,
+                                  memory_space=pltpu.VMEM)]
+    for a in psi_axes_e + psi_axes_h:
+        s = [3, 1, 1, 1]
+        s[1 + a] = 2 * slabs[a]
+        in_specs += [pl.BlockSpec(tuple(s), lambda i: (0, 0, 0, 0),
+                                  memory_space=pltpu.VMEM)]
+    if fuse_x:  # full-length per-plane x profiles at both tile lags
+        def prof_spec(imap4):
+            return pl.BlockSpec((3, T, 1, 1),
+                                lambda i, _m=imap4: (0, _m(i)[1], 0, 0),
+                                memory_space=pltpu.VMEM)
+        in_specs += [prof_spec(tile_imap), prof_spec(lag2_imap),
+                     prof_spec(lag1_imap), prof_spec(lag3_imap)]
+    if src_on:
+        in_specs += [pl.BlockSpec((2, 1, 1), lambda i: (0, 0, 0),
+                                  memory_space=pltpu.VMEM)]
+    in_specs += [pl.BlockSpec((T, 1, 1),
+                              lambda i: (jnp.minimum(i, ntiles - 1),
+                                         0, 0),
+                              memory_space=pltpu.VMEM),      # wall_x
+                 pl.BlockSpec((T, 1, 1),
+                              lambda i: (jnp.minimum(
+                                  jnp.maximum(i - 2, 0), ntiles - 1),
+                                  0, 0),
+                              memory_space=pltpu.VMEM),      # wall_x2
+                 pl.BlockSpec((1, n2, 1), lambda i: (0, 0, 0),
+                              memory_space=pltpu.VMEM),      # wall_y
+                 pl.BlockSpec((1, 1, n3), lambda i: (0, 0, 0),
+                              memory_space=pltpu.VMEM)]      # wall_z
+
+    out_specs = [stack_spec(ne, (n2, n3), lag2_imap),        # E out
+                 stack_spec(nh, (n2, n3), lag3_imap)]        # H out
+    out_specs += [stack_spec(len(rows_e[a]), psi_last2(a),
+                             lag2_imap) for a in psi_axes_e]
+    out_specs += [stack_spec(len(rows_h[a]), psi_last2(a),
+                             lag3_imap) for a in psi_axes_h]
+    if fuse_x:
+        out_specs += [pl.BlockSpec((kxe, T, n2, n3), xpsi_lag2_imap,
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((kxh, T, n2, n3), xpsi_lag3_imap,
+                                   memory_space=pltpu.VMEM)]
+
+    out_shape = [jax.ShapeDtypeStruct((ne, n1, n2, n3), fst),
+                 jax.ShapeDtypeStruct((nh, n1, n2, n3), fst)]
+    out_shape += [jax.ShapeDtypeStruct(_stack_shape(a, len(rows_e[a])),
+                                       np.float32) for a in psi_axes_e]
+    out_shape += [jax.ShapeDtypeStruct(_stack_shape(a, len(rows_h[a])),
+                                       np.float32) for a in psi_axes_h]
+    if fuse_x:
+        out_shape += [jax.ShapeDtypeStruct((kxe, Sx, n2, n3),
+                                           np.float32),
+                      jax.ShapeDtypeStruct((kxh, Sx, n2, n3),
+                                           np.float32)]
+
+    # Donation: module docstring — reads always precede the (lag-2 /
+    # lag-3) writes of the same block, every array enters once.
+    n_psi = len(psi_axes_e) + len(psi_axes_h) + (2 if fuse_x else 0)
+    aliases = {j: j for j in range(2 + n_psi)}
+
+    # allocation order mirrors take(): field rings, then spe1a for all
+    # e axes, spe1b for all e axes, sph1a / sph1b likewise, x-psi rings
+    scratch = [pltpu.VMEM((ne, T, n2, n3), jnp.float32),    # se1a
+               pltpu.VMEM((ne, T, n2, n3), jnp.float32),    # se1b
+               pltpu.VMEM((ne, T, n2, n3), jnp.float32),    # se2
+               pltpu.VMEM((nh, T, n2, n3), jnp.float32),    # sh0
+               pltpu.VMEM((nh, T, n2, n3), jnp.float32),    # sh1a
+               pltpu.VMEM((nh, T, n2, n3), jnp.float32),    # sh1b
+               pltpu.VMEM((nh, 1, n2, n3), jnp.float32)]    # sh0h
+    for rows, axes in ((rows_e, psi_axes_e), (rows_h, psi_axes_h)):
+        for _slot in ("a", "b"):
+            for a in axes:
+                s2, s3 = psi_last2(a)
+                scratch += [pltpu.VMEM((len(rows[a]), T, s2, s3),
+                                       jnp.float32)]
+    if fuse_x:
+        scratch += [pltpu.VMEM((kxe, T, n2, n3), jnp.float32),
+                    pltpu.VMEM((kxe, T, n2, n3), jnp.float32),
+                    pltpu.VMEM((kxh, T, n2, n3), jnp.float32),
+                    pltpu.VMEM((kxh, T, n2, n3), jnp.float32)]
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(ntiles + 3,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        input_output_aliases=aliases,
+        scratch_shapes=scratch,
+        compiler_params=COMPILER_PARAMS(
+            vmem_limit_bytes=_pk._VMEM_TOTAL),
+        interpret=interpret,
+    )
+
+    # ---- the step (advances TWO steps) ----------------------------------
+    from fdtd3d_tpu.ops.sources import waveform
+
+    prepare = tail.prepare
+
+    def step(pstate, coeffs):
+        if "_pk_wall_x" not in coeffs:
+            # direct callers hand raw coeffs; the chunk runner hoists
+            # prepare() outside the scan (round 6)
+            coeffs = prepare(coeffs)
+        t = pstate["t"]
+        new_state = dict(pstate)
+        args = [pstate["E"], pstate["H"]]
+        args += [pstate[f"psE{a}"] for a in psi_axes_e]
+        args += [pstate[f"psH{a}"] for a in psi_axes_h]
+        if fuse_x:
+            args += [pstate["psxE"], pstate["psxH"]]
+        args += [coeffs[f"_pk_prof_e{a}"] for a in psi_axes_e]
+        args += [coeffs[f"_pk_prof_h{a}"] for a in psi_axes_h]
+        if fuse_x:
+            args += [coeffs["_pk_prof_ex"], coeffs["_pk_prof_ex"],
+                     coeffs["_pk_prof_hx"], coeffs["_pk_prof_hx"]]
+        if src_on:
+            with _named("source"):
+                wf = jnp.stack([
+                    waveform(ps.waveform, t, 0.5, static.omega,
+                             static.dt, np.float32),
+                    waveform(ps.waveform, t + 1, 0.5, static.omega,
+                             static.dt, np.float32)])
+                args += [(np.float32(ps.amplitude)
+                          * wf).reshape(2, 1, 1)]
+        args += [coeffs["_pk_wall_x"], coeffs["_pk_wall_x"],
+                 coeffs["_pk_wall_y"], coeffs["_pk_wall_z"]]
+        with _named("packed-kernel-tb"):
+            outs = call(*args)
+        p = 0
+        new_state["E"] = outs[p]; p += 1
+        new_state["H"] = outs[p]; p += 1
+        for a in psi_axes_e:
+            new_state[f"psE{a}"] = outs[p]; p += 1
+        for a in psi_axes_h:
+            new_state[f"psH{a}"] = outs[p]; p += 1
+        if fuse_x:
+            new_state["psxE"] = outs[p]; p += 1
+            new_state["psxH"] = outs[p]; p += 1
+        new_state["t"] = t + 2
+        return new_state
+
+    step.pack = tail.pack
+    step.unpack = tail.unpack
+    step.packed = True
+    step.prepare = prepare
+    step.steps_per_call = 2
+    step.tail_step = tail
+    step.diag = {"tile": {"EH": T},
+                 "fused_x": fuse_x,
+                 "temporal_block": 2,
+                 "vmem_block_bytes": {"EH": _block_bytes(T)},
+                 "vmem_scratch_bytes": _scratch_bytes(T)}
+    return step

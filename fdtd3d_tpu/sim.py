@@ -403,7 +403,8 @@ class Simulation:
         from fdtd3d_tpu import log as _log
         from fdtd3d_tpu.ops import pallas_packed
         from fdtd3d_tpu.solver import make_chunk_runner
-        if self.step_kind not in ("pallas_packed", "pallas_packed_ds"):
+        if self.step_kind not in ("pallas_packed", "pallas_packed_ds",
+                                  "pallas_packed_tb"):
             raise exc
         kind = self.step_kind
         failed_tile = ((self.step_diag or {}).get("tile") or {}).get("EH")
@@ -432,14 +433,25 @@ class Simulation:
                                                health=self._health_on)
             finally:
                 pallas_packed._RUNTIME_BUDGET = None
-            if getattr(runner, "kind", None) != kind:
+            new_kind = getattr(runner, "kind", None)
+            if new_kind != kind and not (
+                    kind == "pallas_packed_tb"
+                    and new_kind == "pallas_packed"):
                 # the shrunken budget fell out of packed scope entirely
-                # — switching carry representations mid-run is unsound
+                # — switching carry representations mid-run is unsound.
+                # (tb -> packed IS sound: both use the packed carry and
+                # the rebuild routes it through the dict form below.)
                 raise exc
             new_tile = (runner.diag.get("tile") or {}).get("EH")
-            if failed_tile is not None and new_tile is not None \
+            if new_kind == kind and failed_tile is not None \
+                    and new_tile is not None \
                     and new_tile >= failed_tile:
-                continue      # same/bigger tile would fail again
+                # same-kernel rebuild at the same/bigger tile would
+                # fail again; across a tb -> packed downgrade the tile
+                # is NOT comparable (the single-step kernel's scratch
+                # is ~1/3 the tb ring's, so an equal or bigger tile can
+                # be perfectly viable — don't skip the rung)
+                continue
             break
         _log.warn(
             f"packed kernel compile failed at tile {failed_tile}; "

@@ -449,12 +449,19 @@ def _want_pallas(static: StaticSetup, mesh_axes) -> bool:
             or pallas_packed.eligible(static, mesh_axes))
 
 
-def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
+def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
+              allow_multistep: bool = True):
     """Build the pure leapfrog step. mesh_axes/mesh_shape: see stencil.py.
 
     Dispatches to the fused Pallas kernels (ops/pallas3d.py) when the
     configuration is eligible and use_pallas is not False; otherwise the
     pure-jnp step below (identical semantics) is built.
+
+    ``allow_multistep=False`` skips the temporal-blocked kernel
+    (ops/pallas_packed_tb.py), whose step advances TWO steps per call —
+    callers that require the one-step contract (the paired-complex leg
+    builder) pass it; make_chunk_runner handles multi-step steps via
+    ``step.steps_per_call`` / ``step.tail_step``.
     """
     if static.paired_complex:
         return _make_paired_complex_step(static, mesh_axes, mesh_shape)
@@ -495,6 +502,21 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):
         # fused kernel needs only the one variable.
         if not _os.environ.get("FDTD3D_NO_PACKED") \
                 and not _os.environ.get("FDTD3D_FORCE_FUSED"):
+            # Temporal-blocked kernel (round 8): TWO Yee steps per HBM
+            # pass (~24 B/cell f32) on its (stricter) scope; its step
+            # advances 2 steps per call (steps_per_call), with a
+            # same-tile pallas_packed tail for odd counts.
+            # FDTD3D_NO_TEMPORAL forces the round-6 single-step kernel
+            # bit-for-bit (the escape hatch mirroring FDTD3D_NO_PACKED).
+            if allow_multistep \
+                    and not _os.environ.get("FDTD3D_NO_TEMPORAL"):
+                from fdtd3d_tpu.ops import pallas_packed_tb
+                tb = pallas_packed_tb.make_packed_tb_step(
+                    static, mesh_axes, mesh_shape)
+                if tb is not None:
+                    tb.kind = "pallas_packed_tb"
+                    # tb.tail_step.kind is set by make_packed_tb_step
+                    return tb
             from fdtd3d_tpu.ops import pallas_packed
             pk = pallas_packed.make_packed_eh_step(static, mesh_axes,
                                                    mesh_shape)
@@ -1029,8 +1051,12 @@ def _make_paired_complex_step(static: StaticSetup, mesh_axes=None,
                                 topology=static.topology)
     st_im = dataclasses.replace(build_static(cfg_im),
                                 topology=static.topology)
-    step_re = make_step(st_re, mesh_axes, mesh_shape)
-    step_im = make_step(st_im, mesh_axes, mesh_shape)
+    # allow_multistep=False: the paired wrapper calls each leg once per
+    # step, so a two-steps-per-call leg would silently double-advance
+    step_re = make_step(st_re, mesh_axes, mesh_shape,
+                        allow_multistep=False)
+    step_im = make_step(st_im, mesh_axes, mesh_shape,
+                        allow_multistep=False)
     leg_pack = getattr(step_re, "pack", None)
     leg_unpack = getattr(step_re, "unpack", None)
 
@@ -1112,6 +1138,16 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
     """
     step = make_step(static, mesh_axes, mesh_shape)
     prep = getattr(step, "prepare", None)
+    # Temporal-blocked steps advance steps_per_call (=2) steps per call:
+    # the scan runs n // spc blocked calls and the remainder runs on
+    # tail_step — a single-step pallas_packed built at the SAME tile,
+    # so both share one packed-carry layout and one prepared coeffs
+    # dict (ops/pallas_packed_tb.py).
+    spc = int(getattr(step, "steps_per_call", 1))
+    tail_step = getattr(step, "tail_step", None)
+    if spc > 1 and tail_step is None:
+        raise ValueError(f"step advances {spc} steps/call but exposes "
+                         f"no tail_step for remainder handling")
 
     health_fn = None
     if health:
@@ -1136,7 +1172,13 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
 
         def body(s, _):
             return step(s, cc), None
-        out, _ = jax.lax.scan(body, state, None, length=n)
+        if spc > 1:
+            nb, rem = divmod(n, spc)
+            out, _ = jax.lax.scan(body, state, None, length=nb)
+            for _ in range(rem):
+                out = tail_step(out, cc)   # trailing single step(s)
+        else:
+            out, _ = jax.lax.scan(body, state, None, length=n)
         if health_fn is not None:
             # the scope covers the in-graph unpack of packed carries
             # too (view(s) runs before make_health_fn's own scope)
@@ -1147,6 +1189,7 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
     run_chunk.health = health_fn is not None
     run_chunk.kind = getattr(step, "kind", "jnp")
     run_chunk.diag = getattr(step, "diag", None)
+    run_chunk.steps_per_call = spc
     if getattr(step, "packed", False):
         run_chunk.packed = True
         run_chunk.pack = step.pack

@@ -75,7 +75,8 @@ HOST_SPANS = ("compile", "chunk", "pack", "vmem-ladder-rebuild",
 # (tools/trace_attribution.py): every HLO op whose name stack carries
 # one of them is charged to that section.
 GRAPH_SPANS = ("E-update", "H-update", "cpml", "halo-exchange", "source",
-               "tfsf", "packed-kernel", "health", "prepare")
+               "tfsf", "packed-kernel", "packed-kernel-tb", "health",
+               "prepare")
 
 
 def span(name: str):

@@ -2,9 +2,12 @@
 
 ISSUE 3 acceptance, asserted deterministically on CPU (pure tracing,
 no compile, no chip): the ledger attributes >= 95% of per-step flops
-AND bytes to named sections for all four step kinds (jnp, pallas,
-pallas_packed, pallas_packed_ds), the schema validates, and the
-roofline lane turns an HBM GB/s calibration into a modeled step time.
+AND bytes to named sections for every production step kind (jnp,
+pallas, pallas_packed, pallas_packed_tb, pallas_packed_ds), the schema
+validates, and the roofline lane turns an HBM GB/s calibration into a
+modeled step time. Round 8 adds the temporal-blocked kernel's
+"roofline moved" gate: its per-step field bytes must be <= 0.55x the
+single-step packed kernel's on the same config.
 """
 
 import json
@@ -67,12 +70,57 @@ def test_ledger_expected_sections(ledgers):
         set(ledgers["jnp"]["sections"])
     assert "packed-kernel" in ledgers["pallas_packed"]["sections"]
     assert "packed-kernel" in ledgers["pallas_packed_ds"]["sections"]
+    assert "packed-kernel-tb" in \
+        ledgers["pallas_packed_tb"]["sections"]
     # two-pass kernels attribute their family kernels to E/H-update
     assert {"E-update", "H-update"} <= set(ledgers["pallas"]["sections"])
     # the health reduction is per-chunk, never per-step
     for kind in KINDS:
         assert "health" in ledgers[kind]["per_chunk_sections"]
         assert "health" not in ledgers[kind]["sections"]
+
+
+def test_tb_ledger_roofline_moved(ledgers):
+    """Round-8 acceptance gate, CPU-deterministic: the temporal-blocked
+    kernel's PER-STEP field bytes — the packed-kernel section's
+    pallas_call charge, i.e. the modeled HBM traffic — must be
+    <= 0.55x the single-step packed kernel's on the same config (the
+    kernel moves 12 field volumes per TWO steps instead of per one)."""
+    tb = ledgers["pallas_packed_tb"]
+    pk = ledgers["pallas_packed"]
+    assert tb["steps_per_call"] == 2
+    assert pk["steps_per_call"] == 1
+    tb_b = tb["sections"]["packed-kernel-tb"]["bytes"] / tb["cells"]
+    pk_b = pk["sections"]["packed-kernel"]["bytes"] / pk["cells"]
+    assert tb_b <= 0.55 * pk_b, \
+        f"tb kernel {tb_b:.1f} B/cell/step vs packed {pk_b:.1f}"
+
+
+def test_tb_ledger_total_bytes_halve_sourceless():
+    """Same gate on the whole per-step byte total, sourceless (the
+    sourced packed kernel carries post-kernel patch machinery whose
+    unfused byte bound would flatter the ratio): exactly the 2x
+    temporal-blocking claim, every operand charged."""
+    import dataclasses
+
+    from fdtd3d_tpu.config import PointSourceConfig
+    vals = {}
+    for kind in ("pallas_packed", "pallas_packed_tb"):
+        cfg = dataclasses.replace(
+            costs.config_for_kind(kind),
+            point_source=PointSourceConfig(enabled=False))
+        led = costs.chunk_ledger(cfg, n_steps=8, kind=kind)
+        vals[kind] = led["per_step"]["bytes_per_cell"]
+    ratio = vals["pallas_packed_tb"] / vals["pallas_packed"]
+    assert ratio <= 0.55, f"per-step bytes ratio {ratio:.3f} > 0.55"
+
+
+def test_tb_ledger_odd_horizon_raises():
+    """An odd n_steps would hide tail-step cost in the per-chunk table;
+    the ledger refuses instead of silently blurring the split."""
+    cfg = costs.config_for_kind("pallas_packed_tb")
+    with pytest.raises(ValueError, match="steps_per_call"):
+        costs.chunk_ledger(cfg, n_steps=7, kind="pallas_packed_tb")
 
 
 def test_ds_flops_exceed_f32(ledgers):

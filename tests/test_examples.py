@@ -135,9 +135,10 @@ def test_save_cmd_to_file_roundtrip(tmp_path):
 
 
 @pytest.mark.parametrize("argv,want_kind", [
-    # 3D + pallas forced (interpret mode on CPU) -> packed kernel
+    # 3D + pallas forced (interpret mode on CPU) -> the sourceless hot
+    # path since round 8 is the temporal-blocked packed kernel
     (["--3d", "--same-size", "16", "--time-steps", "2", "--use-pml",
-      "--pml-size", "2", "--use-pallas", "on"], "pallas_packed"),
+      "--pml-size", "2", "--use-pallas", "on"], "pallas_packed_tb"),
     # pallas off -> jnp, stated explicitly at startup
     (["--3d", "--same-size", "16", "--time-steps", "2",
       "--use-pallas", "off"], "jnp"),

@@ -141,6 +141,7 @@ def test_packed_ds_point_source_vs_f32():
         assert rel < 1e-4, f"{c}: rel {rel:.2e}"
 
 
+@pytest.mark.slow
 def test_packed_ds_checkpoint_resume_bit_exact(tmp_path):
     """Checkpoint/resume through the packed pair carry: the lo words,
     pair psi state, and incident-line pairs must all round-trip — a

@@ -1,0 +1,438 @@
+"""Temporal-blocked packed kernel (ops/pallas_packed_tb.py) vs jnp.
+
+Round 8: TWO Yee steps per HBM pass — the kernel deepens the packed
+pipeline to four phases (E(t+1) on tile i, H(t+1) on i-1, E(t+2) on
+i-2, H(t+2) on i-3 from VMEM ring scratch) and runs the CPML psi
+recursion twice per pass, halving per-step field traffic (48 -> ~24
+B/cell f32). Parity with the jnp step must hold at f32 roundoff
+INCLUDING the psi recursion state, for even AND odd total step counts
+(odd counts append one single-step ``pallas_packed`` tail built at the
+SAME tile) and for odd / two-region tilings (pipeline-drain edges).
+``FDTD3D_NO_TEMPORAL=1`` is the escape hatch that forces the round-6
+single-step kernel bit-for-bit.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fdtd3d_tpu.config import (MaterialsConfig, OutputConfig,
+                               ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig, SphereConfig,
+                               TfsfConfig)
+from fdtd3d_tpu.sim import Simulation
+
+BASE = dict(scheme="3D", size=(16, 16, 16), time_steps=8, dx=1e-3,
+            courant_factor=0.4, wavelength=8e-3)
+
+
+def _seed_fields(sim, seed=0):
+    key = jax.random.PRNGKey(seed)
+    for grp in ("E", "H"):
+        for c in list(sim.state[grp]):
+            key, k2 = jax.random.split(key)
+            sim.set_field(c, 0.01 * np.asarray(
+                jax.random.normal(k2, sim.state[grp][c].shape)))
+
+
+def _run(use_pallas, seed=0, **kw):
+    cfg = dict(BASE, use_pallas=use_pallas, **kw)
+    sim = Simulation(SimConfig(**cfg))
+    _seed_fields(sim, seed=seed)
+    sim.run()
+    return sim
+
+
+def _parity(tol=2e-6, seed=0, psi=True, **kw):
+    j = _run(False, seed=seed, **kw)
+    p = _run(True, seed=seed, **kw)
+    assert p.step_kind == "pallas_packed_tb", p.step_kind
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < tol, f"{c}: rel {rel:.2e}"
+    if psi and "psi_E" in j.state:
+        for grp in ("psi_E", "psi_H"):
+            for k in j.state[grp]:
+                a = np.asarray(j.state[grp][k])
+                b = np.asarray(p.state[grp][k])
+                rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+                assert rel < tol, f"{grp}/{k}: rel {rel:.2e}"
+    return j, p
+
+
+def test_tb_vacuum_parity():
+    _parity()
+
+
+@pytest.mark.slow
+def test_tb_cpml_parity_even():
+    """Subsumed in tier-1 by test_tb_odd_ntiles_and_two_region_x_psi
+    (even horizon + full CPML at a two-region tiling); kept in the slow
+    lane as the minimal single-region repro."""
+    _parity(pml=PmlConfig(size=(3, 3, 3)))
+
+
+def test_tb_cpml_parity_odd_steps():
+    """Odd horizon: n//2 blocked passes + ONE single-step tail on the
+    identical packed-carry layout (solver.make_chunk_runner)."""
+    _parity(pml=PmlConfig(size=(3, 3, 3)), time_steps=7)
+
+
+def test_tb_odd_ntiles_and_two_region_x_psi():
+    """48-long x at tile 16 -> 3 tiles with the two-region tile-aligned
+    x-psi layout (interior tile pins its block; lag-2/lag-3 output
+    maps): the pipeline-drain edges the ISSUE names."""
+    j, p = _parity(pml=PmlConfig(size=(3, 3, 3)), size=(48, 16, 16))
+    assert p.step_diag["temporal_block"] == 2
+
+
+def test_tb_two_region_odd_steps_sourced():
+    _parity(pml=PmlConfig(size=(3, 3, 3)), size=(48, 16, 16),
+            time_steps=7,
+            point_source=PointSourceConfig(enabled=True, component="Ey",
+                                           position=(30, 8, 8)))
+
+
+@pytest.mark.slow
+def test_tb_point_source_parity_even():
+    """The mid-grid injection rides IN-KERNEL (both E phases add the
+    masked waveform term before ca/cb — a post-patch cannot reach the
+    second step's curls). Tier-1 coverage of that path lives in
+    test_tb_two_region_odd_steps_sourced, whose blocked passes inject
+    in both phases too; this pure-even single-region variant rides the
+    slow lane (tier-1 wall budget)."""
+    src = PointSourceConfig(enabled=True, component="Ez",
+                            position=(8, 8, 8))
+    _parity(pml=PmlConfig(size=(3, 3, 3)), point_source=src)
+
+
+@pytest.mark.slow
+def test_tb_x_only_and_yz_only_pml():
+    """Axis-isolated CPML parities — a debugging decomposition of the
+    full-PML parity above (which exercises both mechanisms at once);
+    slow lane for the tier-1 wall budget."""
+    _parity(pml=PmlConfig(size=(3, 0, 0)))   # fused-x path alone
+    _parity(pml=PmlConfig(size=(0, 3, 3)))   # y/z slab recursions alone
+
+
+@pytest.mark.slow
+def test_tb_bf16_smoke():
+    """Slow lane (tier-1 wall budget): the acceptance parity gate is
+    f32; bench's accuracy spot-check covers bf16 on chip windows."""
+    _parity(tol=3e-2, psi=False, dtype="bfloat16",
+            pml=PmlConfig(size=(3, 3, 3)))
+
+
+def test_tb_escape_hatch_bit_for_bit(monkeypatch):
+    """FDTD3D_NO_TEMPORAL must force the round-6 kernel: same kind and
+    BIT-identical fields as a dispatch where the tb builder is absent
+    entirely (the acceptance criterion's escape hatch)."""
+    kw = dict(pml=PmlConfig(size=(3, 3, 3)))
+    with monkeypatch.context() as m:
+        m.setenv("FDTD3D_NO_TEMPORAL", "1")
+        a = _run(True, **kw)
+    assert a.step_kind == "pallas_packed", a.step_kind
+
+    from fdtd3d_tpu.ops import pallas_packed_tb
+    with monkeypatch.context() as m:
+        m.setattr(pallas_packed_tb, "make_packed_tb_step",
+                  lambda *args, **kwargs: None)
+        b = _run(True, **kw)
+    assert b.step_kind == "pallas_packed", b.step_kind
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        assert np.array_equal(np.asarray(a.field(c)),
+                              np.asarray(b.field(c))), c
+
+
+# -------------------------------------------------------------------------
+# eligibility: the scope is a strict subset of the packed kernel's
+# -------------------------------------------------------------------------
+
+def test_tb_fallbacks_stay_on_packed():
+    """Out-of-tb-scope configs must land on the round-6 packed kernel
+    (never jnp, never silently tb): TFSF, in-absorber source, sharded,
+    Drude."""
+    tfsf = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+        tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2))))
+    assert tfsf.step_kind == "pallas_packed", tfsf.step_kind
+
+    absorber = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(2, 8, 8))))
+    assert absorber.step_kind == "pallas_packed", absorber.step_kind
+
+    sharded = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(1, 2, 2))))
+    assert sharded.step_kind == "pallas_packed", sharded.step_kind
+
+    drude = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),
+        materials=MaterialsConfig(
+            use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+            drude_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                      radius=3))))
+    assert drude.step_kind == "pallas_packed", drude.step_kind
+
+
+def test_tb_material_grid_falls_back():
+    """A material grid would need each coefficient streamed at two tile
+    lags: out of scope, packed kernel covers it."""
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+        materials=MaterialsConfig(
+            eps=2.0, eps_sphere=SphereConfig(enabled=True,
+                                             center=(8, 8, 8),
+                                             radius=4, value=6.0))))
+    assert sim.step_kind == "pallas_packed", sim.step_kind
+
+
+def test_tb_paired_complex_legs_stay_single_step(monkeypatch):
+    """The paired-complex wrapper calls each leg once per step — a
+    two-steps-per-call leg would silently double-advance
+    (make_step(allow_multistep=False))."""
+    monkeypatch.setenv("FDTD3D_FORCE_PAIRED_COMPLEX", "1")
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+        complex_fields=True))
+    assert sim.step_kind == "complex2x_pallas_packed", sim.step_kind
+
+
+def test_tb_force_tile_validation():
+    """make_packed_eh_step(force_tile=...) (the tb tail builder's hook)
+    rejects non-divisor / too-thin tiles instead of building a
+    mismatched carry layout."""
+    from fdtd3d_tpu import solver
+    from fdtd3d_tpu.ops import pallas_packed
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)))
+    static = solver.build_static(cfg)
+    assert pallas_packed.make_packed_eh_step(static, force_tile=5) is None
+    assert pallas_packed.make_packed_eh_step(static, force_tile=16) is None
+    ok = pallas_packed.make_packed_eh_step(static, force_tile=8)
+    assert ok is not None and ok.diag["tile"]["EH"] == 8
+
+
+def test_tb_step_contract():
+    """The multi-step step object's contract with make_chunk_runner:
+    steps_per_call=2, a single-step tail at the SAME tile, shared
+    pack/unpack/prepare."""
+    from fdtd3d_tpu import solver
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)))
+    static = solver.build_static(cfg)
+    step = solver.make_step(static)
+    assert step.kind == "pallas_packed_tb"
+    assert step.steps_per_call == 2
+    tail = step.tail_step
+    assert tail.kind == "pallas_packed"
+    assert tail.diag["tile"]["EH"] == step.diag["tile"]["EH"]
+    assert step.pack is tail.pack and step.unpack is tail.unpack
+    assert step.prepare is tail.prepare
+    # the one-step contract escape for wrappers
+    single = solver.make_step(static, allow_multistep=False)
+    assert single.kind == "pallas_packed"
+    # a chunk runner built on the tb step reports the multi-step shape
+    runner = solver.make_chunk_runner(static)
+    assert runner.kind == "pallas_packed_tb"
+    assert runner.steps_per_call == 2
+
+
+# -------------------------------------------------------------------------
+# donation safety (structural, mirrors test_h_inputs_never_donated)
+# -------------------------------------------------------------------------
+
+def test_tb_donation_fetch_before_write(monkeypatch):
+    """Structural donation-safety: every ALIASED operand's in-map must
+    be monotone (each HBM block fetched once) and fetch each block no
+    later than the out-map's first visit of it — backward-read state
+    never sees a block its own (masked or real) output writes could
+    already have flushed. Non-field operands (profiles, source, walls)
+    must not be donated at all. Interpreter mode cannot surface the
+    hazard at runtime — assert the structure."""
+    from jax.experimental import pallas as pl
+
+    from fdtd3d_tpu import solver
+    from fdtd3d_tpu.ops import pallas_packed_tb
+
+    captured = {}
+    real_call = pl.pallas_call
+
+    def spy(kernel, **kw):
+        captured["aliases"] = dict(kw.get("input_output_aliases") or {})
+        captured["in_specs"] = list(kw.get("in_specs"))
+        captured["out_specs"] = list(kw.get("out_specs"))
+        captured["grid"] = kw.get("grid")
+        return real_call(kernel, **kw)
+
+    monkeypatch.setattr(pallas_packed_tb.pl, "pallas_call", spy)
+    cfg = SimConfig(**dict(BASE, size=(48, 16, 16)), use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez",
+                        position=(24, 8, 8)))
+    static = solver.build_static(cfg)
+    step = pallas_packed_tb.make_packed_tb_step(static)
+    assert step is not None and captured
+
+    aliases = captured["aliases"]
+    n_in = len(captured["in_specs"])
+    n_out = len(captured["out_specs"])
+    # every output is fed by a donated input with the same position;
+    # everything else (profiles/source/walls) is NOT donated
+    assert aliases == {j: j for j in range(n_out)}, aliases
+    assert n_in > n_out
+
+    (n_iters,) = captured["grid"]
+
+    def blocks(spec):
+        # x-block index per grid iteration (index maps are pure)
+        return [int(spec.index_map(i)[1]) for i in range(n_iters)]
+
+    for j in sorted(aliases):
+        fetches = blocks(captured["in_specs"][j])
+        visits = blocks(captured["out_specs"][aliases[j]])
+        assert fetches == sorted(fetches), \
+            f"operand {j}: non-monotone in-map {fetches}"
+        first_fetch = {}
+        for i, b in enumerate(fetches):
+            first_fetch.setdefault(b, i)
+        first_visit = {}
+        for i, b in enumerate(visits):
+            first_visit.setdefault(b, i)
+        for b, fi in first_fetch.items():
+            assert fi <= first_visit.get(b, n_iters), (
+                f"operand {j}: block {b} fetched at iteration {fi} "
+                f"after its first out visit {first_visit.get(b)} — "
+                f"donation hazard")
+
+
+# -------------------------------------------------------------------------
+# chunk runner / carry / flight recorder integration
+# -------------------------------------------------------------------------
+
+def test_tb_multi_chunk_odd_chunks_carry():
+    """Odd-length chunks run blocked passes + the single-step tail
+    INSIDE one compiled chunk; several such chunks must compose to the
+    same answer as one even scan."""
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez", position=(8, 8, 8)))
+    one = Simulation(cfg)
+    one.advance(6)
+    many = Simulation(cfg)
+    many.advance(3)   # 1 blocked + 1 tail
+    _ = many.state["E"]["Ez"]      # force an unpack between chunks
+    many.advance(3)   # odd again (re-uses the compiled length)
+    assert many.step_kind == "pallas_packed_tb"
+    assert one.t == many.t == 6
+    a = np.asarray(one.field("Ez"))
+    b = np.asarray(many.field("Ez"))
+    assert np.abs(a - b).max() / (np.abs(a).max() + 1e-30) < 2e-6
+
+
+@pytest.mark.slow
+def test_tb_checkpoint_roundtrip():
+    """Bit-exact resume across the tb carry; the tile-dependent unpack
+    it depends on is covered in tier-1 by
+    test_tb_multi_chunk_odd_chunks_carry (tier-1 wall budget)."""
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez", position=(8, 8, 8)))
+    import tempfile
+    sim = Simulation(cfg)
+    sim.advance(4)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "ck.npz")
+        sim.checkpoint(path)
+        sim.advance(4)
+        ref = np.asarray(sim.field("Ez"))
+        res = Simulation(cfg)
+        res.restore(path)
+        assert res.t == 4
+        res.advance(4)
+        got = np.asarray(res.field("Ez"))
+    assert np.abs(ref - got).max() == 0.0   # bit-exact resume
+
+
+def test_tb_health_counters_unpack_blocked_carry(tmp_path):
+    """The flight recorder's in-graph health counters must unpack the
+    tb packed carry (telemetry satellite): finite energy per chunk,
+    matching the jnp run's counters, odd chunk included."""
+    from fdtd3d_tpu import telemetry
+
+    def run(up):
+        cfg = SimConfig(
+            **BASE, use_pallas=up, pml=PmlConfig(size=(3, 3, 3)),
+            point_source=PointSourceConfig(enabled=True, component="Ez",
+                                           position=(8, 8, 8)),
+            output=OutputConfig(
+                telemetry_path=str(tmp_path / f"t_{up}.jsonl"),
+                check_finite=True))
+        sim = Simulation(cfg)
+        sim.advance(5)   # odd: blocked passes + tail inside the chunk
+        sim.close_telemetry()
+        return sim, telemetry.read_jsonl(cfg.output.telemetry_path)
+
+    sim_p, recs_p = run(True)
+    assert sim_p.step_kind == "pallas_packed_tb"
+    sim_j, recs_j = run(False)
+    chunks_p = [r for r in recs_p if r["type"] == "chunk"]
+    chunks_j = [r for r in recs_j if r["type"] == "chunk"]
+    assert [c["t"] for c in chunks_p] == [5]
+    for cp, cj in zip(chunks_p, chunks_j):
+        assert cp["finite"] is True
+        assert cp["energy"] == pytest.approx(cj["energy"], rel=1e-4)
+        assert cp["max_e"] == pytest.approx(cj["max_e"], rel=1e-4)
+
+
+def test_tb_vmem_ladder_downgrade_to_packed(monkeypatch):
+    """A VMEM-ladder rebuild that falls out of tb scope down to the
+    single-step packed kernel is SOUND (same packed-carry family,
+    re-packed through the dict form) and must keep the run alive."""
+    from fdtd3d_tpu import solver
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)))
+    sim = Simulation(cfg)
+    assert sim.step_kind == "pallas_packed_tb"
+    _seed_fields(sim, seed=3)
+    sim.advance(2)   # materialize the packed carry
+
+    real = solver.make_chunk_runner
+
+    def forced_packed(static, mesh_axes=None, mesh_shape=None,
+                      health=False):
+        saved = os.environ.get("FDTD3D_NO_TEMPORAL")
+        os.environ["FDTD3D_NO_TEMPORAL"] = "1"
+        try:
+            return real(static, mesh_axes, mesh_shape, health=health)
+        finally:
+            if saved is None:
+                os.environ.pop("FDTD3D_NO_TEMPORAL", None)
+            else:
+                os.environ["FDTD3D_NO_TEMPORAL"] = saved
+
+    monkeypatch.setattr(solver, "make_chunk_runner", forced_packed)
+    sim.step_diag = dict(sim.step_diag, tile={"EH": 99})
+    sim._vmem_fallback(RuntimeError("mosaic vmem overflow (simulated)"))
+    assert sim.step_kind == "pallas_packed"
+    sim.advance(6)
+
+    ref = Simulation(cfg.__class__(**dict(BASE, use_pallas=False,
+                                          pml=PmlConfig(size=(3, 3, 3)))))
+    _seed_fields(ref, seed=3)
+    ref.advance(8)
+    for c in ("Ez", "Hy"):
+        a = np.asarray(ref.field(c), np.float32)
+        b = np.asarray(sim.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-6, f"{c}: rel {rel:.2e}"

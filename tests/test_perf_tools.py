@@ -236,6 +236,58 @@ def test_sentinel_history_beats_best():
     assert v["paths"]["float32x2"]["verdict"] == "REGRESSION"
 
 
+def test_sentinel_tb_paths_registered():
+    """Round-8 satellite: the temporal-blocked kernel is a first-class
+    sentinel path (f32_packed_tb / bf16_tb), referenced from the r*
+    history (the r10 fixture round carries tb keys) and window- and
+    grid-normalized like every other path."""
+    ps = _sentinel()
+    cur = dict(CUR_OK, tb_mcells=15100.0, tb_n=640,
+               tb_bf16_mcells=26100.0, tb_bf16_n=768)
+    v = ps.check_artifact(cur, _best(), _history())
+    assert v["paths"]["f32_packed_tb"]["verdict"] == "OK"
+    assert v["paths"]["bf16_tb"]["verdict"] == "OK"
+    assert v["paths"]["f32_packed_tb"]["reference"] == 15000.0
+    # a >10% tb drop at the same window calibration regresses
+    v = ps.check_artifact(dict(cur, tb_mcells=13000.0),
+                          _best(), _history())
+    assert v["paths"]["f32_packed_tb"]["verdict"] == "REGRESSION"
+    assert any("f32_packed_tb" in m for m in v["regressions"])
+    # a smaller measured grid than the reference's is amortization gap
+    v = ps.check_artifact(dict(cur, tb_mcells=5000.0, tb_n=256),
+                          _best(), _history())
+    assert v["paths"]["f32_packed_tb"]["verdict"] == "INCONCLUSIVE"
+    # a window where stage 3c never produced a number: NOT-MEASURED,
+    # never a phantom regression
+    v = ps.check_artifact(CUR_OK, _best(), _history())
+    assert v["paths"]["f32_packed_tb"]["verdict"] == "NOT-MEASURED"
+    assert v["status"] == "OK"
+
+
+def test_sentinel_tb_ledger_diff():
+    """Round-8 satellite: the ledger_tb fixture pair catches a blocked-
+    kernel per-section bytes regression chip-free."""
+    ps = _sentinel()
+    with open(os.path.join(FIX, "ledger_tb_ref.json")) as f:
+        ref = json.load(f)
+    with open(os.path.join(FIX, "ledger_tb_regressed.json")) as f:
+        cur = json.load(f)
+    assert ps.check_ledgers(ref, ref)["status"] == "OK"
+    v = ps.check_ledgers(cur, ref)
+    assert v["status"] == "REGRESSION"
+    assert any("packed-kernel-tb" in m for m in v["regressions"])
+    # tb ledgers never diff against single-step packed ones (the whole
+    # point is the per-step halving; a cross-kind diff would "regress")
+    with open(os.path.join(FIX, "ledger_ref.json")) as f:
+        pk_ref = json.load(f)
+    assert ps.check_ledgers(ref, pk_ref)["status"] == "SKIPPED"
+    # and the fixture pair encodes the roofline claim itself: the tb
+    # reference's per-step bytes/cell sit at ~half the packed ref's
+    ratio = ref["per_step"]["bytes_per_cell"] \
+        / pk_ref["per_step"]["bytes_per_cell"]
+    assert ratio <= 0.55, ratio
+
+
 def test_sentinel_ledger_diff():
     ps = _sentinel()
     with open(os.path.join(FIX, "ledger_ref.json")) as f:
@@ -351,7 +403,9 @@ def test_bench_profile_env_plumbs_profile_dir(monkeypatch, tmp_path):
     import inspect
 
     import bench
-    src = inspect.getsource(bench.measure)
+    # _measure is the stage body (measure is the round-8 wrapper that
+    # pins FDTD3D_NO_TEMPORAL for the legacy packed stages)
+    src = inspect.getsource(bench._measure)
     assert "FDTD3D_BENCH_PROFILE" in src and "profile_dir" in src
     assert "sim.close()" in src
 

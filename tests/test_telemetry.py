@@ -174,12 +174,14 @@ def test_nan_tripwire_jnp(tmp_path):
 
 def test_nan_tripwire_packed_pallas():
     """ISSUE 2 satellite: inject a NaN mid-run on the PACKED path and
-    assert the in-graph flag trips with the chunk + step bound."""
+    assert the in-graph flag trips with the chunk + step bound. Since
+    round 8 the sourceless packed hot path is the temporal-blocked
+    kernel — the tripwire must unpack ITS carry in-graph too."""
     cfg = SimConfig(
         **BASE3D, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
         output=OutputConfig(check_finite=True))
     sim = Simulation(cfg)
-    assert sim.step_kind == "pallas_packed", sim.step_kind
+    assert sim.step_kind == "pallas_packed_tb", sim.step_kind
     assert sim._runner_health is True
     _nan_trip(sim)
 

@@ -46,6 +46,11 @@ PATHS = {
                                      "pallas_mcells")),
     "jnp": ("jnp_mcells", ("jnp_mcells",)),
     "bf16": ("bf16_mcells", ("bf16_mcells",)),
+    # round-8 temporal-blocked kernel (two steps per HBM pass): its own
+    # first-class paths — the single-step stages pin FDTD3D_NO_TEMPORAL
+    # so neither history pollutes the other's reference
+    "f32_packed_tb": ("tb_mcells", ("tb_mcells",)),
+    "bf16_tb": ("tb_bf16_mcells", ("tb_bf16_mcells",)),
     "float32x2": ("float32x2_mcells", ("float32x2_mcells",)),
 }
 
@@ -59,6 +64,8 @@ PATH_N_KEYS = {
     "f32_packed": ("f32_n",),
     "jnp": ("f32_n",),          # jnp stages share the f32 grid ladder
     "bf16": ("bf16_n", "n"),
+    "f32_packed_tb": ("tb_n",),
+    "bf16_tb": ("tb_bf16_n",),
     "float32x2": ("float32x2_n",),
 }
 
