@@ -47,6 +47,13 @@ ATTEMPT_TIMEOUT_S = 2400
 import contextlib
 
 
+class StageRequirementError(Exception):
+    """A stage's require_kind precondition failed — deterministic, so
+    the supervised-stage bounded retry must NOT re-run it (it is not in
+    supervisor.TRANSIENT_ERRORS); the caller's grid-size ladder handles
+    it like any other failed attempt."""
+
+
 @contextlib.contextmanager
 def _no_temporal(flag: bool):
     """Pin FDTD3D_NO_TEMPORAL=1 for one stage: the legacy f32/bf16
@@ -132,6 +139,13 @@ def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
             if prof_root else None),
     )
     sim = Simulation(cfg)
+    # SIGTERM-style durability (ISSUE 5 satellite): a killed bench
+    # child still finalizes the telemetry run_end record and the trace
+    # capture — close() is idempotent, so the finally below and this
+    # atexit hook compose.
+    import atexit
+    _close = sim.close
+    atexit.register(_close)
     snk = sim.telemetry
     # suppress the warm-up chunk's telemetry record (first tunnel
     # dispatch + executable upload is orders slower): it would sit in
@@ -144,7 +158,7 @@ def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
             # be reported as the kernel's number — raise so the
             # caller's grid-size ladder treats it like any other
             # failed attempt
-            raise RuntimeError(
+            raise StageRequirementError(
                 f"stage requires step_kind {require_kind}, got "
                 f"{sim.step_kind}")
         # Warm up: compile AND force one real device->host readback
@@ -191,6 +205,7 @@ def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
         if sim.telemetry is None:
             sim.telemetry = snk
         sim.close()
+        atexit.unregister(_close)
 
 
 def probe_hbm_gbps() -> float:
@@ -443,6 +458,31 @@ def run_measurement() -> None:
     """Child-process entry: measure both paths, print the one JSON line."""
     import jax
 
+    # SIGTERM -> SystemExit so the finally/atexit finalizers run (the
+    # telemetry run_end record survives a driver-side kill)
+    import signal
+    try:
+        signal.signal(signal.SIGTERM, lambda _s, _f: sys.exit(143))
+    except (ValueError, OSError):
+        pass
+
+    # Durable-stage wrapper (ISSUE 5 satellite): every measurement
+    # stage runs under the supervisor's bounded retry, and the per-
+    # stage verdict (attempts/ok/errors) is embedded in the artifact —
+    # one transient device error no longer voids an entire bench
+    # window's JSON contract, and a retried stage says so.
+    from fdtd3d_tpu import supervisor as _sup
+    stage_supervision = {}
+    _policy = _sup.RetryPolicy(max_retries=1, backoff_base_s=5.0,
+                               backoff_max_s=5.0)
+
+    def sup_measure(tag, *a, **kw):
+        rec = {}
+        stage_supervision[tag] = rec
+        return _sup.run_with_retry(lambda: measure(*a, **kw),
+                                   policy=_policy, label=tag,
+                                   record=rec)
+
     platform = jax.default_backend()
     on_tpu = platform in ("tpu", "axon")
     try:
@@ -478,13 +518,15 @@ def run_measurement() -> None:
         n, steps = 64, 10
     t_stage1 = time.time()
     jnp_stats, f32_stats, bf16_stats, ds_stats = {}, {}, {}, {}
-    jnp_mc = measure(n, steps, use_pallas=False, stats=jnp_stats)
+    jnp_mc = sup_measure("s1_jnp", n, steps, use_pallas=False,
+                         stats=jnp_stats)
     # no_temporal=True on every legacy packed stage: these numbers feed
     # BENCH_BEST and the sentinel's f32_packed/bf16 references, so they
     # must keep measuring the round-6 single-step kernel; the round-8
     # temporal-blocked kernel gets its own stage (3c) below.
-    pallas_mc = measure(n, steps, use_pallas=True, no_temporal=True,
-                        stats=f32_stats) if on_tpu else 0.0
+    pallas_mc = sup_measure("s1_pallas", n, steps, use_pallas=True,
+                            no_temporal=True,
+                            stats=f32_stats) if on_tpu else 0.0
     stage1_s = time.time() - t_stage1
     # Stage 2: the 256^3 pallas timing itself is the 512^3 go/no-go —
     # a direct measurement of THIS window's speed, unlike the HBM probe.
@@ -497,11 +539,13 @@ def run_measurement() -> None:
     if on_tpu and pallas_mc >= GATE_MCELLS_512 and \
             stage1_s < STAGE1_BUDGET_S:
         try:
-            jnp_512 = measure(512, 30, use_pallas=False,
-                              stats=jnp_stats)
+            jnp_512 = sup_measure("s2_jnp_512", 512, 30,
+                                  use_pallas=False, stats=jnp_stats)
             try:
-                pallas_512 = measure(512, 90, use_pallas=True,
-                                     no_temporal=True, stats=f32_stats)
+                pallas_512 = sup_measure("s2_pallas_512", 512, 90,
+                                         use_pallas=True,
+                                         no_temporal=True,
+                                         stats=f32_stats)
             except Exception:
                 # retry ladder: two-pass at the raised budget (unless
                 # the caller pinned one), then two-pass at the default
@@ -514,14 +558,16 @@ def run_measurement() -> None:
                     if saved["FDTD3D_VMEM_BUDGET_MB"] is None:
                         os.environ["FDTD3D_VMEM_BUDGET_MB"] = "86"
                     try:
-                        pallas_512 = measure(512, 90, use_pallas=True,
-                                             no_temporal=True,
-                                             stats=f32_stats)
+                        pallas_512 = sup_measure(
+                            "s2_pallas_512_twopass", 512, 90,
+                            use_pallas=True, no_temporal=True,
+                            stats=f32_stats)
                     except Exception:
                         os.environ.pop("FDTD3D_VMEM_BUDGET_MB", None)
-                        pallas_512 = measure(512, 90, use_pallas=True,
-                                             no_temporal=True,
-                                             stats=f32_stats)
+                        pallas_512 = sup_measure(
+                            "s2_pallas_512_twopass_default", 512, 90,
+                            use_pallas=True, no_temporal=True,
+                            stats=f32_stats)
                 finally:
                     for k, v in saved.items():
                         if v is None:
@@ -543,8 +589,9 @@ def run_measurement() -> None:
     if on_tpu and pallas_mc >= GATE_MCELLS_512:
         if n >= 512:
             try:
-                f32_640 = measure(640, 120, use_pallas=True,
-                                  no_temporal=True, stats=f32_stats)
+                f32_640 = sup_measure("s3_f32_640", 640, 120,
+                                      use_pallas=True,
+                                      no_temporal=True, stats=f32_stats)
                 if f32_640 > pallas_mc:
                     pallas_mc, n = f32_640, 640
             except Exception as e:
@@ -556,9 +603,11 @@ def run_measurement() -> None:
                 # same-window 768^3 bf16 13849 (120) vs 13488 (60) —
                 # the fixed ~180 ms round-trip tax is still ~3 ms/step
                 # at 60; session-3 close-out, 2026-07-31
-                bf16_mc = measure(bn, 90 if bn == 512 else 120,
-                                  use_pallas=True, dtype="bfloat16",
-                                  no_temporal=True, stats=bf16_stats)
+                bf16_mc = sup_measure(f"s3_bf16_{bn}", bn,
+                                      90 if bn == 512 else 120,
+                                      use_pallas=True, dtype="bfloat16",
+                                      no_temporal=True,
+                                      stats=bf16_stats)
                 bf16_n = bn
                 break
             except Exception as e:
@@ -577,17 +626,20 @@ def run_measurement() -> None:
     tb_stats, tb_bf16_stats = {}, {}
     if on_tpu and pallas_mc >= GATE_MCELLS_512:
         try:
-            tb_mc = measure(n, 90 if n >= 512 else 120, use_pallas=True,
-                            require_kind="pallas_packed_tb",
-                            stats=tb_stats)
+            tb_mc = sup_measure("s3c_tb_f32", n,
+                                90 if n >= 512 else 120,
+                                use_pallas=True,
+                                require_kind="pallas_packed_tb",
+                                stats=tb_stats)
             tb_n = n
         except Exception as e:
             print(f"stage3c tb f32 {n} failed: {e!r:.300}",
                   file=sys.stderr, flush=True)
         if bf16_n:
             try:
-                tb_bf16_mc = measure(
-                    bf16_n, 90 if bf16_n == 512 else 120,
+                tb_bf16_mc = sup_measure(
+                    "s3c_tb_bf16", bf16_n,
+                    90 if bf16_n == 512 else 120,
                     use_pallas=True, dtype="bfloat16",
                     require_kind="pallas_packed_tb",
                     stats=tb_bf16_stats)
@@ -608,10 +660,11 @@ def run_measurement() -> None:
         # grid's overhead amortization no longer wins)
         for dn in (384, 448, 256):
             try:
-                ds_mc = measure(dn, 60, use_pallas=True,
-                                dtype="float32x2",
-                                require_kind="pallas_packed_ds",
-                                stats=ds_stats)
+                ds_mc = sup_measure(f"s4_float32x2_{dn}", dn, 60,
+                                    use_pallas=True,
+                                    dtype="float32x2",
+                                    require_kind="pallas_packed_ds",
+                                    stats=ds_stats)
                 ds_n = dn
                 break
             except Exception as e:
@@ -662,6 +715,11 @@ def run_measurement() -> None:
         "float32x2_n": ds_n,
         "hbm_probe_gbps": gbps,
         "platform": platform,
+        # Durable-stage verdicts (supervisor.run_with_retry): per-stage
+        # attempts/ok/errors, so a retried or degraded stage is visible
+        # in the very artifact the driver records — a transient device
+        # error no longer voids the JSON contract silently.
+        "stage_supervision": stage_supervision,
         # Per-chunk Mcells/s percentiles (StepClock.summary) of the
         # last successful stage per dtype: the in-run variance a single
         # best-of-repeats number hides (tunnel throttling mid-stage

@@ -173,7 +173,18 @@ def build_parser() -> argparse.ArgumentParser:
                    default="npz",
                    help="npz: rank-0 single file; orbax: sharding-aware "
                         "per-host shard writes (large/multi-host runs)")
+    g.add_argument("--checkpoint-keep", type=int, default=3,
+                   help="keep-K rotation for --checkpoint-every: only "
+                        "the newest K committed snapshots stay on disk "
+                        "(0 = keep all)")
     g.add_argument("--load-checkpoint", metavar="PATH", default=None)
+    g.add_argument("--resume", metavar="auto|PATH", default=None,
+                   help="resume a killed/preempted run from a COMMITTED "
+                        "checkpoint and finish the remaining steps: "
+                        "'auto' picks the newest committed snapshot in "
+                        "--save-dir (snapshots failing their integrity "
+                        "checks are skipped with a warning), or give an "
+                        "explicit path (docs/ROBUSTNESS.md runbook)")
     g.add_argument("--norms-every", type=int, default=0,
                    help="print field norms every N steps")
     g.add_argument("--metrics-every", type=int, default=0,
@@ -206,6 +217,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "wall time, run provenance, VMEM-ladder events) "
                         "to PATH; summarize with "
                         "tools/telemetry_report.py")
+
+    g = p.add_argument_group("durability (docs/ROBUSTNESS.md)")
+    g.add_argument("--supervise", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="run under the durable-run supervisor: bounded "
+                        "retry with exponential backoff for transient "
+                        "device errors; on a NaN/Inf health trip, roll "
+                        "back to the last committed checkpoint and "
+                        "resume down the kernel degradation ladder "
+                        "(implies --check-finite)")
 
     g = p.add_argument_group("planning")
     g.add_argument("--dry-run", action=argparse.BooleanOptionalAction, default=False,
@@ -341,6 +362,7 @@ def args_to_config(args) -> SimConfig:
             save_materials=args.save_materials,
             checkpoint_every=args.checkpoint_every,
             checkpoint_backend=args.checkpoint_backend,
+            checkpoint_keep=args.checkpoint_keep,
             norms_every=args.norms_every, metrics_every=args.metrics_every,
             log_level=args.log_level,
             profile=bool(args.profile), check_finite=args.check_finite,
@@ -391,7 +413,9 @@ def save_cmd_file(args, path: str):
     (NTFF cadence) are resolved first: a file saved under today's
     defaults must replay identically even if a default or formula
     changes in a later version (the reference re-emits the full
-    effective settings the same way).
+    effective settings the same way). Written crash-safely
+    (io.atomic_open): a kill mid-save must not leave a half command
+    file that would replay as a different run.
     """
     if args.ntff:
         freq, every, start = resolve_ntff_cadence(args_to_config(args))
@@ -425,7 +449,8 @@ def save_cmd_file(args, path: str):
                     lines.append(neg)
         else:
             lines.append(f"{opt} {val}")
-    with open(path, "w") as f:
+    from fdtd3d_tpu.io import atomic_open
+    with atomic_open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
 
 
@@ -445,7 +470,8 @@ def write_ntff_pattern(col, cfg) -> str:
         pattern = pattern / peak
     os.makedirs(cfg.output.save_dir, exist_ok=True)
     path = os.path.join(cfg.output.save_dir, "ntff_pattern.txt")
-    with open(path, "w") as f:
+    from fdtd3d_tpu.io import atomic_open
+    with atomic_open(path, "w") as f:
         f.write("# theta_deg phi_deg directivity(normalized)\n")
         for i, th in enumerate(thetas):
             for j, ph in enumerate(phis):
@@ -492,21 +518,90 @@ def main(argv: Optional[List[str]] = None) -> int:
                                num_processes=args.num_processes,
                                process_id=args.process_id)
 
+    if args.supervise:
+        # the supervisor consumes the in-graph tripwire: force it on
+        args.check_finite = True
     cfg = args_to_config(args)
     from fdtd3d_tpu import io
-    from fdtd3d_tpu.log import log, set_level
+    from fdtd3d_tpu.log import log, set_level, warn
     from fdtd3d_tpu.sim import Simulation  # deferred: jax init is slow
     set_level(cfg.output.log_level)
     sim = Simulation(cfg)
+    sup = None  # durable-run supervisor (--supervise); may REPLACE sim
+
+    def _current_sim():
+        # after a ladder degrade the supervisor's sim replaces the
+        # original — every finalizer must resolve the live one
+        return sup.sim if (sup is not None and sup.sim is not None) \
+            else sim
+
+    def _finalize():
+        _current_sim().close()   # idempotent
+
+    # Durability of the observability lanes (docs/ROBUSTNESS.md): the
+    # try/finally below covers in-process exits; atexit + a SIGTERM ->
+    # SystemExit handler extend the same guarantee to signal-style
+    # kills, so the telemetry run_end record and the device-trace
+    # finalization survive them too.
+    import atexit
+    import signal
+    atexit.register(_finalize)
+    try:
+        signal.signal(signal.SIGTERM,
+                      lambda _sig, _frm: sys.exit(143))
+    except (ValueError, OSError):  # pragma: no cover - non-main thread
+        pass
     # ONE try/finally from construction (which opens the telemetry
     # sink and writes run_start) to the end: EVERY exit — config
     # errors before the run, a NaN blow-up's FloatingPointError
     # mid-run, IO failures after it — must end the recording with
     # its run_end record (first_unhealthy_t) and release the fd.
     try:
+        if args.resume and args.load_checkpoint:
+            raise SystemExit(
+                "--resume and --load-checkpoint are mutually exclusive")
         if args.load_checkpoint:
             sim.restore(args.load_checkpoint)
             log(f"restored checkpoint {args.load_checkpoint} at t={sim.t}")
+        if args.resume:
+            if args.resume == "auto":
+                found = io.find_checkpoints(cfg.output.save_dir)
+                if not found:
+                    raise SystemExit(
+                        f"--resume auto: no committed checkpoint in "
+                        f"{cfg.output.save_dir!r} (cadence runs write "
+                        f"ckpt_tNNNNNN snapshots there; see "
+                        f"docs/ROBUSTNESS.md)")
+                for _t_ck, cand in found:
+                    if _t_ck > cfg.time_steps:
+                        # a previous LONGER same-config run's leftover
+                        # passes every meta guard (time_steps is not
+                        # in the meta) and would "finish" this run
+                        # instantly from the old run's state
+                        warn(f"skipping {cand}: t={_t_ck} is past "
+                             f"this run's horizon ({cfg.time_steps})")
+                        continue
+                    # ValueError too: a stale snapshot from an earlier
+                    # run (other size/topology/dtype/carry family)
+                    # fails the _check_ckpt_meta guards — skip it like
+                    # a corrupt one, per the --resume help contract
+                    try:
+                        sim.restore(cand)
+                        log(f"resumed from {cand} at t={sim.t}")
+                        break
+                    except (io.CheckpointCorrupt, ValueError) as exc:
+                        warn(f"skipping unusable checkpoint: {exc}")
+                else:
+                    raise SystemExit(
+                        "--resume auto: no usable committed checkpoint "
+                        "(every candidate was corrupt, incompatible, "
+                        "or past this run's horizon)")
+            else:
+                try:
+                    sim.restore(args.resume)
+                except (io.CheckpointCorrupt, ValueError) as exc:
+                    raise SystemExit(f"--resume: {exc}")
+                log(f"resumed from {args.resume} at t={sim.t}")
         if cfg.output.save_materials:
             io.write_materials(sim)
         import jax
@@ -559,6 +654,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fdtd3d_tpu import telemetry as _telemetry
 
         def on_interval(s):
+            if ntff_col is not None and ntff_col.sim is not s:
+                # a supervisor ladder degrade replaced the Simulation:
+                # the collector must read the LIVE one (same grid, dt
+                # and box — the degraded cfg differs only in kernel
+                # dispatch), not the stale pre-trip fields
+                ntff_col.sim = s
             if ntff_col is not None and s.t >= ntff_start and \
                     s.t % ntff_every == 0:
                 with _telemetry.span("ntff-sample"):
@@ -584,26 +685,32 @@ def main(argv: Optional[List[str]] = None) -> int:
             if cfg.output.save_res and s.t % cfg.output.save_res == 0:
                 with _telemetry.span("io-dump"):
                     io.write_outputs(s, s.t)
-            if cfg.output.checkpoint_every and \
-                    s.t % cfg.output.checkpoint_every == 0:
-                import os
-                os.makedirs(cfg.output.save_dir, exist_ok=True)
-                ext = ".npz" if cfg.output.checkpoint_backend == "npz" else ""
-                with _telemetry.span("checkpoint"):
-                    s.checkpoint(os.path.join(cfg.output.save_dir,
-                                              f"ckpt_t{s.t:06d}{ext}"),
-                                 backend=cfg.output.checkpoint_backend)
+            # (checkpoint cadence moved INTO Simulation.advance —
+            # crash-safe keep-K rotation aligned to chunk boundaries;
+            # the gcd interval above still includes checkpoint_every so
+            # chunks land exactly on the cadence multiples)
 
-        # After a checkpoint restore, run only the REMAINING steps so the
-        # resumed run ends at the same t as the uninterrupted one.
+        # After a checkpoint restore (--load-checkpoint / --resume),
+        # run only the REMAINING steps so the resumed run ends at the
+        # same t as the uninterrupted one.
         # (The device-trace lane — --profile DIR / --trace — is wired
         # through Simulation: capture starts at the first advance and
         # the finally below finalizes it on EVERY exit.)
-        remaining = max(0, cfg.time_steps - sim.t) if args.load_checkpoint \
-            else cfg.time_steps
-        sim.run(time_steps=remaining,
-                on_interval=on_interval if interval else None,
-                interval=interval)
+        remaining = max(0, cfg.time_steps - sim.t) \
+            if (args.load_checkpoint or args.resume) else cfg.time_steps
+        if args.supervise:
+            # Supervisor.run takes the ABSOLUTE horizon (it tracks its
+            # own progress across rollbacks); max() keeps an
+            # already-finished resume a no-op.
+            from fdtd3d_tpu.supervisor import Supervisor
+            sup = Supervisor(sim=sim)
+            sim = sup.run(time_steps=max(cfg.time_steps, sim.t),
+                          on_interval=on_interval if interval else None,
+                          interval=interval)
+        else:
+            sim.run(time_steps=remaining,
+                    on_interval=on_interval if interval else None,
+                    interval=interval)
         sim.block_until_ready()
         if ntff_col is not None:
             if ntff_col.n_samples > 0:
@@ -624,6 +731,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         mcps = cells * cfg.time_steps / dt_wall / 1e6
         if sim.clock is not None:
             log(f"profile: {sim.clock.report()}")
+        if sup is not None and (sup.retries or sup.rollbacks
+                                or sup.degrades):
+            log(f"supervisor: {sup.retries} retries, "
+                f"{sup.rollbacks} rollbacks, {sup.degrades} ladder "
+                f"degrades (now {sim.step_kind})")
         log(f"done: {cfg.time_steps} steps in {dt_wall:.2f}s "
             f"({mcps:.1f} Mcells/s)")
         return 0
@@ -631,11 +743,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # finalizes BOTH observability lanes on every exit: the
         # device-trace capture (a crash mid-capture must still leave a
         # parseable trace directory, never a partial artifact) and the
-        # telemetry sink's run_end record.
-        n_rec = sim.telemetry.n_records if sim.telemetry is not None \
+        # telemetry sink's run_end record. The current sim may be a
+        # supervisor ladder replacement of the one built above.
+        cur = _current_sim()
+        n_rec = cur.telemetry.n_records if cur.telemetry is not None \
             else 0
-        sim.close()
-        if sim.telemetry is not None:
+        cur.close()
+        atexit.unregister(_finalize)
+        if cur.telemetry is not None:
             log(f"telemetry: {n_rec + 1} records -> "
                 f"{cfg.output.telemetry_path}")
 

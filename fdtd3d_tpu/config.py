@@ -196,6 +196,12 @@ class OutputConfig:
     # "npz": rank-0 gathers and writes one file; "orbax": sharding-aware,
     # every host writes its own shards (large/multi-host runs)
     checkpoint_backend: str = "npz"
+    # keep-K rotation for the checkpoint_every cadence: after each
+    # cadence snapshot commits, only the newest K stay on disk
+    # (0 = keep all). Snapshots are written crash-safely (io.atomic_open)
+    # and named ckpt_tNNNNNN[.npz] in save_dir; resume with the CLI's
+    # --resume auto (io.find_latest_checkpoint).
+    checkpoint_keep: int = 3
     norms_every: int = 0           # print L2/Linf norms every N steps
     # structured per-interval metrics (energy, norms, divergence
     # residual — diag.metrics) appended to save_dir/metrics.jsonl

@@ -505,7 +505,8 @@ def main(argv=None) -> int:
     if args.out:
         d = os.path.dirname(os.path.abspath(args.out))
         os.makedirs(d, exist_ok=True)
-        with open(args.out, "w") as f:
+        from fdtd3d_tpu.io import atomic_open
+        with atomic_open(args.out, "w") as f:
             f.write(txt + "\n")
     report(txt)
     return 0

@@ -1,0 +1,252 @@
+"""Deterministic fault-injection harness (docs/ROBUSTNESS.md §fault-plan).
+
+The durable-run layer (io.py atomic writes, the checkpoint cadence, and
+the supervisor's rollback/degrade ladder) is only trustworthy if every
+recovery path is *provable* end-to-end — so faults are injected as
+deterministic functions of the run itself (step counters, write
+counters), never wall clock or RNG. A **fault plan** is a small ordered
+list of one-shot faults parsed from a compact spec string, installed
+programmatically (``install``) or via ``FDTD3D_FAULT_PLAN`` in the
+environment (picked up once per process by ``Simulation.__init__``):
+
+    nan@t=8,field=Ez; preempt@t=16; fail_write@n=2; corrupt_ckpt@n=1
+
+Fault kinds:
+
+``nan@t=T[,field=COMP]``
+    Inject a single NaN into COMP at the first chunk boundary with
+    ``t >= T`` (between compiled chunks, after the auto-checkpoint
+    cadence — the snapshot at the same ``t`` stays clean). The next
+    chunk's in-graph health counters trip ``FloatingPointError``.
+``preempt@t=T``
+    Raise :class:`SimulatedPreemption` at the first chunk boundary with
+    ``t >= T`` — the stand-in for a preempted TPU window / SIGKILL.
+    It subclasses ``BaseException`` on purpose: generic
+    ``except Exception`` recovery paths must NOT swallow it, mirroring
+    a real kill.
+``error@t=T[,times=K]``
+    Raise :class:`InjectedTransientError` (a ``RuntimeError``) at chunk
+    boundaries with ``t >= T``, K times total — the deterministic
+    stand-in for a transient dispatch/runtime error the supervisor's
+    bounded retry must absorb.
+``fail_write@n=N``
+    The Nth write through the atomic writer (io.atomic_open /
+    io.atomic_publish, counted process-wide while a plan is active)
+    raises :class:`InjectedWriteError` BEFORE publish — proving the
+    target file is never half-written.
+``corrupt_ckpt@n=N[,mode=truncate|zero]``
+    After the Nth *committed* checkpoint, damage it on disk (truncate
+    the file / zero bytes mid-file; for an orbax directory, delete its
+    COMMIT marker) — proving the integrity checks catch it and resume
+    falls back to an older snapshot.
+
+All faults are one-shot (``times`` generalizes that for ``error``), so
+a rolled-back run does not re-fire them — exactly the semantics of a
+real single incident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+from fdtd3d_tpu import log as _log
+
+
+class SimulatedPreemption(BaseException):
+    """Simulated kill between chunks (fault plan ``preempt@t=T``).
+
+    BaseException, not Exception: recovery code that catches broad
+    ``Exception`` must not accidentally absorb a simulated kill — the
+    point of the fault is to END the process the way a preemption
+    would, leaving only committed checkpoints behind."""
+
+
+class InjectedTransientError(RuntimeError):
+    """Deterministic stand-in for a transient dispatch/runtime error."""
+
+
+class InjectedWriteError(OSError):
+    """The fault plan failed this write before it was published."""
+
+
+_KINDS = ("nan", "preempt", "error", "fail_write", "corrupt_ckpt")
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    t: int = 0            # step threshold (nan / preempt / error)
+    field: str = "Ez"     # target component (nan)
+    n: int = 0            # ordinal (fail_write: Nth write; corrupt_ckpt:
+    #                       Nth committed checkpoint)
+    times: int = 1        # firings before the fault is spent (error)
+    mode: str = "truncate"  # corrupt_ckpt damage mode: truncate | zero
+    fired: int = 0        # firings so far (one-shot bookkeeping)
+
+
+class FaultPlan:
+    """An ordered list of one-shot faults + the process-wide counters
+    the ordinal faults key on."""
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = list(faults)
+        self.write_count = 0   # atomic writes seen (fail_write)
+        self.ckpt_count = 0    # committed checkpoints seen (corrupt_ckpt)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """``kind@k=v,k=v; kind@...`` -> FaultPlan (docs/ROBUSTNESS.md)."""
+        faults = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            kind, _, rest = entry.partition("@")
+            kind = kind.strip()
+            if kind not in _KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in plan entry "
+                    f"{entry!r} (valid: {', '.join(_KINDS)})")
+            f = Fault(kind=kind)
+            for kv in rest.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                key, _, val = kv.partition("=")
+                key, val = key.strip(), val.strip()
+                if key in ("t", "n", "times"):
+                    try:
+                        setattr(f, key, int(val))
+                    except ValueError:
+                        raise ValueError(
+                            f"fault plan entry {entry!r}: {key} must be "
+                            f"an integer, got {val!r}")
+                elif key in ("field", "mode"):
+                    setattr(f, key, val)
+                else:
+                    raise ValueError(
+                        f"unknown fault-plan key {key!r} in {entry!r} "
+                        f"(valid: t, n, times, field, mode)")
+            if f.mode not in ("truncate", "zero"):
+                raise ValueError(
+                    f"fault plan entry {entry!r}: mode must be "
+                    f"truncate|zero, got {f.mode!r}")
+            faults.append(f)
+        return cls(faults)
+
+
+_PLAN: Optional[FaultPlan] = None
+
+
+def install(plan) -> FaultPlan:
+    """Install a plan (spec string or FaultPlan) process-wide."""
+    global _PLAN
+    _PLAN = FaultPlan.parse(plan) if isinstance(plan, str) else plan
+    return _PLAN
+
+
+def clear() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def load_env() -> Optional[FaultPlan]:
+    """Adopt ``FDTD3D_FAULT_PLAN`` once per process (Simulation calls
+    this at construction). A plan already installed wins — its fired
+    flags are the record that the incident already happened; re-parsing
+    the env would re-arm every fault on each new Simulation."""
+    spec = os.environ.get("FDTD3D_FAULT_PLAN")
+    if spec and _PLAN is None:
+        install(spec)
+        _log.warn(f"fault plan active (FDTD3D_FAULT_PLAN): {spec}")
+    return _PLAN
+
+
+# --------------------------------------------------------------------------
+# hooks (each a no-op when no plan is installed)
+# --------------------------------------------------------------------------
+
+
+def on_write(path: str) -> None:
+    """From io's atomic writers, immediately BEFORE publish: a
+    fail_write fault fires here, so the target is never touched."""
+    if _PLAN is None:
+        return
+    _PLAN.write_count += 1
+    for f in _PLAN.faults:
+        if f.kind == "fail_write" and not f.fired \
+                and _PLAN.write_count == f.n:
+            f.fired = 1
+            raise InjectedWriteError(
+                f"fault plan: atomic write #{f.n} ({path}) failed "
+                f"(injected)")
+
+
+def on_checkpoint(path: str) -> None:
+    """From Simulation.checkpoint, after a snapshot COMMITTED."""
+    if _PLAN is None:
+        return
+    _PLAN.ckpt_count += 1
+    for f in _PLAN.faults:
+        if f.kind == "corrupt_ckpt" and not f.fired \
+                and _PLAN.ckpt_count == f.n:
+            f.fired = 1
+            _damage(path, f.mode)
+
+
+def _damage(path: str, mode: str) -> None:
+    """Deliberately corrupt a committed checkpoint on disk."""
+    if os.path.isdir(path):  # orbax: un-commit it
+        from fdtd3d_tpu import io  # deferred: io imports this module
+        marker = os.path.join(path, io.ORBAX_COMMIT_MARKER)
+        if os.path.exists(marker):
+            os.remove(marker)
+        _log.warn(f"fault plan: removed COMMIT marker of {path}")
+        return
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        if mode == "zero":
+            fh.seek(size // 2)
+            fh.write(b"\0" * min(64, size - size // 2))
+        else:
+            fh.truncate(max(1, size // 2))
+    _log.warn(f"fault plan: corrupted checkpoint {path} ({mode})")
+
+
+def on_chunk_boundary(sim) -> None:
+    """From Simulation.advance, after each compiled chunk (and after
+    the auto-checkpoint cadence, so a snapshot at the same ``t`` is
+    clean): fires nan / error / preempt faults whose step threshold has
+    been reached."""
+    if _PLAN is None:
+        return
+    t = sim._t_host
+    for f in _PLAN.faults:
+        if f.kind == "nan" and not f.fired and t >= f.t:
+            f.fired = 1
+            _inject_nan(sim, f.field)
+        elif f.kind == "error" and f.fired < f.times and t >= f.t:
+            f.fired += 1
+            raise InjectedTransientError(
+                f"fault plan: injected transient error "
+                f"#{f.fired}/{f.times} at t={t}")
+        elif f.kind == "preempt" and not f.fired and t >= f.t:
+            f.fired = 1
+            raise SimulatedPreemption(
+                f"fault plan: simulated preemption at t={t}")
+
+
+def _inject_nan(sim, comp: str) -> None:
+    import numpy as np
+    group = "E" if comp[:1] == "E" else "H"
+    cur = np.array(sim.state[group][comp])
+    idx = tuple(s // 2 for s in cur.shape)
+    cur[idx] = np.nan
+    sim.set_field(comp, cur)
+    _log.warn(f"fault plan: injected NaN into {comp} at t={sim._t_host}")

@@ -15,19 +15,124 @@ and the DAT-as-checkpoint posture of §5.4:
 * checkpoint — one ``.npz`` of the ENTIRE solver state pytree (fields,
   CPML psi, Drude J, incident line, step counter), the orbax-free
   equivalent of the reference's save->load-from-DAT resume workflow.
+
+Durability contract (docs/ROBUSTNESS.md): EVERY file this package
+writes goes through the atomic writer (``atomic_open`` /
+``atomic_publish``: tmp file + fsync + ``os.replace``), so a crash
+mid-write can never leave a torn artifact under the final name —
+asserted structurally by tests/test_lint_atomic_write.py. Append-only
+JSONL sinks (telemetry, metrics) are the one sanctioned exception:
+each record is a single flushed line, and a torn tail line is
+tolerated by their readers. Checkpoints additionally carry a payload
+checksum + per-array manifest; readers raise :class:`CheckpointCorrupt`
+(naming the path and WHICH check failed) instead of a raw numpy/zip
+traceback, and orbax checkpoint directories require a COMMIT marker
+written only after the save fully finished.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import re
 import struct
+import zipfile
 import zlib
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from fdtd3d_tpu import _native
+from fdtd3d_tpu import faults as _faults
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint failed an integrity check.
+
+    The message names the path and WHICH check failed (zip/npz
+    structure, manifest, checksum, missing COMMIT marker). Resume paths
+    (CLI ``--resume auto``, the supervisor's rollback) catch this and
+    fall back to an older committed snapshot."""
+
+
+# ---------------------------------------------------------------------------
+# atomic writer — the one durable-write primitive
+# ---------------------------------------------------------------------------
+
+
+def _tmp_name(path: str) -> str:
+    return f"{path}.tmp.{os.getpid()}"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the parent directory so the rename itself is durable."""
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # pragma: no cover - platform without dir fsync
+        pass
+
+
+def _publish_tmp(path: str, tmp: str) -> None:
+    """The shared publish epilogue of both atomic primitives: fire the
+    fail-the-Nth-write fault hook BEFORE the rename (the final name
+    must never have been touched on an injected failure), then rename
+    into place and fsync the parent directory."""
+    _faults.on_write(path)
+    os.replace(tmp, path)
+    _fsync_dir(path)
+
+
+@contextlib.contextmanager
+def atomic_open(path: str, mode: str = "w"):
+    """Crash-safe whole-file write: tmp + flush + fsync + ``os.replace``.
+
+    The file appears under its final name fully written or not at all;
+    a crash (or an injected ``fail_write`` fault) mid-write leaves the
+    previous version intact and no debris under the final name. Modes:
+    'w'/'wb'/'x'/'xb' only — append-mode sinks don't rewrite and read
+    modes don't write."""
+    if any(c in mode for c in "ra+"):
+        raise ValueError(
+            f"atomic_open is for whole-file writes ('w'/'wb'/'x'), "
+            f"got mode {mode!r}")
+    tmp = _tmp_name(path)
+    try:
+        with open(tmp, mode) as fh:
+            yield fh
+            fh.flush()
+            os.fsync(fh.fileno())
+        _publish_tmp(path, tmp)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_publish(path: str, write_fn) -> None:
+    """Atomic publish for writers that need a real filesystem path
+    (the native C++ dumpers, ``ndarray.tofile``): ``write_fn(tmp)``
+    produces the complete file, which is then fsync'd and renamed into
+    place. Same crash contract as :func:`atomic_open`."""
+    tmp = _tmp_name(path)
+    try:
+        write_fn(tmp)
+        with open(tmp, "rb") as fh:
+            os.fsync(fh.fileno())
+        _publish_tmp(path, tmp)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 # ---------------------------------------------------------------------------
 # DAT
@@ -43,15 +148,19 @@ def dump_dat(arr: np.ndarray, path: str, step: Optional[int] = None):
     """
     arr = np.asarray(arr)
     le = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
-    if not _native.write_raw(path, le):
-        le.tofile(path)
+
+    def _write(tmp):
+        if not _native.write_raw(tmp, le):
+            le.tofile(tmp)
+
+    atomic_publish(path, _write)
     # record the dtype of the bytes actually written (little-endian) —
     # recording the source dtype breaks roundtrip for big-endian input.
     manifest = {"shape": list(arr.shape), "dtype": le.dtype.str,
                 "order": "C", "endian": "little"}
     if step is not None:
         manifest["step"] = int(step)
-    with open(path + ".manifest.json", "w") as f:
+    with atomic_open(path + ".manifest.json", "w") as f:
         json.dump(manifest, f)
 
 
@@ -81,16 +190,20 @@ def dump_txt(arr: np.ndarray, path: str):
     ~40x slower on 3D grids); formats are identical (%.9e).
     """
     arr = np.asarray(arr)
-    if _native.dump_txt(path, arr):
-        return
-    with open(path, "w") as f:
-        it = np.nditer(arr, flags=["multi_index"])
-        for v in it:
-            idx = " ".join(str(i) for i in it.multi_index)
-            if np.iscomplexobj(arr):
-                f.write(f"{idx} {v.real:.9e} {v.imag:.9e}\n")
-            else:
-                f.write(f"{idx} {float(v):.9e}\n")
+
+    def _write(tmp):
+        if _native.dump_txt(tmp, arr):
+            return
+        with open(tmp, "w") as f:
+            it = np.nditer(arr, flags=["multi_index"])
+            for v in it:
+                idx = " ".join(str(i) for i in it.multi_index)
+                if np.iscomplexobj(arr):
+                    f.write(f"{idx} {v.real:.9e} {v.imag:.9e}\n")
+                else:
+                    f.write(f"{idx} {float(v):.9e}\n")
+
+    atomic_publish(path, _write)
 
 
 def load_txt(path: str, shape: Tuple[int, ...],
@@ -173,10 +286,14 @@ def dump_bmp(arr: np.ndarray, path: str, active_axes=(0, 1)):
             cut = cut.T
         img = cut.T  # rows = axis b (vertical), cols = axis a
     rgb = colormap_diverging(img)
-    if _native.encode_bmp(path, rgb):
-        return
-    with open(path, "wb") as f:
-        f.write(_bmp_encode(rgb))
+
+    def _write(tmp):
+        if _native.encode_bmp(tmp, rgb):
+            return
+        with open(tmp, "wb") as f:
+            f.write(_bmp_encode(rgb))
+
+    atomic_publish(path, _write)
 
 
 def load_bmp_size(path: str) -> Tuple[int, int]:
@@ -254,29 +371,87 @@ def _flatten(prefix: str, tree, out: Dict[str, np.ndarray]):
         out[prefix] = arr
 
 
+def _state_checksum(flat: Dict[str, np.ndarray]) -> int:
+    """crc32 over every array's name + raw bytes, in sorted-key order."""
+    crc = 0
+    for key in sorted(flat):
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(flat[key]).tobytes(), crc)
+    return crc
+
+
 def save_checkpoint(state, path: str, extra: Optional[Dict] = None):
-    """Bit-exact .npz snapshot of the whole state pytree."""
+    """Bit-exact .npz snapshot of the whole state pytree.
+
+    Crash-safe: written through :func:`atomic_open` (a crash mid-write
+    leaves the previous snapshot intact — an .npz under its final name
+    is COMMITTED by construction). The metadata blob carries a payload
+    checksum (`_checksum`) and a per-array manifest (`_manifest`) that
+    :func:`load_checkpoint` verifies."""
     flat: Dict[str, np.ndarray] = {}
     _flatten("", state, flat)
-    meta = json.dumps(extra or {})
-    np.savez(path, __meta__=np.frombuffer(
-        zlib.compress(meta.encode()), dtype=np.uint8), **flat)
+    meta = dict(extra or {})
+    meta["_manifest"] = {k: [list(v.shape), v.dtype.str]
+                         for k, v in flat.items()}
+    meta["_checksum"] = _state_checksum(flat)
+    blob = json.dumps(meta)
+    with atomic_open(path, "wb") as f:
+        # np.savez on a file OBJECT: no implicit ".npz" suffix games,
+        # and the bytes land in the atomic writer's tmp file
+        np.savez(f, __meta__=np.frombuffer(
+            zlib.compress(blob.encode()), dtype=np.uint8), **flat)
 
 
-def load_checkpoint(path: str) -> Tuple[Dict, Dict]:
-    """-> (state pytree of numpy arrays, extra metadata dict)."""
-    with np.load(path, allow_pickle=False) as z:
-        extra = {}
-        state: Dict = {}
-        for key in z.files:
-            if key == "__meta__":
-                extra = json.loads(zlib.decompress(z[key].tobytes()))
-                continue
-            parts = key.split("/")
-            node = state
-            for p in parts[:-1]:
-                node = node.setdefault(p, {})
-            node[parts[-1]] = z[key]
+def load_checkpoint(path: str, verify: bool = True) -> Tuple[Dict, Dict]:
+    """-> (state pytree of numpy arrays, extra metadata dict).
+
+    Integrity: a truncated/corrupt .npz, a manifest mismatch, or a
+    payload-checksum failure raises :class:`CheckpointCorrupt` naming
+    the path and the failed check — never a raw numpy/zipfile
+    traceback. Checkpoints written before the checksum era (no
+    `_checksum`/`_manifest` keys) load without those checks."""
+    flat: Dict[str, np.ndarray] = {}
+    extra: Dict = {}
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            for key in z.files:
+                if key == "__meta__":
+                    extra = json.loads(zlib.decompress(z[key].tobytes()))
+                    continue
+                flat[key] = z[key]
+    except (zipfile.BadZipFile, ValueError, OSError, EOFError,
+            KeyError, zlib.error, json.JSONDecodeError) as exc:
+        raise CheckpointCorrupt(
+            f"{path}: unreadable checkpoint (npz/zip structure check "
+            f"failed: {type(exc).__name__}: {exc})") from exc
+    manifest = extra.pop("_manifest", None)
+    checksum = extra.pop("_checksum", None)
+    if verify and manifest is not None:
+        want = {k: (tuple(s), d) for k, (s, d) in manifest.items()}
+        got = {k: (v.shape, v.dtype.str) for k, v in flat.items()}
+        if want != got:
+            missing = sorted(set(want) - set(got))
+            extra_k = sorted(set(got) - set(want))
+            changed = sorted(k for k in set(want) & set(got)
+                             if want[k] != got[k])
+            raise CheckpointCorrupt(
+                f"{path}: manifest check failed (missing arrays: "
+                f"{missing or 'none'}; unexpected: {extra_k or 'none'}; "
+                f"shape/dtype changed: {changed or 'none'})")
+    if verify and checksum is not None:
+        actual = _state_checksum(flat)
+        if actual != checksum:
+            raise CheckpointCorrupt(
+                f"{path}: payload checksum check failed (stored "
+                f"{checksum:#010x}, computed {actual:#010x}) — the "
+                f"snapshot was damaged after it was committed")
+    state: Dict = {}
+    for key, arr in flat.items():
+        parts = key.split("/")
+        node = state
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
     return state, extra
 
 
@@ -291,14 +466,22 @@ def _import_orbax():
             "npz backend") from exc
 
 
+# A committed orbax checkpoint directory carries this marker, written
+# by rank 0 only after ck.wait_until_finished() AND the metadata
+# sidecar landed: a preempted/crashed save leaves a directory without
+# it, and readers refuse the un-committed snapshot.
+ORBAX_COMMIT_MARKER = "COMMIT.fdtd3d"
+
+
 def save_checkpoint_orbax(state, path: str, extra: Optional[Dict] = None):
     """Sharding-aware checkpoint: every host writes ITS OWN shards.
 
     The TPU-native alternative to the .npz snapshot for large/multi-host
     runs — no rank-0 gather of the global state (at 1024^3 the npz path
     stages ~30 GB on one host). `path` becomes a directory; metadata
-    rides a REQUIRED .meta.json sidecar written by rank 0 (restore
-    refuses a checkpoint separated from it).
+    rides a REQUIRED .meta.json sidecar and the directory is only
+    COMMITTED once rank 0 publishes the marker file (both atomic, both
+    after the save fully finished).
     """
     import jax
     ocp = _import_orbax()
@@ -309,17 +492,26 @@ def save_checkpoint_orbax(state, path: str, extra: Optional[Dict] = None):
     if jax.process_index() == 0:
         # atomic publish: a preemption between checkpoint completion and
         # the sidecar write must not strand (or half-write) the metadata
-        tmp = path + ".meta.json.tmp"
-        with open(tmp, "w") as f:
+        with atomic_open(path + ".meta.json", "w") as f:
             json.dump(extra or {}, f)
-        os.replace(tmp, path + ".meta.json")
+        # COMMIT marker LAST: its presence asserts shards + sidecar
+        with atomic_open(os.path.join(path, ORBAX_COMMIT_MARKER),
+                         "w") as f:
+            f.write("committed\n")
 
 
 def read_orbax_meta(path: str) -> Dict:
     """Metadata of an orbax checkpoint — validate BEFORE restoring."""
-    meta_path = os.path.abspath(path) + ".meta.json"
+    path = os.path.abspath(path)
+    marker = os.path.join(path, ORBAX_COMMIT_MARKER)
+    if not os.path.exists(marker):
+        raise CheckpointCorrupt(
+            f"{path}: missing {ORBAX_COMMIT_MARKER} marker — the "
+            f"checkpoint was never committed (crash or preemption "
+            f"mid-save?); use an older committed snapshot")
+    meta_path = path + ".meta.json"
     if not os.path.exists(meta_path):
-        raise ValueError(
+        raise CheckpointCorrupt(
             f"{path}: missing {os.path.basename(meta_path)} sidecar — "
             f"the metadata guards (scheme/size/topology) cannot be "
             f"checked; keep the sidecar next to the checkpoint directory")
@@ -327,7 +519,7 @@ def read_orbax_meta(path: str) -> Dict:
         try:
             return json.load(f)
         except json.JSONDecodeError as exc:
-            raise ValueError(
+            raise CheckpointCorrupt(
                 f"{path}: corrupt metadata sidecar "
                 f"({os.path.basename(meta_path)}): {exc}") from exc
 
@@ -348,6 +540,82 @@ def load_checkpoint_orbax(path: str, target) -> Dict:
                                                         None)), target)
     with ocp.StandardCheckpointer() as ck:
         return ck.restore(path, abstract)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint discovery + keep-K rotation (resume/rollback both use these)
+# ---------------------------------------------------------------------------
+
+# the cadence writer's naming scheme: ckpt_t000123.npz (npz backend) or
+# the directory ckpt_t000123 (orbax backend)
+_CKPT_NAME_RE = re.compile(r"^ckpt_t(\d+)(\.npz)?$")
+
+
+def find_checkpoints(save_dir: str) -> List[Tuple[int, str]]:
+    """COMMITTED snapshots in ``save_dir`` -> [(t, path)], newest first.
+
+    Committed means: an ``.npz`` under its final name (the atomic
+    writer never publishes a partial file), or an orbax directory
+    carrying the COMMIT marker. Integrity beyond commit (checksums) is
+    verified at load time — resume paths try candidates newest-first
+    and fall back past a :class:`CheckpointCorrupt` one."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(save_dir)
+    except OSError:
+        return []
+    for name in names:
+        m = _CKPT_NAME_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(save_dir, name)
+        if os.path.isdir(path):
+            if not os.path.exists(os.path.join(path,
+                                               ORBAX_COMMIT_MARKER)):
+                continue  # never committed: crash mid-save
+        elif not m.group(2):
+            continue  # a FILE without .npz is not one of ours
+        out.append((int(m.group(1)), path))
+    out.sort(key=lambda kv: (-kv[0], kv[1]))
+    return out
+
+
+def find_latest_checkpoint(save_dir: str) -> Optional[str]:
+    """Path of the newest COMMITTED snapshot in save_dir, or None."""
+    found = find_checkpoints(save_dir)
+    return found[0][1] if found else None
+
+
+def prune_checkpoints(save_dir: str, keep: int,
+                      t_max: Optional[int] = None) -> List[str]:
+    """Keep the newest ``keep`` committed snapshots, delete the rest
+    (including orbax sidecars). Returns the pruned paths.
+
+    ``t_max`` (the cadence writer passes the current step) restricts
+    the rotation to snapshots at t <= t_max: leftovers a previous
+    LONGER run left in the same save_dir sort newest and would
+    otherwise crowd the live run's own snapshots out of the keep-K
+    window — deleting exactly the state a resume needs."""
+    import shutil
+    pruned: List[str] = []
+    if keep <= 0:
+        return pruned
+    found = find_checkpoints(save_dir)
+    if t_max is not None:
+        found = [(t, p) for t, p in found if t <= t_max]
+    for _t, path in found[keep:]:
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+                side = path + ".meta.json"
+                if os.path.exists(side):
+                    os.remove(side)
+            else:
+                os.remove(path)
+            pruned.append(path)
+        except OSError:
+            pass  # a prune failure must never kill the run
+    return pruned
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -24,6 +25,7 @@ try:  # jax >= 0.5 exposes shard_map at top level
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from fdtd3d_tpu import faults as _faults
 from fdtd3d_tpu import profiling
 from fdtd3d_tpu import telemetry as _telemetry
 from fdtd3d_tpu.config import SimConfig
@@ -46,6 +48,9 @@ class Simulation:
 
     def __init__(self, cfg: SimConfig, devices: Optional[List] = None):
         self.cfg = cfg
+        # deterministic fault-injection harness (fdtd3d_tpu/faults.py):
+        # adopt FDTD3D_FAULT_PLAN once per process; a no-op otherwise
+        _faults.load_env()
         # State lives in ONE of two forms: `_sstate` (the dict-of-arrays
         # pytree every slow path uses) or `_pstate` (the packed stacked
         # carry of ops/pallas_packed.py, kept across chunks so the
@@ -125,6 +130,11 @@ class Simulation:
         # readback budget); restore() re-syncs it from the checkpoint.
         self._t_host = 0
         self._chunk_idx = 0
+        # auto-checkpoint cadence (OutputConfig.checkpoint_every): the
+        # step the last cadence snapshot was written at (restore()
+        # re-syncs it so a resumed run does not immediately re-write)
+        self._ckpt_last_t = 0
+        self._closed = False
         self.telemetry: Optional[_telemetry.TelemetrySink] = None
         if cfg.output.telemetry_path:
             self.telemetry = _telemetry.TelemetrySink(
@@ -358,6 +368,49 @@ class Simulation:
         elif self._check_finite:
             # no in-graph counters on this runner: legacy host pass
             profiling.assert_finite(self._carry(), context=f"t={self.t}")
+        # Auto-checkpoint cadence, aligned to chunk boundaries: fires
+        # AFTER the health guard above, so a tripped chunk never
+        # commits its NaN state as a "good" snapshot. The fault hooks
+        # fire last — a snapshot at this t stays clean of an injected
+        # NaN, and a simulated preemption leaves it committed.
+        self._maybe_auto_checkpoint()
+        if _faults.active() is not None:
+            _faults.on_chunk_boundary(self)
+        return self
+
+    def _maybe_auto_checkpoint(self):
+        """checkpoint_every/keep-K rotation (OutputConfig): write a
+        committed snapshot at the first chunk boundary past each
+        cadence multiple, then prune to the newest keep-K.
+
+        Collective: every rank calls it (checkpoint() gathers); the
+        prune runs on rank 0 only, like the write itself."""
+        ce = self.cfg.output.checkpoint_every
+        if not ce:
+            return
+        if self._t_host // ce <= self._ckpt_last_t // ce:
+            return
+        self.checkpoint_now()
+
+    def checkpoint_now(self):
+        """Write a committed cadence-style snapshot (ckpt_tNNNNNN in
+        save_dir) of the CURRENT state and prune to keep-K — the same
+        path/rotation contract as the checkpoint_every cadence, callable
+        off-cadence (the supervisor seeds a rollback floor with it).
+        Collective: every rank must call it (checkpoint() gathers)."""
+        from fdtd3d_tpu import io
+        out = self.cfg.output
+        t = self._t_host
+        if jax.process_index() == 0:
+            os.makedirs(out.save_dir, exist_ok=True)
+        ext = ".npz" if out.checkpoint_backend == "npz" else ""
+        path = os.path.join(out.save_dir, f"ckpt_t{t:06d}{ext}")
+        with _telemetry.span("checkpoint"):
+            self.checkpoint(path, backend=out.checkpoint_backend)
+        self._ckpt_last_t = t
+        if out.checkpoint_keep > 0 and jax.process_index() == 0:
+            io.prune_checkpoints(out.save_dir, out.checkpoint_keep,
+                                 t_max=t)
         return self
 
     def close_telemetry(self):
@@ -373,10 +426,14 @@ class Simulation:
 
     def close(self):
         """Finalize every observability lane: stop the device-trace
-        capture (if one is live) and close the telemetry sink. Safe to
-        call on every exit path — both halves are idempotent — and the
-        CLI/bench hold it in try/finally so a crash mid-run still
+        capture (if one is live) and close the telemetry sink.
+        Idempotent — safe to call on every exit path. The CLI/bench
+        hold it in try/finally AND register it via ``atexit`` so a
+        SIGTERM-style exit (sys.exit from a signal handler) still
         finalizes the trace directory and the run_end record."""
+        if self._closed:
+            return self
+        self._closed = True
         if self.tracer is not None:
             self.tracer.stop()
         return self.close_telemetry()
@@ -589,7 +646,14 @@ class Simulation:
                 "size": list(self.cfg.size),
                 # psi slab layout depends on the decomposition
                 # (solver.slab_axes)
-                "topology": list(self.topology)}
+                "topology": list(self.topology),
+                # dtype + carry family: the dict-form state carries
+                # dtype-specific companions (ds lo words, compensated
+                # residuals, Drude J) — restore validates both so a
+                # mismatch is a friendly error, not a cast surprise
+                "dtype": self.cfg.dtype,
+                "step_kind": self.step_kind,
+                "state_keys": sorted(self.state.keys())}
 
     def _check_ckpt_meta(self, extra):
         if extra.get("scheme") not in (None, self.cfg.scheme):
@@ -606,6 +670,21 @@ class Simulation:
                 f"{tuple(extra['topology'])} but this run uses "
                 f"{self.topology}; the CPML psi slab layout is "
                 f"per-topology — resume on the same topology")
+        if extra.get("dtype") not in (None, self.cfg.dtype):
+            raise ValueError(
+                f"checkpoint dtype {extra.get('dtype')!r} != config "
+                f"dtype {self.cfg.dtype!r}; resume on the same dtype "
+                f"(the state carries dtype-specific companions — ds lo "
+                f"words, compensated residuals — that do not convert)")
+        if "state_keys" in extra:
+            want = sorted(self.state.keys())
+            got = list(extra["state_keys"])
+            if got != want:
+                raise ValueError(
+                    f"checkpoint carry family {got} != this run's "
+                    f"{want}; the step-kind family (ds/compensated/"
+                    f"Drude companions) must match — resume with the "
+                    f"same physics/dtype configuration")
 
     def checkpoint(self, path: str, backend: str = "npz"):
         """Bit-exact snapshot of the full solver state pytree.
@@ -619,6 +698,8 @@ class Simulation:
         if backend == "orbax":
             io.save_checkpoint_orbax(self.state, path,
                                      extra=self._ckpt_meta())
+            if jax.process_index() == 0:
+                _faults.on_checkpoint(path)  # committed: harness hook
             return self
         if backend != "npz":
             raise ValueError(f"unknown checkpoint backend {backend!r}")
@@ -627,6 +708,7 @@ class Simulation:
         if jax.process_index() != 0:
             return self
         io.save_checkpoint(state_np, path, extra=self._ckpt_meta())
+        _faults.on_checkpoint(path)  # committed: harness hook
         return self
 
     def restore(self, path: str):
@@ -634,10 +716,11 @@ class Simulation:
 
         The backend is detected from the path: an orbax checkpoint is a
         directory (restored shard-by-shard into this sim's shardings), an
-        .npz is a host-side file.
+        .npz is a host-side file. A snapshot failing its integrity
+        checks raises :class:`fdtd3d_tpu.io.CheckpointCorrupt` (naming
+        the path and the failed check); resume paths catch it and fall
+        back to an older committed snapshot.
         """
-        import os
-
         from fdtd3d_tpu import io
         self._metrics_cache = None  # diag cache keys on t, not contents
         if os.path.isdir(path):
@@ -646,9 +729,21 @@ class Simulation:
             self._check_ckpt_meta(io.read_orbax_meta(path))
             self.state = io.load_checkpoint_orbax(path, self.state)
             self._t_host = self.t  # re-sync the telemetry step mirror
+            self._ckpt_last_t = self._t_host
             return self
         loaded, extra = io.load_checkpoint(path)
         self._check_ckpt_meta(extra)
+        return self.adopt_state(loaded)
+
+    def adopt_state(self, loaded):
+        """Install a host-side dict-form state tree as the live state.
+
+        The tail of :meth:`restore`, exposed on its own so the
+        supervisor's rollback can re-seed a sim from an in-memory
+        snapshot without touching disk: casts/reshapes each leaf to
+        this sim's dtypes, re-shards under a mesh, and re-syncs the
+        host step mirror + checkpoint cadence."""
+        self._metrics_cache = None
         want = jax.tree.structure(self.state)
         got = jax.tree.structure(loaded)
         if want != got:
@@ -663,4 +758,5 @@ class Simulation:
         else:
             self.state = jax.tree.map(jnp.asarray, loaded)
         self._t_host = self.t  # re-sync the telemetry step mirror
+        self._ckpt_last_t = self._t_host
         return self

@@ -58,9 +58,14 @@ import numpy as np
 # v2 (round 7): run_start gains required device_kind + hbm_gbps
 # provenance (BENCH_BEST already carried both; the JSONL now does too)
 # and the "attribution" record type (tools/trace_attribution.py) joins
-# the schema. v1 files still read/validate (READ_VERSIONS).
-SCHEMA_VERSION = 2
-READ_VERSIONS = (1, 2)
+# the schema. v3 (round 9): the durable-run supervisor's recovery
+# records — "retry" (bounded-retry attempt for a transient error),
+# "rollback" (restored to the last committed checkpoint), "degrade"
+# (kernel degradation-ladder step) — so post-mortems can reconstruct
+# every recovery (docs/ROBUSTNESS.md). v1/v2 files still
+# read/validate (READ_VERSIONS).
+SCHEMA_VERSION = 3
+READ_VERSIONS = (1, 2, 3)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -336,6 +341,21 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "t": (int,), "steps": (int,), "wall_s": _NUM,
         "mcells_per_s": _NUM, "first_unhealthy_t": _OPT_NUM,
     },
+    # v3 (durable-run supervisor, fdtd3d_tpu/supervisor.py): one record
+    # per recovery action, so tools/telemetry_report.py can summarize
+    # how a run survived.
+    "retry": {
+        "t": (int,), "attempt": (int,), "delay_s": _NUM,
+        "error": (str,),
+    },
+    "rollback": {
+        "t_failed": (int,), "t_restored": (int,), "source": (str,),
+        "reason": (str,),
+    },
+    "degrade": {
+        "t": (int,), "old_kind": (str,), "new_kind": (str,),
+        "reason": (str,),
+    },
 }
 
 
@@ -344,11 +364,13 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
 # by earlier builds keep reading cleanly.
 _V2_ONLY_KEYS = {"run_start": ("device_kind", "hbm_gbps")}
 _V2_ONLY_TYPES = ("attribution",)
+# and from v3 on: the supervisor's recovery records
+_V3_ONLY_TYPES = ("retry", "rollback", "degrade")
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
     """Raise ValueError when a record violates its declared schema
-    version (writers emit v2; v1 files remain readable)."""
+    version (writers emit v3; v1/v2 files remain readable)."""
     if not isinstance(rec, dict):
         raise ValueError(f"record is not an object: {rec!r}")
     v = rec.get("v")
@@ -357,7 +379,8 @@ def validate_record(rec: Dict[str, Any]) -> None:
                          f"{READ_VERSIONS}")
     rtype = rec.get("type")
     if rtype not in RECORD_SCHEMA or \
-            (v == 1 and rtype in _V2_ONLY_TYPES):
+            (v == 1 and rtype in _V2_ONLY_TYPES) or \
+            (v < 3 and rtype in _V3_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):

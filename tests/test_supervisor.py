@@ -1,0 +1,315 @@
+"""Durable-run supervisor tests (ISSUE 5 tentpole piece 2).
+
+Acceptance: a NaN injection at step t triggers rollback to the last
+COMMITTED checkpoint plus ONE kernel-ladder degrade, and the run
+completes the horizon with the right t and BIT-VALID state (identical
+to a clean continuation of the degraded kind from the same snapshot),
+with the retry/rollback/degrade records validating against telemetry
+schema v3.
+
+CPU-deterministic and sleep-free: the backoff clock is injected
+(RetryPolicy.sleep), faults fire on step counters.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import faults, io, telemetry
+from fdtd3d_tpu.config import (OutputConfig, PmlConfig, PointSourceConfig,
+                               SimConfig)
+from fdtd3d_tpu.supervisor import (RetryPolicy, Supervisor, degrade_plan,
+                                   run_with_retry)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plan(monkeypatch):
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _cfg2d(save_dir, **out_kw):
+    out_kw.setdefault("checkpoint_every", 8)
+    return SimConfig(
+        scheme="2D_TMz", size=(24, 24, 1), time_steps=24, dx=1e-3,
+        courant_factor=0.5, wavelength=10e-3,
+        pml=PmlConfig(size=(4, 4, 0)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(12, 12, 0)),
+        output=OutputConfig(save_dir=str(save_dir), **out_kw))
+
+
+# -------------------------------------------------------------------------
+# run_with_retry (the stage-shaped flavor bench.py embeds)
+# -------------------------------------------------------------------------
+
+def test_run_with_retry_records_attempts():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError(f"transient #{calls['n']}")
+        return "done"
+
+    rec = {}
+    out = run_with_retry(flaky, policy=RetryPolicy(
+        max_retries=3, sleep=sleeps.append), label="stage", record=rec)
+    assert out == "done"
+    assert rec["ok"] is True and rec["attempts"] == 3
+    assert len(rec["errors"]) == 2
+    assert sleeps == [1.0, 2.0]  # exponential backoff, injected clock
+
+
+def test_run_with_retry_exhaustion_keeps_record():
+    rec = {}
+    with pytest.raises(RuntimeError):
+        run_with_retry(lambda: (_ for _ in ()).throw(
+            RuntimeError("always")), policy=RetryPolicy(
+                max_retries=2, sleep=lambda _s: None), record=rec)
+    assert rec["ok"] is False and rec["attempts"] == 3
+
+
+def test_run_with_retry_nontransient_propagates_immediately():
+    rec = {}
+    with pytest.raises(KeyError):
+        run_with_retry(lambda: (_ for _ in ()).throw(KeyError("nope")),
+                       policy=RetryPolicy(sleep=lambda _s: None),
+                       record=rec)
+    assert rec["attempts"] == 1
+
+
+# -------------------------------------------------------------------------
+# the degradation ladder map
+# -------------------------------------------------------------------------
+
+def test_degrade_plan_ladder():
+    pins, _fn = degrade_plan("pallas_packed_tb")
+    assert pins == {"FDTD3D_NO_TEMPORAL": "1"}
+    pins, _fn = degrade_plan("pallas_packed")
+    assert pins == {"FDTD3D_NO_PACKED": "1"}
+    pins, fn = degrade_plan("pallas")
+    assert pins == {} and fn is not None
+    assert degrade_plan("jnp") is None          # bottom
+    assert degrade_plan("jnp_ds") is None
+
+
+# -------------------------------------------------------------------------
+# transient retry with bounded backoff + rollback
+# -------------------------------------------------------------------------
+
+def test_transient_errors_retried_with_rollback(tmp_path):
+    faults.install("error@t=8,times=2")
+    cfg = _cfg2d(tmp_path,
+                 telemetry_path=str(tmp_path / "t.jsonl"))
+    sleeps = []
+    sup = Supervisor(cfg, policy=RetryPolicy(max_retries=3,
+                                             sleep=sleeps.append))
+    sim = sup.run(interval=8)
+    sim.close()
+    assert sim._t_host == 24
+    assert sup.retries == 2 and sup.rollbacks == 2
+    assert sleeps == [1.0, 2.0]  # no real sleeping in tier-1
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)  # validates
+    types = [r["type"] for r in recs]
+    assert types.count("retry") == 2 and types.count("rollback") == 2
+    assert types[0] == "run_start" and types[-1] == "run_end"
+    for comp, v in sim.fields().items():
+        assert np.isfinite(v).all(), comp
+
+
+def test_transient_retry_exhaustion_reraises(tmp_path):
+    faults.install("error@t=8,times=5")
+    sup = Supervisor(_cfg2d(tmp_path), policy=RetryPolicy(
+        max_retries=2, sleep=lambda _s: None))
+    with pytest.raises(faults.InjectedTransientError):
+        sup.run(interval=8)
+
+
+def test_preemption_is_never_swallowed(tmp_path):
+    faults.install("preempt@t=8")
+    sup = Supervisor(_cfg2d(tmp_path), policy=RetryPolicy(
+        max_retries=5, sleep=lambda _s: None))
+    with pytest.raises(faults.SimulatedPreemption):
+        sup.run(interval=8)
+
+
+# -------------------------------------------------------------------------
+# ACCEPTANCE: NaN -> rollback to committed ckpt -> ONE ladder degrade
+# -------------------------------------------------------------------------
+
+def test_nan_rollback_degrades_tb_to_packed_bit_valid(tmp_path):
+    import dataclasses
+    d = tmp_path / "run"
+    cfg = SimConfig(
+        scheme="3D", size=(16, 16, 16), time_steps=24, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3, use_pallas=True,
+        pml=PmlConfig(size=(3, 3, 3)),
+        output=OutputConfig(save_dir=str(d), checkpoint_every=8,
+                            telemetry_path=str(tmp_path / "t.jsonl")))
+    faults.install("nan@t=8,field=Ez")
+    sup = Supervisor(cfg, policy=RetryPolicy(sleep=lambda _s: None))
+    sim = sup.run(interval=8)
+    sim.close()
+    faults.clear()
+
+    # the ladder stepped tb -> packed exactly once, finished the horizon
+    assert sim.step_kind == "pallas_packed", sim.step_kind
+    assert sim._t_host == 24
+    assert sup.degrades == 1 and sup.rollbacks == 1
+    # the env pin was cleaned up after the supervised run
+    assert "FDTD3D_NO_TEMPORAL" not in os.environ
+    for comp, v in sim.fields().items():
+        assert np.isfinite(np.asarray(v, np.float32)).all(), comp
+
+    # schema v3: rollback + degrade records validate and carry the facts
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    rb = [r for r in recs if r["type"] == "rollback"]
+    dg = [r for r in recs if r["type"] == "degrade"]
+    assert len(rb) == 1 and len(dg) == 1
+    assert rb[0]["t_failed"] == 16 and rb[0]["t_restored"] == 8
+    assert rb[0]["source"].endswith("ckpt_t000008.npz")
+    assert dg[0]["old_kind"] == "pallas_packed_tb"
+    assert dg[0]["new_kind"] == "pallas_packed"
+    # ONE run_start/run_end span despite the mid-run sim replacement
+    types = [r["type"] for r in recs]
+    assert types.count("run_start") == 1 and types.count("run_end") == 1
+
+    # BIT-VALID: identical to a clean continuation of the degraded kind
+    # from the same committed snapshot (the NaN never re-fires)
+    from fdtd3d_tpu.sim import Simulation
+    os.environ["FDTD3D_NO_TEMPORAL"] = "1"
+    try:
+        ref_cfg = dataclasses.replace(cfg, output=dataclasses.replace(
+            cfg.output, telemetry_path=None, checkpoint_every=0))
+        ref = Simulation(ref_cfg)
+        assert ref.step_kind == "pallas_packed"
+        ref.restore(os.path.join(str(d), "ckpt_t000008.npz"))
+        ref.advance(8)
+        ref.advance(8)
+    finally:
+        del os.environ["FDTD3D_NO_TEMPORAL"]
+    got = sim.fields()
+    for comp, v in ref.fields().items():
+        assert np.array_equal(np.asarray(v), np.asarray(got[comp])), comp
+
+
+def test_nan_on_jnp_bottom_of_ladder_reraises(tmp_path):
+    """On the reference path the blow-up is physics: no rung below it,
+    so the trip propagates instead of looping."""
+    faults.install("nan@t=8")
+    sup = Supervisor(_cfg2d(tmp_path), policy=RetryPolicy(
+        sleep=lambda _s: None))
+    with pytest.raises(FloatingPointError):
+        sup.run(interval=8)
+    assert sup.degrades == 0
+
+
+def test_rollback_ignores_stale_newer_checkpoint(tmp_path):
+    """save_dir still holds a FINISHED previous run's snapshots (same
+    config, so every metadata guard passes): a rollback must never
+    fast-forward onto the old run's later-t state."""
+    from fdtd3d_tpu.sim import Simulation
+    Simulation(_cfg2d(tmp_path)).advance(24)  # leaves ckpt_t000024 etc.
+    assert io.find_latest_checkpoint(str(tmp_path)).endswith(
+        "ckpt_t000024.npz")
+
+    faults.install("error@t=8,times=1")
+    cfg = _cfg2d(tmp_path, telemetry_path=str(tmp_path / "t.jsonl"))
+    sup = Supervisor(cfg, policy=RetryPolicy(sleep=lambda _s: None))
+    sim = sup.run(interval=8)
+    sim.close()
+    assert sim._t_host == 24 and sup.rollbacks == 1
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    rb = [r for r in recs if r["type"] == "rollback"]
+    # restored to THIS run's t=8 snapshot, not the stale t=24 one
+    assert rb[0]["t_failed"] == 8 and rb[0]["t_restored"] == 8
+    assert rb[0]["source"].endswith("ckpt_t000008.npz")
+
+
+def test_on_interval_not_refired_after_rollback(tmp_path):
+    """A rollback re-advances through boundaries whose interval
+    callbacks already ran; re-firing them would double-count the NTFF
+    DFT accumulator / duplicate metrics rows."""
+    import dataclasses
+    cfg = SimConfig(
+        scheme="3D", size=(16, 16, 16), time_steps=24, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3, use_pallas=True,
+        pml=PmlConfig(size=(3, 3, 3)),
+        output=OutputConfig(save_dir=str(tmp_path / "run"),
+                            checkpoint_every=8))
+    # nan lands at boundary t=12 (first boundary >= 10); the next
+    # chunk trips at t=16, rolling back to ckpt_t000008 — boundary 12
+    # is then re-advanced through and must NOT re-fire its callback
+    faults.install("nan@t=10,field=Ez")
+    seen = []
+    sup = Supervisor(cfg, policy=RetryPolicy(sleep=lambda _s: None))
+    sim = sup.run(interval=4, on_interval=lambda s: seen.append(s.t))
+    assert sim._t_host == 24 and sup.degrades == 1
+    assert seen == [4, 8, 12, 16, 20, 24], seen
+
+
+def test_boundary_callbacks_fire_after_same_t_rollback(tmp_path):
+    """An error firing AFTER a boundary's cadence checkpoint committed
+    (but before its interval callbacks ran) must not permanently skip
+    that boundary's callbacks: the rollback restores the boundary
+    bit-exact and the callback fires then — metrics/NTFF cadences stay
+    identical to an unsupervised run."""
+    faults.install("error@t=8,times=1")
+    seen = []
+    sup = Supervisor(_cfg2d(tmp_path),
+                     policy=RetryPolicy(sleep=lambda _s: None))
+    sim = sup.run(interval=8, on_interval=lambda s: seen.append(s.t))
+    assert sim._t_host == 24
+    assert seen == [8, 16, 24], seen
+
+
+def test_degraded_build_failure_reattaches_sink(tmp_path):
+    """If constructing the degraded Simulation itself fails, the
+    telemetry sink must land back on the surviving sim so the caller's
+    close() still writes the run_end record."""
+    from fdtd3d_tpu.sim import Simulation
+    cfg = SimConfig(
+        scheme="3D", size=(16, 16, 16), time_steps=24, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3, use_pallas=True,
+        pml=PmlConfig(size=(3, 3, 3)),
+        output=OutputConfig(save_dir=str(tmp_path / "run"),
+                            checkpoint_every=8,
+                            telemetry_path=str(tmp_path / "t.jsonl")))
+    faults.install("nan@t=8,field=Ez")
+    calls = {"n": 0}
+
+    def factory(c):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("degraded build failed (injected)")
+        return Simulation(c)
+
+    sup = Supervisor(cfg, sim_factory=factory,
+                     policy=RetryPolicy(sleep=lambda _s: None))
+    with pytest.raises(RuntimeError, match="degraded build failed"):
+        sup.run(interval=8)
+    assert sup.sim is not None and sup.sim.telemetry is not None
+    sup.sim.close()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    assert recs[-1]["type"] == "run_end"
+
+
+def test_rollback_without_checkpoints_uses_initial_snapshot(tmp_path):
+    """No cadence configured: the supervisor's in-memory snapshot of
+    the starting state is the rollback target of last resort."""
+    faults.install("error@t=8,times=1")
+    cfg = _cfg2d(tmp_path, checkpoint_every=0,
+                 telemetry_path=str(tmp_path / "t.jsonl"))
+    sup = Supervisor(cfg, policy=RetryPolicy(sleep=lambda _s: None))
+    sim = sup.run(interval=8)
+    sim.close()
+    assert sim._t_host == 24
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    rb = [r for r in recs if r["type"] == "rollback"]
+    assert rb and rb[0]["source"] == "initial-snapshot"
+    assert rb[0]["t_restored"] == 0

@@ -108,6 +108,29 @@ def test_validate_record_rejects_malformed():
         telemetry.validate_record({"v": 1, "type": "chunk", "chunk": 1})
 
 
+def test_schema_v3_recovery_records():
+    """The supervisor's retry/rollback/degrade records (round 9): valid
+    at v3, unknown at v1/v2 (old files must keep validating cleanly)."""
+    recs = {
+        "retry": {"t": 8, "attempt": 1, "delay_s": 1.0,
+                  "error": "RuntimeError: x"},
+        "rollback": {"t_failed": 16, "t_restored": 8,
+                     "source": "out/ckpt_t000008.npz",
+                     "reason": "FloatingPointError: y"},
+        "degrade": {"t": 8, "old_kind": "pallas_packed_tb",
+                    "new_kind": "pallas_packed",
+                    "reason": "FloatingPointError: y"},
+    }
+    for rtype, fields in recs.items():
+        telemetry.validate_record({"v": 3, "type": rtype, **fields})
+        for v_old in (1, 2):
+            with pytest.raises(ValueError, match="unknown record type"):
+                telemetry.validate_record({"v": v_old, "type": rtype,
+                                           **fields})
+    with pytest.raises(ValueError, match="missing"):
+        telemetry.validate_record({"v": 3, "type": "degrade", "t": 8})
+
+
 # -------------------------------------------------------------------------
 # in-graph guarantee: no full-field host transfer, ≤1 scalar readback
 # -------------------------------------------------------------------------

@@ -15,6 +15,9 @@ bench stages append):
 * health: the first unhealthy step bound (non-finite flag), final
   energy, max div·E residual
 * VMEM-ladder downgrade events
+* recovery events (schema v3, the durable-run supervisor): bounded
+  retries, checkpoint rollbacks and kernel-ladder degrades — how the
+  run survived, not just whether it did
 
 ``--json`` emits the same summary as one JSON object per run instead
 of text (for dashboards / the driver).
@@ -72,6 +75,12 @@ def summarize_run(run):
         "chunks": len(chunks),
         "complete": end is not None,
         "ladder_downgrades": ladder,
+        # durable-run supervisor events (schema v3)
+        "recoveries": {
+            "retries": [r for r in run if r["type"] == "retry"],
+            "rollbacks": [r for r in run if r["type"] == "rollback"],
+            "degrades": [r for r in run if r["type"] == "degrade"],
+        },
     }
     if not chunks:
         return out
@@ -154,6 +163,24 @@ def format_text(summaries) -> str:
                          f"{d['old_tile']} -> {d['new_tile']} "
                          f"(budget {d['old_budget_mb']} -> "
                          f"{d['new_budget_mb']} MiB)")
+        rec = s.get("recoveries", {})
+        for r in rec.get("retries", []):
+            lines.append(f"  RETRY at t={r['t']} (attempt "
+                         f"{r['attempt']}, backoff {r['delay_s']:.1f}s):"
+                         f" {r['error']}")
+        for r in rec.get("rollbacks", []):
+            lines.append(f"  ROLLBACK t={r['t_failed']} -> "
+                         f"t={r['t_restored']} ({r['source']}): "
+                         f"{r['reason']}")
+        for r in rec.get("degrades", []):
+            lines.append(f"  DEGRADE at t={r['t']}: {r['old_kind']} -> "
+                         f"{r['new_kind']}: {r['reason']}")
+        n_rec = sum(len(v) for v in rec.values())
+        if n_rec:
+            lines.append(f"  survived {n_rec} recovery events "
+                         f"(retries {len(rec['retries'])}, rollbacks "
+                         f"{len(rec['rollbacks'])}, degrades "
+                         f"{len(rec['degrades'])})")
     return "\n".join(lines)
 
 
