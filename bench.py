@@ -135,6 +135,11 @@ def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
             profile=True,
             telemetry_path=os.environ.get("FDTD3D_BENCH_TELEMETRY")
             or None,
+            # FDTD3D_BENCH_PER_CHIP=1 (+ telemetry): record the v4
+            # per-chip/imbalance lane too, so a multi-chip bench
+            # window feeds its own artifact's multichip summary
+            per_chip_telemetry=bool(
+                os.environ.get("FDTD3D_BENCH_PER_CHIP")),
             profile_dir=os.path.join(prof_root, prof_tag)
             if prof_root else None),
     )
@@ -797,6 +802,16 @@ def run_measurement() -> None:
         out["best_known_n"] = best.get("n")
         out["best_known_hbm_probe_gbps"] = best.get("hbm_probe_gbps")
         out["best_known_session"] = best.get("session")
+    # MULTICHIP observability summary (round 10): modeled
+    # halo-bytes/chip for the reference pod decomposition, recorded
+    # async overlap-window counts, and this window's per-chip
+    # imbalance — beside the sentinel verdict below, so the comm lanes
+    # ship in the same artifact the driver records.
+    try:
+        out["multichip"] = _comm_observability(
+            telemetry_path=os.environ.get("FDTD3D_BENCH_TELEMETRY"))
+    except Exception as exc:  # never kill the bench
+        out["multichip"] = {"error": str(exc)[:200]}
     # Perf-regression sentinel (round 7): every artifact carries its
     # own verdict vs BENCH_BEST + the BENCH_r* history, so a >10%
     # per-path cliff can never ship silently — it is flagged in the
@@ -817,6 +832,93 @@ def run_measurement() -> None:
         out["perf_sentinel"] = {"status": "ERROR",
                                 "error": str(exc)[:200]}
     print(json.dumps(out), flush=True)
+
+
+def _comm_observability(telemetry_path=None, topology=(2, 2, 2),
+                        n=256):
+    """The MULTICHIP observability summary embedded in the bench
+    artifact alongside perf_sentinel (round 10): the modeled
+    halo-bytes/chip for the reference pod decomposition of the bench
+    workload (ledger comm model — pure host math, chip-free), the
+    newest recorded async overlap-window counts (tools/aot_overlap.py
+    --out artifacts at the repo root), and this window's per-chip
+    imbalance summary when the telemetry JSONL carries v4 imbalance
+    records (single-chip windows record why it is absent). Never
+    raises — each lane degrades to an explanatory note."""
+    import glob
+
+    out = {"topology": list(topology)}
+    try:
+        from fdtd3d_tpu.config import PmlConfig, SimConfig
+        from fdtd3d_tpu.costs import halo_bytes_per_chip, \
+            halo_topology_table
+        cfg = SimConfig(scheme="3D", size=(n, n, n), time_steps=8,
+                        dx=1e-3, courant_factor=0.5, wavelength=32e-3,
+                        pml=PmlConfig(size=(10, 10, 10)))
+        import math
+        out["halo_bytes_per_chip_per_step"] = \
+            halo_bytes_per_chip(cfg, topology)
+        out["halo_topology_table"] = \
+            halo_topology_table(cfg, math.prod(topology))
+    except Exception as exc:
+        out["model_error"] = str(exc)[:200]
+    # async overlap windows: newest recorded artifact, if any
+    root = os.path.dirname(os.path.abspath(__file__))
+    arts = sorted(glob.glob(os.path.join(root, "OVERLAP*.json")),
+                  key=lambda p: os.path.getmtime(p), reverse=True)
+    if arts:
+        try:
+            with open(arts[0]) as f:
+                art = json.load(f)
+            out["overlap_windows"] = {
+                "source": os.path.basename(arts[0]),
+                "windows_with_compute":
+                    art.get("windows_with_compute"),
+                "async_starts": art.get("async_starts"),
+                "sync_collective_permutes":
+                    art.get("sync_collective_permutes"),
+            }
+        except Exception as exc:
+            out["overlap_windows"] = {"error": str(exc)[:200]}
+    else:
+        out["overlap_windows"] = None
+        out["overlap_note"] = ("no OVERLAP*.json artifact on record — "
+                               "run tools/aot_overlap.py --out "
+                               "OVERLAP_BEST.json in a toolchain "
+                               "window")
+    # per-chip imbalance: this window's telemetry, when multi-chip
+    imb = None
+    if telemetry_path and os.path.exists(telemetry_path):
+        try:
+            from fdtd3d_tpu import telemetry as _t
+            recs = [r for r in _t.read_jsonl(telemetry_path)
+                    if r.get("type") == "imbalance"]
+            if recs:
+                worst = max(recs, key=lambda r: r.get("ratio") or 0.0)
+                imb = {"chunks": len(recs),
+                       "worst_ratio": worst.get("ratio"),
+                       "straggler_chip": worst.get("argmax"),
+                       "metric": worst.get("metric"),
+                       "n_chips": worst.get("n_chips")}
+                # a diverged chip outranks any ratio (imbalance_summary
+                # emits ratio=null + nonfinite_chips for it) — the
+                # artifact must carry that signal, not bury it
+                bad = next((r for r in recs
+                            if r.get("nonfinite_chips")), None)
+                if bad is not None:
+                    imb["nonfinite_chips"] = bad["nonfinite_chips"]
+                    imb["nonfinite_t"] = bad.get("t")
+        except Exception as exc:
+            imb = {"error": str(exc)[:200]}
+    out["per_chip_imbalance"] = imb
+    if imb is None:
+        out["per_chip_note"] = ("no v4 imbalance records this window "
+                                "(single chip, or telemetry/"
+                                "per-chip lane off) — enable with "
+                                "FDTD3D_BENCH_TELEMETRY=path + "
+                                "FDTD3D_BENCH_PER_CHIP=1 on a "
+                                "multi-chip mesh")
+    return out
 
 
 def _load_sentinel():

@@ -217,6 +217,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "wall time, run provenance, VMEM-ladder events) "
                         "to PATH; summarize with "
                         "tools/telemetry_report.py")
+    g.add_argument("--per-chip-telemetry",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="with --telemetry: also record the UN-psummed "
+                        "per-chip health counters (schema-v4 per_chip "
+                        "records, tiny all_gathered scalars on the "
+                        "same readback) plus a per-chunk imbalance "
+                        "summary (max/mean ratio, straggler chip)")
 
     g = p.add_argument_group("durability (docs/ROBUSTNESS.md)")
     g.add_argument("--supervise", action=argparse.BooleanOptionalAction,
@@ -367,6 +374,7 @@ def args_to_config(args) -> SimConfig:
             log_level=args.log_level,
             profile=bool(args.profile), check_finite=args.check_finite,
             telemetry_path=args.telemetry,
+            per_chip_telemetry=args.per_chip_telemetry,
             # --profile DIR routes the device-trace lane; --trace is
             # the legacy alias (saved command files)
             profile_dir=(args.profile

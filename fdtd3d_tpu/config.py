@@ -227,6 +227,13 @@ class OutputConfig:
     # as ladder_downgrade events. CLI flag: --telemetry PATH.
     # Summarize with tools/telemetry_report.py.
     telemetry_path: Optional[str] = None
+    # Per-chip lane (telemetry schema v4, round 10): with a sink
+    # attached, each chunk additionally records the UN-psummed per-chip
+    # health counters (tiny all_gathered scalars on the same single
+    # readback) as a "per_chip" record plus an "imbalance" summary
+    # (max/mean ratio + argmax straggler chip). CLI flag:
+    # --per-chip-telemetry. No-op without telemetry_path.
+    per_chip_telemetry: bool = False
     # Device-trace lane (round 7): when set, Simulation starts a
     # jax.profiler capture into this directory at the first advance()
     # and finalizes it in Simulation.close() — crash-safe via the

@@ -36,17 +36,41 @@ and telemetry v2 run_start records) into a modeled step time and
 Mcells/s. ``tools/trace_attribution.py`` merges this modeled view with
 measured device-trace time; ``tools/perf_sentinel.py`` diffs ledgers
 across commits to flag per-section cost growth.
+
+Ledger v2 (round 10) adds the **ICI/interconnect comm lane** beside the
+HBM roofline: pass ``topology=(px,py,pz)`` and the chunk runner is
+traced INSIDE shard_map over a host-device mesh (still pure tracing,
+no compile, CPU-deterministic), so every ``ppermute`` halo exchange
+appears in the jaxpr at its per-chip plane size. The ``comm`` table
+then carries: traced ppermute bytes/chip/step + message counts charged
+to their named sections (the ``halo-exchange`` scopes), the
+plan.py-modeled halo bytes per neighbor per axis (the SINGLE source of
+truth tools/weak_scaling.py and bench.py quote), a per-topology
+halo-bytes/chip table over every valid factorization of the chip
+count, and a modeled sync-vs-async overlap window (halo bytes over an
+ICI GB/s assumption vs per-chip HBM bytes over the probe).
+``validate_ledger`` accepts v1 files (no ``comm`` key) unchanged;
+``tools/perf_sentinel.py``'s comm lane gates halo-bytes/chip and the
+async overlap-window count (``tools/aot_overlap.py`` artifacts embed
+via ``--overlap``) deterministically.
 """
 
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import json
 import os
 import re
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
-LEDGER_VERSION = 1
+# v2 (round 10): the ICI comm lane — a `comm` table (traced ppermute
+# bytes/messages per chip, plan.py halo model, per-topology table,
+# modeled sync-vs-async overlap window) joins the ledger when a
+# topology is traced; None for unsharded ledgers. v1 files (no `comm`
+# key) keep validating.
+LEDGER_VERSION = 2
+LEDGER_READ_VERSIONS = (1, 2)
 LEDGER_SCHEMA = "fdtd3d-cost-ledger"
 
 # The production step kinds the ledger covers (ISSUE 3 acceptance, +
@@ -54,6 +78,20 @@ LEDGER_SCHEMA = "fdtd3d-cost-ledger"
 # variants trace too, via kind=None).
 STEP_KINDS = ("jnp", "pallas", "pallas_packed", "pallas_packed_tb",
               "pallas_packed_ds")
+
+# Kinds whose step supports a sharded (shard_map) trace — the comm
+# lane's acceptance surface. pallas_packed_tb is unsharded-only (the
+# two-plane ghost pipeline is ROADMAP open item 1).
+SHARDED_STEP_KINDS = ("jnp", "pallas", "pallas_packed",
+                      "pallas_packed_ds")
+
+# Default aggregate per-chip ICI bandwidth assumption for the modeled
+# sync-vs-async overlap window (GB/s). A deliberate placeholder
+# constant — the point of the model is the RATIO of halo traffic to
+# interior HBM traffic per topology, not an absolute time; override
+# with --ici-gbps / ici_gbps= when a measured value exists for the
+# target fabric.
+ICI_GBPS_DEFAULT = 90.0
 
 # flop weight per output element, by primitive name
 _TRANSCENDENTAL = frozenset((
@@ -73,6 +111,14 @@ _ZERO_FLOP = frozenset((
     "get", "swap", "masked_load", "masked_swap", "addupdate",
     "broadcast", "split", "expand_dims", "real", "imag", "complex",
     "ppermute", "psum", "pmax", "pmin", "all_gather", "axis_index"))
+
+# Cross-chip collectives (the ICI comm lane, ledger v2): ppermute is
+# the halo-exchange workhorse and is tracked per SECTION (bytes are
+# operand+result — one plane sent AND one received per chip, exactly
+# plan.py's send+recv accounting); the reduction collectives (health
+# psums, the per-chip all_gather lane) are tracked per primitive.
+_REDUCE_COLLECTIVES = frozenset(("psum", "pmax", "pmin", "all_gather",
+                                 "all_to_all", "reduce_scatter"))
 
 # recursed (never costed directly): higher-order primitives, keyed by
 # the param holding their inner jaxpr(s)
@@ -152,12 +198,18 @@ def _section_of(stack: str) -> str:
 
 
 class _Acc:
-    """Per-section (flops, bytes) accumulators, per-step + per-chunk."""
+    """Per-section (flops, bytes) accumulators, per-step + per-chunk,
+    plus the comm lane: ppermute bytes/messages per section and
+    reduction-collective message counts per primitive."""
 
     def __init__(self, n_steps: int):
         self.n_steps = n_steps
         self.step: Dict[str, list] = {}
         self.chunk: Dict[str, list] = {}
+        self.comm_step: Dict[str, list] = {}    # section -> [bytes, msgs]
+        self.comm_chunk: Dict[str, list] = {}
+        self.coll_step: Dict[str, list] = {}    # prim -> [bytes, msgs]
+        self.coll_chunk: Dict[str, list] = {}
         self.step_scan_seen = False
 
     def add(self, in_step: bool, section: str, flops: float,
@@ -167,11 +219,33 @@ class _Acc:
         cell[0] += flops
         cell[1] += bytes_
 
+    def add_comm(self, in_step: bool, section: str, bytes_: float,
+                 msgs: float):
+        tgt = self.comm_step if in_step else self.comm_chunk
+        cell = tgt.setdefault(section, [0.0, 0.0])
+        cell[0] += bytes_
+        cell[1] += msgs
+
+    def add_coll(self, in_step: bool, prim: str, bytes_: float,
+                 msgs: float):
+        tgt = self.coll_step if in_step else self.coll_chunk
+        cell = tgt.setdefault(prim, [0.0, 0.0])
+        cell[0] += bytes_
+        cell[1] += msgs
+
 
 def _merge(acc: _Acc, other: _Acc):
     for in_step, src in ((True, other.step), (False, other.chunk)):
         for sec, (f, b) in src.items():
             acc.add(in_step, sec, f, b)
+    for in_step, src in ((True, other.comm_step),
+                         (False, other.comm_chunk)):
+        for sec, (b, m) in src.items():
+            acc.add_comm(in_step, sec, b, m)
+    for in_step, src in ((True, other.coll_step),
+                         (False, other.coll_chunk)):
+        for prim, (b, m) in src.items():
+            acc.add_coll(in_step, prim, b, m)
     acc.step_scan_seen = acc.step_scan_seen or other.step_scan_seen
 
 
@@ -237,6 +311,14 @@ def _walk(acc: _Acc, jaxpr, prefix: str, mult: float, in_step: bool,
             inner = getattr(inner, "jaxpr", inner)
             _walk(acc, inner, stack, mult, in_step, count_bytes)
             continue
+        # comm lane (ledger v2): collectives count regardless of
+        # count_bytes — a ppermute inside a pallas_call body is still
+        # ICI traffic, not VMEM
+        if name == "ppermute":
+            acc.add_comm(in_step, _section_of(stack),
+                         mult * _eqn_bytes(eqn), mult)
+        elif name in _REDUCE_COLLECTIVES:
+            acc.add_coll(in_step, name, mult * _eqn_bytes(eqn), mult)
         flops = mult * _eqn_flops(eqn)
         bytes_ = mult * _eqn_bytes(eqn) if count_bytes else 0.0
         if flops or bytes_:
@@ -298,18 +380,180 @@ def config_for_kind(kind: str, n: int = 16, pml: int = 3,
 
 
 # --------------------------------------------------------------------------
+# the comm model (ledger v2 lane)
+# --------------------------------------------------------------------------
+
+def halo_bytes_per_chip(cfg, topology) -> int:
+    """THE modeled halo-bytes/chip/step number (single source of truth:
+    plan.py's curl-term accounting) for cfg on a forced topology.
+    tools/weak_scaling.py, bench.py and the ledger comm lane all quote
+    this; tests assert the traced jaxpr matches it."""
+    from fdtd3d_tpu.plan import plan_for_topology
+    return int(plan_for_topology(cfg, topology).halo_bytes_per_step)
+
+
+def halo_topology_table(cfg, n_chips: int) -> Dict[str, int]:
+    """Modeled halo-bytes/chip/step for EVERY valid factorization of
+    n_chips over the grid (pure host math — pod-scale tables cost
+    nothing). Keys are 'px.py.pz'; invalid splits (inactive axis,
+    non-divisible grid) are skipped."""
+    from fdtd3d_tpu.parallel.mesh import _factorizations
+    from fdtd3d_tpu.plan import plan_for_topology
+    out: Dict[str, int] = {}
+    for fac in _factorizations(int(n_chips), 3):
+        try:
+            p = plan_for_topology(cfg, fac)
+        except ValueError:
+            continue
+        out[".".join(str(f) for f in fac)] = int(p.halo_bytes_per_step)
+    return out
+
+
+def overlap_model(per_chip_step_bytes: float, halo_bytes: float,
+                  hbm_gbps: Optional[float],
+                  ici_gbps: Optional[float] = None
+                  ) -> Optional[Dict[str, float]]:
+    """Modeled sync-vs-async overlap window for one topology: halo
+    traffic over the ICI assumption vs per-chip INTERIOR HBM traffic
+    over the probe. ``per_chip_step_bytes`` must already EXCLUDE the
+    halo bytes (the generic byte walk charges ppermute operands too —
+    counting them at both HBM and ICI rate would double-book the
+    planes; _comm_lane subtracts). Deterministic given its two
+    bandwidth inputs; None without an HBM calibration (never
+    fabricated)."""
+    if not hbm_gbps or hbm_gbps <= 0:
+        return None
+    ici = float(ici_gbps) if ici_gbps else ICI_GBPS_DEFAULT
+    compute_ms = per_chip_step_bytes / (hbm_gbps * 1e9) * 1e3
+    comm_ms = halo_bytes / (ici * 1e9) * 1e3
+    sync_ms = compute_ms + comm_ms
+    async_ms = max(compute_ms, comm_ms)
+    return {
+        "ici_gbps": ici,
+        "hbm_gbps": float(hbm_gbps),
+        "modeled_compute_ms": compute_ms,
+        "modeled_comm_ms": comm_ms,
+        "modeled_step_ms_sync": sync_ms,
+        "modeled_step_ms_async": async_ms,
+        # fraction of the comm window interior compute can hide when
+        # the exchange lowers async (aot_overlap's start..done windows)
+        "hideable_frac": min(1.0, compute_ms / comm_ms)
+        if comm_ms > 0 else 1.0,
+        "modeled_async_speedup": sync_ms / async_ms
+        if async_ms > 0 else 1.0,
+    }
+
+
+_OVERLAP_KEYS = ("sync_collective_permutes", "async_starts",
+                 "async_dones", "windows", "windows_with_compute",
+                 "heavy_ops_inside_windows", "max_window_gap_instrs")
+
+# the artifact contract tools/aot_overlap.py writes (it imports this
+# schema tag + validator, so writer and ledger-ingest cannot drift)
+OVERLAP_SCHEMA = "fdtd3d-overlap"
+_OVERLAP_REQUIRED = ("sync_collective_permutes", "async_starts",
+                     "windows", "windows_with_compute")
+
+
+def check_overlap_artifact(art: Any) -> None:
+    """Reject anything that is not a tools/aot_overlap.py artifact —
+    a wrong file fed to --overlap must fail at ingest, not ship an
+    empty async_windows table that silently disables the sentinel's
+    overlap gates."""
+    schema = art.get("schema") if isinstance(art, dict) else None
+    if schema != OVERLAP_SCHEMA:
+        raise ValueError(f"not a {OVERLAP_SCHEMA} artifact "
+                         f"(schema={schema!r}); produce one with "
+                         f"tools/aot_overlap.py --out")
+    for key in _OVERLAP_REQUIRED:
+        if not isinstance(art.get(key), int):
+            raise ValueError(f"overlap artifact missing {key!r}")
+
+
+def _comm_lane(cfg, acc: _Acc, topo, n_chips: int,
+               per_chip_step_bytes: float, hbm_gbps: Optional[float],
+               ici_gbps: Optional[float],
+               overlap: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Assemble the ledger's `comm` table from the sharded-walk
+    accumulators + the plan.py model."""
+    from fdtd3d_tpu.plan import plan_for_topology
+
+    def _tbl(src: Dict[str, list]) -> Dict[str, Dict[str, float]]:
+        return {k: {"bytes": b, "messages": m}
+                for k, (b, m) in sorted(src.items())}
+
+    pp_bytes = sum(b for b, _ in acc.comm_step.values())
+    pp_msgs = sum(m for _, m in acc.comm_step.values())
+    halo_b, _halo_m = acc.comm_step.get("halo-exchange", (0.0, 0.0))
+    p = plan_for_topology(cfg, topo)
+    modeled = int(p.halo_bytes_per_step)
+    comm: Dict[str, Any] = {
+        "topology": list(topo),
+        "n_chips": int(n_chips),
+        "per_step": {
+            "ppermute_bytes_per_chip": pp_bytes,
+            "ppermute_messages": pp_msgs,
+            # the acceptance bar: >=95% of traced ppermute bytes must
+            # land on the named halo-exchange scopes
+            "halo_attribution": (halo_b / pp_bytes) if pp_bytes else 1.0,
+            "sections": _tbl(acc.comm_step),
+        },
+        "per_chunk": {
+            "ppermute": _tbl(acc.comm_chunk),
+            "collectives": _tbl(acc.coll_chunk),
+        },
+        "collectives_per_step": _tbl(acc.coll_step),
+        "plan": {
+            "halo_bytes_per_chip_per_step": modeled,
+            "by_axis": p.halo_by_axis,
+            # the jnp stencil path ppermutes exactly the curl-term
+            # planes plan.py counts; kernel paths add thin patch-fix
+            # planes on top, so traced >= modeled there
+            "traced_minus_modeled_bytes": pp_bytes - modeled,
+        },
+        "topology_table": halo_topology_table(cfg, n_chips),
+        # interior traffic = per-step bytes minus the halo planes the
+        # byte walk already charged (they move on ICI, not HBM)
+        "overlap_model": overlap_model(
+            max(0.0, per_chip_step_bytes - pp_bytes), pp_bytes,
+            hbm_gbps, ici_gbps),
+    }
+    if overlap is not None:
+        # an aot_overlap.py artifact (compiled-HLO async window counts)
+        # rides along so one file carries both comm gates; validated
+        # at ingest — a wrong file must not ship an empty table
+        check_overlap_artifact(overlap)
+        comm["async_windows"] = {k: overlap[k] for k in _OVERLAP_KEYS
+                                 if k in overlap}
+    return comm
+
+
+# --------------------------------------------------------------------------
 # the ledger
 # --------------------------------------------------------------------------
 
 def chunk_ledger(cfg, n_steps: int = 8,
                  hbm_gbps: Optional[float] = None,
-                 kind: Optional[str] = None) -> Dict[str, Any]:
+                 kind: Optional[str] = None,
+                 topology: Optional[Sequence[int]] = None,
+                 ici_gbps: Optional[float] = None,
+                 overlap: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
     """Trace cfg's chunk runner and attribute per-step flops/bytes.
 
     ``kind`` forces one of STEP_KINDS via the same environment knobs
     the measurement tools use (and raises if the forced kind did not
     engage — a silent fallback would attribute the wrong graph).
     Pure tracing: no compile, no device execution, CPU-deterministic.
+
+    ``topology=(px,py,pz)`` traces the runner INSIDE shard_map over a
+    host-device mesh (still tracing only — works on the virtual CPU
+    mesh): section/per_step tables are then PER-CHIP (``cells`` is the
+    local cell count) and the ledger carries the v2 ``comm`` lane —
+    traced ppermute bytes/messages per section, the plan.py halo
+    model, the per-topology table and the modeled overlap window.
+    ``overlap`` embeds a tools/aot_overlap.py artifact's async window
+    counts; ``ici_gbps`` overrides the modeled ICI bandwidth.
     """
     import jax
 
@@ -317,9 +561,29 @@ def chunk_ledger(cfg, n_steps: int = 8,
     from fdtd3d_tpu.solver import (build_coeffs, build_static,
                                    init_state, make_chunk_runner)
 
+    if overlap is not None and topology is None:
+        raise ValueError("overlap= only rides the comm lane: pass "
+                         "topology= too (the artifact embeds under "
+                         "comm.async_windows; silently dropping it "
+                         "would disable the sentinel's overlap gates)")
+    topo = None
     with _forced_env(kind):
         static = build_static(cfg)
-        runner = make_chunk_runner(static, health=True)
+        if topology is not None:
+            from fdtd3d_tpu.config import ParallelConfig
+            from fdtd3d_tpu.parallel import mesh as pmesh
+            # same validation path Simulation/plan use
+            topo = pmesh.resolve_topology(
+                ParallelConfig(topology="manual",
+                               manual_topology=tuple(int(p)
+                                                     for p in topology)),
+                static.grid_shape, static.mode.active_axes)
+            static = dataclasses.replace(static, topology=topo)
+            runner = make_chunk_runner(static, pmesh.mesh_axis_map(topo),
+                                       pmesh.mesh_shape_map(topo),
+                                       health=True)
+        else:
+            runner = make_chunk_runner(static, health=True)
     if kind is not None and runner.kind != kind:
         raise RuntimeError(
             f"requested step kind {kind!r} but the runner engaged "
@@ -331,8 +595,42 @@ def chunk_ledger(cfg, n_steps: int = 8,
                                        getattr(a, "dtype", type(a))),
         coeffs_np)
     state_sh = jax.eval_shape(lambda: init_state(static))
-    if getattr(runner, "packed", False):
-        state_sh = jax.eval_shape(runner.pack, state_sh)
+    specs = None
+    if topo is None:
+        if getattr(runner, "packed", False):
+            state_sh = jax.eval_shape(runner.pack, state_sh)
+    else:
+        from fdtd3d_tpu.parallel import mesh as pmesh
+
+        def _rescale(tree_sh, spec_tree, grow: bool):
+            """Divide (or multiply) each leaf's sharded dims by its
+            PartitionSpec's shard counts: pack() is a per-SHARD
+            function (the x-psi slab layout depends on the LOCAL
+            extent), so the global packed arg shapes must be
+            per-shard-pack x topology, not pack-of-global."""
+            shards = pmesh.mesh_shape_map(topo)
+
+            def conv(sd, spec):
+                shape = list(sd.shape)
+                for i, ax in enumerate(spec):
+                    if ax is None:
+                        continue
+                    f = 1
+                    for nm in (ax if isinstance(ax, tuple) else (ax,)):
+                        f *= shards.get(nm, 1)
+                    shape[i] = shape[i] * f if grow else shape[i] // f
+                return jax.ShapeDtypeStruct(tuple(shape), sd.dtype)
+            return jax.tree.map(conv, tree_sh, spec_tree)
+
+        if getattr(runner, "packed", False):
+            local_sh = _rescale(state_sh,
+                                pmesh.state_specs(state_sh, topo),
+                                grow=False)
+            local_packed = jax.eval_shape(runner.pack, local_sh)
+            specs = pmesh.packed_specs(local_packed, topo)
+            state_sh = _rescale(local_packed, specs, grow=True)
+        else:
+            specs = pmesh.state_specs(state_sh, topo)
 
     # Multi-step kernels (pallas_packed_tb advances steps_per_call=2
     # steps per scan iteration): the step scan's length is
@@ -347,8 +645,23 @@ def chunk_ledger(cfg, n_steps: int = 8,
             f"steps_per_call={spc}: the tail steps would blur the "
             f"per-step/per-chunk split — trace an even horizon")
 
-    closed = jax.make_jaxpr(lambda s, c: runner(s, c, n=n_steps))(
-        state_sh, coeffs_sh)
+    traced = lambda s, c: runner(s, c, n=n_steps)  # noqa: E731
+    if topo is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from fdtd3d_tpu.parallel import mesh as pmesh
+        try:
+            mesh = pmesh.build_mesh(topo)
+        except ValueError as exc:
+            raise RuntimeError(
+                f"comm-lane trace for topology {topo} ({exc}); on CPU "
+                f"set XLA_FLAGS=--xla_force_host_platform_device_count"
+                f"=N before jax initializes") from exc
+        coeff_specs = pmesh.coeff_specs(coeffs_np, topo)
+        traced = pmesh.shard_map_compat(
+            traced, mesh, in_specs=(specs, coeff_specs),
+            out_specs=(specs, {k: P() for k in telemetry.HEALTH_KEYS}))
+    closed = jax.make_jaxpr(traced)(state_sh, coeffs_sh)
     acc = _Acc(n_steps // spc)
     _walk(acc, closed.jaxpr, "", 1.0, False, True)
     if not acc.step_scan_seen:
@@ -360,6 +673,10 @@ def chunk_ledger(cfg, n_steps: int = 8,
         for cell in acc.step.values():
             cell[0] /= spc
             cell[1] /= spc
+        for tbl in (acc.comm_step, acc.coll_step):
+            for cell in tbl.values():
+                cell[0] /= spc
+                cell[1] /= spc
 
     def _table(src: Dict[str, list]) -> Dict[str, Dict[str, float]]:
         tf = sum(f for f, _ in src.values()) or 1.0
@@ -374,7 +691,10 @@ def chunk_ledger(cfg, n_steps: int = 8,
     un_f, un_b = acc.step.get("unattributed", (0.0, 0.0))
     cells = 1.0
     for a in static.mode.active_axes:
-        cells *= static.grid_shape[a]
+        n_a = static.grid_shape[a]
+        if topo is not None:
+            n_a //= topo[a]   # sharded trace: per-CHIP (local) cells
+        cells *= n_a
     ledger: Dict[str, Any] = {
         "schema": LEDGER_SCHEMA,
         "ledger_version": LEDGER_VERSION,
@@ -385,6 +705,7 @@ def chunk_ledger(cfg, n_steps: int = 8,
         "cells": int(cells),
         "n_steps": int(n_steps),
         "steps_per_call": spc,
+        "topology": list(topo) if topo is not None else None,
         "sections": _table(acc.step),
         "per_chunk_sections": _table(acc.chunk),
         "per_step": {
@@ -395,11 +716,20 @@ def chunk_ledger(cfg, n_steps: int = 8,
             "flops_per_cell": step_f / cells,
             "bytes_per_cell": step_b / cells,
         },
+        "comm": None,
         "model": ("jaxpr-walk: unfused byte upper bound; pallas_call "
                   "operands counted once; step scan body counted once "
-                  "(per-step); cond takes its max branch"),
+                  "(per-step); cond takes its max branch"
+                  + ("; sharded trace: sections/per_step/cells are "
+                     "PER-CHIP" if topo is not None else "")),
     }
     gbps = hbm_gbps if hbm_gbps is not None else telemetry.get_hbm_probe()
+    if topo is not None:
+        n_chips = 1
+        for p_ in topo:
+            n_chips *= p_
+        ledger["comm"] = _comm_lane(cfg, acc, topo, n_chips, step_b,
+                                    gbps, ici_gbps, overlap)
     if gbps and gbps > 0:
         t_step = step_b / (gbps * 1e9)
         ledger["roofline"] = {
@@ -415,15 +745,19 @@ def chunk_ledger(cfg, n_steps: int = 8,
 
 
 def validate_ledger(led: Dict[str, Any]) -> None:
-    """Raise ValueError when a dict is not a valid v1 cost ledger."""
+    """Raise ValueError when a dict is not a valid cost ledger.
+
+    Writers emit v2 (the comm lane); v1 files — no ``comm`` key —
+    keep validating unchanged (LEDGER_READ_VERSIONS)."""
     if not isinstance(led, dict):
         raise ValueError(f"ledger is not an object: {type(led)}")
     if led.get("schema") != LEDGER_SCHEMA:
         raise ValueError(f"ledger schema {led.get('schema')!r} != "
                          f"{LEDGER_SCHEMA!r}")
-    if led.get("ledger_version") != LEDGER_VERSION:
-        raise ValueError(f"ledger version {led.get('ledger_version')!r} "
-                         f"!= {LEDGER_VERSION}")
+    version = led.get("ledger_version")
+    if version not in LEDGER_READ_VERSIONS:
+        raise ValueError(f"ledger version {version!r} not in "
+                         f"{LEDGER_READ_VERSIONS}")
     for key, typ in (("step_kind", str), ("scheme", str), ("grid", list),
                      ("dtype", str), ("n_steps", int),
                      ("sections", dict), ("per_chunk_sections", dict),
@@ -444,6 +778,40 @@ def validate_ledger(led: Dict[str, Any]) -> None:
                 not isinstance(row.get("bytes"), (int, float)):
             raise ValueError(f"ledger.sections[{sec!r}] malformed: "
                              f"{row!r}")
+    if version >= 2:
+        if "comm" not in led:
+            raise ValueError("v2 ledger missing the comm key (None is "
+                             "valid for unsharded ledgers)")
+        validate_comm(led["comm"])
+
+
+def validate_comm(comm: Optional[Dict[str, Any]]) -> None:
+    """Validate a ledger's comm lane (None = unsharded, valid)."""
+    if comm is None:
+        return
+    if not isinstance(comm, dict):
+        raise ValueError(f"ledger.comm is not an object: {type(comm)}")
+    if not isinstance(comm.get("topology"), list):
+        raise ValueError("ledger.comm.topology missing or not a list")
+    if not isinstance(comm.get("n_chips"), int):
+        raise ValueError("ledger.comm.n_chips missing")
+    ps = comm.get("per_step")
+    if not isinstance(ps, dict):
+        raise ValueError("ledger.comm.per_step missing")
+    for key in ("ppermute_bytes_per_chip", "ppermute_messages",
+                "halo_attribution"):
+        if not isinstance(ps.get(key), (int, float)):
+            raise ValueError(f"ledger.comm.per_step.{key} missing")
+    if not 0.0 <= ps["halo_attribution"] <= 1.0:
+        raise ValueError(f"ledger.comm.per_step.halo_attribution out "
+                         f"of [0,1]: {ps['halo_attribution']}")
+    pl = comm.get("plan")
+    if not isinstance(pl, dict) or not isinstance(
+            pl.get("halo_bytes_per_chip_per_step"), (int, float)):
+        raise ValueError("ledger.comm.plan.halo_bytes_per_chip_per_step "
+                         "missing")
+    if not isinstance(comm.get("topology_table"), dict):
+        raise ValueError("ledger.comm.topology_table missing")
 
 
 def _best_hbm_gbps() -> Optional[float]:
@@ -484,6 +852,17 @@ def main(argv=None) -> int:
     ap.add_argument("--hbm-gbps", type=float, default=None,
                     help="HBM bandwidth for the roofline lane "
                          "(default: BENCH_BEST.json's recorded probe)")
+    ap.add_argument("--topology", metavar="PX,PY,PZ", default=None,
+                    help="trace sharded over this (px,py,pz) chip "
+                         "topology (comm lane: needs px*py*pz host "
+                         "devices — virtual CPU devices work)")
+    ap.add_argument("--ici-gbps", type=float, default=None,
+                    help=f"aggregate per-chip ICI bandwidth for the "
+                         f"modeled overlap window (default "
+                         f"{ICI_GBPS_DEFAULT})")
+    ap.add_argument("--overlap", metavar="PATH", default=None,
+                    help="tools/aot_overlap.py artifact JSON whose "
+                         "async window counts ride the comm lane")
     ap.add_argument("--out", metavar="PATH", default=None,
                     help="also write the ledger JSON to PATH")
     args = ap.parse_args(argv)
@@ -492,14 +871,27 @@ def main(argv=None) -> int:
     cfg = config_for_kind(kind or "jnp", n=args.same_size,
                           pml=args.pml_size, time_steps=args.steps)
     if kind is None:
-        import dataclasses
         cfg = dataclasses.replace(cfg, use_pallas=None)
     if args.dtype:
-        import dataclasses
         cfg = dataclasses.replace(cfg, dtype=args.dtype)
+    topology = None
+    if args.topology:
+        topology = tuple(int(p) for p in
+                         args.topology.replace("x", ",").split(","))
+    overlap = None
+    if args.overlap:
+        if topology is None:
+            ap.error("--overlap only rides the comm lane: pass "
+                     "--topology too (the artifact embeds under "
+                     "comm.async_windows)")
+        with open(args.overlap) as f:
+            overlap = json.load(f)
+        check_overlap_artifact(overlap)  # fail at ingest, not ship-time
     gbps = args.hbm_gbps if args.hbm_gbps is not None else \
         _best_hbm_gbps()
-    led = chunk_ledger(cfg, n_steps=args.steps, hbm_gbps=gbps, kind=kind)
+    led = chunk_ledger(cfg, n_steps=args.steps, hbm_gbps=gbps, kind=kind,
+                       topology=topology, ici_gbps=args.ici_gbps,
+                       overlap=overlap)
     validate_ledger(led)
     txt = json.dumps(led, indent=1)
     if args.out:

@@ -40,6 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 from fdtd3d_tpu.layout import CURL_TERMS, component_axis
 from fdtd3d_tpu.ops.pallas3d import (COMPILER_PARAMS, _VMEM_LIMIT,
                                      _pick_tile)
+from fdtd3d_tpu.telemetry import named as _named
 
 AXES = "xyz"
 
@@ -111,8 +112,9 @@ def _traced_patch_fix(static, out_H, c, p, a, s, db, coeffs,
         # cross-shard: when the owner holds P at its first plane, P-1
         # is the lower b-neighbor's LAST plane — ship the delta down
         n_sh_b = mesh_shape[name_b]
-        recv = lax.ppermute(delta, name_b,
-                            [(r + 1, r) for r in range(n_sh_b - 1)])
+        with _named("halo-exchange"):
+            recv = lax.ppermute(delta, name_b,
+                                [(r + 1, r) for r in range(n_sh_b - 1)])
         gate = coeffs[f"g{AXES[b]}"][0] + n_b == gplane
         last = -db_plane(n_b - 1) * (s * inv_dx) * recv
         last = jnp.where(gate, last, 0.0)
@@ -125,8 +127,10 @@ def _traced_patch_fix(static, out_H, c, p, a, s, db, coeffs,
             name_a = mesh_axes[a]
             n_sh_a = mesh_shape[name_a]
             first = lax.slice_in_dim(delta, 0, 1, axis=a)
-            nxt = lax.ppermute(first, name_a,
-                               [(r + 1, r) for r in range(n_sh_a - 1)])
+            with _named("halo-exchange"):
+                nxt = lax.ppermute(first, name_a,
+                                   [(r + 1, r)
+                                    for r in range(n_sh_a - 1)])
             n_a_loc = delta.shape[a]
             hi_sl = [slice(None)] * 3
             hi_sl[a] = slice(n_a_loc - 1, n_a_loc)
@@ -231,9 +235,10 @@ def apply_patch_h_corrections(static, new_H, psi_H, patches, coeffs,
                         name = mesh_axes[a]
                         n_sh = mesh_shape[name]
                         first = lax.slice_in_dim(delta, 0, 1, axis=a)
-                        nxt = lax.ppermute(
-                            first, name,
-                            [(r + 1, r) for r in range(n_sh - 1)])
+                        with _named("halo-exchange"):
+                            nxt = lax.ppermute(
+                                first, name,
+                                [(r + 1, r) for r in range(n_sh - 1)])
                         n_loc = delta.shape[a]
                         hi_sl = [slice(None)] * 3
                         hi_sl[a] = slice(n_loc - 1, n_loc)

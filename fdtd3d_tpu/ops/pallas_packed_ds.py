@@ -1196,16 +1196,16 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         # H pair plane along every sharded axis (backward diffs);
         # ppermute delivers zeros at the global lo edge (PEC ghost).
         # Hi and lo words ship together in the one stacked plane.
-        for a in sharded_axes:
-            name = mesh_axes[a]
-            n_sh = mesh_shape[name]
-            n_a = (n1, n2, n3)[a]
-            plane = lax.slice_in_dim(pstate["H"], n_a - 1, n_a,
-                                     axis=1 + a)
-            with _named("halo-exchange"):
+        with _named("halo-exchange"):
+            for a in sharded_axes:
+                name = mesh_axes[a]
+                n_sh = mesh_shape[name]
+                n_a = (n1, n2, n3)[a]
+                plane = lax.slice_in_dim(pstate["H"], n_a - 1, n_a,
+                                         axis=1 + a)
                 gh_ = lax.ppermute(plane, name,
                                    [(r, r + 1) for r in range(n_sh - 1)])
-            args.append(gh_)
+                args.append(gh_)
 
         args += [cg(f"_pk_wall_{AXES[a]}", _vec3_key,
                     f"wall_{AXES[a]}", a) for a in range(3)]
@@ -1244,31 +1244,36 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
         # recursions and identity F factors. At the global hi edge
         # ppermute delivers zeros and the fix vanishes (one SPMD
         # program).
-        for a in sharded_axes:
-            name = mesh_axes[a]
-            n_sh = mesh_shape[name]
-            n_a = (n1, n2, n3)[a]
-            first = lax.slice_in_dim(new_E, 0, 1, axis=1 + a)
-            with _named("halo-exchange"):
-                nxt = lax.ppermute(first, name,
-                                   [(r + 1, r) for r in range(n_sh - 1)])
-            sl_hi = [slice(None)] * 3
-            sl_hi[a] = slice(n_a - 1, n_a)
-            sl_hi = tuple(sl_hi)
-            for jc, c in enumerate(h_comps):
-                for (aa, jd, sg) in CURL_TERMS[component_axis(c)]:
-                    if aa != a or ("E" + AXES[jd]) not in e_comps:
-                        continue
-                    db = (coeffs[f"db_{c}"], coeffs[f"db_{c}_lo"])
-                    if jnp.ndim(db[0]) == 3:
-                        db = (db[0][sl_hi], db[1][sl_hi])
-                    term = ds.mul_ff(nxt[jd], nxt[ne + jd],
-                                     iv_pair[0], iv_pair[1])
-                    if sg > 0:
-                        term = _neg_pair(term)  # dH = -db * s * E/dx
-                    fix = ds.mul_ff(db[0], db[1], *term)
-                    new_H = _pair_add_at(new_H, jc, nh, sl_hi,
-                                         fix[0], fix[1])
+        # scope note (comm-lane attribution): the fix is H-update work;
+        # the ppermute itself re-scopes to halo-exchange (innermost
+        # wins in the cost ledger / trace parser)
+        with _named("H-update"):
+            for a in sharded_axes:
+                name = mesh_axes[a]
+                n_sh = mesh_shape[name]
+                n_a = (n1, n2, n3)[a]
+                first = lax.slice_in_dim(new_E, 0, 1, axis=1 + a)
+                with _named("halo-exchange"):
+                    nxt = lax.ppermute(first, name,
+                                       [(r + 1, r)
+                                        for r in range(n_sh - 1)])
+                sl_hi = [slice(None)] * 3
+                sl_hi[a] = slice(n_a - 1, n_a)
+                sl_hi = tuple(sl_hi)
+                for jc, c in enumerate(h_comps):
+                    for (aa, jd, sg) in CURL_TERMS[component_axis(c)]:
+                        if aa != a or ("E" + AXES[jd]) not in e_comps:
+                            continue
+                        db = (coeffs[f"db_{c}"], coeffs[f"db_{c}_lo"])
+                        if jnp.ndim(db[0]) == 3:
+                            db = (db[0][sl_hi], db[1][sl_hi])
+                        term = ds.mul_ff(nxt[jd], nxt[ne + jd],
+                                         iv_pair[0], iv_pair[1])
+                        if sg > 0:
+                            term = _neg_pair(term)  # dH = -db*s*E/dx
+                        fix = ds.mul_ff(db[0], db[1], *term)
+                        new_H = _pair_add_at(new_H, jc, nh, sl_hi,
+                                             fix[0], fix[1])
 
         for a in psi_axes_h:
             new_state[f"psH{a}"] = psh_stacks[a]

@@ -112,6 +112,22 @@ def resolve_topology(parallel_cfg, grid_shape: Tuple[int, int, int],
     raise ValueError(f"unknown topology {parallel_cfg.topology!r}")
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map across jax API generations: top-level vs experimental
+    import, check_vma vs check_rep kwarg. The one shim Simulation and
+    the cost ledger's comm-lane trace both use."""
+    try:  # jax >= 0.5 exposes shard_map at top level
+        from jax import shard_map as _sm
+    except ImportError:  # pragma: no cover - older jax layout
+        from jax.experimental.shard_map import shard_map as _sm
+    try:
+        return _sm(fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_vma=False)
+    except TypeError:  # older kwarg name
+        return _sm(fn, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, check_rep=False)
+
+
 def build_mesh(topology: Tuple[int, int, int], devices=None) -> Mesh:
     """Mesh with axis names x/y/z from an (px, py, pz) topology."""
     n = int(np.prod(topology))

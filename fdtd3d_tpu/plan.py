@@ -14,7 +14,7 @@ configs (1024^3 on 64 chips) can be validated on a laptop.
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 
@@ -37,6 +37,12 @@ class Plan:
     #                            when spatially varying)
     halo_bytes_per_step: int   # ppermute traffic per chip per full step
     n_chips: int
+    # Per-axis halo breakdown (comm-lane observability, round 10): for
+    # each SHARDED axis, the curl-term plane count, one plane's bytes,
+    # and the per-neighbor / per-step traffic. Keys are axis letters;
+    # sum of bytes_per_step over axes == halo_bytes_per_step.
+    halo_by_axis: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def hbm_per_chip(self) -> int:
@@ -143,11 +149,36 @@ def plan(cfg, n_devices: int = 1) -> Plan:
     # halo traffic: ops/stencil.py ppermutes one plane per curl term
     # crossing a sharded axis; each plane is sent AND received.
     halo = 0
+    by_axis: Dict[str, Dict[str, int]] = {}
     for a in range(3):
         if topo[a] > 1:
             plane = cells // local[a] * fb
-            halo += 2 * _halo_planes(mode, a) * plane
+            planes = _halo_planes(mode, a)
+            axis_bytes = 2 * planes * plane
+            halo += axis_bytes
+            by_axis[AXES[a]] = {
+                "planes_per_step": planes,
+                "plane_bytes": plane,
+                # per FULL step each crossing plane goes to ONE
+                # neighbor and its counterpart arrives from the other
+                # (E-phase down, H-phase up): send+recv totals split
+                # evenly across the two neighbors
+                "bytes_per_neighbor_per_step": planes * plane,
+                "bytes_per_step": axis_bytes,
+            }
     return Plan(topology=topo, local_shape=local, fields_bytes=fields,
                 psi_bytes=psi, drude_bytes=drude, inc_bytes=inc,
                 coeff_bytes=coeff, halo_bytes_per_step=halo,
-                n_chips=int(np.prod(topo)))
+                n_chips=int(np.prod(topo)), halo_by_axis=by_axis)
+
+
+def plan_for_topology(cfg, topology: Tuple[int, int, int]) -> Plan:
+    """plan() with a FORCED (px, py, pz) decomposition — the comm lane
+    (fdtd3d_tpu/costs.py) models specific topologies rather than the
+    auto heuristic's pick."""
+    from fdtd3d_tpu.config import ParallelConfig
+    topology = tuple(int(p) for p in topology)
+    cfg = dataclasses.replace(
+        cfg, parallel=ParallelConfig(topology="manual",
+                                     manual_topology=topology))
+    return plan(cfg, n_devices=int(np.prod(topology)))

@@ -20,27 +20,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-try:  # jax >= 0.5 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
-
 from fdtd3d_tpu import faults as _faults
 from fdtd3d_tpu import profiling
 from fdtd3d_tpu import telemetry as _telemetry
 from fdtd3d_tpu.config import SimConfig
 from fdtd3d_tpu.parallel import mesh as pmesh
+from fdtd3d_tpu.parallel.mesh import shard_map_compat as \
+    _shard_map_compat
 from fdtd3d_tpu.solver import (StaticSetup, build_coeffs, build_static,
                                init_state, make_chunk_runner)
-
-
-def _shard_map_compat(fn, mesh, in_specs, out_specs):
-    try:
-        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=False)
-    except TypeError:  # older kwarg name
-        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_rep=False)
 
 
 class Simulation:
@@ -97,9 +85,15 @@ class Simulation:
         # reduction per chunk + one scalar readback, never a host pass.
         self._health_on = bool(cfg.output.telemetry_path) \
             or cfg.output.check_finite
+        # Per-chip lane (telemetry v4): un-psummed per-chip counters
+        # ride the same fused readback when a sink will record them.
+        self._per_chip_on = self._health_on \
+            and bool(cfg.output.per_chip_telemetry) \
+            and bool(cfg.output.telemetry_path)
         self._bind_runner(make_chunk_runner(self.static, mesh_axes,
                                             mesh_shape,
-                                            health=self._health_on))
+                                            health=self._health_on,
+                                            per_chip=self._per_chip_on))
         if cfg.require_pallas and self.step_kind in ("jnp", "jnp_ds"):
             import jax as _jax
             from fdtd3d_tpu.ops import pallas3d
@@ -262,8 +256,12 @@ class Simulation:
                 out_specs = st_specs
                 if self._runner_health:
                     # health counters come out psum/pmax-replicated
-                    out_specs = (st_specs,
-                                 {k: P() for k in _telemetry.HEALTH_KEYS})
+                    # (the per-chip all_gather vectors replicate too)
+                    hspec = {k: P() for k in _telemetry.HEALTH_KEYS}
+                    if getattr(self._runner, "per_chip", False):
+                        hspec["per_chip"] = {
+                            k: P() for k in _telemetry.PER_CHIP_KEYS}
+                    out_specs = (st_specs, hspec)
                 fn = _shard_map_compat(fn, self.mesh,
                                        in_specs=(st_specs,
                                                  self._coeff_specs),
@@ -351,6 +349,20 @@ class Simulation:
                 chunk=self._chunk_idx, t=self._t_host, steps=n_steps,
                 wall_s=wall, cells=self._cells, health=hv,
                 vmem_rung=int(getattr(self, "_vmem_rung", 0)))
+            per_chip = hv.get("per_chip")
+            if per_chip is not None:
+                # per-chip lane (schema v4): the raw vectors plus the
+                # imbalance summary — both from the SAME readback the
+                # chunk record used, no extra device traffic
+                self.telemetry.emit(
+                    "per_chip", chunk=self._chunk_idx, t=self._t_host,
+                    n_chips=len(next(iter(per_chip.values()))),
+                    counters=per_chip)
+                imb = _telemetry.imbalance_summary(per_chip)
+                if imb is not None:
+                    self.telemetry.emit("imbalance",
+                                        chunk=self._chunk_idx,
+                                        t=self._t_host, **imb)
         if hv is not None:
             if not hv["finite"] and self._check_finite:
                 # name the components host-side only AFTER the in-graph
@@ -484,10 +496,10 @@ class Simulation:
             pallas_packed._RUNTIME_BUDGET = nxt
             try:
                 with _telemetry.span("vmem-ladder-rebuild"):
-                    runner = make_chunk_runner(self.static,
-                                               self._mesh_axes,
-                                               self._mesh_shape,
-                                               health=self._health_on)
+                    runner = make_chunk_runner(
+                        self.static, self._mesh_axes, self._mesh_shape,
+                        health=self._health_on,
+                        per_chip=self._per_chip_on)
             finally:
                 pallas_packed._RUNTIME_BUDGET = None
             new_kind = getattr(runner, "kind", None)

@@ -1111,7 +1111,7 @@ def _make_paired_complex_step(static: StaticSetup, mesh_axes=None,
 
 
 def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
-                      health: bool = False):
+                      health: bool = False, per_chip: bool = False):
     """scan-over-steps runner: run_chunk(state, coeffs, n) with static n.
 
     When the packed kernel is engaged (``run_chunk.packed``), the scan
@@ -1135,6 +1135,10 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
     whose top-level unpack routes through host numpy) instead supply
     their own in-graph list of dict-form views. ``run_chunk.health``
     reports whether the counters are actually wired.
+
+    ``per_chip=True`` additionally all_gathers the un-psummed local
+    counters into the health dict's ``per_chip`` vectors (telemetry
+    schema v4's per-chip lane; ``run_chunk.per_chip`` reports it).
     """
     step = make_step(static, mesh_axes, mesh_shape)
     prep = getattr(step, "prepare", None)
@@ -1158,7 +1162,8 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
                 view = lambda s: [step.unpack(s)]  # noqa: E731
             else:
                 view = lambda s: [s]  # noqa: E731
-        hfn = telemetry.make_health_fn(static, mesh_axes)
+        hfn = telemetry.make_health_fn(static, mesh_axes,
+                                       per_chip=per_chip)
         health_fn = lambda s: hfn(view(s))  # noqa: E731
 
     def run_chunk(state, coeffs, n: int):
@@ -1187,6 +1192,7 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
         return out
 
     run_chunk.health = health_fn is not None
+    run_chunk.per_chip = health_fn is not None and per_chip
     run_chunk.kind = getattr(step, "kind", "jnp")
     run_chunk.diag = getattr(step, "diag", None)
     run_chunk.steps_per_call = spc

@@ -62,13 +62,23 @@ import numpy as np
 # records — "retry" (bounded-retry attempt for a transient error),
 # "rollback" (restored to the last committed checkpoint), "degrade"
 # (kernel degradation-ladder step) — so post-mortems can reconstruct
-# every recovery (docs/ROBUSTNESS.md). v1/v2 files still
-# read/validate (READ_VERSIONS).
-SCHEMA_VERSION = 3
-READ_VERSIONS = (1, 2, 3)
+# every recovery (docs/ROBUSTNESS.md). v4 (round 10): the per-chip
+# lane — "per_chip" (un-psummed per-chip counter vectors, tiny
+# all_gathered scalars riding the fused health readback) and
+# "imbalance" (max/mean ratio + argmax straggler chip per chunk) — so
+# a pod run surfaces a straggling or diverging chip WHILE it runs.
+# v1-v3 files still read/validate (READ_VERSIONS).
+SCHEMA_VERSION = 4
+READ_VERSIONS = (1, 2, 3, 4)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
+
+# The un-psummed counters the per-chip lane all_gathers (kept tiny on
+# purpose: three f32 scalars per chip per chunk). Chip index = the
+# mesh-linearized position (row-major over the (x, y, z) mesh axes —
+# jax.lax.all_gather's tuple-axis flattening order).
+PER_CHIP_KEYS = ("energy", "max_e", "max_h")
 
 # Span names as they appear in XProf (docs/OBSERVABILITY.md keeps the
 # one-line description of each). Host-side spans (TraceAnnotation):
@@ -108,7 +118,7 @@ def named(name: str):
 # health counters (in-graph)
 # --------------------------------------------------------------------------
 
-def make_health_fn(static, mesh_axes=None):
+def make_health_fn(static, mesh_axes=None, per_chip: bool = False):
     """Build the fused health reduction: states -> dict of f32 scalars.
 
     ``states`` is a SEQUENCE of dict-form state pytrees (one normally;
@@ -117,6 +127,13 @@ def make_health_fn(static, mesh_axes=None):
     re² + im²). Runs inside the jitted chunk (and inside shard_map on a
     mesh: local reductions are finished with psum/pmax over the mesh
     axis names, so every rank returns the GLOBAL scalars).
+
+    ``per_chip=True`` (the round-10 comm-observability lane) adds a
+    ``per_chip`` entry: the UN-psummed local counters (PER_CHIP_KEYS),
+    all_gathered over the mesh axes into replicated length-n_chips
+    vectors — a handful of extra f32 scalars on the same single
+    readback, never a second dispatch. Unsharded runs get length-1
+    vectors so consumers see one shape.
     """
     import jax
     import jax.numpy as jnp
@@ -195,6 +212,7 @@ def make_health_fn(static, mesh_axes=None):
                     "max_h": jnp.maximum(acc["max_h"], p["max_h"]),
                     "_ok": jnp.logical_and(acc["_ok"], p["_ok"]),
                 }
+            local = {k: acc[k] for k in PER_CHIP_KEYS}
             if axis_names:
                 acc["energy"] = lax.psum(acc["energy"], axis_names)
                 acc["_div_sumsq"] = lax.psum(acc["_div_sumsq"],
@@ -206,7 +224,7 @@ def make_health_fn(static, mesh_axes=None):
                 acc["max_h"] = lax.pmax(acc["max_h"], axis_names)
                 acc["_ok"] = lax.pmin(acc["_ok"].astype(jnp.float32),
                                       axis_names) > 0.5
-            return {
+            out = {
                 "energy": acc["energy"],
                 "div_l2": jnp.sqrt(acc["_div_sumsq"]
                                    / jnp.maximum(acc["_div_count"], 1.0)),
@@ -215,22 +233,81 @@ def make_health_fn(static, mesh_axes=None):
                 "max_h": acc["max_h"],
                 "nonfinite": 1.0 - acc["_ok"].astype(jnp.float32),
             }
+            if per_chip:
+                if axis_names:
+                    out["per_chip"] = {
+                        k: lax.all_gather(v.astype(jnp.float32),
+                                          axis_names)
+                        for k, v in local.items()}
+                else:
+                    out["per_chip"] = {
+                        k: v.astype(jnp.float32)[None]
+                        for k, v in local.items()}
+            return out
 
     return health
 
 
-def readback(health) -> Dict[str, float]:
+def readback(health) -> Dict[str, Any]:
     """ONE device->host transfer of the scalar health tuple -> floats.
 
     This is the per-chunk readback budget in its entirety: a handful of
     f32 scalars (plus ``finite`` derived host-side), never a field
-    array. tests/test_telemetry.py counts calls through here."""
+    array — the optional per-chip lane adds len(PER_CHIP_KEYS) x
+    n_chips scalars to the SAME transfer, not a second one.
+    tests/test_telemetry.py counts calls through here."""
     import jax
     with span("telemetry-readback"):
         vals = jax.device_get(health)
-    out = {k: float(np.asarray(v)) for k, v in vals.items()}
+    per = vals.pop("per_chip", None)
+    out: Dict[str, Any] = {k: float(np.asarray(v))
+                           for k, v in vals.items()}
     out["finite"] = out.pop("nonfinite", 0.0) == 0.0
+    if per is not None:
+        out["per_chip"] = {k: [float(x) for x in np.asarray(v).ravel()]
+                           for k, v in per.items()}
     return out
+
+
+def imbalance_summary(per_chip: Dict[str, list],
+                      metric: str = "energy") -> Optional[Dict[str, Any]]:
+    """Per-chunk load-asymmetry summary from a per-chip counter vector:
+    max, mean, max/mean ratio and the argmax (straggler-candidate)
+    chip. A perfectly balanced decomposition reads ratio ~1.0; a chip
+    diverging (energy blow-up) or holding asymmetric work drifts the
+    ratio — the cheap in-run proxy for the trace-level straggler
+    attribution (tools/trace_attribution.py). None when the metric is
+    absent or degenerate (single chip, all-zero)."""
+    vals = per_chip.get(metric)
+    if not vals or len(vals) < 2:
+        return None
+    # A NON-FINITE chip is the worst straggler there is (it diverged):
+    # name it as argmax with ratio null + nonfinite_chips, rather than
+    # dropping it from the stats and crowning a healthy chip — the
+    # divergence case is exactly what the lane exists to surface.
+    vals = [v if v is not None else float("nan") for v in vals]
+    bad = [i for i, v in enumerate(vals) if not np.isfinite(v)]
+    finite = [v for v in vals if np.isfinite(v)]
+    mx = max(finite) if finite else 0.0
+    mean = sum(finite) / len(finite) if finite else 0.0
+    if bad:
+        return {
+            "metric": metric,
+            "max": float(mx),
+            "mean": float(mean),
+            "ratio": None,
+            "argmax": bad[0],
+            "n_chips": len(vals),
+            "nonfinite_chips": bad,
+        }
+    return {
+        "metric": metric,
+        "max": float(mx),
+        "mean": float(mean),
+        "ratio": (float(mx / mean) if mean > 0 else None),
+        "argmax": int(np.argmax(vals)),
+        "n_chips": len(vals),
+    }
 
 
 # --------------------------------------------------------------------------
@@ -356,6 +433,20 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "t": (int,), "old_kind": (str,), "new_kind": (str,),
         "reason": (str,),
     },
+    # v4 (comm observability, round 10): the per-chip lane. One
+    # "per_chip" record per chunk when OutputConfig.per_chip_telemetry
+    # is set — counters maps each PER_CHIP_KEYS name to the length-
+    # n_chips vector (chip index = mesh-linearized position) — and one
+    # "imbalance" record summarizing it (imbalance_summary).
+    "per_chip": {
+        "chunk": (int,), "t": (int,), "n_chips": (int,),
+        "counters": (dict,),
+    },
+    "imbalance": {
+        "chunk": (int,), "t": (int,), "metric": (str,),
+        "max": _NUM, "mean": _NUM, "ratio": _OPT_NUM, "argmax": (int,),
+        "n_chips": (int,),
+    },
 }
 
 
@@ -366,6 +457,8 @@ _V2_ONLY_KEYS = {"run_start": ("device_kind", "hbm_gbps")}
 _V2_ONLY_TYPES = ("attribution",)
 # and from v3 on: the supervisor's recovery records
 _V3_ONLY_TYPES = ("retry", "rollback", "degrade")
+# and from v4 on: the per-chip lane
+_V4_ONLY_TYPES = ("per_chip", "imbalance")
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
@@ -380,7 +473,8 @@ def validate_record(rec: Dict[str, Any]) -> None:
     rtype = rec.get("type")
     if rtype not in RECORD_SCHEMA or \
             (v == 1 and rtype in _V2_ONLY_TYPES) or \
-            (v < 3 and rtype in _V3_ONLY_TYPES):
+            (v < 3 and rtype in _V3_ONLY_TYPES) or \
+            (v < 4 and rtype in _V4_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):
@@ -433,13 +527,20 @@ class TelemetrySink:
             self.emit("run_start", **run_meta)
 
     def emit(self, rec_type: str, **fields) -> Dict[str, Any]:
-        # non-finite counters -> null: NaN/Infinity literals are not
-        # JSON and would break strict readers on exactly the unhealthy
-        # runs this recorder exists to capture (the `finite` flag
-        # carries the health state)
-        fields = {k: (None if isinstance(v, float)
-                      and not np.isfinite(v) else v)
-                  for k, v in fields.items()}
+        # non-finite counters -> null, recursively (the per_chip
+        # record nests vectors): NaN/Infinity literals are not JSON
+        # and would break strict readers on exactly the unhealthy runs
+        # this recorder exists to capture (the `finite` flag carries
+        # the health state)
+        def _scrub(v):
+            if isinstance(v, float) and not np.isfinite(v):
+                return None
+            if isinstance(v, (list, tuple)):
+                return [_scrub(x) for x in v]
+            if isinstance(v, dict):
+                return {k: _scrub(x) for k, x in v.items()}
+            return v
+        fields = {k: _scrub(v) for k, v in fields.items()}
         rec = {"v": SCHEMA_VERSION, "type": rec_type, **fields}
         validate_record(rec)
         if rec_type == "chunk":

@@ -20,6 +20,12 @@ SCAN_DIRS = (os.path.join(ROOT, "fdtd3d_tpu"),
 # log.py IS the print wrapper — the single allowed call site.
 ALLOWED = {"log.py"}
 
+# Quarantined LEGACY tools (round 10): superseded by the attribution
+# layer (PR 3) and gated behind --i-know-this-is-legacy; they are
+# frozen historical reproduction scripts, not part of the maintained
+# tools surface this lint guards.
+LEGACY = {"measure_r3.py", "measure_r4.py"}
+
 # a call site: "print(" not preceded by a word char or dot (so
 # pprint(, x.print( and docstring prose mentioning print() with a
 # preceding backtick/quote still need the line-level filters below)
@@ -47,7 +53,8 @@ def test_no_bare_print_outside_log():
     for scan_root in SCAN_DIRS:
         for root, _dirs, files in os.walk(scan_root):
             for fname in files:
-                if not fname.endswith(".py") or fname in ALLOWED:
+                if not fname.endswith(".py") or fname in ALLOWED \
+                        or fname in LEGACY:
                     continue
                 path = os.path.join(root, fname)
                 for lineno, tok in _code_lines(path):

@@ -410,11 +410,12 @@ def test_tb_vmem_ladder_downgrade_to_packed(monkeypatch):
     real = solver.make_chunk_runner
 
     def forced_packed(static, mesh_axes=None, mesh_shape=None,
-                      health=False):
+                      health=False, per_chip=False):
         saved = os.environ.get("FDTD3D_NO_TEMPORAL")
         os.environ["FDTD3D_NO_TEMPORAL"] = "1"
         try:
-            return real(static, mesh_axes, mesh_shape, health=health)
+            return real(static, mesh_axes, mesh_shape, health=health,
+                        per_chip=per_chip)
         finally:
             if saved is None:
                 os.environ.pop("FDTD3D_NO_TEMPORAL", None)

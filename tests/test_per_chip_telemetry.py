@@ -1,0 +1,187 @@
+"""Per-chip telemetry lane (schema v4, ISSUE 7 tentpole).
+
+The fused health readback gains an optional UN-psummed per-chip
+counter tuple (tiny all_gathered scalars on the SAME single readback);
+the sink records them as v4 ``per_chip`` records plus an ``imbalance``
+summary (max/mean ratio, argmax straggler chip). Asserted on the
+8-device virtual CPU mesh; plus the v1-v4 fixture-corpus round-trip.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from fdtd3d_tpu import telemetry
+from fdtd3d_tpu.config import (OutputConfig, ParallelConfig,
+                               PmlConfig, PointSourceConfig, SimConfig)
+from fdtd3d_tpu.sim import Simulation
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(ROOT, "tests", "fixtures")
+
+
+def _cfg(tmp_path, n_devices=8, per_chip=True):
+    return SimConfig(
+        scheme="3D", size=(16, 16, 16), time_steps=4, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(2, 2, 2)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(8, 8, 8)),
+        parallel=ParallelConfig(topology="auto", n_devices=n_devices)
+        if n_devices > 1 else ParallelConfig(),
+        output=OutputConfig(telemetry_path=str(tmp_path / "t.jsonl"),
+                            per_chip_telemetry=per_chip))
+
+
+def test_per_chip_records_on_mesh(tmp_path):
+    cfg = _cfg(tmp_path)
+    sim = Simulation(cfg, devices=jax.devices()[:8])
+    assert sim.mesh is not None
+    sim.advance(2)
+    sim.advance(2)
+    sim.close()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    chunks = [r for r in recs if r["type"] == "chunk"]
+    pcs = [r for r in recs if r["type"] == "per_chip"]
+    imbs = [r for r in recs if r["type"] == "imbalance"]
+    assert len(pcs) == len(chunks) == 2
+    assert len(imbs) == 2
+    pc = pcs[-1]
+    assert pc["v"] == 4 and pc["n_chips"] == 8
+    assert set(pc["counters"]) == set(telemetry.PER_CHIP_KEYS)
+    for vec in pc["counters"].values():
+        assert len(vec) == 8
+    # the un-psummed per-chip energies sum to the global counter, and
+    # the per-chip max_e maxes to it (the same reduction, split open)
+    chunk = chunks[-1]
+    assert sum(pc["counters"]["energy"]) == \
+        pytest.approx(chunk["energy"], rel=1e-5)
+    assert max(pc["counters"]["max_e"]) == \
+        pytest.approx(chunk["max_e"], rel=1e-6)
+    # imbalance summarizes that vector: point source in one shard ->
+    # a real straggler chip with ratio > 1
+    imb = imbs[-1]
+    assert imb["n_chips"] == 8
+    assert imb["argmax"] == int(np.argmax(pc["counters"]["energy"]))
+    assert imb["ratio"] is not None and imb["ratio"] > 1.0
+    assert imb["max"] == pytest.approx(max(pc["counters"]["energy"]))
+
+
+def test_per_chip_same_single_readback(tmp_path, monkeypatch):
+    """The lane rides the existing one-readback budget: enabling it
+    must not add device_get calls."""
+    calls = []
+    orig = jax.device_get
+
+    def counting(x):
+        calls.append(1)
+        return orig(x)
+
+    cfg = _cfg(tmp_path)
+    sim = Simulation(cfg, devices=jax.devices()[:8])
+    monkeypatch.setattr(jax, "device_get", counting)
+    sim.advance(2)
+    assert sum(calls) == 1
+    sim.close()
+
+
+def test_per_chip_unsharded_degenerates(tmp_path):
+    """A single-device run still writes per_chip records (length-1
+    vectors, one shape for consumers) but no imbalance record —
+    nothing to compare."""
+    cfg = _cfg(tmp_path, n_devices=1)
+    sim = Simulation(cfg)
+    sim.advance(2)
+    sim.close()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    pcs = [r for r in recs if r["type"] == "per_chip"]
+    assert pcs and pcs[0]["n_chips"] == 1
+    assert all(len(v) == 1 for v in pcs[0]["counters"].values())
+    assert not [r for r in recs if r["type"] == "imbalance"]
+
+
+def test_per_chip_off_by_default(tmp_path):
+    cfg = _cfg(tmp_path, per_chip=False)
+    sim = Simulation(cfg, devices=jax.devices()[:8])
+    sim.advance(2)
+    sim.close()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    assert not [r for r in recs
+                if r["type"] in ("per_chip", "imbalance")]
+
+
+def test_schema_v4_validation_rules():
+    pc = {"chunk": 1, "t": 8, "n_chips": 2,
+          "counters": {"energy": [1.0, 2.0]}}
+    imb = {"chunk": 1, "t": 8, "metric": "energy", "max": 2.0,
+           "mean": 1.5, "ratio": 1.333, "argmax": 1, "n_chips": 2}
+    telemetry.validate_record({"v": 4, "type": "per_chip", **pc})
+    telemetry.validate_record({"v": 4, "type": "imbalance", **imb})
+    # the v4 types are unknown to every older version
+    for v in (1, 2, 3):
+        with pytest.raises(ValueError, match="unknown record type"):
+            telemetry.validate_record({"v": v, "type": "per_chip",
+                                       **pc})
+        with pytest.raises(ValueError, match="unknown record type"):
+            telemetry.validate_record({"v": v, "type": "imbalance",
+                                       **imb})
+    with pytest.raises(ValueError, match="missing"):
+        telemetry.validate_record({"v": 4, "type": "per_chip",
+                                   "chunk": 1, "t": 8})
+    # a degenerate imbalance (zero mean) records ratio null
+    telemetry.validate_record({"v": 4, "type": "imbalance",
+                               **dict(imb, ratio=None)})
+
+
+def test_imbalance_summary_helper():
+    s = telemetry.imbalance_summary(
+        {"energy": [1.0, 1.0, 2.0, 0.0]})
+    assert s["max"] == 2.0 and s["argmax"] == 2 and s["n_chips"] == 4
+    assert s["ratio"] == pytest.approx(2.0 / 1.0)
+    assert telemetry.imbalance_summary({"energy": [1.0]}) is None
+    assert telemetry.imbalance_summary({}) is None
+    # a NON-FINITE chip is the worst straggler there is: it is named
+    # as argmax (ratio null, nonfinite_chips listed) — never dropped
+    # in favor of a healthy chip (review finding, round 10)
+    s2 = telemetry.imbalance_summary(
+        {"energy": [1.0, float("nan"), 3.0]})
+    assert s2["argmax"] == 1 and s2["nonfinite_chips"] == [1]
+    assert s2["ratio"] is None and s2["max"] == 3.0
+
+
+def test_sink_scrubs_nested_nonfinite(tmp_path):
+    """A diverging chip's NaN counter must not emit a NaN literal
+    (not JSON) inside the nested per_chip vectors."""
+    sink = telemetry.TelemetrySink(str(tmp_path / "s.jsonl"))
+    sink.emit("per_chip", chunk=1, t=8, n_chips=2,
+              counters={"energy": [1.0, float("nan")]})
+    sink._fh.close()
+    sink._fh = None
+    line = (tmp_path / "s.jsonl").read_text().strip()
+    rec = json.loads(line)  # would raise on a bare NaN literal
+    assert rec["counters"]["energy"] == [1.0, None]
+
+
+def test_fixture_corpus_round_trips_v1_to_v4():
+    """Satellite acceptance: every checked-in telemetry JSONL fixture
+    still validates, and the corpus spans schema v1..v4 so no version
+    can silently rot out of the read path."""
+    paths = sorted(glob.glob(os.path.join(FIX, "*.jsonl")))
+    assert paths, "no JSONL fixtures found"
+    versions = set()
+    for path in paths:
+        for rec in telemetry.read_jsonl(path):  # validates each record
+            versions.add(rec["v"])
+            # round-trip: re-serialized records validate too
+            telemetry.validate_record(json.loads(json.dumps(rec)))
+    assert versions >= set(telemetry.READ_VERSIONS), versions
+    # and the v4 file specifically carries the new record types
+    types = {r["type"] for r in telemetry.read_jsonl(
+        os.path.join(FIX, "telemetry_v4.jsonl"))}
+    assert {"per_chip", "imbalance", "retry", "rollback",
+            "degrade"} <= types

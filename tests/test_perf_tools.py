@@ -49,7 +49,7 @@ def test_run_start_carries_device_kind_and_probe(tmp_path):
         telemetry.set_hbm_probe(None)
     recs = telemetry.read_jsonl(cfg.output.telemetry_path)
     start = recs[0]
-    assert start["v"] == 3
+    assert start["v"] == telemetry.SCHEMA_VERSION
     assert isinstance(start["device_kind"], str) and start["device_kind"]
     assert start["hbm_gbps"] == 612.5
 
@@ -70,12 +70,14 @@ def test_schema_v2_validation_rules():
     telemetry.validate_record({"v": 2, "type": "attribution", **att})
     with pytest.raises(ValueError, match="unknown record type"):
         telemetry.validate_record({"v": 1, "type": "attribution", **att})
-    # v3 (round 9) is a valid version now — but the v2 required keys
-    # still apply to it
-    with pytest.raises(ValueError, match="device_kind"):
-        telemetry.validate_record({"v": 3, "type": "run_start", **base})
+    # v3 (round 9) and v4 (round 10) are valid versions now — but the
+    # v2 required keys still apply to them
+    for v in (3, 4):
+        with pytest.raises(ValueError, match="device_kind"):
+            telemetry.validate_record({"v": v, "type": "run_start",
+                                       **base})
     with pytest.raises(ValueError, match="not in"):
-        telemetry.validate_record({"v": 4, "type": "run_start", **base})
+        telemetry.validate_record({"v": 5, "type": "run_start", **base})
 
 
 def test_fixture_jsonl_validates_and_reports():
@@ -351,6 +353,207 @@ def test_bench_invokes_sentinel():
     import inspect
     src = inspect.getsource(bench.run_measurement)
     assert "perf_sentinel" in src and "check_artifact" in src
+
+
+# -------------------------------------------------------------------------
+# comm lane (round 10): sentinel gate, per-core attribution, aot_overlap
+# -------------------------------------------------------------------------
+
+def _comm_fix(name):
+    with open(os.path.join(FIX, name)) as f:
+        return json.load(f)
+
+
+def test_sentinel_comm_lane_verdicts():
+    """Acceptance: PASS on ref/ref, REGRESSION on regressed/ref —
+    chip-free, from the checked-in v2-ledger fixture pair."""
+    ps = _sentinel()
+    ref = _comm_fix("comm_ref.json")
+    bad = _comm_fix("comm_regressed.json")
+    ok = ps.check_comm(ref, ref)
+    assert ok["status"] == "OK" and not ok["regressions"]
+    v = ps.check_comm(bad, ref)
+    assert v["status"] == "REGRESSION"
+    msgs = " | ".join(v["regressions"])
+    assert "halo-bytes/chip" in msgs
+    assert "overlap windows" in msgs
+    assert "synchronous collective-permutes" in msgs
+    # the fixture pair also encodes the overlap claim itself
+    assert ref["comm"]["async_windows"]["windows_with_compute"] == 2
+    assert bad["comm"]["async_windows"]["windows_with_compute"] == 0
+
+
+def test_sentinel_comm_skips_cross_topology_and_v1():
+    ps = _sentinel()
+    ref = _comm_fix("comm_ref.json")
+    other = json.loads(json.dumps(ref))
+    other["comm"]["topology"] = [1, 2, 4]
+    assert ps.check_comm(other, ref)["status"] == "SKIPPED"
+    # a v1 ledger (no comm lane) skips rather than phantom-gating
+    v1 = _comm_fix("ledger_ref.json")
+    assert ps.check_comm(v1, ref)["status"] == "SKIPPED"
+    # cross-kind never diffs
+    jnp_led = json.loads(json.dumps(ref))
+    jnp_led["step_kind"] = "jnp"
+    assert ps.check_comm(jnp_led, ref)["status"] == "SKIPPED"
+
+
+def test_sentinel_comm_missing_overlap_is_inconclusive():
+    """A current ledger shipped WITHOUT an aot_overlap artifact while
+    the reference gates overlap must say so (INCONCLUSIVE), never
+    silently pass the window checks (review finding, round 10)."""
+    ps = _sentinel()
+    ref = _comm_fix("comm_ref.json")
+    cur = json.loads(json.dumps(ref))
+    del cur["comm"]["async_windows"]
+    v = ps.check_comm(cur, ref)
+    assert v["status"] == "INCONCLUSIVE"
+    assert not v["regressions"]
+    assert any("NOT evaluated" in m for m in v["inconclusive"])
+    # the reverse (ref has no overlap on record) stays OK — there is
+    # nothing to gate against
+    v2 = ps.check_comm(ref, cur)
+    assert v2["status"] == "OK"
+
+
+def test_sentinel_comm_attribution_bar_gates():
+    """A strategy change that loses the halo-exchange scoping (<95%
+    attribution) is itself a regression — it blinds the lane."""
+    ps = _sentinel()
+    ref = _comm_fix("comm_ref.json")
+    blind = json.loads(json.dumps(ref))
+    blind["comm"]["per_step"]["halo_attribution"] = 0.80
+    v = ps.check_comm(blind, ref)
+    assert v["status"] == "REGRESSION"
+    assert any("attribution" in m for m in v["regressions"])
+
+
+def test_sentinel_comm_cli_exit_codes(tmp_path):
+    tool = os.path.join(ROOT, "tools", "perf_sentinel.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps({"platform": "cpu"}))
+
+    def run(comm_file):
+        return subprocess.run(
+            [sys.executable, tool, str(cur),
+             "--best", os.path.join(FIX, "bench_best.json"),
+             "--history", os.path.join(FIX, "bench_history_r*.json"),
+             "--comm", os.path.join(FIX, comm_file),
+             "--comm-ref", os.path.join(FIX, "comm_ref.json")],
+            capture_output=True, text=True, timeout=120, env=env)
+
+    ok = run("comm_ref.json")
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = run("comm_regressed.json")
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "halo-bytes/chip" in bad.stderr
+
+
+def test_aot_overlap_hlo_gate_chip_free(tmp_path):
+    """tools/aot_overlap.py --hlo: the async-window analysis runs on a
+    checked-in scheduled-HLO fixture with no TPU toolchain at all, and
+    --out writes the schema-tagged artifact the comm lane embeds."""
+    ao = _load_tool("aot_overlap")
+    out = tmp_path / "overlap.json"
+    rc = ao.main(["--hlo", os.path.join(FIX, "overlap_ref.hlo"),
+                  "--out", str(out)])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    ao.validate_overlap(art)
+    assert art["schema"] == "fdtd3d-overlap"
+    assert art["sync_collective_permutes"] == 0
+    assert art["async_starts"] == 2 and art["async_dones"] == 2
+    assert art["windows"] == 2 and art["windows_with_compute"] == 2
+    assert art["heavy_ops_inside_windows"] == 4
+    with pytest.raises(ValueError, match="fdtd3d-overlap"):
+        ao.validate_overlap({"schema": "nope"})
+
+
+def test_trace_attribution_multicore_golden():
+    """Satellite acceptance: the synthetic multi-core (TPU-shaped)
+    fixture drives the per-core path — golden per-core sums, imbalance
+    ratio, and the named top-straggler core."""
+    ta = _load_tool("trace_attribution")
+    path = os.path.join(FIX, "fixture.trace.multicore.json")
+    events = ta._load_events(path)
+    per_core = ta.attribute_events_per_core(events)
+    assert set(per_core) == {"TPU:0", "TPU:1", "TPU:2", "TPU:3"}
+    assert per_core["TPU:2"] == pytest.approx(
+        {"packed-kernel": 0.330, "halo-exchange": 0.060,
+         "health": 0.010})
+    imb = ta.core_imbalance(per_core)
+    assert imb["straggler"] == "TPU:2"
+    assert imb["max_ms"] == pytest.approx(0.400)
+    assert imb["mean_ms"] == pytest.approx(0.300)
+    assert imb["ratio"] == pytest.approx(1.3333, abs=1e-4)
+    # merged into the attribution record, still schema-valid
+    graph_ms, host_ms = ta.attribute_events(events)
+    rec = ta.merge_with_ledger(graph_ms, host_ms, None, path,
+                               per_core=per_core)
+    telemetry.validate_record(rec)
+    assert rec["imbalance"]["straggler"] == "TPU:2"
+    assert rec["per_core"]["TPU:3"]["total_ms"] == pytest.approx(0.300)
+    txt = ta.format_text(rec)
+    assert "straggler TPU:2" in txt
+    # host-only/single-core events yield no per-core lane (no keys)
+    rec2 = ta.merge_with_ledger(graph_ms, host_ms, None, path,
+                                per_core={})
+    assert "per_core" not in rec2 and "imbalance" not in rec2
+
+
+def test_trace_attribution_core_name_variants():
+    ta = _load_tool("trace_attribution")
+    assert ta._core_of("/device:TPU:3") == "TPU:3"
+    assert ta._core_of("TPU:1 (pid 7)") == "TPU:1"
+    # chip AND core both survive: two chips' core-0 timelines must
+    # not merge into one key (review finding, round 10)
+    assert ta._core_of("Chip 0 Core 1") == "chip0-core1"
+    assert ta._core_of("Chip 1 Core 1") == "chip1-core1"
+    assert ta._core_of("Core 2") == "core:2"
+    assert ta._core_of("python main thread") is None
+
+
+def test_legacy_measure_tools_quarantined():
+    """Satellite: measure_r3/r4 exit 2 without the explicit opt-in
+    flag and still run (import-time) with it."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for tool in ("measure_r3.py", "measure_r4.py"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", tool)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc.returncode == 2, (tool, proc.stdout, proc.stderr)
+        assert "--i-know-this-is-legacy" in proc.stderr
+    # the gate function itself accepts the flag (the full sweep is a
+    # chip-window affair, not a tier-1 run)
+    m3 = _load_tool("measure_r3")
+    assert m3.require_legacy_flag(["--i-know-this-is-legacy"]) is True
+    assert m3.require_legacy_flag([]) is False
+
+
+def test_bench_embeds_multichip_summary():
+    """Satellite: the bench artifact carries the MULTICHIP comm
+    summary (modeled halo-bytes/chip + topology table; overlap windows
+    and per-chip imbalance degrade to explanatory notes off-chip)."""
+    import bench
+    out = bench._comm_observability()
+    assert out["topology"] == [2, 2, 2]
+    assert out["halo_bytes_per_chip_per_step"] > 0
+    assert "2.2.2" in out["halo_topology_table"]
+    # chip-free container: both runtime lanes explain their absence
+    assert out["overlap_windows"] is None or \
+        "windows_with_compute" in out["overlap_windows"]
+    # and the hook site exists in the measurement path
+    import inspect
+    src = inspect.getsource(bench.run_measurement)
+    assert "_comm_observability" in src and '"multichip"' in src
+    # with a telemetry file carrying v4 imbalance records, the worst
+    # ratio + straggler surface
+    out2 = bench._comm_observability(
+        telemetry_path=os.path.join(FIX, "telemetry_v4.jsonl"))
+    imb = out2["per_chip_imbalance"]
+    assert imb["worst_ratio"] == pytest.approx(1.0333)
+    assert imb["straggler_chip"] == 5 and imb["n_chips"] == 8
 
 
 # -------------------------------------------------------------------------

@@ -223,7 +223,8 @@ def test_ladder_downgrade_event(tmp_path, monkeypatch):
 
     import fdtd3d_tpu.solver as solver_mod
 
-    def fake_runner(static, mesh_axes, mesh_shape, health=False):
+    def fake_runner(static, mesh_axes, mesh_shape, health=False,
+                    per_chip=False):
         r = lambda state, coeffs, n: state  # noqa: E731
         r.kind = "pallas_packed"
         r.diag = {"tile": {"EH": 4}}

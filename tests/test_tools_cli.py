@@ -1,0 +1,133 @@
+"""Tier-1 tools-CLI smoke (ISSUE 7 satellite, CI/tooling).
+
+The per-tool tests exercise library functions through importlib; what
+they MISS is rot in the CLI surface itself — a broken import, a
+renamed flag, an argparse typo — which only shows up when the script
+runs as an operator would run it. This file subprocess-runs every
+``tools/*.py``:
+
+* ``--help`` must exit 0 for every maintained tool (quarantined LEGACY
+  tools instead prove their gate: exit 2 + the opt-in flag hint);
+* every tool with a checked-in fixture also runs ONCE end-to-end on
+  it, chip-free.
+
+Chip-bound sweeps (decompose_overhead, measure_lowdim,
+accuracy_frontier, weak_scaling) only smoke ``--help`` here — their
+measurement bodies are chip-window affairs.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+FIX = os.path.join(ROOT, "tests", "fixtures")
+
+# Quarantined legacy tools: their CLI contract IS the refusal.
+LEGACY = {"measure_r3.py", "measure_r4.py"}
+
+ALL_TOOLS = sorted(os.path.basename(p)
+                   for p in glob.glob(os.path.join(TOOLS, "*.py")))
+
+
+def _run(args, timeout=180):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=timeout, env=env,
+                          cwd=ROOT)
+
+
+def test_tool_listing_is_current():
+    """The smoke surface tracks the directory — a new tool cannot be
+    added without joining (or explicitly quarantining from) the lane."""
+    assert ALL_TOOLS, "tools/ is empty?"
+    assert LEGACY <= set(ALL_TOOLS)
+
+
+@pytest.mark.parametrize("tool",
+                         [t for t in ALL_TOOLS if t not in LEGACY])
+def test_every_tool_help_exits_zero(tool):
+    proc = _run([os.path.join(TOOLS, tool), "--help"])
+    assert proc.returncode == 0, (tool, proc.stdout, proc.stderr)
+    assert "usage" in proc.stdout.lower(), (tool, proc.stdout)
+
+
+@pytest.mark.parametrize("tool", sorted(LEGACY))
+def test_legacy_tools_refuse_without_flag(tool):
+    proc = _run([os.path.join(TOOLS, tool), "--help"])
+    assert proc.returncode == 2, (tool, proc.stdout, proc.stderr)
+    assert "--i-know-this-is-legacy" in proc.stderr, tool
+
+
+# -------------------------------------------------------------------------
+# one fixture-driven end-to-end run per fixture-capable tool
+# -------------------------------------------------------------------------
+
+def test_telemetry_report_runs_on_fixtures():
+    for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl"):
+        proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
+                     os.path.join(FIX, fixture), "--json"])
+        assert proc.returncode == 0, (fixture, proc.stderr)
+        json.loads(proc.stdout)  # --json emits parseable summaries
+
+
+def test_trace_attribution_runs_on_fixtures(tmp_path):
+    out = tmp_path / "attr.jsonl"
+    proc = _run([os.path.join(TOOLS, "trace_attribution.py"),
+                 os.path.join(FIX, "fixture.trace.multicore.json"),
+                 "--ledger", os.path.join(FIX, "comm_ref.json"),
+                 "--json", "--out", str(out)])
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(out.read_text())
+    assert rec["type"] == "attribution"
+    assert rec["imbalance"]["straggler"] == "TPU:2"
+
+
+def test_perf_sentinel_runs_on_fixtures(tmp_path):
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps({"platform": "cpu"}))
+    proc = _run([os.path.join(TOOLS, "perf_sentinel.py"), str(cur),
+                 "--best", os.path.join(FIX, "bench_best.json"),
+                 "--history", os.path.join(FIX, "bench_history_r*.json"),
+                 "--ledger", os.path.join(FIX, "ledger_ref.json"),
+                 "--ledger-ref", os.path.join(FIX, "ledger_ref.json"),
+                 "--comm", os.path.join(FIX, "comm_ref.json"),
+                 "--comm-ref", os.path.join(FIX, "comm_ref.json"),
+                 "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    verdict = json.loads(proc.stdout)
+    assert verdict["ledger"]["status"] == "OK"
+    assert verdict["comm"]["status"] == "OK"
+
+
+def test_aot_overlap_runs_on_fixture(tmp_path):
+    out = tmp_path / "overlap.json"
+    proc = _run([os.path.join(TOOLS, "aot_overlap.py"),
+                 "--hlo", os.path.join(FIX, "overlap_ref.hlo"),
+                 "--out", str(out)])
+    assert proc.returncode == 0, proc.stderr
+    art = json.loads(out.read_text())
+    assert art["schema"] == "fdtd3d-overlap"
+    assert art["windows_with_compute"] == 2
+
+
+def test_costs_module_cli_runs():
+    """python -m fdtd3d_tpu.costs is the ledger's operator entry —
+    smoke the sharded comm-lane form too (8 virtual devices)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                         + " --xla_force_host_platform_device_count=8"
+                         ).strip()}
+    proc = subprocess.run(
+        [sys.executable, "-m", "fdtd3d_tpu.costs", "--kind", "jnp",
+         "--same-size", "16", "--pml-size", "2",
+         "--topology", "2,2,2", "--hbm-gbps", "600"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr
+    led = json.loads(proc.stdout)
+    assert led["comm"]["per_step"]["halo_attribution"] >= 0.95

@@ -47,14 +47,16 @@ def _plan_for(tile: int, n_devices: int):
 
 
 def test_halo_traffic_invariant_under_weak_scaling():
-    """plan.py's per-chip halo bytes/step must be constant under weak
-    scaling once all three axes shard (8 -> 64 -> 512 chips), and must
-    equal the hand formula: per sharded axis, 2 directions x
-    _halo_planes curl-term planes x tile^2 x itemsize (VERDICT weak-4).
-    """
-    from fdtd3d_tpu.plan import _halo_planes
-    from fdtd3d_tpu.solver import build_static
-    from fdtd3d_tpu.config import SimConfig
+    """The ledger comm model's per-chip halo bytes/step (the ONE
+    source of truth the tools quote: costs.halo_bytes_per_chip ->
+    plan.py) must be constant under weak scaling once all three axes
+    shard (8 -> 64 -> 512 chips), agree with plan() row-for-row, AND
+    match the independent hand curl-term oracle — kept in the TEST
+    precisely so plan() is never verified against itself
+    (VERDICT weak-4)."""
+    from fdtd3d_tpu.costs import halo_bytes_per_chip
+    from fdtd3d_tpu.config import ParallelConfig, PmlConfig, SimConfig
+    from fdtd3d_tpu.parallel.mesh import choose_topology
 
     tile = 16
     plans = {n: _plan_for(tile, n) for n in (8, 64, 512)}
@@ -65,12 +67,35 @@ def test_halo_traffic_invariant_under_weak_scaling():
     halos = {n: p.halo_bytes_per_step for n, p in plans.items()}
     assert len(set(halos.values())) == 1, halos
 
-    # hand formula cross-check against the mode's curl-term counts
+    # independent magnitude oracle (kept on purpose: the tools quote
+    # ONE model, but the test must not verify plan() against itself):
+    # per sharded axis, 2 directions x curl-term planes x tile^2 x 4 B
+    from fdtd3d_tpu.plan import _halo_planes
+    from fdtd3d_tpu.solver import build_static
     mode = build_static(SimConfig(scheme="3D", size=(16, 16, 16),
                                   time_steps=1)).mode
     expect = sum(2 * _halo_planes(mode, a) * tile * tile * 4
                  for a in range(3))
-    assert halos[8] == expect, (halos[8], expect)
+    assert halos[512] == expect, (halos[512], expect)
+
+    # the planner's number IS the ledger comm model's number, per
+    # topology (single source of truth — what weak_scaling.py rows and
+    # the ledger comm lane both quote)
+    for n, p in plans.items():
+        probe = choose_topology(n, (tile * n,) * 3, (0, 1, 2))
+        size = tuple(tile * t for t in probe)
+        cfg = SimConfig(
+            scheme="3D", size=size, time_steps=4, dx=1e-3,
+            courant_factor=0.5, wavelength=32e-3,
+            pml=PmlConfig(size=(min(10, tile // 4),) * 3),
+            parallel=ParallelConfig(topology="auto", n_devices=n))
+        assert halo_bytes_per_chip(cfg, p.topology) == \
+            p.halo_bytes_per_step, n
+    # and the per-axis breakdown sums to the total
+    bya = plans[8].halo_by_axis
+    assert sum(r["bytes_per_step"] for r in bya.values()) == halos[8]
+    assert all(r["bytes_per_step"] == 2 * r["bytes_per_neighbor_per_step"]
+               for r in bya.values())
 
     # per-chip state is constant under weak scaling too
     hbm = {n: p.hbm_per_chip for n, p in plans.items()}
@@ -79,7 +104,9 @@ def test_halo_traffic_invariant_under_weak_scaling():
 
 def test_plan_matches_live_run_topology():
     """The planner's chosen topology agrees with what the live 8-device
-    run resolves (the accounting is about THAT decomposition)."""
+    run resolves (the accounting is about THAT decomposition), and the
+    harness row carries the ledger comm model's halo number for it."""
     r8 = run_point(8, tile=16, steps=4)
     p8 = _plan_for(16, 8)
     assert tuple(r8["topology"]) == p8.topology
+    assert r8["halo_bytes_per_chip_per_step"] == p8.halo_bytes_per_step

@@ -32,8 +32,18 @@ exceeds one chip's VMEM). Measured 2026-07-31: pallas_packed_ds,
 0 synchronous, 12 async pairs (4 extra: the lo-word ghost planes),
 11/12 windows with compute inside, 940 heavy ops total.
 
+Round 10 — first-class chip-free gate: the analysis half is decoupled
+from the AOT compile half. ``--hlo FILE`` analyzes an already-dumped
+scheduled-HLO text (e.g. a checked-in fixture, or a --dump from a
+previous window) with NO toolchain at all, and ``--out PATH`` writes
+the counts as a schema-tagged JSON artifact ("fdtd3d-overlap") that
+``python -m fdtd3d_tpu.costs --overlap`` embeds in the ledger comm
+lane and ``tools/perf_sentinel.py``'s comm lane gates: a strategy
+change that loses async windows (or reintroduces synchronous
+collective-permutes) fails deterministically, no chip needed.
+
 Usage: python tools/aot_overlap.py [--n 128] [--topo v5e:2x2]
-       [--dtype float32|float32x2]
+       [--dtype float32|float32x2] [--hlo FILE] [--out PATH]
 """
 
 import argparse
@@ -164,32 +174,74 @@ def analyze(txt: str):
     }
 
 
-def main():
-    ap = argparse.ArgumentParser()
+# ONE schema + validator, owned by the comm lane (the ledger ingest
+# side) — this tool writes what that side reads
+from fdtd3d_tpu.costs import OVERLAP_SCHEMA  # noqa: E402
+from fdtd3d_tpu.costs import check_overlap_artifact as \
+    validate_overlap  # noqa: E402
+
+
+def overlap_artifact(counts: dict, source: str, **meta) -> dict:
+    """Schema-tagged artifact dict the ledger comm lane / sentinel
+    consume (costs.chunk_ledger(overlap=...), perf_sentinel --comm)."""
+    out = {"schema": OVERLAP_SCHEMA, "source": source}
+    out.update(meta)
+    out.update(counts)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="halo compute/communication-overlap evidence from "
+                    "scheduled HLO: AOT-compile an abstract multi-chip "
+                    "topology, or analyze a dumped HLO text chip-free "
+                    "(--hlo)")
     ap.add_argument("--n", type=int, default=None,
                     help="global grid edge (default 128; 64 for "
                          "float32x2, whose 128^3 pair-operand tile "
                          "exceeds one chip's VMEM — this tool compiles "
                          "the raw runner, no VMEM fallback ladder)")
     ap.add_argument("--topo", default="v5e:2x2")
-    ap.add_argument("--dump", default="")
+    ap.add_argument("--dump", default="",
+                    help="also write the scheduled HLO text here "
+                         "(re-analyzable later via --hlo)")
     ap.add_argument("--dtype", default="float32",
                     choices=("float32", "float32x2"),
                     help="field storage dtype; float32x2 compiles the "
                          "packed-ds kernel's 4-chip executable")
-    args = ap.parse_args()
-    if args.n is None:
-        args.n = 64 if args.dtype == "float32x2" else 128
-    kind, compiled = build_compiled(args.n, args.topo, args.dtype)
-    txt = compiled.as_text()
-    if args.dump:
-        with open(args.dump, "w") as f:
-            f.write(txt)
-    out = {"topology": args.topo, "n": args.n, "dtype": args.dtype,
-           "step_kind": kind}
-    out.update(analyze(txt))
+    ap.add_argument("--hlo", metavar="FILE", default=None,
+                    help="analyze this scheduled-HLO text instead of "
+                         "AOT-compiling (chip- and toolchain-free)")
+    ap.add_argument("--out", metavar="PATH", default=None,
+                    help="write the counts as a schema-tagged JSON "
+                         "artifact (ledger --overlap / sentinel --comm "
+                         "input)")
+    args = ap.parse_args(argv)
+    if args.hlo:
+        with open(args.hlo) as f:
+            txt = f.read()
+        out = overlap_artifact(analyze(txt), f"hlo:{args.hlo}")
+    else:
+        if args.n is None:
+            args.n = 64 if args.dtype == "float32x2" else 128
+        kind, compiled = build_compiled(args.n, args.topo, args.dtype)
+        txt = compiled.as_text()
+        if args.dump:
+            with open(args.dump, "w") as f:
+                f.write(txt)
+        out = overlap_artifact(analyze(txt), f"aot:{args.topo}",
+                               topology=args.topo, n=args.n,
+                               dtype=args.dtype, step_kind=kind)
+    validate_overlap(out)
+    if args.out:
+        d = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(d, exist_ok=True)
+        from fdtd3d_tpu.io import atomic_open
+        with atomic_open(args.out, "w") as f:
+            f.write(json.dumps(out, indent=1) + "\n")
     report(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

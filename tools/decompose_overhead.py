@@ -63,6 +63,15 @@ def time_chunk(sim, n_steps, repeats=3):
 
 
 def main():
+    # argparse for the --help contract alone (the smoke lane in
+    # tests/test_tools_cli.py): the sweep itself is argument-free and
+    # chip-bound
+    import argparse
+    argparse.ArgumentParser(
+        description="decompose the per-step overhead wall with "
+                    "controlled on-chip contrasts (chunk-length / pml "
+                    "/ volume / dtype sweeps); chip-window tool, "
+                    "prints one JSON blob").parse_args()
     import jax
     out = {"device": jax.devices()[0].device_kind}
     from bench import probe_hbm_gbps

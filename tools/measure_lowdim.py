@@ -55,6 +55,14 @@ def measure(scheme, size, steps, pml, repeats=3):
 
 
 def main():
+    # argparse for the --help contract alone (the smoke lane in
+    # tests/test_tools_cli.py): the sweep itself is argument-free and
+    # chip-bound
+    import argparse
+    argparse.ArgumentParser(
+        description="1D/2D jnp-path throughput vs the HBM B/cell "
+                    "bound; chip-window tool, one JSON line per "
+                    "case").parse_args()
     from bench import probe_hbm_gbps
 
     try:

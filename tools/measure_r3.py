@@ -1,11 +1,15 @@
-"""LEGACY (round 7): round-3 same-window measurement sweep.
+"""LEGACY (round 7; quarantined round 10): round-3 measurement sweep.
 
-Kept runnable for reproducing BASELINE.md's round-3 table, but the
-blessed way to decompose step time is now the attribution layer:
+Superseded by the attribution layer:
 ``python -m fdtd3d_tpu.costs`` (static per-section flops/bytes ledger,
 no chip needed) + CLI/bench ``--profile DIR`` with
 ``tools/trace_attribution.py`` (measured device-trace time per
-section), gated by ``tools/perf_sentinel.py``.
+section), gated by ``tools/perf_sentinel.py``. Kept ONLY to reproduce
+BASELINE.md's round-3 table: running it now requires the explicit
+``--i-know-this-is-legacy`` flag (exit 2 otherwise), and the file is
+excluded from the tools lint surface (tests/test_lint_no_print.py
+LEGACY set). Its recorded fixture (tools/measure_r3.json, when
+present) stays citable either way.
 
 Round-3 same-window measurement sweep (VERDICT.md round-2 item 2).
 
@@ -77,6 +81,23 @@ def measure(n, steps, use_pallas, dtype="float32", pml_axes="xyz",
 
 def jnp_readback(sim, n):
     return sim.state["E"]["Ez"][n // 2, n // 2, n // 2]
+
+
+LEGACY_FLAG = "--i-know-this-is-legacy"
+
+
+def require_legacy_flag(argv=None) -> bool:
+    """Quarantine gate: True when the caller passed the explicit
+    opt-in flag; otherwise warn-and-refuse (the caller exits 2)."""
+    argv = sys.argv[1:] if argv is None else argv
+    if LEGACY_FLAG in argv:
+        return True
+    warn(f"LEGACY tool (quarantined round 10): superseded by the "
+         f"attribution layer — python -m fdtd3d_tpu.costs, --profile "
+         f"DIR + tools/trace_attribution.py, tools/perf_sentinel.py. "
+         f"To reproduce the historical BASELINE table anyway, re-run "
+         f"with {LEGACY_FLAG}.")
+    return False
 
 
 def main():
@@ -163,4 +184,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if not require_legacy_flag():
+        sys.exit(2)
     main()

@@ -1,11 +1,15 @@
-"""LEGACY (round 7): round-4 same-window measurement sweep.
+"""LEGACY (round 7; quarantined round 10): round-4 kernel sweep.
 
-Kept runnable for reproducing BASELINE.md's round-4 kernel table, but
-the blessed way to decompose step time is now the attribution layer:
+Superseded by the attribution layer:
 ``python -m fdtd3d_tpu.costs`` (static per-section flops/bytes ledger,
 no chip needed) + CLI/bench ``--profile DIR`` with
 ``tools/trace_attribution.py`` (measured device-trace time per
-section), gated by ``tools/perf_sentinel.py``.
+section), gated by ``tools/perf_sentinel.py``. Kept ONLY to reproduce
+BASELINE.md's round-4 kernel table: running it now requires the
+explicit ``--i-know-this-is-legacy`` flag (exit 2 otherwise), and the
+file is excluded from the tools lint surface
+(tests/test_lint_no_print.py LEGACY set). Its recorded fixture
+(tools/measure_r4.json) stays citable either way.
 
 Round-4 same-window measurement sweep (VERDICT.md round-3 items 1/5).
 
@@ -163,4 +167,7 @@ def main():
 
 
 if __name__ == "__main__":
+    from measure_r3 import require_legacy_flag
+    if not require_legacy_flag():
+        sys.exit(2)
     main()

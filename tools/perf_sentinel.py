@@ -24,6 +24,15 @@ With ``--ledger``/``--ledger-ref`` (fdtd3d_tpu/costs.py artifacts) the
 sentinel also diffs the static per-section cost model: per-step bytes
 or flops growth beyond the threshold in any section IS a regression
 outright — the ledger is deterministic, weather is no excuse.
+
+With ``--comm``/``--comm-ref`` (v2 ledgers carrying the ICI comm
+lane) it gates cross-chip communication the same deterministic way,
+same topology only: halo-bytes/chip or message-count growth beyond
+the threshold regresses; so does a drop in the embedded async
+overlap-window count (tools/aot_overlap.py artifacts ride the comm
+lane via ``--overlap``) or any reappearing SYNCHRONOUS
+collective-permute — the chip-free gate set ROADMAP item 1's
+communication-strategy autotuner is built against.
 """
 
 from __future__ import annotations
@@ -229,6 +238,90 @@ def check_ledgers(current: Dict[str, Any], reference: Dict[str, Any],
     return out
 
 
+def check_comm(current: Dict[str, Any], reference: Dict[str, Any],
+               threshold: float = 0.10) -> Dict[str, Any]:
+    """Comm-lane diff of two v2 ledgers (fdtd3d_tpu/costs.py with a
+    ``comm`` table). Deterministic — growth past the threshold is a
+    regression outright. Same step kind AND topology only: halo bytes
+    scale with the decomposition, so a cross-topology diff would gate
+    apples against oranges."""
+    from fdtd3d_tpu import costs
+    costs.validate_ledger(current)
+    costs.validate_ledger(reference)
+    out: Dict[str, Any] = {"threshold": threshold, "regressions": []}
+    cur, ref = current.get("comm"), reference.get("comm")
+    if cur is None or ref is None:
+        out["status"] = "SKIPPED"
+        out["note"] = "one or both ledgers carry no comm lane " \
+                      "(unsharded trace, or a v1 ledger)"
+        return out
+    if current.get("step_kind") != reference.get("step_kind"):
+        out["status"] = "SKIPPED"
+        out["note"] = (f"step kinds differ: {current.get('step_kind')} "
+                       f"vs {reference.get('step_kind')}")
+        return out
+    if cur["topology"] != ref["topology"]:
+        out["status"] = "SKIPPED"
+        out["note"] = (f"topologies differ: {cur['topology']} vs "
+                       f"{ref['topology']} — comm costs only compare "
+                       f"on the same decomposition")
+        return out
+    out["topology"] = cur["topology"]
+    for label, getter in (
+            ("halo-bytes/chip/step (traced)",
+             lambda c: c["per_step"]["ppermute_bytes_per_chip"]),
+            ("halo-bytes/chip/step (plan model)",
+             lambda c: c["plan"]["halo_bytes_per_chip_per_step"]),
+            ("ppermute messages/step",
+             lambda c: c["per_step"]["ppermute_messages"])):
+        cur_v, ref_v = float(getter(cur)), float(getter(ref))
+        growth = cur_v / ref_v - 1.0 if ref_v > 0 else 0.0
+        out[label] = {"current": cur_v, "reference": ref_v,
+                      "growth": round(growth, 4)}
+        if growth > threshold:
+            out["regressions"].append(
+                f"{label} grew {growth:+.1%} ({ref_v:.0f} -> "
+                f"{cur_v:.0f})")
+    # attribution health: the >=95% halo-scope bar is part of the gate
+    # (a strategy change that loses scoping blinds the whole lane)
+    attr = float(cur["per_step"]["halo_attribution"])
+    out["halo_attribution"] = attr
+    if attr < 0.95:
+        out["regressions"].append(
+            f"halo-exchange attribution dropped to {attr:.1%} "
+            f"(<95%: ppermutes outside the named scopes)")
+    # async overlap windows (aot_overlap artifacts riding the ledgers):
+    # FEWER windows-with-compute = overlap lost; any synchronous
+    # collective-permute reappearing = the async lowering itself lost
+    cw, rw = cur.get("async_windows"), ref.get("async_windows")
+    out["inconclusive"] = []
+    if rw and not cw:
+        # the reference gates overlap but the current ledger shipped
+        # without an aot_overlap artifact: the window checks CANNOT
+        # run — say so loudly instead of silently passing them
+        out["inconclusive"].append(
+            "reference carries async_windows but the current ledger "
+            "does not (aot_overlap artifact missing from --overlap): "
+            "overlap-window and sync-permute gates NOT evaluated")
+    if cw and rw:
+        cur_w = int(cw.get("windows_with_compute", 0))
+        ref_w = int(rw.get("windows_with_compute", 0))
+        out["overlap_windows"] = {"current": cur_w, "reference": ref_w}
+        if ref_w > 0 and cur_w < ref_w * (1.0 - threshold):
+            out["regressions"].append(
+                f"async overlap windows with compute dropped "
+                f"{ref_w} -> {cur_w}")
+        cur_sync = int(cw.get("sync_collective_permutes", 0))
+        if cur_sync > int(rw.get("sync_collective_permutes", 0)):
+            out["regressions"].append(
+                f"synchronous collective-permutes appeared: "
+                f"{cur_sync} (ref "
+                f"{rw.get('sync_collective_permutes', 0)})")
+    out["status"] = "REGRESSION" if out["regressions"] else (
+        "INCONCLUSIVE" if out["inconclusive"] else "OK")
+    return out
+
+
 def main(argv=None) -> int:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(
@@ -245,6 +338,11 @@ def main(argv=None) -> int:
                     help="current cost ledger (fdtd3d_tpu.costs) JSON")
     ap.add_argument("--ledger-ref", default=None,
                     help="reference cost ledger to diff against")
+    ap.add_argument("--comm", default=None,
+                    help="current v2 ledger with a comm lane "
+                         "(fdtd3d_tpu.costs --topology)")
+    ap.add_argument("--comm-ref", default=None,
+                    help="reference comm-lane ledger to gate against")
     ap.add_argument("--threshold", type=float, default=0.10)
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
@@ -270,8 +368,16 @@ def main(argv=None) -> int:
             led_ref = json.load(f)
         verdict["ledger"] = check_ledgers(led_cur, led_ref,
                                           threshold=args.threshold)
+    if args.comm and args.comm_ref:
+        with open(args.comm) as f:
+            comm_cur = json.load(f)
+        with open(args.comm_ref) as f:
+            comm_ref = json.load(f)
+        verdict["comm"] = check_comm(comm_cur, comm_ref,
+                                     threshold=args.threshold)
     regressions = verdict["throughput"]["regressions"] \
-        + verdict.get("ledger", {}).get("regressions", [])
+        + verdict.get("ledger", {}).get("regressions", []) \
+        + verdict.get("comm", {}).get("regressions", [])
     verdict["status"] = "REGRESSION" if regressions else \
         verdict["throughput"]["status"]
     if args.json:
@@ -287,9 +393,12 @@ def main(argv=None) -> int:
                       if cur is not None and ref is not None else ""))
         if "ledger" in verdict:
             report(f"  ledger: {verdict['ledger']['status']}")
+        if "comm" in verdict:
+            report(f"  comm:   {verdict['comm']['status']}")
     for msg in regressions:
         warn(f"perf sentinel: {msg}")
-    for msg in verdict["throughput"]["inconclusive"]:
+    for msg in verdict["throughput"]["inconclusive"] \
+            + verdict.get("comm", {}).get("inconclusive", []):
         warn(f"perf sentinel (inconclusive): {msg}")
     return 1 if regressions else 0
 

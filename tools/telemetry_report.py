@@ -82,6 +82,24 @@ def summarize_run(run):
             "degrades": [r for r in run if r["type"] == "degrade"],
         },
     }
+    # per-chip lane (schema v4): the worst per-chunk imbalance ratio
+    # and its straggler chip, when the run recorded the lane
+    imb_all = [r for r in run if r["type"] == "imbalance"]
+    imb = [r for r in imb_all if r.get("ratio") is not None]
+    if imb:
+        worst = max(imb, key=lambda r: r["ratio"])
+        out["imbalance"] = {"chunks": len(imb),
+                            "worst_ratio": worst["ratio"],
+                            "worst_t": worst["t"],
+                            "straggler_chip": worst["argmax"],
+                            "metric": worst["metric"],
+                            "n_chips": worst["n_chips"]}
+    # a diverged (non-finite) chip outranks any ratio: name it
+    bad = next((r for r in imb_all if r.get("nonfinite_chips")), None)
+    if bad is not None:
+        out.setdefault("imbalance", {})["nonfinite_chips"] = \
+            bad["nonfinite_chips"]
+        out["imbalance"]["nonfinite_t"] = bad["t"]
     if not chunks:
         return out
     walls = [c["wall_s"] for c in chunks]
@@ -163,6 +181,20 @@ def format_text(summaries) -> str:
                          f"{d['old_tile']} -> {d['new_tile']} "
                          f"(budget {d['old_budget_mb']} -> "
                          f"{d['new_budget_mb']} MiB)")
+        if s.get("imbalance"):
+            im = s["imbalance"]
+            if im.get("worst_ratio") is not None:
+                lines.append(
+                    f"  per-chip: worst {im['metric']} imbalance "
+                    f"{im['worst_ratio']:.3f}x (max/mean over "
+                    f"{im['n_chips']} chips) at t={im['worst_t']},"
+                    f" straggler chip {im['straggler_chip']}")
+            if im.get("nonfinite_chips"):
+                lines.append(
+                    f"  per-chip: NON-FINITE counters on chip(s) "
+                    f"{im['nonfinite_chips']} first at "
+                    f"t={im['nonfinite_t']} — diverged chip(s), see "
+                    f"the straggler runbook")
         rec = s.get("recoveries", {})
         for r in rec.get("retries", []):
             lines.append(f"  RETRY at t={r['t']} (attempt "

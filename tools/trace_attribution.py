@@ -18,6 +18,14 @@ attribution rule). With ``--ledger`` the modeled shares sit next to the
 measured ones in a single merged artifact: one telemetry schema-v2
 ``attribution`` record, validated by ``telemetry.validate_record``.
 
+Round 10 (comm observability): multi-core captures additionally get
+PER-CORE device timelines — each trace process whose name looks like a
+device core (``/device:TPU:N``, ``TPU:N``, ``... Core N``) keeps its
+own per-section sums — merged into the same record as ``per_core``
+(per-core section tables + totals) and ``imbalance`` (max/mean ratio
+of per-core totals and the named top-straggler core). A single-core or
+host-only capture simply omits both keys.
+
 Degrades cleanly: a directory with no trace files (capture skipped —
 no chip, no profiler) reports that and exits 0 with no artifact.
 
@@ -95,12 +103,87 @@ def attribute_events(events) -> Tuple[Dict[str, float], Dict[str, float]]:
     return graph, host
 
 
+# device-core process names as trace viewers emit them: jax/XProf
+# exports "/device:TPU:0"; raw xplane conversions show "TPU:0" or
+# "... Chip 0 ... Core 1" variants (chip AND core must both survive —
+# collapsing "Chip 0 Core 0" and "Chip 1 Core 0" into one key would
+# merge two devices' timelines). Host processes (python, threads)
+# match none and stay out of the per-core lane.
+_CORE_RES = (re.compile(r"/device:([A-Za-z]+:\d+)"),
+             re.compile(r"\b(TPU:\d+)\b"),
+             re.compile(r"\b[Cc]hip\s*(\d+)\b.*\b[Cc]ore\s*(\d+)\b"),
+             re.compile(r"\b[Cc]ore\s*(\d+)\b"))
+
+
+def _core_of(process_name: str) -> Optional[str]:
+    for rx in _CORE_RES:
+        m = rx.search(process_name or "")
+        if not m:
+            continue
+        if len(m.groups()) == 2:
+            return f"chip{m.group(1)}-core{m.group(2)}"
+        g = m.group(1)
+        return g if ":" in g else f"core:{g}"
+    return None
+
+
+def attribute_events_per_core(events) -> Dict[str, Dict[str, float]]:
+    """Per-CORE graph-section sums: {core: {section: ms}}.
+
+    Core identity comes from the trace's process_name metadata
+    (ph=='M') — only pids whose name looks like a device core
+    participate; host-side spans never pollute a core's timeline."""
+    pid_core: Dict[Any, str] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            core = _core_of((ev.get("args") or {}).get("name", ""))
+            if core is not None:
+                pid_core[ev.get("pid")] = core
+    out: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        core = pid_core.get(ev.get("pid"))
+        if core is None:
+            continue
+        sec = _event_sections(ev)
+        if sec is None or sec not in telemetry.GRAPH_SPANS:
+            continue
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3
+        tbl = out.setdefault(core, {})
+        tbl[sec] = tbl.get(sec, 0.0) + dur_ms
+    return out
+
+
+def core_imbalance(per_core: Dict[str, Dict[str, float]]
+                   ) -> Optional[Dict[str, Any]]:
+    """Straggler attribution over per-core TOTAL section time: max and
+    mean total, their ratio, and the named top-straggler core. None
+    below two cores (nothing to compare)."""
+    if len(per_core) < 2:
+        return None
+    totals = {core: sum(tbl.values()) for core, tbl in per_core.items()}
+    straggler = max(totals, key=totals.get)
+    mx = totals[straggler]
+    mean = sum(totals.values()) / len(totals)
+    return {
+        "max_ms": round(mx, 4),
+        "mean_ms": round(mean, 4),
+        "ratio": round(mx / mean, 4) if mean > 0 else None,
+        "straggler": straggler,
+        "n_cores": len(per_core),
+    }
+
+
 def merge_with_ledger(graph_ms: Dict[str, float],
                       host_ms: Dict[str, float],
                       ledger: Optional[Dict[str, Any]],
-                      source: str) -> Dict[str, Any]:
+                      source: str,
+                      per_core: Optional[Dict[str, Dict[str, float]]]
+                      = None) -> Dict[str, Any]:
     """One merged measured-vs-modeled attribution artifact (telemetry
-    schema-v2 'attribution' record)."""
+    schema-v2 'attribution' record; multi-core captures add the
+    per_core tables + imbalance straggler summary)."""
     total = sum(graph_ms.values())
     sections: Dict[str, Any] = {}
     names = set(graph_ms)
@@ -128,6 +211,15 @@ def merge_with_ledger(graph_ms: Dict[str, float],
     if host_ms:
         rec["host_spans_ms"] = {k: round(v, 4)
                                 for k, v in sorted(host_ms.items())}
+    if per_core:
+        rec["per_core"] = {
+            core: {"sections": {k: round(v, 4)
+                                for k, v in sorted(tbl.items())},
+                   "total_ms": round(sum(tbl.values()), 4)}
+            for core, tbl in sorted(per_core.items())}
+        imb = core_imbalance(per_core)
+        if imb is not None:
+            rec["imbalance"] = imb
     if ledger is not None:
         rec["ledger_step_kind"] = ledger.get("step_kind")
         if ledger.get("roofline"):
@@ -152,6 +244,18 @@ def format_text(rec: Dict[str, Any]) -> str:
         lines.append(f"  {name:16s} " + "; ".join(bits))
     for k, v in (rec.get("host_spans_ms") or {}).items():
         lines.append(f"  [host] {k:16s} {v:.3f} ms")
+    for core, row in (rec.get("per_core") or {}).items():
+        lines.append(f"  [core] {core:12s} total {row['total_ms']:.3f}"
+                     f" ms  " + "; ".join(
+                         f"{s} {v:.3f}" for s, v in
+                         row["sections"].items()))
+    if rec.get("imbalance"):
+        im = rec["imbalance"]
+        lines.append(f"  imbalance: max/mean "
+                     f"{im['ratio'] if im['ratio'] is not None else '?'}"
+                     f" over {im['n_cores']} cores — top straggler "
+                     f"{im['straggler']} ({im['max_ms']:.3f} ms vs mean "
+                     f"{im['mean_ms']:.3f} ms)")
     if rec.get("roofline"):
         r = rec["roofline"]
         lines.append(f"  roofline: {r['hbm_gbps']:.1f} GB/s -> modeled "
@@ -190,13 +294,16 @@ def main(argv=None) -> int:
         report(f"no trace files under {args.trace!r} (capture skipped "
                f"or not yet finalized); nothing to attribute")
         return 0
-    graph_ms, host_ms = attribute_events(_load_events(files[0]))
+    events = _load_events(files[0])
+    graph_ms, host_ms = attribute_events(events)
     if not graph_ms and not host_ms:
         warn(f"{files[0]}: no fdtd3d/* events found — trace predates "
              f"the named spans, or the device lane carries no HLO "
              f"metadata on this backend (host spans require a capture "
              f"around Simulation.advance)")
-    rec = merge_with_ledger(graph_ms, host_ms, ledger, files[0])
+    per_core = attribute_events_per_core(events)
+    rec = merge_with_ledger(graph_ms, host_ms, ledger, files[0],
+                            per_core=per_core)
     if args.out:
         with open(args.out, "a") as f:
             f.write(json.dumps(rec) + "\n")
