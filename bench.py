@@ -463,13 +463,16 @@ def run_measurement() -> None:
     """Child-process entry: measure both paths, print the one JSON line."""
     import jax
 
-    # SIGTERM -> SystemExit so the finally/atexit finalizers run (the
-    # telemetry run_end record survives a driver-side kill)
+    # SIGTERM/SIGINT -> SystemExit so the finally/atexit finalizers run
+    # (the telemetry run_end record survives a driver-side kill AND an
+    # operator Ctrl-C — SIGINT parity, docs/ROBUSTNESS.md)
     import signal
-    try:
-        signal.signal(signal.SIGTERM, lambda _s, _f: sys.exit(143))
-    except (ValueError, OSError):
-        pass
+    for _sig, _code in ((signal.SIGTERM, 143), (signal.SIGINT, 130)):
+        try:
+            signal.signal(_sig,
+                          lambda _s, _f, _c=_code: sys.exit(_c))
+        except (ValueError, OSError):
+            pass
 
     # Durable-stage wrapper (ISSUE 5 satellite): every measurement
     # stage runs under the supervisor's bounded retry, and the per-

@@ -487,6 +487,78 @@ def write_ntff_pattern(col, cfg) -> str:
     return path
 
 
+def _peek_supervisor_state(cfg, resume: str):
+    """-> (supervisor recovery state or None, snapshot path or None).
+
+    The recovery state a previous SUPERVISED run persisted into the
+    snapshot ``--resume`` will pick (io.read_checkpoint_meta — metadata
+    only, no state bytes). Lets a supervised resume re-apply ladder
+    pins and the degraded topology BEFORE the Simulation is built, so
+    a preemption mid-degrade resumes degraded. Applies the same cheap
+    metadata guards the restore loop does (scheme/size/dtype), so a
+    FOREIGN run's leftover snapshot in the same save_dir cannot donate
+    its recovery state; the restore loop warns if it ends up restoring
+    a different snapshot than the one peeked (payload corruption is
+    only discovered at load time)."""
+    from fdtd3d_tpu import io
+    from fdtd3d_tpu.log import warn
+    if resume == "auto":
+        cands = [p for t, p in io.find_checkpoints(cfg.output.save_dir)
+                 if t <= cfg.time_steps]
+    else:
+        cands = [resume]
+    for cand in cands:
+        try:
+            meta = io.read_checkpoint_meta(cand)
+        except Exception as exc:
+            warn(f"supervised resume: cannot peek {cand} ({exc}); "
+                 f"trying the next snapshot")
+            continue
+        # the SAME metadata guards sim._check_ckpt_meta enforces at
+        # restore time (one shared predicate — they cannot drift): a
+        # snapshot the restore loop would skip must not decide how
+        # this run resumes
+        from fdtd3d_tpu.sim import ckpt_meta_mismatch
+        reason = ckpt_meta_mismatch(cfg, meta)
+        if reason:
+            warn(f"supervised resume: not adopting recovery state "
+                 f"from {cand} ({reason})")
+            continue
+        # the newest usable snapshot decides — matching what the
+        # resume below will restore from. The path is only reported
+        # when state was actually adopted (the mismatch warning below
+        # must never claim an adoption that did not happen).
+        state = meta.get("supervisor")
+        return state, (cand if state else None)
+    return None, None
+
+
+def _check_topology_fits(cfg, resuming: bool = False):
+    """Friendly SystemExit when the requested decomposition cannot map
+    onto the available device count — never a raw mesh/shard_map
+    traceback (the named-error satellite of docs/ROBUSTNESS.md)."""
+    import jax
+
+    from fdtd3d_tpu.parallel.mesh import resolve_topology
+    try:
+        topo = resolve_topology(cfg.parallel, cfg.grid_shape,
+                                cfg.mode.active_axes,
+                                n_devices=jax.device_count())
+    except ValueError as exc:
+        raise SystemExit(f"invalid decomposition topology: {exc}")
+    n = topo[0] * topo[1] * topo[2]
+    if n > jax.device_count():
+        hint = ""
+        if resuming:
+            hint = (" — snapshots are topology-portable: pass a "
+                    "smaller --manual-topology (or --topology none) "
+                    "and --resume reshards the checkpoint onto it "
+                    "(docs/ROBUSTNESS.md)")
+        raise SystemExit(
+            f"topology {topo} needs {n} devices but only "
+            f"{jax.device_count()} are available{hint}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
@@ -534,8 +606,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fdtd3d_tpu.log import log, set_level, warn
     from fdtd3d_tpu.sim import Simulation  # deferred: jax init is slow
     set_level(cfg.output.log_level)
-    sim = Simulation(cfg)
     sup = None  # durable-run supervisor (--supervise); may REPLACE sim
+    peeked_ckpt = None  # the snapshot whose supervisor state we adopted
+    if args.supervise:
+        # built BEFORE the Simulation: a supervised --resume adopts the
+        # recovery state (ladder pins, degraded topology) a previous
+        # supervised run persisted into its snapshots, so the sim is
+        # constructed on the topology the run should CONTINUE on
+        from fdtd3d_tpu.supervisor import Supervisor
+        resume_state = None
+        if args.resume:
+            resume_state, peeked_ckpt = _peek_supervisor_state(
+                cfg, args.resume)
+        sup = Supervisor(cfg=cfg, resume_state=resume_state)
+        try:
+            cfg = sup.cfg
+            _check_topology_fits(cfg, resuming=bool(args.resume))
+            sim = sup.ensure_sim()
+        except BaseException:
+            # the ctor may have pinned kernel escape hatches from the
+            # persisted state; a failure before run()'s own finally
+            # must not leak them into the calling process
+            sup._restore_env()
+            raise
+    else:
+        _check_topology_fits(cfg, resuming=bool(args.resume))
+        sim = Simulation(cfg)
 
     def _current_sim():
         # after a ladder degrade the supervisor's sim replaces the
@@ -547,18 +643,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         _current_sim().close()   # idempotent
 
     # Durability of the observability lanes (docs/ROBUSTNESS.md): the
-    # try/finally below covers in-process exits; atexit + a SIGTERM ->
-    # SystemExit handler extend the same guarantee to signal-style
-    # kills, so the telemetry run_end record and the device-trace
-    # finalization survive them too.
+    # try/finally below covers in-process exits; atexit + SIGTERM/
+    # SIGINT -> SystemExit handlers extend the same guarantee to
+    # signal-style kills AND an operator Ctrl-C, so the telemetry
+    # run_end record and the device-trace finalization survive them
+    # too. The previous handlers are restored on every exit (library
+    # callers — tests — must not inherit ours).
     import atexit
     import signal
     atexit.register(_finalize)
-    try:
-        signal.signal(signal.SIGTERM,
-                      lambda _sig, _frm: sys.exit(143))
-    except (ValueError, OSError):  # pragma: no cover - non-main thread
-        pass
+    _old_handlers = {}
+    for _sig, _code in ((signal.SIGTERM, 143), (signal.SIGINT, 130)):
+        try:
+            _old_handlers[_sig] = signal.signal(
+                _sig, lambda _s, _frm, _c=_code: sys.exit(_c))
+        except (ValueError, OSError):  # pragma: no cover - non-main thread
+            pass
     # ONE try/finally from construction (which opens the telemetry
     # sink and writes run_start) to the end: EVERY exit — config
     # errors before the run, a NaN blow-up's FloatingPointError
@@ -596,6 +696,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     try:
                         sim.restore(cand)
                         log(f"resumed from {cand} at t={sim.t}")
+                        if peeked_ckpt is not None and \
+                                cand != peeked_ckpt:
+                            # the supervisor state was adopted from a
+                            # snapshot that then failed to load: the
+                            # counters/pins may not match this state
+                            warn(f"supervisor recovery state was "
+                                 f"adopted from {peeked_ckpt} but the "
+                                 f"run resumed from {cand}; inspect "
+                                 f"both with tools/ckpt_inspect.py")
                         break
                     except (io.CheckpointCorrupt, ValueError) as exc:
                         warn(f"skipping unusable checkpoint: {exc}")
@@ -706,12 +815,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the finally below finalizes it on EVERY exit.)
         remaining = max(0, cfg.time_steps - sim.t) \
             if (args.load_checkpoint or args.resume) else cfg.time_steps
-        if args.supervise:
+        if sup is not None:
             # Supervisor.run takes the ABSOLUTE horizon (it tracks its
             # own progress across rollbacks); max() keeps an
             # already-finished resume a no-op.
-            from fdtd3d_tpu.supervisor import Supervisor
-            sup = Supervisor(sim=sim)
             sim = sup.run(time_steps=max(cfg.time_steps, sim.t),
                           on_interval=on_interval if interval else None,
                           interval=interval)
@@ -740,10 +847,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         if sim.clock is not None:
             log(f"profile: {sim.clock.report()}")
         if sup is not None and (sup.retries or sup.rollbacks
-                                or sup.degrades):
+                                or sup.degrades or sup.topology_rung):
             log(f"supervisor: {sup.retries} retries, "
                 f"{sup.rollbacks} rollbacks, {sup.degrades} ladder "
-                f"degrades (now {sim.step_kind})")
+                f"degrades, {sup.topology_rung} topology rungs "
+                f"(now {sim.step_kind} on {sim.topology})")
         log(f"done: {cfg.time_steps} steps in {dt_wall:.2f}s "
             f"({mcps:.1f} Mcells/s)")
         return 0
@@ -758,6 +866,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             else 0
         cur.close()
         atexit.unregister(_finalize)
+        for _sig, _old in _old_handlers.items():
+            try:
+                signal.signal(_sig, _old)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        if sup is not None:
+            sup._restore_env()  # idempotent; run()'s finally usually did
         if cur.telemetry is not None:
             log(f"telemetry: {n_rec + 1} records -> "
                 f"{cfg.output.telemetry_path}")

@@ -13,11 +13,15 @@ environment (picked up once per process by ``Simulation.__init__``):
 
 Fault kinds:
 
-``nan@t=T[,field=COMP]``
+``nan@t=T[,field=COMP][,chip=C]``
     Inject a single NaN into COMP at the first chunk boundary with
     ``t >= T`` (between compiled chunks, after the auto-checkpoint
     cadence — the snapshot at the same ``t`` stays clean). The next
     chunk's in-graph health counters trip ``FloatingPointError``.
+    ``chip=C`` places the NaN at the center of chip C's shard (chip
+    index = the mesh-linearized position, telemetry.PER_CHIP_KEYS
+    convention) — the deterministic stand-in for one diverging/faulty
+    chip in a pod, so chip-scoped recovery paths are provable.
 ``preempt@t=T``
     Raise :class:`SimulatedPreemption` at the first chunk boundary with
     ``t >= T`` — the stand-in for a preempted TPU window / SIGKILL.
@@ -29,11 +33,22 @@ Fault kinds:
     boundaries with ``t >= T``, K times total — the deterministic
     stand-in for a transient dispatch/runtime error the supervisor's
     bounded retry must absorb.
-``fail_write@n=N``
+``fail_write@n=N[,host=H]``
     The Nth write through the atomic writer (io.atomic_open /
     io.atomic_publish, counted process-wide while a plan is active)
     raises :class:`InjectedWriteError` BEFORE publish — proving the
-    target file is never half-written.
+    target file is never half-written. ``host=H`` scopes the counter
+    to writes attributed to host H (``current_host()``: the simulated
+    writer installed by :func:`simulated_host`, else the real
+    ``jax.process_index()``) — the Nth write BY THAT HOST fails, so
+    multi-host commit protocols can lose exactly one writer.
+``host_lost@n=H``
+    Simulated loss of host H during a coordinated multi-writer
+    checkpoint: the next time host H participates in a two-phase
+    publish (io.publish_host_marker), :class:`SimulatedHostLoss` — a
+    ``SimulatedPreemption``, so a ``BaseException`` — fires before its
+    marker lands, leaving a PARTIAL marker set that discovery must
+    treat as uncommitted.
 ``corrupt_ckpt@n=N[,mode=truncate|zero]``
     After the Nth *committed* checkpoint, damage it on disk (truncate
     the file / zero bytes mid-file; for an orbax directory, delete its
@@ -47,9 +62,11 @@ real single incident.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
-from typing import List, Optional
+import sys
+from typing import Dict, List, Optional
 
 from fdtd3d_tpu import log as _log
 
@@ -63,6 +80,12 @@ class SimulatedPreemption(BaseException):
     would, leaving only committed checkpoints behind."""
 
 
+class SimulatedHostLoss(SimulatedPreemption):
+    """One host of a multi-writer set died mid-commit
+    (fault plan ``host_lost@n=H``) — same never-swallowed semantics as
+    a whole-process preemption, scoped to the lost writer."""
+
+
 class InjectedTransientError(RuntimeError):
     """Deterministic stand-in for a transient dispatch/runtime error."""
 
@@ -71,7 +94,20 @@ class InjectedWriteError(OSError):
     """The fault plan failed this write before it was published."""
 
 
-_KINDS = ("nan", "preempt", "error", "fail_write", "corrupt_ckpt")
+_KINDS = ("nan", "preempt", "error", "fail_write", "corrupt_ckpt",
+          "host_lost")
+
+# Keys each kind actually reads: a key the kind would silently ignore
+# (e.g. fail_write@...,chip=1 where host= was meant) is a plan that
+# "proves" a scenario that never ran — rejected as loudly as a typo.
+_KIND_KEYS = {
+    "nan": ("t", "field", "chip"),
+    "preempt": ("t",),
+    "error": ("t", "times"),
+    "fail_write": ("n", "host"),
+    "corrupt_ckpt": ("n", "mode"),
+    "host_lost": ("n",),
+}
 
 
 @dataclasses.dataclass
@@ -80,9 +116,12 @@ class Fault:
     t: int = 0            # step threshold (nan / preempt / error)
     field: str = "Ez"     # target component (nan)
     n: int = 0            # ordinal (fail_write: Nth write; corrupt_ckpt:
-    #                       Nth committed checkpoint)
+    #                       Nth committed checkpoint; host_lost: the
+    #                       lost host's id)
     times: int = 1        # firings before the fault is spent (error)
     mode: str = "truncate"  # corrupt_ckpt damage mode: truncate | zero
+    chip: Optional[int] = None  # chip scope (nan): mesh-linearized id
+    host: Optional[int] = None  # host scope (fail_write)
     fired: int = 0        # firings so far (one-shot bookkeeping)
 
 
@@ -93,6 +132,9 @@ class FaultPlan:
     def __init__(self, faults: List[Fault]):
         self.faults = list(faults)
         self.write_count = 0   # atomic writes seen (fail_write)
+        # per-host write counters (fail_write@...,host=H scopes its
+        # ordinal to writes attributed to that host)
+        self.write_counts: Dict[int, int] = {}
         self.ckpt_count = 0    # committed checkpoints seen (corrupt_ckpt)
 
     @classmethod
@@ -116,7 +158,13 @@ class FaultPlan:
                     continue
                 key, _, val = kv.partition("=")
                 key, val = key.strip(), val.strip()
-                if key in ("t", "n", "times"):
+                if key in ("t", "n", "times", "chip", "host", "field",
+                           "mode") and key not in _KIND_KEYS[kind]:
+                    raise ValueError(
+                        f"fault-plan key {key!r} does not apply to "
+                        f"kind {kind!r} in {entry!r} (valid for "
+                        f"{kind}: {', '.join(_KIND_KEYS[kind])})")
+                if key in ("t", "n", "times", "chip", "host"):
                     try:
                         setattr(f, key, int(val))
                     except ValueError:
@@ -128,7 +176,7 @@ class FaultPlan:
                 else:
                     raise ValueError(
                         f"unknown fault-plan key {key!r} in {entry!r} "
-                        f"(valid: t, n, times, field, mode)")
+                        f"(valid: t, n, times, field, mode, chip, host)")
             if f.mode not in ("truncate", "zero"):
                 raise ValueError(
                     f"fault plan entry {entry!r}: mode must be "
@@ -169,23 +217,85 @@ def load_env() -> Optional[FaultPlan]:
 
 
 # --------------------------------------------------------------------------
+# host attribution (multi-writer commit simulation + host-scoped faults)
+# --------------------------------------------------------------------------
+
+# the simulated writer id installed by simulated_host(); None = use the
+# real process index
+_SIM_HOST: Optional[int] = None
+
+
+@contextlib.contextmanager
+def simulated_host(host: int):
+    """Attribute everything inside the block to writer ``host``.
+
+    The CPU-deterministic stand-in for a multi-host writer set: tier-1
+    drives the coordinated-commit protocol (io.publish_host_marker /
+    commit_if_complete) once per simulated host, and host-scoped faults
+    (``fail_write@...,host=H``, ``host_lost@n=H``) key on this id."""
+    global _SIM_HOST
+    old = _SIM_HOST
+    _SIM_HOST = int(host)
+    try:
+        yield
+    finally:
+        _SIM_HOST = old
+
+
+def current_host() -> int:
+    """The writer id faults attribute work to: the simulated host when
+    one is installed, else the real ``jax.process_index()`` (0 when jax
+    was never imported — this module must not initialize a backend)."""
+    if _SIM_HOST is not None:
+        return _SIM_HOST
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    return 0
+
+
+# --------------------------------------------------------------------------
 # hooks (each a no-op when no plan is installed)
 # --------------------------------------------------------------------------
 
 
 def on_write(path: str) -> None:
     """From io's atomic writers, immediately BEFORE publish: a
-    fail_write fault fires here, so the target is never touched."""
+    fail_write fault fires here, so the target is never touched.
+    Host-scoped faults count only writes attributed to their host."""
     if _PLAN is None:
         return
     _PLAN.write_count += 1
+    host = current_host()
+    _PLAN.write_counts[host] = _PLAN.write_counts.get(host, 0) + 1
     for f in _PLAN.faults:
-        if f.kind == "fail_write" and not f.fired \
-                and _PLAN.write_count == f.n:
+        if f.kind != "fail_write" or f.fired:
+            continue
+        count = (_PLAN.write_counts[host] if f.host is not None
+                 else _PLAN.write_count)
+        if (f.host is None or f.host == host) and count == f.n:
             f.fired = 1
+            scope = f" by host {host}" if f.host is not None else ""
             raise InjectedWriteError(
-                f"fault plan: atomic write #{f.n} ({path}) failed "
-                f"(injected)")
+                f"fault plan: atomic write #{f.n}{scope} ({path}) "
+                f"failed (injected)")
+
+
+def on_host_publish(host: int) -> None:
+    """From io.publish_host_marker, BEFORE the marker write: a
+    host_lost fault kills exactly that writer mid-commit, leaving the
+    two-phase marker set partial."""
+    if _PLAN is None:
+        return
+    for f in _PLAN.faults:
+        if f.kind == "host_lost" and not f.fired and f.n == host:
+            f.fired = 1
+            raise SimulatedHostLoss(
+                f"fault plan: host {host} lost during coordinated "
+                f"commit (injected)")
 
 
 def on_checkpoint(path: str) -> None:
@@ -230,7 +340,7 @@ def on_chunk_boundary(sim) -> None:
     for f in _PLAN.faults:
         if f.kind == "nan" and not f.fired and t >= f.t:
             f.fired = 1
-            _inject_nan(sim, f.field)
+            _inject_nan(sim, f.field, chip=f.chip)
         elif f.kind == "error" and f.fired < f.times and t >= f.t:
             f.fired += 1
             raise InjectedTransientError(
@@ -242,11 +352,29 @@ def on_chunk_boundary(sim) -> None:
                 f"fault plan: simulated preemption at t={t}")
 
 
-def _inject_nan(sim, comp: str) -> None:
+def _inject_nan(sim, comp: str, chip: Optional[int] = None) -> None:
     import numpy as np
     group = "E" if comp[:1] == "E" else "H"
     cur = np.array(sim.state[group][comp])
-    idx = tuple(s // 2 for s in cur.shape)
+    if chip is None:
+        idx = tuple(s // 2 for s in cur.shape)
+    else:
+        # chip-scoped: the NaN lands at the CENTER of chip `chip`'s
+        # shard (chip index = mesh-linearized row-major position over
+        # the (x, y, z) topology — telemetry.PER_CHIP_KEYS convention),
+        # so per-chip attribution can name the faulty chip.
+        topo = tuple(sim.topology)
+        n_chips = int(np.prod(topo))
+        if not 0 <= chip < n_chips:
+            raise ValueError(
+                f"fault plan: chip={chip} out of range for topology "
+                f"{topo} ({n_chips} chips)")
+        pos = np.unravel_index(chip, topo)
+        local = tuple(s // p for s, p in zip(cur.shape, topo))
+        idx = tuple(p * ln + ln // 2
+                    for p, ln in zip(pos, local))
     cur[idx] = np.nan
     sim.set_field(comp, cur)
-    _log.warn(f"fault plan: injected NaN into {comp} at t={sim._t_host}")
+    where = f" (chip {chip}, cell {idx})" if chip is not None else ""
+    _log.warn(f"fault plan: injected NaN into {comp}{where} "
+              f"at t={sim._t_host}")

@@ -37,14 +37,16 @@ import json
 import os
 import re
 import struct
+import time
 import zipfile
 import zlib
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from fdtd3d_tpu import _native
 from fdtd3d_tpu import faults as _faults
+from fdtd3d_tpu import log as _log
 
 
 class CheckpointCorrupt(ValueError):
@@ -455,6 +457,175 @@ def load_checkpoint(path: str, verify: bool = True) -> Tuple[Dict, Dict]:
     return state, extra
 
 
+def read_checkpoint_meta(path: str) -> Dict:
+    """Metadata of a snapshot WITHOUT loading its arrays.
+
+    Works on both backends (an ``.npz`` reads just the ``__meta__``
+    member; a directory goes through :func:`read_orbax_meta`). The
+    cheap peek resume paths use to decide HOW to resume — supervisor
+    state, source topology — before any state bytes move. Integrity of
+    the payload is still load_checkpoint's job."""
+    if os.path.isdir(path):
+        return read_orbax_meta(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if "__meta__" not in z.files:
+                return {}
+            extra = json.loads(zlib.decompress(z["__meta__"].tobytes()))
+    except (zipfile.BadZipFile, ValueError, OSError, EOFError,
+            KeyError, zlib.error, json.JSONDecodeError) as exc:
+        raise CheckpointCorrupt(
+            f"{path}: unreadable checkpoint metadata "
+            f"({type(exc).__name__}: {exc})") from exc
+    extra.pop("_manifest", None)
+    extra.pop("_checksum", None)
+    return extra
+
+
+# ---------------------------------------------------------------------------
+# topology reshard: CPML psi slab layout conversion (reshard-on-resume)
+# ---------------------------------------------------------------------------
+#
+# Every leaf of the state pytree is a GLOBAL array with a topology-
+# independent shape — except the CPML psi recursions, whose storage is
+# slab-compacted PER SHARD (solver.slab_axes: each shard keeps only the
+# 2*(npml+1) boundary planes of its own axis, or the full extent when a
+# shard is too thin). A snapshot is therefore topology-portable once
+# its psi leaves are converted: expand the source layout to the full
+# axis, then compact onto the target layout. Both directions are exact
+# data movement; the compact step VALIDATES that every dropped plane is
+# zero (physically guaranteed — psi is identically zero outside the
+# absorbing slabs — so a non-zero drop means the snapshot and its
+# declared layout disagree).
+
+_PSI_GROUPS = ("psi_E", "psi_H", "lopsi_E", "lopsi_H")
+_AXES = "xyz"
+
+
+def psi_slab_expand(arr: np.ndarray, axis: int, n_global: int,
+                    topo_a: int, m: Optional[int],
+                    key: str = "psi") -> np.ndarray:
+    """Stored psi (slab-compact or full) -> full-length global axis.
+
+    ``m`` is the per-side slab plane count of the SOURCE layout
+    (solver.slab_axes value), or None for full storage. Shard ``i`` of
+    ``topo_a`` holds planes ``[i*2m, i*2m+m)`` (its local lo edge) and
+    ``[i*2m+m, (i+1)*2m)`` (its local hi edge)."""
+    arr = np.asarray(arr)
+    if m is None:
+        if arr.shape[axis] != n_global:
+            raise ValueError(
+                f"reshard: {key} has {arr.shape[axis]} planes along "
+                f"axis {_AXES[axis]} but the declared layout is full "
+                f"storage of {n_global} — snapshot and layout disagree")
+        return arr
+    want = 2 * m * topo_a
+    if arr.shape[axis] != want:
+        raise ValueError(
+            f"reshard: {key} has {arr.shape[axis]} planes along axis "
+            f"{_AXES[axis]} but the declared slab layout "
+            f"(m={m} x {topo_a} shards) stores {want} — snapshot and "
+            f"layout disagree")
+    shape = list(arr.shape)
+    shape[axis] = n_global
+    out = np.zeros(shape, dtype=arr.dtype)
+    ln = n_global // topo_a
+
+    def _take(a, lo, hi):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = slice(lo, hi)
+        return tuple(sl)
+
+    for i in range(topo_a):
+        out[_take(out, i * ln, i * ln + m)] = \
+            arr[_take(arr, i * 2 * m, i * 2 * m + m)]
+        out[_take(out, (i + 1) * ln - m, (i + 1) * ln)] = \
+            arr[_take(arr, i * 2 * m + m, (i + 1) * 2 * m)]
+    return out
+
+
+def psi_slab_compact(full: np.ndarray, axis: int, topo_a: int,
+                     m: Optional[int],
+                     key: str = "psi") -> np.ndarray:
+    """Full-length psi -> the target layout (slab-compact or full).
+
+    VALIDATED: planes outside every target shard's kept slabs must be
+    identically zero (they are, for any state a real run produced —
+    psi lives only in the global absorbing slabs, which every layout
+    keeps). A non-zero drop raises instead of silently losing state."""
+    full = np.asarray(full)
+    if m is None:
+        return full
+    n_global = full.shape[axis]
+    ln = n_global // topo_a
+    shape = list(full.shape)
+    shape[axis] = 2 * m * topo_a
+    out = np.zeros(shape, dtype=full.dtype)
+
+    def _take(a, lo, hi):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = slice(lo, hi)
+        return tuple(sl)
+
+    kept = np.zeros(n_global, dtype=bool)
+    for i in range(topo_a):
+        out[_take(out, i * 2 * m, i * 2 * m + m)] = \
+            full[_take(full, i * ln, i * ln + m)]
+        out[_take(out, i * 2 * m + m, (i + 1) * 2 * m)] = \
+            full[_take(full, (i + 1) * ln - m, (i + 1) * ln)]
+        kept[i * ln:i * ln + m] = True
+        kept[(i + 1) * ln - m:(i + 1) * ln] = True
+    dropped = np.where(~kept)[0]
+    if dropped.size:
+        probe = np.take(full, dropped, axis=axis)
+        if np.any(probe != 0):
+            raise ValueError(
+                f"reshard would drop non-zero psi planes of {key} "
+                f"(axis {_AXES[axis]}, planes outside the target slab "
+                f"layout m={m} x {topo_a} shards hold non-zero "
+                f"recursion state) — the snapshot does not match its "
+                f"declared layout; refusing a lossy reshard")
+    return out
+
+
+def reshard_psi_tree(state: Dict, grid_shape: Tuple[int, int, int],
+                     src_topology: Tuple[int, int, int],
+                     src_slabs: Dict[int, int],
+                     dst_topology: Tuple[int, int, int],
+                     dst_slabs: Dict[int, int]) -> Dict:
+    """Convert every psi leaf of a host-side state tree between
+    topologies' slab layouts (everything else passes through).
+
+    ``src_slabs``/``dst_slabs`` map axis index -> per-side plane count
+    for axes using slab storage under that topology (solver.slab_axes
+    of the respective static setups). Pure numpy; returns a new tree
+    sharing the non-psi leaves."""
+    for label, topo in (("source", src_topology),
+                        ("target", dst_topology)):
+        for a in range(3):
+            if topo[a] < 1 or grid_shape[a] % topo[a]:
+                raise ValueError(
+                    f"reshard: {label} topology {tuple(topo)} does not "
+                    f"divide grid {tuple(grid_shape)} evenly on axis "
+                    f"{_AXES[a]}")
+    out = dict(state)
+    for group in _PSI_GROUPS:
+        if group not in state:
+            continue
+        newg = {}
+        for key, arr in state[group].items():
+            ax_letter = key.rsplit("_", 1)[1]
+            a = _AXES.index(ax_letter)
+            full = psi_slab_expand(arr, a, grid_shape[a],
+                                   src_topology[a], src_slabs.get(a),
+                                   key=f"{group}/{key}")
+            newg[key] = psi_slab_compact(full, a, dst_topology[a],
+                                         dst_slabs.get(a),
+                                         key=f"{group}/{key}")
+        out[group] = newg
+    return out
+
+
 def _import_orbax():
     try:
         import orbax.checkpoint as ocp
@@ -466,22 +637,134 @@ def _import_orbax():
             "npz backend") from exc
 
 
-# A committed orbax checkpoint directory carries this marker, written
-# by rank 0 only after ck.wait_until_finished() AND the metadata
-# sidecar landed: a preempted/crashed save leaves a directory without
-# it, and readers refuse the un-committed snapshot.
+# ---------------------------------------------------------------------------
+# coordinated commit: two-phase marker protocol for multi-writer snapshots
+# ---------------------------------------------------------------------------
+
+# A committed directory-style checkpoint carries this marker, written by
+# rank 0 only after EVERY participating writer's per-host marker landed
+# (phase 2 of the two-phase protocol below): a preempted/crashed save —
+# of any single writer — leaves a directory without it (or with a
+# partial marker set), and readers refuse the un-committed snapshot.
 ORBAX_COMMIT_MARKER = "COMMIT.fdtd3d"
 
+# Phase 1: each participating process atomically publishes its shards
+# plus one of these markers (host id + expected writer count). Phase 2:
+# process 0 publishes ORBAX_COMMIT_MARKER only after observing the FULL
+# marker set. Discovery (find_checkpoints / commit_status) treats any
+# partial set as uncommitted — skipped with a warning, never a crash.
+_HOST_MARKER_RE = re.compile(r"^HOST\.(\d+)\.fdtd3d$")
 
-def save_checkpoint_orbax(state, path: str, extra: Optional[Dict] = None):
+
+def host_marker_name(host: int) -> str:
+    return f"HOST.{int(host):04d}.fdtd3d"
+
+
+def publish_host_marker(dirpath: str, host: int, num_writers: int):
+    """Phase 1 of the coordinated commit, called by EACH writer after
+    its own shards are fully written: atomically publish this host's
+    marker. The ``host_lost`` / host-scoped ``fail_write`` fault hooks
+    fire here, so a lost writer leaves a provably partial set."""
+    _faults.on_host_publish(int(host))
+    os.makedirs(dirpath, exist_ok=True)
+    with atomic_open(os.path.join(dirpath, host_marker_name(host)),
+                     "w") as f:
+        json.dump({"host": int(host),
+                   "num_writers": int(num_writers)}, f)
+
+
+def commit_status(dirpath: str) -> Dict[str, Any]:
+    """Commit-marker completeness of a directory snapshot.
+
+    -> ``{"committed": bool, "markers": [host ids], "num_writers":
+    Optional[int], "missing": [host ids], "legacy": bool}``.
+    ``legacy`` marks a pre-two-phase directory (COMMIT marker, no host
+    markers) — still committed, single-writer era. A COMMIT marker over
+    an INCOMPLETE marker set does not count as committed either: the
+    partial set is authoritative (a damaged/hand-rolled directory must
+    never resurrect as a resume source)."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return {"committed": False, "markers": [], "num_writers": None,
+                "missing": [], "legacy": False}
+    markers: List[int] = []
+    num_writers: Optional[int] = None
+    for name in names:
+        m = _HOST_MARKER_RE.match(name)
+        if not m:
+            continue
+        host = int(m.group(1))
+        markers.append(host)
+        try:
+            with open(os.path.join(dirpath, name)) as f:
+                nw = int(json.load(f).get("num_writers", 0))
+            num_writers = max(num_writers or 0, nw)
+        except (OSError, ValueError, json.JSONDecodeError):
+            pass  # marker content is advisory; presence is the phase-1 fact
+    markers.sort()
+    commit = ORBAX_COMMIT_MARKER in names
+    if not markers:
+        # pre-two-phase directory: COMMIT alone was the whole protocol
+        return {"committed": commit, "markers": [], "num_writers": None,
+                "missing": [], "legacy": commit}
+    authoritative = False
+    if commit:
+        # the COMMIT marker's recorded writer count is authoritative:
+        # a stray marker from an earlier crashed wider attempt must
+        # not inflate the expected set of a smaller committed save
+        try:
+            with open(os.path.join(dirpath, ORBAX_COMMIT_MARKER)) as f:
+                num_writers = int(json.load(f)["num_writers"])
+            authoritative = True
+        except (OSError, ValueError, KeyError, TypeError,
+                json.JSONDecodeError):
+            pass  # legacy "committed" content: fall through
+    if not authoritative and (num_writers is None
+                              or num_writers < len(markers)):
+        num_writers = max(markers) + 1
+    missing = [h for h in range(num_writers) if h not in markers]
+    return {"committed": commit and not missing, "markers": markers,
+            "num_writers": num_writers, "missing": missing,
+            "legacy": False}
+
+
+def commit_if_complete(dirpath: str, num_writers: int) -> bool:
+    """Phase 2, rank 0 only: publish the COMMIT marker iff EVERY
+    writer's phase-1 marker is present (a stray marker from an earlier
+    crashed attempt neither helps nor hurts). Returns whether it
+    committed. Reads only the marker NAMES — one listdir, no per-file
+    opens: this is the poll body of save_checkpoint_orbax and must
+    stay cheap on the shared filesystems pod checkpoints live on."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return False
+    present = {int(m.group(1)) for m in
+               (_HOST_MARKER_RE.match(n) for n in names) if m}
+    want = set(range(int(num_writers)))
+    if not want <= present:
+        return False
+    with atomic_open(os.path.join(dirpath, ORBAX_COMMIT_MARKER),
+                     "w") as f:
+        json.dump({"num_writers": int(num_writers),
+                   "hosts": sorted(want)}, f)
+    return True
+
+
+def save_checkpoint_orbax(state, path: str, extra: Optional[Dict] = None,
+                          commit_timeout_s: float = 600.0):
     """Sharding-aware checkpoint: every host writes ITS OWN shards.
 
     The TPU-native alternative to the .npz snapshot for large/multi-host
     runs — no rank-0 gather of the global state (at 1024^3 the npz path
     stages ~30 GB on one host). `path` becomes a directory; metadata
-    rides a REQUIRED .meta.json sidecar and the directory is only
-    COMMITTED once rank 0 publishes the marker file (both atomic, both
-    after the save fully finished).
+    rides a REQUIRED .meta.json sidecar. Commit is the two-phase marker
+    protocol: every process publishes its per-host marker after the
+    save finished, and process 0 publishes the COMMIT marker only after
+    observing the full set (polling the shared filesystem up to
+    ``commit_timeout_s`` — single-process runs observe it immediately,
+    so tier-1 never sleeps).
     """
     import jax
     ocp = _import_orbax()
@@ -489,22 +772,43 @@ def save_checkpoint_orbax(state, path: str, extra: Optional[Dict] = None):
     with ocp.StandardCheckpointer() as ck:
         ck.save(path, state, force=True)
         ck.wait_until_finished()
+    n_writers = jax.process_count()
+    publish_host_marker(path, jax.process_index(), n_writers)
     if jax.process_index() == 0:
         # atomic publish: a preemption between checkpoint completion and
         # the sidecar write must not strand (or half-write) the metadata
         with atomic_open(path + ".meta.json", "w") as f:
             json.dump(extra or {}, f)
-        # COMMIT marker LAST: its presence asserts shards + sidecar
-        with atomic_open(os.path.join(path, ORBAX_COMMIT_MARKER),
-                         "w") as f:
-            f.write("committed\n")
+        # COMMIT marker LAST: its presence asserts every writer's
+        # shards + markers + the sidecar
+        deadline = time.monotonic() + commit_timeout_s
+        while not commit_if_complete(path, n_writers):
+            if time.monotonic() >= deadline:
+                st = commit_status(path)
+                raise CheckpointCorrupt(
+                    f"{path}: coordinated commit timed out after "
+                    f"{commit_timeout_s:.0f}s — hosts {st['missing']} "
+                    f"never published their markers (lost writers?); "
+                    f"the snapshot stays uncommitted and discovery "
+                    f"will skip it")
+            time.sleep(0.05)  # pragma: no cover - multi-host only
 
 
 def read_orbax_meta(path: str) -> Dict:
-    """Metadata of an orbax checkpoint — validate BEFORE restoring."""
+    """Metadata of a directory checkpoint — validate BEFORE restoring.
+
+    Requires the two-phase commit to have COMPLETED: a missing COMMIT
+    marker or a partial per-host marker set raises
+    :class:`CheckpointCorrupt` naming the missing writers."""
     path = os.path.abspath(path)
-    marker = os.path.join(path, ORBAX_COMMIT_MARKER)
-    if not os.path.exists(marker):
+    st = commit_status(path)
+    if not st["committed"]:
+        if st["markers"] and st["missing"]:
+            raise CheckpointCorrupt(
+                f"{path}: partial commit-marker set — hosts "
+                f"{st['missing']} of {st['num_writers']} never "
+                f"published (writer lost mid-commit?); the snapshot "
+                f"was never committed; use an older committed one")
         raise CheckpointCorrupt(
             f"{path}: missing {ORBAX_COMMIT_MARKER} marker — the "
             f"checkpoint was never committed (crash or preemption "
@@ -570,8 +874,18 @@ def find_checkpoints(save_dir: str) -> List[Tuple[int, str]]:
             continue
         path = os.path.join(save_dir, name)
         if os.path.isdir(path):
-            if not os.path.exists(os.path.join(path,
-                                               ORBAX_COMMIT_MARKER)):
+            st = commit_status(path)
+            if not st["committed"]:
+                if st["markers"] and st["missing"]:
+                    # phase 1 started but never completed: a writer
+                    # died mid-commit. Loud skip — a pod operator
+                    # should learn a host was lost, not just that an
+                    # older snapshot was picked.
+                    _log.warn(
+                        f"skipping {path}: partial commit-marker set "
+                        f"(hosts {st['missing']} of "
+                        f"{st['num_writers']} missing) — a writer was "
+                        f"lost mid-commit; treating as uncommitted")
                 continue  # never committed: crash mid-save
         elif not m.group(2):
             continue  # a FILE without .npz is not one of ours

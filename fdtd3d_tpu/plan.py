@@ -14,7 +14,7 @@ configs (1024^3 on 64 chips) can be validated on a laptop.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -182,3 +182,51 @@ def plan_for_topology(cfg, topology: Tuple[int, int, int]) -> Plan:
         cfg, parallel=ParallelConfig(topology="manual",
                                      manual_topology=topology))
     return plan(cfg, n_devices=int(np.prod(topology)))
+
+
+# ---------------------------------------------------------------------------
+# topology ladder (topology-elastic durable runs, docs/ROBUSTNESS.md)
+# ---------------------------------------------------------------------------
+
+
+def degrade_topology(topology: Tuple[int, int, int]
+                     ) -> Optional[Tuple[int, int, int]]:
+    """One rung down the topology ladder: the next SMALLER valid
+    decomposition, or None at the unsharded bottom.
+
+    The rung shrinks the largest per-axis factor to its largest proper
+    divisor (first such axis on ties) — e.g. (2,2,2) -> (1,2,2) ->
+    (1,1,2) -> (1,1,1) -> None. Divisibility is preserved by
+    construction: any divisor of a factor that divided the grid still
+    divides it, so every rung is a valid topology for the same grid.
+    The supervisor walks this ladder when recovery on the current
+    topology is exhausted (lost chip, shrunken allocation), resuming
+    via the reshard-on-resume checkpoint path."""
+    t = [int(p) for p in topology]
+    mx = max(t)
+    if mx <= 1:
+        return None
+    a = t.index(mx)
+    for d in range(mx // 2, 0, -1):
+        if mx % d == 0:
+            t[a] = d
+            break
+    return tuple(t)
+
+
+def fits_devices(topology: Tuple[int, int, int], n_devices: int) -> bool:
+    """Whether a decomposition can map onto ``n_devices`` chips."""
+    return int(np.prod([int(p) for p in topology])) <= int(n_devices)
+
+
+def shrink_to_devices(topology: Tuple[int, int, int], n_devices: int
+                      ) -> Tuple[int, int, int]:
+    """Walk the topology ladder until the decomposition fits the
+    available device count (shrunken-allocation resume): returns the
+    first rung with at most ``n_devices`` chips — at worst (1, 1, 1),
+    which always fits."""
+    topo: Optional[Tuple[int, int, int]] = tuple(int(p)
+                                                 for p in topology)
+    while topo is not None and not fits_devices(topo, n_devices):
+        topo = degrade_topology(topo)
+    return topo if topo is not None else (1, 1, 1)

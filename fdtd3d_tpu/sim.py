@@ -30,6 +30,33 @@ from fdtd3d_tpu.parallel.mesh import shard_map_compat as \
 from fdtd3d_tpu.solver import (StaticSetup, build_coeffs, build_static,
                                init_state, make_chunk_runner)
 
+_AXES_STR = "xyz"
+
+
+def ckpt_meta_mismatch(cfg, extra) -> Optional[str]:
+    """The cfg-level snapshot-compatibility guards (scheme / grid size
+    / dtype): None when compatible, else the full error message.
+
+    ONE predicate shared by :meth:`Simulation._check_ckpt_meta` (which
+    raises it) and the CLI's supervised-resume peek (which skips the
+    snapshot) — the two must never drift, or a snapshot the restore
+    loop rejects could still donate its recovery state. The
+    carry-family guard needs a live sim's state keys and stays in
+    ``_check_ckpt_meta``; topology deliberately has NO guard
+    (snapshots are topology-portable, restore reshards)."""
+    if extra.get("scheme") not in (None, cfg.scheme):
+        return (f"checkpoint scheme {extra.get('scheme')!r} != "
+                f"config scheme {cfg.scheme!r}")
+    if "size" in extra and tuple(extra["size"]) != tuple(cfg.size):
+        return (f"checkpoint grid size {tuple(extra['size'])} != "
+                f"config size {tuple(cfg.size)}")
+    if extra.get("dtype") not in (None, cfg.dtype):
+        return (f"checkpoint dtype {extra.get('dtype')!r} != config "
+                f"dtype {cfg.dtype!r}; resume on the same dtype "
+                f"(the state carries dtype-specific companions — ds lo "
+                f"words, compensated residuals — that do not convert)")
+    return None
+
 
 class Simulation:
     """Owns solver state + coefficients; advances the leapfrog in chunks."""
@@ -128,6 +155,10 @@ class Simulation:
         # step the last cadence snapshot was written at (restore()
         # re-syncs it so a resumed run does not immediately re-write)
         self._ckpt_last_t = 0
+        # durable extra checkpoint metadata (merged into _ckpt_meta):
+        # the supervisor records its recovery state here so cadence
+        # snapshots carry it across preemptions
+        self.extra_ckpt_meta: Dict = {}
         self._closed = False
         self.telemetry: Optional[_telemetry.TelemetrySink] = None
         if cfg.output.telemetry_path:
@@ -371,12 +402,29 @@ class Simulation:
                        profiling.finite_check(self.state).items()
                        if not ok]
                 names = ", ".join(sorted(bad)) if bad else "unknown"
-                raise FloatingPointError(
-                    f"non-finite field values tripped the in-graph "
-                    f"health counters in chunk {self._chunk_idx}: "
-                    f"first bad step in ({t_prev}, {self._t_host}]; "
-                    f"components: {names} (check the Courant factor / "
-                    f"Drude stability bound)")
+                msg = (f"non-finite field values tripped the in-graph "
+                       f"health counters in chunk {self._chunk_idx}: "
+                       f"first bad step in ({t_prev}, {self._t_host}]; "
+                       f"components: {names} (check the Courant factor "
+                       f"/ Drude stability bound)")
+                # chip attribution (failure path only — never paid per
+                # chunk): which shard(s) hold the non-finite cells, by
+                # mesh-linearized chip id. The supervisor stamps its v5
+                # recovery records with these.
+                chips = counts = None
+                if any(p > 1 for p in self.topology):
+                    counts = self._nonfinite_chip_counts()
+                    if counts is not None and counts.sum() > 0:
+                        chips = [int(i) for i in np.nonzero(counts)[0]]
+                        msg += (f"; non-finite cells on chip(s) "
+                                f"{chips}, worst chip "
+                                f"{int(np.argmax(counts))}")
+                err = FloatingPointError(msg)
+                err.bad_components = sorted(bad)
+                err.bad_chips = chips
+                err.bad_chip = (int(np.argmax(counts))
+                                if chips is not None else None)
+                raise err
         elif self._check_finite:
             # no in-graph counters on this runner: legacy host pass
             profiling.assert_finite(self._carry(), context=f"t={self.t}")
@@ -389,6 +437,62 @@ class Simulation:
         if _faults.active() is not None:
             _faults.on_chunk_boundary(self)
         return self
+
+    def _nonfinite_chip_counts(self):
+        """Per-chip non-finite cell counts over the E/H fields (length
+        n_chips, mesh-linearized chip order) — the host-side chip
+        attribution pass a health trip pays once.
+
+        Reads each device's ADDRESSABLE shard (already-resident local
+        blocks, 1/n_chips of a field each) rather than gathering the
+        global array — a pod-scale field must never stage whole on one
+        host just to be blamed (the same constraint the cadence path
+        honors, io.py). Counts therefore cover THIS process's chips;
+        on multi-host runs a remote-only divergence reads as an empty
+        attribution (null chip stamp), never a wrong one. None on
+        errors (the trip must still raise even if attribution fails)."""
+        try:
+            px, py, pz = self.topology
+            counts = np.zeros(px * py * pz, dtype=np.int64)
+            gx, gy, gz = self.static.grid_shape
+            lx, ly, lz = gx // px, gy // py, gz // pz
+            for grp in ("E", "H"):
+                for _c, v in self.state[grp].items():
+                    shards = getattr(v, "addressable_shards", None)
+                    if shards is not None:
+                        blocks = [
+                            (tuple((sl.start or 0) for sl in sh.index),
+                             np.asarray(sh.data)) for sh in shards]
+                    else:
+                        # host-side global array (paired-complex path):
+                        # already resident, attribute by reshape
+                        blocks = [((0, 0, 0), np.asarray(v))]
+                    for (sx, sy, sz), g in blocks:
+                        if g.dtype.kind not in "fc":  # bf16 -> f32
+                            g = g.astype(np.float32)
+                        bad = ~np.isfinite(g)
+                        if not bad.any():
+                            continue
+                        nx, ny, nz = bad.shape
+                        # a block spans exactly one chip when its
+                        # extent matches the local shard size; a
+                        # full-size host array is split per chip here
+                        per = bad.reshape(nx // lx if nx > lx else 1,
+                                          lx if nx > lx else nx,
+                                          ny // ly if ny > ly else 1,
+                                          ly if ny > ly else ny,
+                                          nz // lz if nz > lz else 1,
+                                          lz if nz > lz else nz
+                                          ).sum(axis=(1, 3, 5))
+                        for bi in np.argwhere(per):
+                            cx = sx // lx + int(bi[0])
+                            cy = sy // ly + int(bi[1])
+                            cz = sz // lz + int(bi[2])
+                            chip = (cx * py + cy) * pz + cz
+                            counts[chip] += int(per[tuple(bi)])
+            return counts
+        except Exception:  # pragma: no cover - attribution best-effort
+            return None
 
     def _maybe_auto_checkpoint(self):
         """checkpoint_every/keep-K rotation (OutputConfig): write a
@@ -654,11 +758,16 @@ class Simulation:
     # -- checkpoint/resume (reference DAT save->load workflow, SURVEY §5.4)
 
     def _ckpt_meta(self):
-        return {"t": self.t, "scheme": self.cfg.scheme,
+        from fdtd3d_tpu import solver as _solver
+        meta = {"t": self.t, "scheme": self.cfg.scheme,
                 "size": list(self.cfg.size),
-                # psi slab layout depends on the decomposition
-                # (solver.slab_axes)
+                # source topology + per-shard psi slab layout
+                # (solver.slab_axes): together they make the snapshot
+                # topology-PORTABLE — restore() reassembles the global
+                # psi state and re-shards it onto the current plan
                 "topology": list(self.topology),
+                "psi_slabs": {_AXES_STR[a]: int(m) for a, m in
+                              _solver.slab_axes(self.static).items()},
                 # dtype + carry family: the dict-form state carries
                 # dtype-specific companions (ds lo words, compensated
                 # residuals, Drude J) — restore validates both so a
@@ -666,28 +775,20 @@ class Simulation:
                 "dtype": self.cfg.dtype,
                 "step_kind": self.step_kind,
                 "state_keys": sorted(self.state.keys())}
+        # extra_ckpt_meta: durable per-run facts riding every snapshot
+        # (the supervisor persists its recovery state here so a
+        # preemption mid-degrade resumes degraded, not re-tripping)
+        meta.update(self.extra_ckpt_meta)
+        return meta
 
     def _check_ckpt_meta(self, extra):
-        if extra.get("scheme") not in (None, self.cfg.scheme):
-            raise ValueError(
-                f"checkpoint scheme {extra.get('scheme')!r} != "
-                f"config scheme {self.cfg.scheme!r}")
-        if "size" in extra and tuple(extra["size"]) != tuple(self.cfg.size):
-            raise ValueError(
-                f"checkpoint grid size {tuple(extra['size'])} != "
-                f"config size {tuple(self.cfg.size)}")
-        if "topology" in extra and tuple(extra["topology"]) != self.topology:
-            raise ValueError(
-                f"checkpoint was written with decomposition topology "
-                f"{tuple(extra['topology'])} but this run uses "
-                f"{self.topology}; the CPML psi slab layout is "
-                f"per-topology — resume on the same topology")
-        if extra.get("dtype") not in (None, self.cfg.dtype):
-            raise ValueError(
-                f"checkpoint dtype {extra.get('dtype')!r} != config "
-                f"dtype {self.cfg.dtype!r}; resume on the same dtype "
-                f"(the state carries dtype-specific companions — ds lo "
-                f"words, compensated residuals — that do not convert)")
+        # cfg-level guards (scheme/size/dtype) shared with the CLI's
+        # supervised-resume peek; a topology mismatch is NOT an error —
+        # snapshots are topology-portable (restore reshards the CPML
+        # psi layout onto the current plan).
+        reason = ckpt_meta_mismatch(self.cfg, extra)
+        if reason:
+            raise ValueError(reason)
         if "state_keys" in extra:
             want = sorted(self.state.keys())
             got = list(extra["state_keys"])
@@ -732,29 +833,98 @@ class Simulation:
         checks raises :class:`fdtd3d_tpu.io.CheckpointCorrupt` (naming
         the path and the failed check); resume paths catch it and fall
         back to an older committed snapshot.
+
+        Snapshots are TOPOLOGY-PORTABLE: one written under a different
+        decomposition (any valid topology, including unsharded) is
+        reassembled to the global state and re-sharded onto THIS sim's
+        plan — the CPML psi slab layout is the only topology-dependent
+        piece, converted by the validated reshard path
+        (io.reshard_psi_tree). Grid/dtype/scheme/carry-family guards
+        still apply.
         """
         from fdtd3d_tpu import io
         self._metrics_cache = None  # diag cache keys on t, not contents
         if os.path.isdir(path):
             # validate metadata BEFORE the restore so mismatches surface
             # as the friendly guards, not orbax shape errors
-            self._check_ckpt_meta(io.read_orbax_meta(path))
+            extra = io.read_orbax_meta(path)
+            self._check_ckpt_meta(extra)
+            src_topo = tuple(extra.get("topology") or self.topology)
+            if src_topo != self.topology:
+                # cross-topology orbax restore: the stored psi shapes
+                # differ from this sim's, so restore against SOURCE-
+                # shaped abstract targets (host-side), reshard, adopt
+                loaded = io.load_checkpoint_orbax(
+                    path, self._source_shaped_target(src_topo))
+                loaded = jax.tree.map(np.asarray, loaded)
+                return self.adopt_state(loaded, src_topology=src_topo,
+                                        src_meta=extra)
             self.state = io.load_checkpoint_orbax(path, self.state)
             self._t_host = self.t  # re-sync the telemetry step mirror
             self._ckpt_last_t = self._t_host
             return self
         loaded, extra = io.load_checkpoint(path)
         self._check_ckpt_meta(extra)
-        return self.adopt_state(loaded)
+        src_topo = tuple(extra.get("topology") or self.topology)
+        return self.adopt_state(loaded, src_topology=src_topo,
+                                src_meta=extra)
 
-    def adopt_state(self, loaded):
+    def _source_shaped_target(self, src_topology):
+        """Abstract state pytree shaped as the SOURCE topology stored it
+        (psi slab layouts are per-topology) — the restore target for a
+        cross-topology orbax load."""
+        src_static = dataclasses.replace(
+            self.static, topology=tuple(src_topology))
+        shapes = jax.eval_shape(lambda: init_state(src_static))
+        # align leaf dtypes with what this sim stores (e.g. paired-
+        # complex host-side state); shapes are the source layout's
+        return jax.tree.map(
+            lambda sd, cur: jax.ShapeDtypeStruct(sd.shape, cur.dtype),
+            shapes, self.state)
+
+    def _reshard_loaded(self, loaded, src_topology, src_meta=None):
+        """Validated psi-layout conversion of a host-side state tree
+        from ``src_topology``'s slab layout onto this sim's (the
+        reshard-on-resume core). Friendly errors name the snapshot's
+        declared layout when it disagrees with the stored arrays."""
+        from fdtd3d_tpu import io
+        from fdtd3d_tpu import log as _log
+        from fdtd3d_tpu import solver as _solver
+        src_topology = tuple(int(p) for p in src_topology)
+        src_static = dataclasses.replace(self.static,
+                                         topology=src_topology)
+        src_slabs = _solver.slab_axes(src_static)
+        dst_slabs = _solver.slab_axes(self.static)
+        if src_meta and "psi_slabs" in src_meta:
+            recorded = {_AXES_STR.index(k): int(v)
+                        for k, v in src_meta["psi_slabs"].items()}
+            if recorded != src_slabs:
+                raise io.CheckpointCorrupt(
+                    f"checkpoint psi slab layout {recorded} does not "
+                    f"match the layout its topology {src_topology} "
+                    f"implies {src_slabs} — the snapshot was written "
+                    f"by an incompatible build or damaged")
+        _log.log(f"resharding checkpoint: topology {src_topology} -> "
+                 f"{self.topology} (psi slabs {src_slabs} -> "
+                 f"{dst_slabs})")
+        return io.reshard_psi_tree(loaded, self.static.grid_shape,
+                                   src_topology, src_slabs,
+                                   self.topology, dst_slabs)
+
+    def adopt_state(self, loaded, src_topology=None, src_meta=None):
         """Install a host-side dict-form state tree as the live state.
 
         The tail of :meth:`restore`, exposed on its own so the
         supervisor's rollback can re-seed a sim from an in-memory
         snapshot without touching disk: casts/reshapes each leaf to
         this sim's dtypes, re-shards under a mesh, and re-syncs the
-        host step mirror + checkpoint cadence."""
+        host step mirror + checkpoint cadence. ``src_topology`` (when
+        it differs from this sim's) routes the tree through the
+        validated psi reshard first."""
+        if src_topology is not None and \
+                tuple(src_topology) != self.topology:
+            loaded = self._reshard_loaded(loaded, src_topology,
+                                          src_meta)
         self._metrics_cache = None
         want = jax.tree.structure(self.state)
         got = jax.tree.structure(loaded)

@@ -21,18 +21,31 @@ workloads that get preempted or hit transient device errors mid-flight.
   ``pallas_packed_tb`` -> ``pallas_packed`` -> two-pass/jnp — forced
   through the kernels' documented escape hatches (FDTD3D_NO_TEMPORAL /
   FDTD3D_NO_PACKED / use_pallas=False), pinned for the remainder of the
-  supervised run. At the bottom of the ladder the trip re-raises: a
-  blow-up the jnp reference path reproduces is physics (Courant/Drude
-  stability), not a kernel bug.
+  supervised run.
+* **topology degrade** (below the kernel ladder, and when transient
+  retries on the current topology are exhausted): roll back to the
+  last committed snapshot and resume on the next SMALLER decomposition
+  (plan.degrade_topology), restored through the reshard-on-resume
+  checkpoint path — the recovery for a lost chip or a shrunken
+  preemptible allocation. Only at the UNSHARDED bottom of BOTH ladders
+  does a health trip re-raise: a blow-up the single-chip jnp reference
+  path reproduces is physics (Courant/Drude stability), not a kernel
+  or chip fault.
 * **simulated preemptions** (``faults.SimulatedPreemption``, a
   ``BaseException``) propagate untouched — a kill is a kill; the
   committed checkpoints + CLI ``--resume auto`` are the recovery path.
+  The supervisor PERSISTS its recovery state (ladder pins, retry
+  counters, topology rung) into every cadence snapshot
+  (``Simulation.extra_ckpt_meta``), so a supervised ``--resume``
+  adopts it and a preemption mid-degrade resumes DEGRADED rather than
+  re-tripping the same fault.
 
-Every recovery emits a structured telemetry record (schema v3:
-``retry`` / ``rollback`` / ``degrade``) through the run's existing
-sink, which follows the simulation across ladder rebuilds — one
-run_start/run_end span per supervised run, summarized by
-tools/telemetry_report.py.
+Every recovery emits a structured telemetry record (schema v5:
+``retry`` / ``rollback`` / ``degrade`` / ``topology_change``, each
+stamped with the chip/host the failure was attributed to when known)
+through the run's existing sink, which follows the simulation across
+ladder rebuilds — one run_start/run_end span per supervised run,
+summarized by tools/telemetry_report.py.
 
 :func:`run_with_retry` is the stage-shaped flavor of the same bounded
 retry: bench.py wraps each measurement stage in it and embeds the
@@ -109,6 +122,17 @@ def run_with_retry(fn, policy: Optional[RetryPolicy] = None,
             policy.sleep(delay)
 
 
+def _cfg_with_topology(cfg, topology):
+    """cfg pinned to an explicit decomposition ((1,1,1) -> unsharded)."""
+    from fdtd3d_tpu.config import ParallelConfig
+    topo = tuple(int(p) for p in topology)
+    if all(p == 1 for p in topo):
+        par = ParallelConfig(topology="none")
+    else:
+        par = ParallelConfig(topology="manual", manual_topology=topo)
+    return dataclasses.replace(cfg, parallel=par)
+
+
 def degrade_plan(kind: str):
     """One rung down the kernel ladder for a sim at ``kind``.
 
@@ -140,7 +164,8 @@ class Supervisor:
     callers must close/inspect that one, not a stale handle."""
 
     def __init__(self, cfg=None, policy: Optional[RetryPolicy] = None,
-                 sim=None, sim_factory=None, devices=None):
+                 sim=None, sim_factory=None, devices=None,
+                 resume_state: Optional[Dict] = None):
         if sim is None and cfg is None:
             raise ValueError("Supervisor needs a cfg or a pre-built sim")
         self.sim = sim
@@ -155,13 +180,92 @@ class Supervisor:
         self._factory = sim_factory or self._default_factory
         self._saved_env: Dict[str, Optional[str]] = {}
         self._snapshot = None   # initial host-side state (no-ckpt runs)
+        self._snapshot_topo = None  # topology it was captured under
         self.retries = 0
         self.rollbacks = 0
         self.degrades = 0
+        self.topology_rung = 0
+        if resume_state:
+            if sim is not None:
+                raise ValueError(
+                    "resume_state applies before the Simulation is "
+                    "built — pass cfg=, not a pre-built sim")
+            self._adopt_resume_state(resume_state)
 
     def _default_factory(self, cfg):
         from fdtd3d_tpu.sim import Simulation
         return Simulation(cfg, self._devices)
+
+    def _adopt_resume_state(self, rs: Dict):
+        """Adopt the recovery state a previous supervised run persisted
+        into its snapshots (io.read_checkpoint_meta -> "supervisor"):
+        re-pin the kernel-ladder escape hatches, resume on the persisted
+        (possibly degraded) topology — shrunk further if the current
+        allocation is smaller — and seed the counters, so a preemption
+        mid-degrade resumes degraded rather than re-tripping."""
+        pins = {k: str(v) for k, v in (rs.get("env_pins") or {}).items()}
+        if pins:
+            self._pin_env(pins)
+            _log.warn(f"supervisor: resuming with persisted "
+                      f"kernel-ladder pins {sorted(pins)}")
+        topo = rs.get("topology")
+        if topo:
+            import jax
+
+            from fdtd3d_tpu import plan as _plan_mod
+            want = tuple(int(p) for p in topo)
+            have = _plan_mod.shrink_to_devices(want, jax.device_count())
+            if have != want:
+                _log.warn(
+                    f"supervisor: persisted topology {want} does not "
+                    f"fit the {jax.device_count()} available devices; "
+                    f"resuming on {have} (shrunken allocation)")
+            self._cfg = _cfg_with_topology(self._cfg, have)
+        self.retries = int(rs.get("retries", 0))
+        self.rollbacks = int(rs.get("rollbacks", 0))
+        self.degrades = int(rs.get("degrades", 0))
+        self.topology_rung = int(rs.get("topology_rung", 0))
+
+    @property
+    def cfg(self):
+        """The EFFECTIVE config (check_finite forced; topology possibly
+        overridden by a persisted resume state)."""
+        return self._cfg
+
+    def ensure_sim(self):
+        """Build (once) and return the supervised Simulation — callers
+        that need the sim before run() (the CLI restores checkpoints
+        and wires NTFF against it) go through here so the persisted
+        resume state is already applied."""
+        if self.sim is None:
+            self.sim = self._factory(self._cfg)
+            self._persist()
+        return self.sim
+
+    # -- durable recovery state -------------------------------------------
+
+    def state_dict(self) -> Dict:
+        """The supervisor's durable recovery state: ladder pins, the
+        current (possibly degraded) topology, counters. Persisted into
+        every cadence snapshot via Simulation.extra_ckpt_meta so a
+        supervised --resume can adopt it."""
+        pins = {k: os.environ[k] for k in self._saved_env
+                if k in os.environ}
+        return {
+            "env_pins": pins,
+            "topology": (list(self.sim.topology)
+                         if self.sim is not None else None),
+            "step_kind": (self.sim.step_kind
+                          if self.sim is not None else None),
+            "retries": int(self.retries),
+            "rollbacks": int(self.rollbacks),
+            "degrades": int(self.degrades),
+            "topology_rung": int(self.topology_rung),
+        }
+
+    def _persist(self):
+        if self.sim is not None:
+            self.sim.extra_ckpt_meta["supervisor"] = self.state_dict()
 
     # -- telemetry ---------------------------------------------------------
 
@@ -215,16 +319,62 @@ class Supervisor:
             raise RuntimeError(
                 f"supervisor: no rollback target for {reason} (no "
                 f"committed checkpoint, no initial snapshot)")
-        sim.adopt_state(self._snapshot)
+        # the snapshot was captured under the topology of that moment;
+        # adopt_state reshards it onto the CURRENT sim's plan when a
+        # topology degrade happened in between
+        sim.adopt_state(self._snapshot,
+                        src_topology=self._snapshot_topo)
         return "initial-snapshot"
 
+    def _host_of(self, chip: Optional[int]) -> Optional[int]:
+        """Host attribution for a recovery record: the host owning the
+        failing chip (contiguous chip->process mapping). None when no
+        chip was implicated — an unattributed failure must read as
+        null, not as 'host 0' (docs/OBSERVABILITY.md v5 semantics)."""
+        if chip is None:
+            return None
+        try:
+            import jax
+            import numpy as np
+            n_chips = max(int(np.prod(self.sim.topology)), 1)
+            return int(chip) * int(jax.process_count()) // n_chips
+        except Exception:  # pragma: no cover - attribution best-effort
+            return None
+
+    def _swap_sim(self, cfg):
+        """Replace the supervised sim with one built on ``cfg``, moving
+        the telemetry sink (ONE run_start/run_end span per supervised
+        run) and stopping the old tracer. Returns the new sim; on a
+        factory failure the sink is reattached to the surviving sim so
+        the caller's close() still writes the run_end record."""
+        old_sim = self.sim
+        sink = old_sim.telemetry
+        old_sim.telemetry = None
+        if old_sim.tracer is not None:
+            old_sim.tracer.stop()
+        try:
+            new_sim = self._factory(cfg)
+        except BaseException:
+            old_sim.telemetry = sink
+            raise
+        new_sim.telemetry = sink
+        return new_sim
+
     def _handle_trip(self, exc: FloatingPointError):
-        """Health trip: rollback + one rung down the kernel ladder."""
+        """Health trip: rollback + one rung down the kernel ladder —
+        or, below the kernel ladder, one rung down the TOPOLOGY ladder
+        (a chip-attributed blow-up on the reference path points at the
+        chip, not the physics, while any sharding remains to shed)."""
         old_sim = self.sim
         old_kind = old_sim.step_kind
+        chip = getattr(exc, "bad_chip", None)
+        host = self._host_of(chip)
         plan = degrade_plan(old_kind)
         if plan is None:
-            raise exc  # bottom of the ladder: this blow-up is physics
+            # bottom of the KERNEL ladder: next is the topology ladder
+            # (raises exc at the unsharded bottom — that is physics)
+            self._topology_degrade(exc, chip=chip, host=host)
+            return
         pins, cfg_fn = plan
         t_failed = old_sim._t_host
         reason = f"{type(exc).__name__}: {str(exc)[:200]}"
@@ -235,22 +385,11 @@ class Supervisor:
         cfg = dataclasses.replace(cfg, output=out, require_pallas=False)
         # the sink follows the run across the rebuild: ONE
         # run_start/run_end span per supervised run
-        sink = old_sim.telemetry
-        old_sim.telemetry = None
-        if old_sim.tracer is not None:
-            old_sim.tracer.stop()
-        try:
-            new_sim = self._factory(cfg)
-        except BaseException:
-            # the degraded build itself failed: reattach the sink so
-            # the caller's close() still writes the run_end record
-            old_sim.telemetry = sink
-            raise
-        new_sim.telemetry = sink
+        new_sim = self._swap_sim(cfg)
         if new_sim.step_kind == old_kind:
             # the escape hatch had no effect (unexpected dispatch):
             # degrading again would loop at this rung forever
-            old_sim.telemetry = sink
+            old_sim.telemetry = new_sim.telemetry
             new_sim.telemetry = None
             self.sim = old_sim
             raise exc
@@ -261,24 +400,71 @@ class Supervisor:
         self.rollbacks += 1
         self._emit("rollback", t_failed=int(t_failed),
                    t_restored=int(self.sim._t_host), source=str(src),
-                   reason=reason)
+                   reason=reason, chip=chip, host=host)
         self._emit("degrade", t=int(self.sim._t_host),
                    old_kind=old_kind, new_kind=new_sim.step_kind,
-                   reason=reason)
+                   reason=reason, chip=chip, host=host)
         _log.warn(f"supervisor: health trip at t<={t_failed} "
                   f"({str(exc)[:120]}); rolled back to "
                   f"t={self.sim._t_host} ({src}) and degraded "
                   f"{old_kind} -> {new_sim.step_kind}")
+        self._persist()
 
-    def _handle_transient(self, exc, consec: int):
-        """Transient error: bounded retry with backoff + rollback."""
+    def _topology_degrade(self, exc, chip: Optional[int] = None,
+                          host: Optional[int] = None):
+        """Roll back and resume on the next smaller topology
+        (plan.degrade_topology) via the reshard-on-resume restore path.
+        Re-raises ``exc`` at the unsharded bottom."""
+        from fdtd3d_tpu import plan as _plan_mod
+        old_topo = tuple(self.sim.topology)
+        new_topo = _plan_mod.degrade_topology(old_topo)
+        if new_topo is None:
+            raise exc  # unsharded bottom: nothing left to shed
+        t_failed = self.sim._t_host
+        reason = f"{type(exc).__name__}: {str(exc)[:200]}"
+        cfg = _cfg_with_topology(self._cfg, new_topo)
+        out = dataclasses.replace(cfg.output, telemetry_path=None,
+                                  profile_dir=None, check_finite=True)
+        cfg = dataclasses.replace(cfg, output=out, require_pallas=False)
+        new_sim = self._swap_sim(cfg)
+        self._cfg = cfg
+        self.sim = new_sim
+        self.topology_rung += 1
+        src = self._rollback(reason, t_failed)  # restore reshards
+        self.rollbacks += 1
+        self._emit("rollback", t_failed=int(t_failed),
+                   t_restored=int(self.sim._t_host), source=str(src),
+                   reason=reason, chip=chip, host=host)
+        self._emit("topology_change", t=int(self.sim._t_host),
+                   old_topology=list(old_topo),
+                   new_topology=list(new_topo), reason=reason,
+                   chip=chip, host=host)
+        _log.warn(f"supervisor: recovery exhausted on topology "
+                  f"{old_topo} at t<={t_failed}"
+                  + (f" (chip {chip} implicated)"
+                     if chip is not None else "")
+                  + f"; rolled back to t={self.sim._t_host} ({src}) "
+                  f"and degraded the topology to {new_topo}")
+        self._persist()
+
+    def _handle_transient(self, exc, consec: int) -> bool:
+        """Transient error: bounded retry with backoff + rollback.
+
+        Returns True when the retry budget on the current topology was
+        exhausted and the supervisor degraded the topology instead
+        (the caller resets its consecutive-failure counter); at the
+        unsharded bottom the error re-raises."""
+        host = self._host_of(None)
         if consec > self.policy.max_retries:
-            raise exc
+            # retries on THIS topology are exhausted: shed a rung
+            self._topology_degrade(exc, chip=None, host=host)
+            return True
         t = self.sim._t_host
         delay = self.policy.delay_s(consec - 1)
         reason = f"{type(exc).__name__}: {str(exc)[:200]}"
         self._emit("retry", t=int(t), attempt=int(consec),
-                   delay_s=float(delay), error=reason)
+                   delay_s=float(delay), error=reason,
+                   chip=None, host=host)
         _log.warn(f"supervisor: transient error at t={t} "
                   f"({str(exc)[:120]}); retry {consec}/"
                   f"{self.policy.max_retries} in {delay:.1f}s")
@@ -288,7 +474,9 @@ class Supervisor:
         self.rollbacks += 1
         self._emit("rollback", t_failed=int(t),
                    t_restored=int(self.sim._t_host), source=str(src),
-                   reason=reason)
+                   reason=reason, chip=None, host=host)
+        self._persist()
+        return False
 
     # -- the loop ----------------------------------------------------------
 
@@ -303,9 +491,9 @@ class Supervisor:
         total = (time_steps if time_steps is not None
                  else self._cfg.time_steps)
         try:
-            if self.sim is None:
-                self.sim = self._factory(self._cfg)
+            self.ensure_sim()
             self._seed_rollback_floor()
+            self._persist()
             consec = 0
             # high-water mark of on_interval callbacks: each boundary's
             # callbacks fire EXACTLY once. A rollback re-advancing
@@ -328,7 +516,8 @@ class Supervisor:
                     self._handle_trip(exc)
                 except TRANSIENT_ERRORS as exc:
                     consec += 1
-                    self._handle_transient(exc, consec)
+                    if self._handle_transient(exc, consec):
+                        consec = 0  # fresh budget on the new topology
                 if on_interval is not None and \
                         self.sim._t_host > done_t:
                     on_interval(self.sim)
@@ -366,3 +555,6 @@ class Supervisor:
         self._snapshot = jax.tree.map(
             lambda x: np.array(pdist.gather_to_host(x)),
             self.sim.state)
+        # remember the layout: a later topology degrade reshards the
+        # snapshot's psi leaves onto the new plan at rollback time
+        self._snapshot_topo = tuple(self.sim.topology)

@@ -67,9 +67,15 @@ import numpy as np
 # all_gathered scalars riding the fused health readback) and
 # "imbalance" (max/mean ratio + argmax straggler chip per chunk) — so
 # a pod run surfaces a straggling or diverging chip WHILE it runs.
-# v1-v3 files still read/validate (READ_VERSIONS).
-SCHEMA_VERSION = 4
-READ_VERSIONS = (1, 2, 3, 4)
+# v5 (topology-elastic durable runs, round 11): recovery records
+# ("retry"/"rollback"/"degrade") are stamped with the chip/host the
+# failure was attributed to (nullable — a transient dispatch error has
+# no chip), and the new "topology_change" record captures the
+# supervisor's topology-degrade rung (resume on a smaller topology via
+# the reshard-on-resume checkpoint path). v1-v4 files still
+# read/validate (READ_VERSIONS).
+SCHEMA_VERSION = 5
+READ_VERSIONS = (1, 2, 3, 4, 5)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -420,18 +426,27 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
     },
     # v3 (durable-run supervisor, fdtd3d_tpu/supervisor.py): one record
     # per recovery action, so tools/telemetry_report.py can summarize
-    # how a run survived.
+    # how a run survived. v5 stamps each with the chip/host the failure
+    # was attributed to (null when unattributable — e.g. a transient
+    # dispatch error, or an unsharded run).
     "retry": {
         "t": (int,), "attempt": (int,), "delay_s": _NUM,
-        "error": (str,),
+        "error": (str,), "chip": _OPT_NUM, "host": _OPT_NUM,
     },
     "rollback": {
         "t_failed": (int,), "t_restored": (int,), "source": (str,),
-        "reason": (str,),
+        "reason": (str,), "chip": _OPT_NUM, "host": _OPT_NUM,
     },
     "degrade": {
         "t": (int,), "old_kind": (str,), "new_kind": (str,),
-        "reason": (str,),
+        "reason": (str,), "chip": _OPT_NUM, "host": _OPT_NUM,
+    },
+    # v5 (topology-elastic durable runs): the supervisor's topology-
+    # degrade rung — rolled back to the last committed snapshot and
+    # resumed on a smaller decomposition via reshard-on-resume.
+    "topology_change": {
+        "t": (int,), "old_topology": (list,), "new_topology": (list,),
+        "reason": (str,), "chip": _OPT_NUM, "host": _OPT_NUM,
     },
     # v4 (comm observability, round 10): the per-chip lane. One
     # "per_chip" record per chunk when OutputConfig.per_chip_telemetry
@@ -459,11 +474,17 @@ _V2_ONLY_TYPES = ("attribution",)
 _V3_ONLY_TYPES = ("retry", "rollback", "degrade")
 # and from v4 on: the per-chip lane
 _V4_ONLY_TYPES = ("per_chip", "imbalance")
+# and from v5 on: the topology-degrade record, plus the chip/host
+# stamps on the recovery records (skipped when validating older files)
+_V5_ONLY_TYPES = ("topology_change",)
+_V5_ONLY_KEYS = {"retry": ("chip", "host"),
+                 "rollback": ("chip", "host"),
+                 "degrade": ("chip", "host")}
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
     """Raise ValueError when a record violates its declared schema
-    version (writers emit v3; v1/v2 files remain readable)."""
+    version (writers emit v5; v1-v4 files remain readable)."""
     if not isinstance(rec, dict):
         raise ValueError(f"record is not an object: {rec!r}")
     v = rec.get("v")
@@ -474,10 +495,13 @@ def validate_record(rec: Dict[str, Any]) -> None:
     if rtype not in RECORD_SCHEMA or \
             (v == 1 and rtype in _V2_ONLY_TYPES) or \
             (v < 3 and rtype in _V3_ONLY_TYPES) or \
-            (v < 4 and rtype in _V4_ONLY_TYPES):
+            (v < 4 and rtype in _V4_ONLY_TYPES) or \
+            (v < 5 and rtype in _V5_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):
+            continue
+        if v < 5 and key in _V5_ONLY_KEYS.get(rtype, ()):
             continue
         if key not in rec:
             raise ValueError(f"{rtype} record missing {key!r}: {rec}")

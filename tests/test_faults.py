@@ -79,6 +79,51 @@ def test_fault_plan_parse_rejects_junk():
         faults.FaultPlan.parse("nan@step=3")
     with pytest.raises(ValueError, match="mode"):
         faults.FaultPlan.parse("corrupt_ckpt@n=1,mode=shred")
+    with pytest.raises(ValueError, match="must be an integer"):
+        faults.FaultPlan.parse("nan@t=8,chip=three")
+    # a key the kind would silently ignore is rejected loudly — the
+    # plan would otherwise "prove" a scenario that never ran
+    with pytest.raises(ValueError, match="does not apply"):
+        faults.FaultPlan.parse("fail_write@n=2,chip=1")  # host= meant
+    with pytest.raises(ValueError, match="does not apply"):
+        faults.FaultPlan.parse("preempt@t=8,times=2")
+
+
+def test_fault_plan_parse_chip_host_scopes():
+    """ISSUE 8: the plan grammar names chips and hosts."""
+    plan = faults.FaultPlan.parse(
+        "nan@t=8,chip=3; host_lost@n=2; fail_write@n=1,host=1")
+    assert plan.faults[0].kind == "nan" and plan.faults[0].chip == 3
+    assert plan.faults[1].kind == "host_lost" and plan.faults[1].n == 2
+    assert plan.faults[2].kind == "fail_write"
+    assert plan.faults[2].n == 1 and plan.faults[2].host == 1
+
+
+def test_nan_chip_scoped_lands_on_named_chip(tmp_path):
+    """nan@...,chip=C places the NaN inside chip C's shard, and the
+    health trip attributes the failure to that chip (the supervisor
+    stamps its v5 records from exc.bad_chip)."""
+    from fdtd3d_tpu.config import ParallelConfig
+    import dataclasses
+    cfg = dataclasses.replace(
+        _cfg(tmp_path, steps=24, every=0, check_finite=True),
+        size=(32, 32, 1),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(2, 2, 1)))
+    faults.install("nan@t=8,chip=1")
+    sim = Simulation(cfg)
+    sim.advance(8)                 # injection at this boundary
+    with pytest.raises(FloatingPointError, match=r"chip") as ei:
+        sim.advance(2)             # short chunk: NaN stays local
+    assert ei.value.bad_chip == 1
+    assert 1 in ei.value.bad_chips
+
+
+def test_nan_chip_out_of_range_is_friendly(tmp_path):
+    faults.install("nan@t=8,chip=9")
+    sim = Simulation(_cfg(tmp_path, every=0))
+    with pytest.raises(ValueError, match="chip=9 out of range"):
+        sim.advance(8)
 
 
 # -------------------------------------------------------------------------
@@ -288,6 +333,155 @@ def test_restore_rejects_carry_family_mismatch(tmp_path):
 
 
 # -------------------------------------------------------------------------
+# SIGINT parity with SIGTERM (ISSUE 8 satellite): Ctrl-C still emits
+# run_end and finalizes traces/sinks
+# -------------------------------------------------------------------------
+
+def test_cli_registers_and_restores_sigint_sigterm(tmp_path,
+                                                   monkeypatch):
+    """cli.main installs SystemExit-raising handlers for BOTH SIGTERM
+    (143) and SIGINT (130), and restores the previous handlers on
+    every exit (library callers must not inherit ours)."""
+    import signal as _signal
+
+    from fdtd3d_tpu.cli import main
+    calls = []
+
+    def fake_signal(sig, handler):
+        calls.append((sig, handler))
+        return _signal.SIG_DFL
+
+    monkeypatch.setattr(_signal, "signal", fake_signal)
+    assert main(_cli_argv(tmp_path)) == 0
+    for sig, code in ((_signal.SIGTERM, 143), (_signal.SIGINT, 130)):
+        ours = [h for s, h in calls if s == sig]
+        assert len(ours) == 2, f"register + restore expected for {sig}"
+        with pytest.raises(SystemExit) as ei:
+            ours[0](sig, None)       # the installed handler
+        assert ei.value.code == code
+        assert ours[-1] is _signal.SIG_DFL  # previous handler restored
+
+
+def test_sigint_finalizes_telemetry_run_end(tmp_path):
+    """End-to-end through a real process: Ctrl-C (SIGINT) mid-run
+    exits 130 AND the telemetry sink still gets its run_end record —
+    the same durability SIGTERM already had."""
+    import json
+    import signal as _signal
+    import subprocess
+    import sys
+    import time
+    tpath = tmp_path / "t.jsonl"
+    argv = [sys.executable, "-m", "fdtd3d_tpu.cli", "--2d", "TMz",
+            "--sizex", "64", "--sizey", "64", "--sizez", "1",
+            "--time-steps", "2000000", "--point-source", "Ez",
+            "--metrics-every", "8", "--telemetry", str(tpath),
+            "--save-dir", str(tmp_path / "out"), "--log-level", "0"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            if tpath.exists() and '"type": "chunk"' in \
+                    tpath.read_text():
+                break  # at least one chunk recorded: mid-run for sure
+            time.sleep(0.1)
+        assert proc.poll() is None, \
+            "run ended before SIGINT could be delivered"
+        proc.send_signal(_signal.SIGINT)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:  # pragma: no cover - hung child
+            proc.kill()
+            proc.wait()
+    assert rc == 130, rc
+    recs = [json.loads(line) for line in open(tpath)]
+    types = [r["type"] for r in recs]
+    assert types[0] == "run_start" and types[-1] == "run_end"
+
+
+# -------------------------------------------------------------------------
+# deterministic chaos (tier-1): bounded fixed-seed fault cocktails drawn
+# from the FULL plan grammar — the run always either completes BIT-VALID
+# (identical to the clean reference) or fails with a named, friendly
+# error; committed checkpoints stay loadable either way (ISSUE 8
+# satellite, promoted from the slow lane).
+# -------------------------------------------------------------------------
+
+# every error class the harness is ALLOWED to surface: each is a named,
+# friendly failure an operator can act on — anything else (a raw numpy/
+# zip/shard_map traceback) fails the test
+_NAMED_FAILURES = (faults.SimulatedPreemption, FloatingPointError,
+                   faults.InjectedTransientError,
+                   faults.InjectedWriteError, io.CheckpointCorrupt)
+
+
+def _draw_plan(rng) -> str:
+    """1-3 bounded faults drawn from the full plan grammar."""
+    entries = []
+    for _ in range(int(rng.integers(1, 4))):
+        kind = ["error", "nan", "preempt", "fail_write",
+                "corrupt_ckpt"][int(rng.integers(0, 5))]
+        if kind == "error":
+            entries.append(f"error@t={int(rng.integers(4, 20))},"
+                           f"times={int(rng.integers(1, 3))}")
+        elif kind == "nan":
+            field = ["Ez", "Hx", "Hy"][int(rng.integers(0, 3))]
+            entries.append(f"nan@t={int(rng.integers(4, 20))},"
+                           f"field={field}")
+        elif kind == "preempt":
+            entries.append(f"preempt@t={int(rng.integers(8, 24))}")
+        elif kind == "fail_write":
+            entries.append(f"fail_write@n={int(rng.integers(1, 4))}")
+        else:
+            entries.append(f"corrupt_ckpt@n={int(rng.integers(1, 3))},"
+                           f"mode={'zero' if rng.random() < 0.5 else 'truncate'}")
+    return "; ".join(entries)
+
+
+@pytest.fixture(scope="module")
+def chaos_reference(tmp_path_factory):
+    """The clean (fault-free) run every completed chaos run must match
+    bit-for-bit: rollback restores are bit-exact, so supervision never
+    changes the physics."""
+    d = tmp_path_factory.mktemp("chaos_ref")
+    sim = Simulation(_cfg(d, steps=24))
+    sim.advance(24)
+    return sim.fields()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_chaos_bounded_fixed_seed_tier1(tmp_path, seed, chaos_reference):
+    from fdtd3d_tpu.supervisor import RetryPolicy, Supervisor
+    rng = np.random.default_rng(seed)
+    spec = _draw_plan(rng)
+    faults.install(spec)
+    cfg = _cfg(tmp_path / "run", steps=24)
+    sup = Supervisor(cfg, policy=RetryPolicy(
+        max_retries=2, sleep=lambda _s: None))
+    try:
+        sim = sup.run(interval=8)
+        assert sim._t_host == 24, spec
+        for comp, ref in chaos_reference.items():
+            assert np.array_equal(sim.fields()[comp], ref), (spec, comp)
+    except _NAMED_FAILURES as exc:
+        assert str(exc), spec        # named AND message-bearing
+    finally:
+        faults.clear()
+    # whatever happened, every COMMITTED snapshot is loadable — except
+    # one the plan itself deliberately damaged (corrupt_ckpt), which
+    # must fail with the NAMED integrity error, not a raw traceback
+    for _t, path in io.find_checkpoints(str(tmp_path / "run")):
+        try:
+            io.load_checkpoint(path)
+        except io.CheckpointCorrupt:
+            assert "corrupt_ckpt" in spec, (spec, path)
+
+
+# -------------------------------------------------------------------------
 # chaos (slow lane): randomized fault sequences, seeded
 # -------------------------------------------------------------------------
 
@@ -307,7 +501,8 @@ def test_chaos_random_fault_sequences(tmp_path, seed):
         entries.append(f"nan@t={int(rng.integers(4, 20))}")
     if rng.random() < 0.3:
         entries.append(f"fail_write@n={int(rng.integers(1, 4))}")
-    faults.install("; ".join(entries) if entries else "error@t=8")
+    spec = "; ".join(entries) if entries else "error@t=8"
+    faults.install(spec)
     cfg = _cfg(tmp_path / f"chaos{seed}", steps=24)
     sup = Supervisor(cfg, policy=RetryPolicy(
         max_retries=4, sleep=lambda _s: None))
@@ -319,4 +514,8 @@ def test_chaos_random_fault_sequences(tmp_path, seed):
     finally:
         faults.clear()
     for _t, path in io.find_checkpoints(str(tmp_path / f"chaos{seed}")):
-        io.load_checkpoint(path)  # committed => loadable, always
+        try:
+            io.load_checkpoint(path)  # committed => loadable
+        except io.CheckpointCorrupt:
+            # only acceptable for a snapshot the plan itself damaged
+            assert "corrupt_ckpt" in spec, (spec, path)

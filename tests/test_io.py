@@ -339,16 +339,29 @@ def test_auto_checkpoint_mid_chunk_cadence_resume_bit_exact(tmp_path):
         assert np.array_equal(b.fields()[comp], ref), comp
 
 
-def test_orbax_checkpoint_rejects_topology_mismatch(tmp_path):
+def test_orbax_checkpoint_cross_topology_restore(tmp_path):
+    """A topology mismatch is no longer a hard error: the orbax
+    restore reassembles the source layout and reshards onto the
+    current plan (reshard-on-resume; topology-portable snapshots)."""
     pytest.importorskip("orbax.checkpoint")
     from fdtd3d_tpu.config import ParallelConfig
 
-    cfg = SimConfig(scheme="3D", size=(16, 16, 16),
+    cfg = SimConfig(scheme="3D", size=(16, 16, 16), time_steps=8,
+                    pml=PmlConfig(size=(3, 3, 3)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez", position=(8, 8, 8)),
                     parallel=ParallelConfig(topology="manual",
                                             manual_topology=(2, 1, 1)))
     a = Simulation(cfg)
+    a.advance(8)
     ckpt = str(tmp_path / "ck")
     a.checkpoint(ckpt, backend="orbax")
-    b = Simulation(SimConfig(scheme="3D", size=(16, 16, 16)))
-    with pytest.raises(ValueError, match="topology"):
-        b.restore(ckpt)
+    b = Simulation(SimConfig(scheme="3D", size=(16, 16, 16),
+                             time_steps=8, pml=PmlConfig(size=(3, 3, 3)),
+                             point_source=PointSourceConfig(
+                                 enabled=True, component="Ez",
+                                 position=(8, 8, 8))))
+    b.restore(ckpt)
+    assert b.t == 8
+    for comp, ref in a.fields().items():
+        assert np.array_equal(b.fields()[comp], ref), comp

@@ -1,0 +1,194 @@
+"""Coordinated multi-writer commit tests (ISSUE 8 tentpole piece 2).
+
+Two-phase marker protocol (io.py): each participating process
+atomically publishes its shards plus a per-host marker (phase 1);
+process 0 publishes COMMIT.fdtd3d only after observing the FULL marker
+set (phase 2). Discovery treats any partial marker set as uncommitted
+— skipped with a warning, never a crash.
+
+Proven CPU-deterministically with SIMULATED writer sets
+(faults.simulated_host drives the protocol once per host) plus
+fault-plan kill points between the phases (host_lost, host-scoped
+fail_write).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import faults, io
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plan(monkeypatch):
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _publish_all(dirpath, hosts, num_writers):
+    """Simulate each writer's phase 1: shard payload + host marker."""
+    os.makedirs(dirpath, exist_ok=True)
+    for h in hosts:
+        with faults.simulated_host(h):
+            # the "shard": any payload the writer owns, atomically
+            io.save_checkpoint({"E": {"Ez": np.full((4, 4), h, np.float32)}},
+                               os.path.join(dirpath, f"shard_{h:04d}.npz"),
+                               extra={"host": h})
+            io.publish_host_marker(dirpath, h, num_writers)
+
+
+def test_two_phase_commit_happy_path(tmp_path):
+    d = str(tmp_path / "ckpt_t000008")
+    _publish_all(d, [0, 1, 2], 3)
+    st = io.commit_status(d)
+    assert st["markers"] == [0, 1, 2] and st["missing"] == []
+    assert not st["committed"]       # phase 2 has not run yet
+    assert io.commit_if_complete(d, 3) is True
+    st = io.commit_status(d)
+    assert st["committed"] and not st["legacy"]
+    # the COMMIT marker records the writer set
+    with open(os.path.join(d, io.ORBAX_COMMIT_MARKER)) as f:
+        commit = json.load(f)
+    assert commit == {"num_writers": 3, "hosts": [0, 1, 2]}
+    assert [t for t, _ in io.find_checkpoints(str(tmp_path))] == [8]
+
+
+def test_partial_marker_set_never_commits(tmp_path, capsys):
+    d = str(tmp_path / "ckpt_t000008")
+    _publish_all(d, [0, 2], 3)       # host 1 never published
+    assert io.commit_if_complete(d, 3) is False
+    assert not os.path.exists(os.path.join(d, io.ORBAX_COMMIT_MARKER))
+    st = io.commit_status(d)
+    assert not st["committed"] and st["missing"] == [1]
+    # discovery: skipped WITH a warning naming the lost writer
+    assert io.find_checkpoints(str(tmp_path)) == []
+    err = capsys.readouterr().err
+    assert "partial commit-marker set" in err and "[1]" in err
+    # and the metadata reader refuses it with the named failure
+    with pytest.raises(io.CheckpointCorrupt, match=r"hosts \[1\] of 3"):
+        io.read_orbax_meta(d)
+
+
+def test_commit_over_partial_set_does_not_count(tmp_path):
+    """A hand-rolled/damaged COMMIT over an incomplete marker set must
+    not resurrect the snapshot: the partial set is authoritative."""
+    d = str(tmp_path / "ckpt_t000008")
+    _publish_all(d, [0], 2)
+    with io.atomic_open(os.path.join(d, io.ORBAX_COMMIT_MARKER)) as f:
+        f.write("forged\n")
+    assert not io.commit_status(d)["committed"]
+    assert io.find_checkpoints(str(tmp_path)) == []
+
+
+def test_stray_marker_never_enables_or_poisons_commit(tmp_path):
+    """A stray marker from an earlier crashed WIDER writer set must
+    neither stand in for a missing real writer (phase 2 requires
+    set(range(n)) <= present, not a subset test the stray can tilt)
+    nor poison a complete smaller set on the read side (the COMMIT
+    marker's recorded writer count is authoritative)."""
+    d = str(tmp_path / "ckpt_t000008")
+    _publish_all(d, [0], 2)
+    with faults.simulated_host(3):
+        io.publish_host_marker(d, 3, 4)   # leftover of a 4-writer era
+    # host 1 missing: the stray must NOT complete the set
+    assert io.commit_if_complete(d, 2) is False
+    assert io.find_checkpoints(str(tmp_path)) == []
+    # once host 1 publishes, the commit goes through, and readers
+    # honor the COMMIT's num_writers=2 despite the stray claiming 4
+    _publish_all(d, [1], 2)
+    assert io.commit_if_complete(d, 2) is True
+    st = io.commit_status(d)
+    assert st["committed"] and st["num_writers"] == 2
+    assert [t for t, _ in io.find_checkpoints(str(tmp_path))] == [8]
+
+
+def test_legacy_single_writer_dir_still_committed(tmp_path):
+    """Pre-two-phase directories (COMMIT marker, no host markers) keep
+    reading as committed — old snapshots must not rot."""
+    d = str(tmp_path / "ckpt_t000016")
+    os.makedirs(d)
+    with io.atomic_open(os.path.join(d, io.ORBAX_COMMIT_MARKER)) as f:
+        f.write("committed\n")
+    st = io.commit_status(d)
+    assert st["committed"] and st["legacy"]
+    assert [t for t, _ in io.find_checkpoints(str(tmp_path))] == [16]
+
+
+# -------------------------------------------------------------------------
+# kill points between the phases (faults.py)
+# -------------------------------------------------------------------------
+
+def test_host_lost_between_phases_leaves_partial_set(tmp_path):
+    """host_lost@n=H kills exactly writer H before its marker lands;
+    the set stays partial, the commit never happens, and — the fault
+    being one-shot — the writer's RETRY completes the snapshot."""
+    d = str(tmp_path / "ckpt_t000008")
+    faults.install("host_lost@n=1")
+    with pytest.raises(faults.SimulatedHostLoss):
+        _publish_all(d, [0, 1, 2], 3)
+    # hosts 0 published; 1 died; 2 never ran (ordered simulation)
+    assert io.commit_if_complete(d, 3) is False
+    assert io.find_checkpoints(str(tmp_path)) == []
+    # the incident is one-shot: the resumed writers complete phase 1
+    _publish_all(d, [1, 2], 3)
+    assert io.commit_if_complete(d, 3) is True
+    assert [t for t, _ in io.find_checkpoints(str(tmp_path))] == [8]
+
+
+def test_host_lost_is_never_swallowed():
+    assert issubclass(faults.SimulatedHostLoss,
+                      faults.SimulatedPreemption)
+    assert not issubclass(faults.SimulatedHostLoss, Exception)
+
+
+def test_host_scoped_fail_write(tmp_path):
+    """fail_write@n=1,host=1 fails host 1's FIRST write only — other
+    writers' counters are untouched, and the atomic contract holds
+    (no marker debris under the final name)."""
+    d = str(tmp_path / "ckpt_t000008")
+    faults.install("fail_write@n=1,host=1")
+    with faults.simulated_host(0):
+        io.publish_host_marker(d, 0, 3)      # host 0 write #1: fine
+    with faults.simulated_host(1):
+        with pytest.raises(faults.InjectedWriteError, match="host 1"):
+            io.publish_host_marker(d, 1, 3)  # host 1 write #1: fails
+    with faults.simulated_host(2):
+        io.publish_host_marker(d, 2, 3)
+    st = io.commit_status(d)
+    assert st["markers"] == [0, 2] and st["missing"] == [1]
+    assert not any(".tmp." in n for n in os.listdir(d))
+    # one-shot: host 1's retry lands, commit completes
+    with faults.simulated_host(1):
+        io.publish_host_marker(d, 1, 3)
+    assert io.commit_if_complete(d, 3) is True
+
+
+def test_simulated_host_scopes_current_host():
+    assert faults.current_host() == 0     # single-process default
+    with faults.simulated_host(5):
+        assert faults.current_host() == 5
+        with faults.simulated_host(2):
+            assert faults.current_host() == 2
+        assert faults.current_host() == 5
+    assert faults.current_host() == 0
+
+
+# -------------------------------------------------------------------------
+# the real sharded saver rides the same protocol
+# -------------------------------------------------------------------------
+
+def test_orbax_save_publishes_markers_and_commit(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    import jax.numpy as jnp
+    d = str(tmp_path / "ckpt_t000004")
+    io.save_checkpoint_orbax({"E": {"Ez": jnp.zeros((8, 8))}}, d,
+                             extra={"t": 4})
+    assert os.path.exists(os.path.join(d, io.host_marker_name(0)))
+    st = io.commit_status(d)
+    assert st["committed"] and st["num_writers"] == 1
+    assert io.read_orbax_meta(d) == {"t": 4}
+    assert [t for t, _ in io.find_checkpoints(str(tmp_path))] == [4]

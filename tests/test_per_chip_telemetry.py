@@ -52,7 +52,7 @@ def test_per_chip_records_on_mesh(tmp_path):
     assert len(pcs) == len(chunks) == 2
     assert len(imbs) == 2
     pc = pcs[-1]
-    assert pc["v"] == 4 and pc["n_chips"] == 8
+    assert pc["v"] == telemetry.SCHEMA_VERSION and pc["n_chips"] == 8
     assert set(pc["counters"]) == set(telemetry.PER_CHIP_KEYS)
     for vec in pc["counters"].values():
         assert len(vec) == 8
@@ -167,9 +167,9 @@ def test_sink_scrubs_nested_nonfinite(tmp_path):
     assert rec["counters"]["energy"] == [1.0, None]
 
 
-def test_fixture_corpus_round_trips_v1_to_v4():
+def test_fixture_corpus_round_trips_v1_to_v5():
     """Satellite acceptance: every checked-in telemetry JSONL fixture
-    still validates, and the corpus spans schema v1..v4 so no version
+    still validates, and the corpus spans schema v1..v5 so no version
     can silently rot out of the read path."""
     paths = sorted(glob.glob(os.path.join(FIX, "*.jsonl")))
     assert paths, "no JSONL fixtures found"
@@ -185,3 +185,8 @@ def test_fixture_corpus_round_trips_v1_to_v4():
         os.path.join(FIX, "telemetry_v4.jsonl"))}
     assert {"per_chip", "imbalance", "retry", "rollback",
             "degrade"} <= types
+    # the v5 file carries the topology-elastic types + chip stamps
+    v5 = telemetry.read_jsonl(os.path.join(FIX, "telemetry_v5.jsonl"))
+    assert {"topology_change"} <= {r["type"] for r in v5}
+    assert any(r.get("chip") is not None for r in v5
+               if r["type"] == "rollback")

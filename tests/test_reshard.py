@@ -1,0 +1,230 @@
+"""Reshard-on-resume tests (ISSUE 8 tentpole piece 1).
+
+Snapshots are topology-portable: the CPML psi recursion state is the
+one topology-dependent piece of the state pytree (per-shard slab
+compaction, solver.slab_axes), and io.psi_slab_expand/compact convert
+it exactly between layouts. Acceptance: a run checkpointed on (2,2,2)
+and resumed on (1,2,2) AND on the unsharded path finishes
+BIT-IDENTICAL to the uninterrupted run (CPU, 8-device virtual mesh).
+
+Grids here are sized so every involved topology picks the SAME
+slab-vs-full storage choice (24-cell axes, pml 3/4: local extents stay
+above the 2*(npml+1) slab threshold) — bit-identical continuation
+across topologies additionally requires the CPML arithmetic path to
+match, which it does exactly then.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import faults, io
+from fdtd3d_tpu.config import (OutputConfig, ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig)
+from fdtd3d_tpu.sim import Simulation
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plan(monkeypatch):
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _cfg3d(save_dir=None, topo=None, steps=24, every=0):
+    par = ParallelConfig() if topo is None else ParallelConfig(
+        topology="manual", manual_topology=topo)
+    out = OutputConfig()
+    if save_dir is not None:
+        out = OutputConfig(save_dir=str(save_dir),
+                           checkpoint_every=every)
+    return SimConfig(
+        scheme="3D", size=(24, 24, 24), time_steps=steps, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(3, 3, 3)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(12, 12, 12)),
+        parallel=par, output=out)
+
+
+def _cli_argv(save_dir, topo="2x2x2", steps=24):
+    argv = ["--3d", "--same-size", "24", "--time-steps", str(steps),
+            "--pml-size", "3", "--use-pml", "--point-source", "Ez",
+            "--courant-factor", "0.4", "--wavelength", "0.008",
+            "--checkpoint-every", "8", "--save-dir", str(save_dir),
+            "--log-level", "0"]
+    if topo is not None:
+        argv += ["--manual-topology", topo]
+    return argv
+
+
+# -------------------------------------------------------------------------
+# psi slab layout conversion units
+# -------------------------------------------------------------------------
+
+def _slab_like(n=24, m=4, p=1, other=(6, 5)):
+    """A physically-plausible psi array in the (m, p) slab layout:
+    non-zero ONLY in the global boundary slabs every layout keeps."""
+    rng = np.random.default_rng(0)
+    full = np.zeros((n,) + other, np.float32)
+    full[:m] = rng.standard_normal((m,) + other)
+    full[n - m:] = rng.standard_normal((m,) + other)
+    return full, io.psi_slab_compact(full, 0, p, m)
+
+
+def test_psi_expand_compact_roundtrip_exact():
+    n, m = 24, 4
+    full, _ = _slab_like(n, m)
+    for p_src in (1, 2, 3):
+        src = io.psi_slab_compact(full, 0, p_src, m)
+        back = io.psi_slab_expand(src, 0, n, p_src, m)
+        assert np.array_equal(back, full), p_src
+        for p_dst in (1, 2, 3):
+            dst = io.psi_slab_compact(back, 0, p_dst, m)
+            again = io.psi_slab_expand(dst, 0, n, p_dst, m)
+            assert np.array_equal(again, full), (p_src, p_dst)
+
+
+def test_psi_expand_full_storage_passthrough():
+    full, _ = _slab_like()
+    assert io.psi_slab_expand(full, 0, 24, 2, None) is full
+    assert io.psi_slab_compact(full, 0, 2, None) is full
+
+
+def test_psi_expand_rejects_wrong_shape():
+    full, slab = _slab_like(24, 4, 2)
+    with pytest.raises(ValueError, match="disagree"):
+        io.psi_slab_expand(slab, 0, 24, 3, 4)   # wrong shard count
+    with pytest.raises(ValueError, match="full storage"):
+        io.psi_slab_expand(slab, 0, 24, 2, None)
+
+
+def test_psi_compact_refuses_lossy_drop():
+    """Non-zero state outside the target slabs (a snapshot disagreeing
+    with its declared layout) must raise, never silently vanish."""
+    full, _ = _slab_like(24, 4)
+    full[5] = 1.0  # interior plane a real run never populates (and
+    #                outside every slab the (m=4, p=2) target keeps)
+    with pytest.raises(ValueError, match="non-zero psi planes"):
+        io.psi_slab_compact(full, 0, 2, 4, key="psi_E/Ez_x")
+
+
+def test_reshard_tree_validates_divisibility():
+    with pytest.raises(ValueError, match="does not divide"):
+        io.reshard_psi_tree({}, (24, 24, 24), (5, 1, 1), {}, (1, 1, 1),
+                            {})
+
+
+# -------------------------------------------------------------------------
+# cross-topology restore (direct API)
+# -------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dst_topo", [(1, 2, 2), None, (2, 1, 1)])
+def test_checkpoint_crosses_topology_bit_exact(tmp_path, dst_topo):
+    ck = str(tmp_path / "ck.npz")
+    a = Simulation(_cfg3d(topo=(2, 2, 2), steps=16))
+    a.advance(8)
+    a.checkpoint(ck)
+    a.advance(8)
+
+    b = Simulation(_cfg3d(topo=dst_topo, steps=16))
+    b.restore(ck)
+    assert b.t == 8
+    b.advance(8)
+    for comp, ref in a.fields().items():
+        assert np.array_equal(b.fields()[comp], ref), \
+            f"{comp} diverged resuming on {dst_topo}"
+
+
+def test_ckpt_meta_records_layout(tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    Simulation(_cfg3d(topo=(2, 2, 2), steps=0)).checkpoint(ck)
+    meta = io.read_checkpoint_meta(ck)
+    assert meta["topology"] == [2, 2, 2]
+    assert meta["psi_slabs"] == {"x": 4, "y": 4, "z": 4}  # npml+1
+
+
+def test_restore_rejects_layout_disagreement(tmp_path):
+    """A snapshot whose recorded psi slab layout contradicts what its
+    topology implies is refused with a friendly CheckpointCorrupt."""
+    sim = Simulation(_cfg3d(topo=(2, 2, 2), steps=0))
+    ck = str(tmp_path / "ck.npz")
+    sim.checkpoint(ck)
+    state, extra = io.load_checkpoint(ck)
+    extra["psi_slabs"] = {"x": 2, "y": 4, "z": 4}  # forged layout
+    forged = str(tmp_path / "forged.npz")
+    io.save_checkpoint(state, forged, extra=extra)
+    other = Simulation(_cfg3d(steps=0))  # unsharded: reshard engages
+    with pytest.raises(io.CheckpointCorrupt, match="slab layout"):
+        other.restore(forged)
+
+
+# -------------------------------------------------------------------------
+# ACCEPTANCE: (2,2,2) run preempted -> resumed on (1,2,2) and unsharded,
+# bit-identical to the uninterrupted run (CPU, 8-device virtual mesh)
+# -------------------------------------------------------------------------
+
+def test_cli_resume_across_topologies_bit_identical(tmp_path,
+                                                    monkeypatch):
+    from fdtd3d_tpu.cli import main
+
+    # uninterrupted reference on (2,2,2)
+    d_ref = tmp_path / "ref"
+    assert main(_cli_argv(d_ref)) == 0
+    ref, ref_extra = io.load_checkpoint(
+        os.path.join(str(d_ref), "ckpt_t000024.npz"))
+    assert ref_extra["topology"] == [2, 2, 2]
+
+    for tag, topo in (("shrunk", "1x2x2"), ("unsharded", None)):
+        d = tmp_path / tag
+        monkeypatch.setenv("FDTD3D_FAULT_PLAN", "preempt@t=16")
+        with pytest.raises(faults.SimulatedPreemption):
+            main(_cli_argv(d))       # killed on (2,2,2) at t=16
+        monkeypatch.delenv("FDTD3D_FAULT_PLAN")
+        faults.clear()
+
+        assert main(_cli_argv(d, topo=topo)
+                    + ["--resume", "auto"]) == 0, tag
+        got, extra = io.load_checkpoint(
+            os.path.join(str(d), "ckpt_t000024.npz"))
+        want_topo = [1, 2, 2] if topo else [1, 1, 1]
+        assert extra["topology"] == want_topo, tag
+        # E/H fields are layout-independent: compare them directly;
+        # psi layouts differ by design — compare through the expand
+        for grp in ("E", "H"):
+            for comp, v in ref[grp].items():
+                assert np.array_equal(got[grp][comp], v), (tag, comp)
+        for grp in ("psi_E", "psi_H"):
+            for key, v in ref[grp].items():
+                a = _expand(v, key, ref_extra)
+                b = _expand(got[grp][key], key, extra)
+                assert np.array_equal(a, b), (tag, grp, key)
+
+
+def _expand(arr, key, extra):
+    ax = "xyz".index(key.rsplit("_", 1)[1])
+    m = (extra.get("psi_slabs") or {}).get("xyz"[ax])
+    return io.psi_slab_expand(np.asarray(arr), ax, 24,
+                              extra["topology"][ax],
+                              int(m) if m is not None else None)
+
+
+# -------------------------------------------------------------------------
+# friendly-error sweep: a topology that cannot map onto the devices
+# -------------------------------------------------------------------------
+
+def test_resume_oversized_topology_is_friendly_systemexit(tmp_path):
+    """--resume with a decomposition needing more chips than the
+    allocation has must exit with a NAMED SystemExit (mentioning the
+    reshard escape hatch), never a raw mesh/shard_map traceback."""
+    from fdtd3d_tpu.cli import main
+    assert main(_cli_argv(tmp_path)) == 0
+    ck = os.path.join(str(tmp_path), "ckpt_t000024.npz")
+    with pytest.raises(SystemExit,
+                       match=r"needs 64 devices.*topology-portable"):
+        main(_cli_argv(tmp_path, topo="4x4x4") + ["--resume", ck])
+    # and an outright invalid decomposition is named too
+    with pytest.raises(SystemExit, match="invalid decomposition"):
+        main(_cli_argv(tmp_path, topo="5x1x1") + ["--resume", ck])

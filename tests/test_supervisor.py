@@ -299,6 +299,174 @@ def test_degraded_build_failure_reattaches_sink(tmp_path):
     assert recs[-1]["type"] == "run_end"
 
 
+# -------------------------------------------------------------------------
+# ACCEPTANCE (ISSUE 8): chip-scoped NaN -> rollback + TOPOLOGY degrade,
+# failing chip named in v5 telemetry, run completes
+# -------------------------------------------------------------------------
+
+def _cfg3d_sharded(save_dir, topo=(2, 2, 2), **out_kw):
+    from fdtd3d_tpu.config import ParallelConfig
+    out_kw.setdefault("checkpoint_every", 8)
+    return SimConfig(
+        scheme="3D", size=(24, 24, 24), time_steps=24, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(3, 3, 3)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(12, 12, 12)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=topo),
+        output=OutputConfig(save_dir=str(save_dir), **out_kw))
+
+
+def test_chip_nan_topology_degrade_completes_bit_valid(tmp_path):
+    """A chip-scoped NaN on the (CPU jnp) reference path: the kernel
+    ladder has no rung below, so the supervisor rolls back to the last
+    committed checkpoint and degrades the TOPOLOGY — (2,2,2) ->
+    (1,2,2) via the reshard-on-resume restore — completing the horizon
+    with state bit-identical to an uninterrupted unsharded run, and
+    the failing chip named in the v5 records."""
+    d = tmp_path / "run"
+    cfg = _cfg3d_sharded(d, telemetry_path=str(tmp_path / "t.jsonl"))
+    faults.install("nan@t=8,field=Ez,chip=3")
+    sup = Supervisor(cfg, policy=RetryPolicy(sleep=lambda _s: None))
+    sim = sup.run(interval=8)
+    sim.close()
+    faults.clear()
+
+    assert sim._t_host == 24
+    assert tuple(sim.topology) == (1, 2, 2)
+    assert sup.topology_rung == 1 and sup.rollbacks == 1
+
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    rb = [r for r in recs if r["type"] == "rollback"]
+    tc = [r for r in recs if r["type"] == "topology_change"]
+    assert len(rb) == 1 and len(tc) == 1
+    assert rb[0]["t_failed"] == 16 and rb[0]["t_restored"] == 8
+    assert rb[0]["chip"] == 3            # the failing chip, named
+    assert tc[0]["old_topology"] == [2, 2, 2]
+    assert tc[0]["new_topology"] == [1, 2, 2]
+    assert tc[0]["chip"] == 3
+    types = [r["type"] for r in recs]
+    assert types.count("run_start") == 1 and types.count("run_end") == 1
+
+    # the cadence snapshots now carry the supervisor's durable state
+    newest = io.find_latest_checkpoint(str(d))
+    meta = io.read_checkpoint_meta(newest)
+    assert meta["supervisor"]["topology"] == [1, 2, 2]
+    assert meta["supervisor"]["topology_rung"] == 1
+
+    # BIT-VALID: identical to the uninterrupted unsharded run (the
+    # 24-cell grid keeps every topology on the same CPML slab path)
+    import dataclasses
+
+    from fdtd3d_tpu.config import ParallelConfig
+    from fdtd3d_tpu.sim import Simulation
+    ref = Simulation(dataclasses.replace(
+        _cfg3d_sharded(tmp_path / "ref", checkpoint_every=0),
+        parallel=ParallelConfig()))
+    ref.advance(24)
+    got = sim.fields()
+    for comp, v in ref.fields().items():
+        assert np.array_equal(np.asarray(v), np.asarray(got[comp])), comp
+
+
+def test_transient_exhaustion_walks_topology_ladder(tmp_path):
+    """Retries exhausted on the current topology: shed a topology rung
+    (with a fresh retry budget) instead of giving up — the recovery
+    for a persistently failing chip/link."""
+    from fdtd3d_tpu.config import ParallelConfig
+    cfg = SimConfig(
+        scheme="2D_TMz", size=(24, 24, 1), time_steps=24, dx=1e-3,
+        courant_factor=0.5, wavelength=10e-3,
+        pml=PmlConfig(size=(4, 4, 0)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(12, 12, 0)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(2, 2, 1)),
+        output=OutputConfig(save_dir=str(tmp_path), checkpoint_every=8,
+                            telemetry_path=str(tmp_path / "t.jsonl")))
+    faults.install("error@t=8,times=2")
+    sup = Supervisor(cfg, policy=RetryPolicy(max_retries=0,
+                                             sleep=lambda _s: None))
+    sim = sup.run(interval=8)
+    sim.close()
+    faults.clear()
+    assert sim._t_host == 24
+    assert tuple(sim.topology) == (1, 1, 1)
+    assert sup.topology_rung == 2 and sup.retries == 0
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    tc = [r for r in recs if r["type"] == "topology_change"]
+    assert [(r["old_topology"], r["new_topology"]) for r in tc] == \
+        [([2, 2, 1], [1, 2, 1]), ([1, 2, 1], [1, 1, 1])]
+
+
+def test_supervised_resume_adopts_persisted_degraded_state(tmp_path,
+                                                           monkeypatch):
+    """A preemption mid-degrade: the next supervised --resume reads the
+    persisted supervisor state from the snapshot and resumes DEGRADED
+    — on the smaller topology, counters seeded — rather than
+    re-tripping on the original plan."""
+    from fdtd3d_tpu.cli import main
+    d = tmp_path / "run"
+    argv = ["--3d", "--same-size", "24", "--time-steps", "24",
+            "--use-pml", "--pml-size", "3", "--point-source", "Ez",
+            "--courant-factor", "0.4", "--wavelength", "0.008",
+            "--manual-topology", "2x2x2", "--checkpoint-every", "8",
+            "--save-dir", str(d), "--supervise", "--log-level", "0"]
+    # NaN at t=8 trips at 16 -> topology degrade to (1,2,2) + rollback
+    # to t=8; the re-advanced boundary at t=16 commits a snapshot
+    # carrying the supervisor state, then the preemption kills the run.
+    monkeypatch.setenv("FDTD3D_FAULT_PLAN",
+                       "nan@t=8,field=Ez,chip=3; preempt@t=16")
+    with pytest.raises(faults.SimulatedPreemption):
+        main(argv)
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN")
+    faults.clear()
+    newest = io.find_latest_checkpoint(str(d))
+    meta = io.read_checkpoint_meta(newest)
+    assert meta["supervisor"]["topology"] == [1, 2, 2]
+
+    # resume (no fault plan): must adopt the degraded topology
+    assert main(argv + ["--resume", "auto"]) == 0
+    _state, extra = io.load_checkpoint(
+        os.path.join(str(d), "ckpt_t000024.npz"))
+    assert extra["t"] == 24
+    assert extra["topology"] == [1, 2, 2]       # resumed DEGRADED
+    assert extra["supervisor"]["topology_rung"] == 1  # counters seeded
+    # no new recovery events fired on the resumed leg
+    assert extra["supervisor"]["rollbacks"] == 1
+
+
+def test_supervised_resume_peek_ignores_foreign_snapshot(tmp_path):
+    """A foreign run's leftover snapshot in the same save_dir (the
+    stale-leftover fault model) must not donate its recovery state to
+    a supervised resume: the peek applies the same scheme/size/dtype
+    guards the restore loop does."""
+    import numpy as np
+
+    from fdtd3d_tpu.cli import _peek_supervisor_state
+    foreign = {"t": 8, "scheme": "3D", "size": [32, 32, 32],
+               "dtype": "float32",
+               "supervisor": {"topology": [2, 2, 2],
+                              "topology_rung": 1, "env_pins":
+                              {"FDTD3D_NO_TEMPORAL": "1"}}}
+    io.save_checkpoint({"E": {"Ez": np.zeros((4, 4), np.float32)}},
+                       str(tmp_path / "ckpt_t000008.npz"),
+                       extra=foreign)
+    cfg = _cfg2d(tmp_path)          # 2D_TMz (24, 24, 1): incompatible
+    state, path = _peek_supervisor_state(cfg, "auto")
+    assert state is None and path is None
+    # a COMPATIBLE snapshot's state IS adopted
+    compatible = {**foreign, "scheme": cfg.scheme,
+                  "size": list(cfg.size), "dtype": cfg.dtype}
+    io.save_checkpoint({"E": {"Ez": np.zeros((4, 4), np.float32)}},
+                       str(tmp_path / "ckpt_t000016.npz"),
+                       extra=compatible)
+    state, path = _peek_supervisor_state(cfg, "auto")
+    assert state == compatible["supervisor"]
+    assert path.endswith("ckpt_t000016.npz")
+
+
 def test_rollback_without_checkpoints_uses_initial_snapshot(tmp_path):
     """No cadence configured: the supervisor's in-memory snapshot of
     the starting state is the rollback target of last resort."""

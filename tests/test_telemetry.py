@@ -131,6 +131,29 @@ def test_schema_v3_recovery_records():
         telemetry.validate_record({"v": 3, "type": "degrade", "t": 8})
 
 
+def test_schema_v5_topology_and_chip_host():
+    """v5 (ISSUE 8): topology_change joins the schema, and the
+    recovery records carry chip/host stamps — REQUIRED (nullable) at
+    v5, skipped when validating v3/v4 files."""
+    tc = {"t": 8, "old_topology": [2, 2, 2],
+          "new_topology": [1, 2, 2], "reason": "chip 3 diverged",
+          "chip": 3, "host": 0}
+    telemetry.validate_record({"v": 5, "type": "topology_change", **tc})
+    for v_old in (1, 2, 3, 4):
+        with pytest.raises(ValueError, match="unknown record type"):
+            telemetry.validate_record({"v": v_old,
+                                       "type": "topology_change", **tc})
+    # chip/host: required at v5 (null allowed), absent pre-v5 is fine
+    base = {"t": 8, "old_kind": "jnp", "new_kind": "jnp", "reason": "x"}
+    telemetry.validate_record({"v": 3, "type": "degrade", **base})
+    with pytest.raises(ValueError, match="missing 'chip'"):
+        telemetry.validate_record({"v": 5, "type": "degrade", **base})
+    telemetry.validate_record({"v": 5, "type": "degrade", **base,
+                               "chip": None, "host": None})
+    telemetry.validate_record({"v": 5, "type": "degrade", **base,
+                               "chip": 3, "host": 1})
+
+
 # -------------------------------------------------------------------------
 # in-graph guarantee: no full-field host transfer, ≤1 scalar readback
 # -------------------------------------------------------------------------

@@ -69,11 +69,68 @@ def test_legacy_tools_refuse_without_flag(tool):
 # -------------------------------------------------------------------------
 
 def test_telemetry_report_runs_on_fixtures():
-    for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl"):
+    for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl",
+                    "telemetry_v5.jsonl"):
         proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
                      os.path.join(FIX, fixture), "--json"])
         assert proc.returncode == 0, (fixture, proc.stderr)
         json.loads(proc.stdout)  # --json emits parseable summaries
+    # the v5 text form names the implicated chip and topology rung
+    proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
+                 os.path.join(FIX, "telemetry_v5.jsonl")])
+    assert proc.returncode == 0, proc.stderr
+    assert "TOPOLOGY CHANGE" in proc.stdout
+    assert "[chip 3, host 0]" in proc.stdout
+
+
+def test_ckpt_inspect_runs_and_verifies(tmp_path):
+    """tools/ckpt_inspect.py: inspect + --verify exit codes on a real
+    snapshot, a corrupted one, and an uncommitted directory."""
+    import numpy as np
+    sys.path.insert(0, ROOT)
+    from fdtd3d_tpu import io
+    ck = str(tmp_path / "ckpt_t000008.npz")
+    io.save_checkpoint(
+        {"E": {"Ez": np.arange(64, dtype=np.float32).reshape(8, 8)}},
+        ck, extra={"t": 8, "scheme": "2D_TMz", "size": [8, 8, 1],
+                   "topology": [2, 1, 1], "psi_slabs": {},
+                   "dtype": "float32", "step_kind": "jnp",
+                   "state_keys": ["E"],
+                   "supervisor": {"topology": [2, 1, 1],
+                                  "topology_rung": 1, "retries": 0,
+                                  "rollbacks": 1, "degrades": 0,
+                                  "env_pins": {}}})
+    tool = os.path.join(TOOLS, "ckpt_inspect.py")
+    proc = _run([tool, ck, "--verify"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "VERDICT: OK" in proc.stdout
+    assert "topology=[2, 1, 1]" in proc.stdout
+    assert "supervisor state" in proc.stdout
+
+    proc = _run([tool, ck, "--verify", "--json"])
+    out = json.loads(proc.stdout)
+    assert out["ok"] and out["checks"]["payload"]
+    assert out["meta"]["supervisor"]["topology_rung"] == 1
+
+    # damaged payload: --verify exits 1 naming the failed check
+    with open(ck, "r+b") as fh:
+        fh.truncate(os.path.getsize(ck) // 2)
+    proc = _run([tool, ck, "--verify"])
+    assert proc.returncode == 1, proc.stdout
+    assert "FAILED" in proc.stdout
+
+    # uncommitted directory snapshot: exit 1, partial set named
+    d = str(tmp_path / "ckpt_t000016")
+    os.makedirs(d)
+    io.publish_host_marker(d, 0, 2)
+    proc = _run([tool, d])
+    assert proc.returncode == 1, proc.stdout
+    assert "NOT COMMITTED" in proc.stdout
+
+    # a missing path is a friendly exit 1, not a traceback
+    proc = _run([tool, str(tmp_path / "nope.npz")])
+    assert proc.returncode == 1
+    assert "no such snapshot" in proc.stderr
 
 
 def test_trace_attribution_runs_on_fixtures(tmp_path):

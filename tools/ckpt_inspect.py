@@ -1,0 +1,184 @@
+"""Inspect/verify a fdtd3d checkpoint snapshot (docs/ROBUSTNESS.md).
+
+Usage:
+    python tools/ckpt_inspect.py PATH [--verify] [--json]
+
+Shows what a resume would see WITHOUT moving any state bytes: the
+snapshot's step, scheme/grid, source topology and per-shard psi slab
+layout (the facts the reshard-on-resume path converts between), dtype,
+carry family, persisted supervisor recovery state, and — for
+directory-style (orbax) snapshots — the two-phase commit-marker
+completeness (per-host markers + COMMIT).
+
+``--verify`` additionally loads the full payload and runs every
+integrity check (npz/zip structure, per-array manifest, payload
+checksum; commit-marker set for directories). Exit codes:
+
+* 0 — snapshot readable (and, with ``--verify``, every check passed)
+* 1 — unreadable / a named integrity check failed
+* 2 — usage error (argparse)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
+
+from fdtd3d_tpu import io  # noqa: E402
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+META_KEYS = ("t", "scheme", "size", "topology", "psi_slabs", "dtype",
+             "step_kind", "state_keys", "supervisor")
+
+
+def inspect(path: str, verify: bool = False) -> dict:
+    """-> {"path", "backend", "meta", "commit"?, "checks", "ok"}."""
+    out = {"path": path, "checks": {}, "ok": True}
+    is_dir = os.path.isdir(path)
+    out["backend"] = "orbax-dir" if is_dir else "npz"
+
+    if is_dir:
+        st = io.commit_status(path)
+        out["commit"] = st
+        out["checks"]["commit"] = st["committed"]
+        if not st["committed"]:
+            out["ok"] = False
+            if st["markers"] and st["missing"]:
+                out["checks"]["commit_error"] = (
+                    f"partial marker set: hosts {st['missing']} of "
+                    f"{st['num_writers']} never published")
+            else:
+                out["checks"]["commit_error"] = (
+                    f"missing {io.ORBAX_COMMIT_MARKER} marker "
+                    f"(never committed)")
+
+    try:
+        meta = io.read_checkpoint_meta(path)
+        out["checks"]["meta"] = True
+    except io.CheckpointCorrupt as exc:
+        out["checks"]["meta"] = False
+        out["checks"]["meta_error"] = str(exc)
+        out["ok"] = False
+        meta = {}
+    out["meta"] = {k: meta.get(k) for k in META_KEYS if k in meta}
+
+    if not is_dir and out["checks"]["meta"]:
+        # array census from the zip directory + stored manifest — no
+        # payload bytes move unless --verify asks for them
+        try:
+            import numpy as np
+            import zlib
+            with np.load(path, allow_pickle=False) as z:
+                names = [n for n in z.files if n != "__meta__"]
+                raw = json.loads(zlib.decompress(
+                    z["__meta__"].tobytes())) if "__meta__" in z.files \
+                    else {}
+            out["arrays"] = len(names)
+            manifest = raw.get("_manifest")
+            if manifest:
+                out["payload_bytes"] = int(sum(
+                    int(np.prod(shape)) * np.dtype(dt).itemsize
+                    for shape, dt in manifest.values()))
+            out["has_checksum"] = "_checksum" in raw
+        except Exception as exc:  # census is advisory, never fatal
+            warn(f"array census failed: {exc}")
+
+    if verify:
+        if is_dir:
+            # directory payload verification is the commit protocol
+            # itself (orbax owns per-array integrity); meta + markers
+            # were checked above
+            out["checks"]["payload"] = out["checks"].get("commit",
+                                                         False)
+        else:
+            try:
+                io.load_checkpoint(path, verify=True)
+                out["checks"]["payload"] = True
+            except io.CheckpointCorrupt as exc:
+                out["checks"]["payload"] = False
+                out["checks"]["payload_error"] = str(exc)
+                out["ok"] = False
+    return out
+
+
+def format_text(out: dict) -> str:
+    lines = [f"{out['path']}  [{out['backend']}]"]
+    meta = out.get("meta") or {}
+    if meta:
+        lines.append(
+            f"  t={meta.get('t')}  scheme={meta.get('scheme')}  "
+            f"size={meta.get('size')}  dtype={meta.get('dtype')}  "
+            f"step_kind={meta.get('step_kind')}")
+        lines.append(
+            f"  topology={meta.get('topology')}  "
+            f"psi_slabs={meta.get('psi_slabs')}  (topology-portable: "
+            f"restore reshards onto any valid plan)")
+        if meta.get("state_keys") is not None:
+            lines.append(f"  carry family: {meta['state_keys']}")
+        sup = meta.get("supervisor")
+        if sup:
+            lines.append(
+                f"  supervisor state: topology={sup.get('topology')} "
+                f"rung={sup.get('topology_rung')} "
+                f"pins={sorted(sup.get('env_pins') or {})} "
+                f"retries={sup.get('retries')} "
+                f"rollbacks={sup.get('rollbacks')} "
+                f"degrades={sup.get('degrades')}")
+    if "arrays" in out:
+        size = out.get("payload_bytes")
+        lines.append(
+            f"  {out['arrays']} arrays"
+            + (f", {size / (1 << 20):.1f} MiB payload"
+               if size is not None else "")
+            + (", checksummed" if out.get("has_checksum") else ""))
+    if "commit" in out:
+        st = out["commit"]
+        if st["legacy"]:
+            lines.append("  commit: committed (legacy single-writer "
+                         "marker)")
+        elif st["committed"]:
+            lines.append(f"  commit: committed "
+                         f"({len(st['markers'])} host markers + COMMIT)")
+        else:
+            lines.append(f"  commit: NOT COMMITTED "
+                         f"(markers {st['markers']}, "
+                         f"missing {st['missing']})")
+    for name, ok in sorted(out["checks"].items()):
+        if name.endswith("_error"):
+            continue
+        err = out["checks"].get(f"{name}_error")
+        lines.append(f"  check {name}: {'OK' if ok else 'FAILED'}"
+                     + (f" — {err}" if err else ""))
+    lines.append("  VERDICT: " + ("OK" if out["ok"] else "CORRUPT/"
+                                  "UNCOMMITTED"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="inspect/verify a fdtd3d checkpoint snapshot")
+    ap.add_argument("path", help=".npz snapshot or orbax directory")
+    ap.add_argument("--verify", action="store_true",
+                    help="load the full payload and run every "
+                         "integrity check (exit 1 on any failure)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the inspection as one JSON object")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.path):
+        warn(f"{args.path}: no such snapshot")
+        return 1
+    out = inspect(args.path, verify=args.verify)
+    if args.json:
+        report(json.dumps(out, indent=1))
+    else:
+        report(format_text(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,9 +15,10 @@ bench stages append):
 * health: the first unhealthy step bound (non-finite flag), final
   energy, max div·E residual
 * VMEM-ladder downgrade events
-* recovery events (schema v3, the durable-run supervisor): bounded
-  retries, checkpoint rollbacks and kernel-ladder degrades — how the
-  run survived, not just whether it did
+* recovery events (schema v3/v5, the durable-run supervisor): bounded
+  retries, checkpoint rollbacks, kernel-ladder degrades and topology
+  changes — how the run survived, not just whether it did — with the
+  implicated chip/host named when the failure was attributable (v5)
 
 ``--json`` emits the same summary as one JSON object per run instead
 of text (for dashboards / the driver).
@@ -75,11 +76,14 @@ def summarize_run(run):
         "chunks": len(chunks),
         "complete": end is not None,
         "ladder_downgrades": ladder,
-        # durable-run supervisor events (schema v3)
+        # durable-run supervisor events (schema v3; v5 adds chip/host
+        # attribution and the topology-degrade record)
         "recoveries": {
             "retries": [r for r in run if r["type"] == "retry"],
             "rollbacks": [r for r in run if r["type"] == "rollback"],
             "degrades": [r for r in run if r["type"] == "degrade"],
+            "topology_changes": [r for r in run
+                                 if r["type"] == "topology_change"],
         },
     }
     # per-chip lane (schema v4): the worst per-chunk imbalance ratio
@@ -196,23 +200,39 @@ def format_text(summaries) -> str:
                     f"t={im['nonfinite_t']} — diverged chip(s), see "
                     f"the straggler runbook")
         rec = s.get("recoveries", {})
+
+        def _at(r):
+            # v5 chip/host attribution suffix (absent on v3/v4 records)
+            parts = []
+            if r.get("chip") is not None:
+                parts.append(f"chip {r['chip']}")
+            if r.get("host") is not None:
+                parts.append(f"host {r['host']}")
+            return f" [{', '.join(parts)}]" if parts else ""
+
         for r in rec.get("retries", []):
             lines.append(f"  RETRY at t={r['t']} (attempt "
-                         f"{r['attempt']}, backoff {r['delay_s']:.1f}s):"
-                         f" {r['error']}")
+                         f"{r['attempt']}, backoff {r['delay_s']:.1f}s)"
+                         f"{_at(r)}: {r['error']}")
         for r in rec.get("rollbacks", []):
             lines.append(f"  ROLLBACK t={r['t_failed']} -> "
-                         f"t={r['t_restored']} ({r['source']}): "
-                         f"{r['reason']}")
+                         f"t={r['t_restored']} ({r['source']})"
+                         f"{_at(r)}: {r['reason']}")
         for r in rec.get("degrades", []):
             lines.append(f"  DEGRADE at t={r['t']}: {r['old_kind']} -> "
-                         f"{r['new_kind']}: {r['reason']}")
+                         f"{r['new_kind']}{_at(r)}: {r['reason']}")
+        for r in rec.get("topology_changes", []):
+            lines.append(f"  TOPOLOGY CHANGE at t={r['t']}: "
+                         f"{tuple(r['old_topology'])} -> "
+                         f"{tuple(r['new_topology'])}{_at(r)}: "
+                         f"{r['reason']}")
         n_rec = sum(len(v) for v in rec.values())
         if n_rec:
             lines.append(f"  survived {n_rec} recovery events "
                          f"(retries {len(rec['retries'])}, rollbacks "
                          f"{len(rec['rollbacks'])}, degrades "
-                         f"{len(rec['degrades'])})")
+                         f"{len(rec['degrades'])}, topology changes "
+                         f"{len(rec.get('topology_changes', []))})")
     return "\n".join(lines)
 
 
