@@ -1,0 +1,334 @@
+"""Unified static-analysis framework: one analyzer, every invariant.
+
+Eight PRs in, the repo's correctness contracts were enforced by
+scattered one-off mechanisms: two hand-rolled AST lints (bare print,
+atomic writes), a kernel-specific donation test, a statistical >=95%
+scope-coverage assertion, and ~10 undocumented ``FDTD3D_*`` env knobs.
+This package makes those invariants *enumerable and zero-tolerance* —
+the single-source-per-invariant discipline the PIConGPU/WarpX
+multi-backend codebases use to keep kernels honest (PAPERS.md) — via
+two engines behind one CLI (``tools/fdtd_lint.py``):
+
+* **Engine 1 — AST** (:mod:`fdtd3d_tpu.analysis.ast_rules`): walks
+  every ``.py`` file in ``fdtd3d_tpu/`` + ``tools/`` (env-registry
+  additionally scans ``bench.py``/``__graft_entry__.py``/``tests/``)
+  and hosts pluggable rule classes: ``no-bare-print``,
+  ``atomic-write``, ``env-registry``, ``tracer-hostility``,
+  ``exception-hygiene``.
+* **Engine 2 — jaxpr/structural**
+  (:mod:`fdtd3d_tpu.analysis.graph_rules`,
+  :mod:`fdtd3d_tpu.analysis.schema_rules`): reuses the cost ledger's
+  production-runner tracing (``costs.trace_chunk``) to verify, per
+  step kind and topology on the CPU virtual mesh: ``donation-safety``
+  (aliased in/out block maps monotone for EVERY Pallas kernel),
+  ``scope-coverage`` (ZERO unscoped collectives — enumerated, not a
+  percentage), ``readback-discipline`` (<=1 device_get per chunk, no
+  full-field transfer) and ``schema-drift`` (every key each writer
+  emits exists in the matching validator's key table).
+
+Rules return :class:`Finding` lists; a checked-in suppression baseline
+(``tools/lint_baseline.json``) may waive specific findings with a
+per-entry reason (docs/STATIC_ANALYSIS.md documents the policy: the
+baseline ships EMPTY and every addition needs a justification).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import tokenize
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+REPORT_SCHEMA = "fdtd3d-lint-report"
+REPORT_VERSION = 1
+BASELINE_SCHEMA = "fdtd3d-lint-baseline"
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Engine-1 default scan surface (repo-relative directories).
+SCAN_DIRS = ("fdtd3d_tpu", "tools")
+
+# Quarantined LEGACY tools (round 10): frozen historical reproduction
+# scripts gated behind --i-know-this-is-legacy; not part of the
+# maintained surface any AST rule guards.
+LEGACY_FILES = frozenset(("measure_r3.py", "measure_r4.py"))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, pointing at a file/line when known."""
+
+    rule: str
+    file: str                 # repo-relative path ("" = repo-wide)
+    line: Optional[int]
+    message: str
+
+    def format(self) -> str:
+        loc = self.file or "<repo>"
+        if self.line is not None:
+            loc += f":{self.line}"
+        return f"{loc}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message}
+
+
+class SourceFile:
+    """One parsed source file, shared across AST rules (parse once)."""
+
+    def __init__(self, relpath: str, abspath: str):
+        self.relpath = relpath
+        self.abspath = abspath
+        with open(abspath, "rb") as f:
+            self.source_bytes = f.read()
+        self.source = self.source_bytes.decode("utf-8")
+        self.tree = ast.parse(self.source, filename=relpath)
+        self._code_lines: Optional[List[Tuple[int, str]]] = None
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.relpath)
+
+    def code_lines(self) -> List[Tuple[int, str]]:
+        """-> [(lineno, code)] with strings and comments stripped via
+        the tokenizer, so docstring prose never trips token rules."""
+        if self._code_lines is None:
+            import io as _io
+            from collections import defaultdict
+            lines: Dict[int, str] = defaultdict(str)
+            reader = _io.BytesIO(self.source_bytes).readline
+            for tok in tokenize.tokenize(reader):
+                if tok.type in (tokenize.STRING, tokenize.COMMENT):
+                    continue
+                lines[tok.start[0]] += tok.string
+            self._code_lines = sorted(lines.items())
+        return self._code_lines
+
+
+class Context:
+    """Shared state for one analysis run: the parsed file surface.
+
+    ``paths``: explicit list of (relpath, abspath) pairs; default is
+    every ``.py`` under SCAN_DIRS. ``extra`` surfaces (env-registry's
+    bench.py/tests/ read scan) are loaded lazily and cached too.
+    """
+
+    def __init__(self, root: str = ROOT,
+                 paths: Optional[Sequence[Tuple[str, str]]] = None,
+                 scan_all: bool = False):
+        self.root = root
+        self._files: Optional[List[SourceFile]] = None
+        self._cache: Dict[str, SourceFile] = {}
+        self._paths = list(paths) if paths is not None else None
+        # scan_all: walk every .py under root instead of SCAN_DIRS —
+        # the CLI's --path mode for linting an arbitrary tree
+        self._scan_all = scan_all
+
+    def _walk(self, reldir: str) -> List[Tuple[str, str]]:
+        out = []
+        base = os.path.join(self.root, reldir)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    ap = os.path.join(dirpath, fn)
+                    out.append((os.path.relpath(ap, self.root), ap))
+        return sorted(out)
+
+    def load(self, relpath: str, abspath: str) -> SourceFile:
+        sf = self._cache.get(relpath)
+        if sf is None:
+            sf = SourceFile(relpath, abspath)
+            self._cache[relpath] = sf
+        return sf
+
+    def files(self) -> List[SourceFile]:
+        """The default engine-1 surface (fdtd3d_tpu/ + tools/)."""
+        if self._files is None:
+            pairs = self._paths
+            if pairs is None:
+                if self._scan_all:
+                    pairs = self._walk(".")
+                else:
+                    pairs = []
+                    for d in SCAN_DIRS:
+                        if os.path.isdir(os.path.join(self.root, d)):
+                            pairs += self._walk(d)
+            self._files = [self.load(rp, ap) for rp, ap in pairs]
+        return self._files
+
+    def extra_files(self, *patterns: str) -> List[SourceFile]:
+        """Additional read-surface files: repo-relative file names or
+        directory names (walked recursively). Missing entries are
+        skipped (a fixture tree has no bench.py)."""
+        out: List[SourceFile] = []
+        for pat in patterns:
+            ap = os.path.join(self.root, pat)
+            if os.path.isfile(ap):
+                out.append(self.load(pat, ap))
+            elif os.path.isdir(ap):
+                out += [self.load(rp, p) for rp, p in self._walk(pat)]
+        return out
+
+
+def walk_shallow(node: ast.AST):
+    """Walk an AST subtree WITHOUT descending into nested function
+    defs / lambdas — those are separate analysis units (shared by the
+    tracer-hostility reachability walk, the exception-hygiene re-raise
+    scan and the schema-drift resolver, so the traversal cannot
+    drift between engines). Yields every other descendant; the root
+    itself is not yielded."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class Rule:
+    """Base class: one named invariant with a ``run(ctx)`` check.
+
+    ``name``: the CLI/--rule identifier. ``engine``: "ast" (pure
+    stdlib, runs anywhere) or "structural" (imports jax / traces the
+    production runner; chip-free but heavier). ``run`` returns
+    (findings, stats) — stats is a small JSON-able dict surfaced in
+    the --json report (e.g. scope-coverage's per-kind unscoped
+    collective counts).
+    """
+
+    name: str = ""
+    engine: str = "ast"
+    doc: str = ""
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        raise NotImplementedError
+
+
+def all_rules() -> List[Rule]:
+    """Every registered rule, AST engine first (cheap before heavy)."""
+    from fdtd3d_tpu.analysis import ast_rules, graph_rules, schema_rules
+    return [cls() for cls in (
+        ast_rules.NoBarePrintRule,
+        ast_rules.AtomicWriteRule,
+        ast_rules.EnvRegistryRule,
+        ast_rules.TracerHostilityRule,
+        ast_rules.ExceptionHygieneRule,
+        schema_rules.SchemaDriftRule,
+        graph_rules.DonationSafetyRule,
+        graph_rules.ScopeCoverageRule,
+        graph_rules.ReadbackDisciplineRule,
+    )]
+
+
+def rules_by_name() -> Dict[str, Rule]:
+    return {r.name: r for r in all_rules()}
+
+
+# ---------------------------------------------------------------------------
+# suppression baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[Dict[str, Any]]:
+    """Parse + validate the suppression baseline; [] when absent.
+
+    Shape: {"schema": "fdtd3d-lint-baseline", "version": 1,
+    "suppressions": [{"rule", "file", "contains", "reason"}, ...]} —
+    every entry MUST carry a non-empty reason (the per-entry comment
+    the acceptance bar requires; JSON has no comments, so the reason
+    field is the comment)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(f"{path}: schema {data.get('schema')!r} != "
+                         f"{BASELINE_SCHEMA!r}")
+    sups = data.get("suppressions")
+    if not isinstance(sups, list):
+        raise ValueError(f"{path}: suppressions missing or not a list")
+    for i, s in enumerate(sups):
+        for key in ("rule", "file", "contains", "reason"):
+            if not isinstance(s.get(key), str):
+                raise ValueError(
+                    f"{path}: suppression #{i} missing {key!r}")
+        if not s["reason"].strip():
+            raise ValueError(
+                f"{path}: suppression #{i} has an empty reason — every "
+                f"baseline entry must justify itself "
+                f"(docs/STATIC_ANALYSIS.md)")
+    return sups
+
+
+def apply_baseline(findings: List[Finding],
+                   suppressions: List[Dict[str, Any]]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """-> (live findings, suppressed findings)."""
+    live, suppressed = [], []
+    for f in findings:
+        hit = False
+        for s in suppressions:
+            if s["rule"] == f.rule and s["file"] == f.file \
+                    and s["contains"] in f.message:
+                hit = True
+                break
+        (suppressed if hit else live).append(f)
+    return live, suppressed
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+
+def run_rules(rule_names: Optional[Sequence[str]] = None,
+              ctx: Optional[Context] = None,
+              baseline_path: Optional[str] = None) -> Dict[str, Any]:
+    """Run the selected rules (default: all) -> JSON-able report.
+
+    Report: {"schema", "version", "rules": {name: {"engine", "doc",
+    "findings", "suppressed", "stats"}}, "findings": [...],
+    "suppressed": [...], "clean": bool}. A rule that crashes is itself
+    reported as a finding (rule="analysis-error") — a broken analyzer
+    must fail the gate, not silently pass it.
+    """
+    ctx = ctx or Context()
+    registry = rules_by_name()
+    names = list(rule_names) if rule_names else list(registry)
+    unknown = [n for n in names if n not in registry]
+    if unknown:
+        raise ValueError(f"unknown rule(s) {unknown}; available: "
+                         f"{sorted(registry)}")
+    suppressions = load_baseline(baseline_path) \
+        if baseline_path else []
+
+    report: Dict[str, Any] = {
+        "schema": REPORT_SCHEMA, "version": REPORT_VERSION,
+        "rules": {}, "findings": [], "suppressed": [],
+    }
+    for name in names:
+        rule = registry[name]
+        try:
+            findings, stats = rule.run(ctx)
+        except Exception as exc:  # a broken rule must fail the gate
+            findings = [Finding("analysis-error", "", None,
+                                f"rule {name!r} crashed: "
+                                f"{type(exc).__name__}: {exc}")]
+            stats = {}
+        live, suppressed = apply_baseline(findings, suppressions)
+        report["rules"][name] = {
+            "engine": rule.engine, "doc": rule.doc,
+            "findings": len(live), "suppressed": len(suppressed),
+            "stats": stats,
+        }
+        report["findings"] += [f.to_json() for f in live]
+        report["suppressed"] += [f.to_json() for f in suppressed]
+    report["clean"] = not report["findings"]
+    return report

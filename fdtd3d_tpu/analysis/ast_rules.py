@@ -1,0 +1,473 @@
+"""Engine 1 — AST rules over the fdtd3d_tpu/ + tools/ source surface.
+
+Each rule is a small, self-contained :class:`~fdtd3d_tpu.analysis.Rule`
+subclass; ``tests/fixtures/lint/`` keeps one known-bad snippet per rule
+(tests/test_analysis.py proves every rule fires on its fixture, so no
+rule can go vacuously green). The two oldest rules — ``no-bare-print``
+and ``atomic-write`` — are the round-3/round-9 hand-rolled lints ported
+onto the framework; ``tests/test_lint_no_print.py`` and
+``tests/test_lint_atomic_write.py`` are now thin wrappers over them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from fdtd3d_tpu.analysis import (LEGACY_FILES, Context, Finding, Rule,
+                                 SourceFile, walk_shallow)
+
+
+def _dotted(func: ast.AST) -> Optional[str]:
+    """'os.environ.get' for an Attribute chain rooted at a Name."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# no-bare-print (ported from tests/test_lint_no_print.py, rounds 3+7)
+# ---------------------------------------------------------------------------
+
+_PRINT_CALL = re.compile(r"(?<![\w.])print\(")
+
+# log.py IS the print wrapper — the single allowed call site.
+_PRINT_ALLOWED = frozenset(("log.py",))
+
+
+class NoBarePrintRule(Rule):
+    name = "no-bare-print"
+    engine = "ast"
+    doc = ("no bare print() outside fdtd3d_tpu/log.py — route through "
+           "log.log()/log.warn()/log.report() (one-switch logging)")
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        findings: List[Finding] = []
+        for sf in ctx.files():
+            if sf.basename in _PRINT_ALLOWED \
+                    or sf.basename in LEGACY_FILES:
+                continue
+            for lineno, tok in sf.code_lines():
+                if _PRINT_CALL.search(tok):
+                    findings.append(Finding(
+                        self.name, sf.relpath, lineno,
+                        f"bare print() — use log.log()/log.warn()/"
+                        f"log.report(): {tok.strip()[:80]}"))
+        return findings, {"files_scanned": len(ctx.files())}
+
+
+# ---------------------------------------------------------------------------
+# atomic-write (ported from tests/test_lint_atomic_write.py, round 9)
+# ---------------------------------------------------------------------------
+
+# io.py hosts the primitives; inside it, w-mode opens may appear only
+# within these function names ("_write" = the atomic_publish writer-
+# closure convention).
+_IO_ALLOWED_FUNCS = frozenset(("atomic_open", "_write"))
+_BANNED_WRITE_ATTRS = frozenset(("tofile", "savez", "savez_compressed"))
+
+
+def _is_write_mode(mode: str) -> bool:
+    return "w" in mode or "x" in mode
+
+
+class _AtomicWriteVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        import os
+        self.is_io = os.path.basename(relpath) == "io.py"
+        self.func_stack: List[str] = []
+        self.offenders: List[Tuple[int, str]] = []
+
+    def _flag(self, node: ast.AST, what: str):
+        self.offenders.append((node.lineno, what))
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _allowed_here(self) -> bool:
+        if not self.is_io:
+            return False
+        return bool(set(self.func_stack) & _IO_ALLOWED_FUNCS)
+
+    def visit_Call(self, node):
+        func = node.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+            if name in _BANNED_WRITE_ATTRS and not self.is_io:
+                self._flag(node, f".{name}() writes files directly — "
+                                 f"route through fdtd3d_tpu.io's "
+                                 f"atomic writer")
+            if name == "open" and not (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id in ("io", "builtins")):
+                name = None  # os.open / gzip.open etc: not builtin open
+        if name == "open":
+            mode = "r"
+            if len(node.args) >= 2 and isinstance(node.args[1],
+                                                  ast.Constant):
+                mode = str(node.args[1].value)
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value,
+                                                   ast.Constant):
+                    mode = str(kw.value.value)
+            literal = (len(node.args) < 2
+                       or isinstance(node.args[1], ast.Constant))
+            if (_is_write_mode(mode) or not literal) \
+                    and not self._allowed_here():
+                self._flag(node, f"open(..., {mode!r}) outside the "
+                                 f"atomic writer — use io.atomic_open/"
+                                 f"io.atomic_publish (append-mode JSONL "
+                                 f"sinks are the one exception)")
+        self.generic_visit(node)
+
+
+class AtomicWriteRule(Rule):
+    name = "atomic-write"
+    engine = "ast"
+    doc = ("every file write in fdtd3d_tpu/ routes through io's atomic "
+           "writer (docs/ROBUSTNESS.md durability contract)")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        v = _AtomicWriteVisitor(sf.relpath)
+        v.visit(sf.tree)
+        return [Finding(self.name, sf.relpath, line, what)
+                for line, what in v.offenders]
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        findings: List[Finding] = []
+        n = 0
+        for sf in ctx.files():
+            # the durability contract covers the package, not tools/
+            # (tools write reports the atomic guarantee adds nothing
+            # to; checkpoints and solver artifacts all live in-package)
+            if not sf.relpath.replace("\\", "/").startswith(
+                    "fdtd3d_tpu"):
+                continue
+            n += 1
+            findings += self.check_file(sf)
+        return findings, {"files_scanned": n}
+
+
+# ---------------------------------------------------------------------------
+# env-registry
+# ---------------------------------------------------------------------------
+
+_ENV_NAME = re.compile(r"^FDTD3D_[A-Z0-9_]+$")
+
+# The read surface beyond the default fdtd3d_tpu/ + tools/ scan:
+# bench.py and the graft entry read bench knobs, tests/ reads
+# FDTD3D_TEST_TPU (conftest CPU pin) — a registry entry read only
+# there must still count as read.
+_ENV_EXTRA_SURFACE = ("bench.py", "__graft_entry__.py", "tests")
+
+
+def _env_reads(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, name) for every literal FDTD3D_* environment READ:
+    os.environ.get/os.getenv/environ[...] loads. Writes (environ[k]=v,
+    .pop cleanup) are not reads."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func) or ""
+            if d.endswith("environ.get") or d in ("os.getenv", "getenv"):
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and _ENV_NAME.match(node.args[0].value):
+                    out.append((node.lineno, node.args[0].value))
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            d = _dotted(node.value) or ""
+            if d.endswith("environ"):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) \
+                        and isinstance(sl.value, str) \
+                        and _ENV_NAME.match(sl.value):
+                    out.append((node.lineno, sl.value))
+    return out
+
+
+def _env_mentions(tree: ast.AST) -> Set[str]:
+    """Every FDTD3D_* string constant in the file (the lenient side of
+    the registered-but-unread check: setenv/monkeypatch/docs-in-code
+    references all count as 'this knob is alive')."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if _ENV_NAME.match(node.value):
+                out.add(node.value)
+    return out
+
+
+class EnvRegistryRule(Rule):
+    name = "env-registry"
+    engine = "ast"
+    doc = ("every literal FDTD3D_* env read appears in config.ENV_KNOBS "
+           "with type/default/doc; registered-but-unread entries fail "
+           "too")
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        from fdtd3d_tpu.config import ENV_KNOBS
+        findings: List[Finding] = []
+        surface = list(ctx.files()) \
+            + ctx.extra_files(*_ENV_EXTRA_SURFACE)
+        mentions: Set[str] = set()
+        n_reads = 0
+        for sf in surface:
+            parts = sf.relpath.replace("\\", "/").split("/")
+            # fixtures are deliberate known-bad snippets, not code
+            if sf.basename in LEGACY_FILES or "fixtures" in parts:
+                continue
+            mentions |= _env_mentions(sf.tree)
+            for lineno, envname in _env_reads(sf.tree):
+                n_reads += 1
+                if envname not in ENV_KNOBS:
+                    findings.append(Finding(
+                        self.name, sf.relpath, lineno,
+                        f"unregistered env knob {envname!r} — declare "
+                        f"it in fdtd3d_tpu.config.ENV_KNOBS with "
+                        f"type/default/doc"))
+        from fdtd3d_tpu.analysis import ROOT as _REPO_ROOT
+        for envname, knob in sorted(ENV_KNOBS.items()):
+            # registered-but-unread is a property of THIS repo's
+            # surface; on a foreign tree (--path) only reads are
+            # checkable
+            if ctx.root != _REPO_ROOT:
+                break
+            if envname not in mentions:
+                findings.append(Finding(
+                    self.name, "fdtd3d_tpu/config.py", None,
+                    f"registered env knob {envname!r} is never read "
+                    f"anywhere — dead registry entry (delete it or "
+                    f"wire the knob)"))
+            if not knob.doc.strip():
+                findings.append(Finding(
+                    self.name, "fdtd3d_tpu/config.py", None,
+                    f"registered env knob {envname!r} has an empty "
+                    f"doc"))
+        return findings, {"registered": len(ENV_KNOBS),
+                          "literal_reads": n_reads}
+
+
+# ---------------------------------------------------------------------------
+# tracer-hostility
+# ---------------------------------------------------------------------------
+
+# The marker the rule understands: a module-level
+#   GRAPH_SAFE_FNS = ("fn_a", "fn_b", ...)
+# declares that every function of that name in the module (at any
+# nesting depth — the step/health closures are nested builders) is
+# GRAPH CODE: it runs under jit/scan/shard_map tracing, where a host
+# call either crashes (``.item()`` on a tracer) or silently pins a
+# trace-time constant (``time.time()``). The rule checks the marked
+# functions AND every same-module function they call by simple name,
+# transitively.
+GRAPH_SAFE_MARKER = "GRAPH_SAFE_FNS"
+
+_HOSTILE_NAME_CALLS = frozenset(("float", "open", "input", "breakpoint"))
+_HOSTILE_ATTR_CALLS = frozenset(("item", "tolist", "block_until_ready"))
+_HOSTILE_DOTTED = (
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "jax.device_put",
+)
+_HOSTILE_ROOTS = ("time.", "os.")
+
+
+def _marker_names(tree: ast.AST) -> Optional[Set[str]]:
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == GRAPH_SAFE_MARKER:
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+    return None
+
+
+def _all_funcdefs(tree: ast.AST) -> List[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+class TracerHostilityRule(Rule):
+    name = "tracer-hostility"
+    engine = "ast"
+    doc = ("no host calls (float()/.item()/np.asarray/time.time()/"
+           "open/os.*) inside functions a module marks GRAPH_SAFE_FNS, "
+           "nor in same-module functions they call")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        marked = _marker_names(sf.tree)
+        if marked is None:
+            return []
+        findings: List[Finding] = []
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn in _all_funcdefs(sf.tree):
+            by_name.setdefault(fn.name, []).append(fn)
+        missing = sorted(marked - set(by_name))
+        for name in missing:
+            findings.append(Finding(
+                self.name, sf.relpath, None,
+                f"{GRAPH_SAFE_MARKER} names {name!r} but no such "
+                f"function exists in the module (marker rot)"))
+        # reachability: marked defs + same-module Name-calls, transitive
+        visited: List[ast.AST] = []
+        seen: Set[int] = set()
+        frontier = [fn for name in sorted(marked & set(by_name))
+                    for fn in by_name[name]]
+        while frontier:
+            fn = frontier.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            visited.append(fn)
+            for node in walk_shallow(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id in by_name:
+                    frontier.extend(by_name[node.func.id])
+        for fn in visited:
+            for node in walk_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = None
+                func = node.func
+                if isinstance(func, ast.Name) \
+                        and func.id in _HOSTILE_NAME_CALLS:
+                    hit = f"{func.id}()"
+                elif isinstance(func, ast.Attribute):
+                    d = _dotted(func)
+                    if d is not None and (
+                            d in _HOSTILE_DOTTED
+                            or any(d.startswith(r)
+                                   for r in _HOSTILE_ROOTS)):
+                        hit = f"{d}()"
+                    elif func.attr in _HOSTILE_ATTR_CALLS:
+                        hit = f".{func.attr}()"
+                if hit:
+                    findings.append(Finding(
+                        self.name, sf.relpath, node.lineno,
+                        f"host call {hit} inside graph-safe function "
+                        f"{fn.name!r} (reachable from "
+                        f"{GRAPH_SAFE_MARKER}) — it would pin a "
+                        f"trace-time constant or crash on a tracer"))
+        return findings
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        findings: List[Finding] = []
+        n_marked = 0
+        for sf in ctx.files():
+            if _marker_names(sf.tree) is not None:
+                n_marked += 1
+            findings += self.check_file(sf)
+        return findings, {"modules_with_markers": n_marked}
+
+
+# ---------------------------------------------------------------------------
+# exception-hygiene
+# ---------------------------------------------------------------------------
+
+# Recovery-path files: code here sits between faults and their
+# handlers, so a too-broad catch can swallow the SimulatedPreemption-
+# family BaseExceptions the fault harness uses to model kills
+# (fdtd3d_tpu/faults.py docstring).
+_RECOVERY_FILES = frozenset(("fdtd3d_tpu/supervisor.py",
+                             "fdtd3d_tpu/faults.py"))
+_PREEMPT_NAMES = frozenset(("SimulatedPreemption", "SimulatedHostLoss"))
+
+
+def _handler_type_names(h: ast.ExceptHandler) -> List[str]:
+    t = h.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def _reraises(h: ast.ExceptHandler) -> bool:
+    """Does the handler body contain a raise? Nested function/lambda
+    subtrees are EXCLUDED (a raise inside a callback the handler merely
+    defines is not a re-raise) without aborting the rest of the scan —
+    walk_shallow skips exactly those subtrees."""
+    for stmt in h.body:
+        if isinstance(stmt, ast.Raise):
+            return True
+        for node in walk_shallow(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+    return False
+
+
+class ExceptionHygieneRule(Rule):
+    name = "exception-hygiene"
+    engine = "ast"
+    doc = ("no bare except anywhere; except BaseException must "
+           "re-raise; supervisor.py/faults.py recovery paths may not "
+           "catch Exception/SimulatedPreemption broadly")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        rel = sf.relpath.replace("\\", "/")
+        recovery = rel in _RECOVERY_FILES
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            names = _handler_type_names(node)
+            if node.type is None:
+                findings.append(Finding(
+                    self.name, sf.relpath, node.lineno,
+                    "bare 'except:' swallows BaseExceptions "
+                    "(SimulatedPreemption kills, KeyboardInterrupt) — "
+                    "name the exception types"))
+                continue
+            if "BaseException" in names and not _reraises(node):
+                findings.append(Finding(
+                    self.name, sf.relpath, node.lineno,
+                    "'except BaseException' without a re-raise would "
+                    "swallow kills — re-raise, or name narrower types"))
+            if recovery:
+                if "Exception" in names:
+                    findings.append(Finding(
+                        self.name, sf.relpath, node.lineno,
+                        "'except Exception' in a recovery path — name "
+                        "the concrete transient types "
+                        "(supervisor.TRANSIENT_ERRORS) so a future "
+                        "broadening to BaseException can never swallow "
+                        "a SimulatedPreemption"))
+                hit = sorted(set(names) & _PREEMPT_NAMES)
+                if hit and not _reraises(node):
+                    findings.append(Finding(
+                        self.name, sf.relpath, node.lineno,
+                        f"handler catches {hit[0]} (a simulated kill) "
+                        f"without re-raising — a kill is a kill "
+                        f"(docs/ROBUSTNESS.md fault model)"))
+        return findings
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        findings: List[Finding] = []
+        for sf in ctx.files():
+            if sf.basename in LEGACY_FILES:
+                continue
+            findings += self.check_file(sf)
+        return findings, {"files_scanned": len(ctx.files())}

@@ -1,0 +1,434 @@
+"""Engine 2 — jaxpr/structural rules on the PRODUCTION chunk runner.
+
+These rules need jax (imported lazily inside ``run``) but no chip:
+everything runs on the CPU backend, with the sharded checks tracing
+inside ``shard_map`` over the 8-device virtual host mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``, the same
+environment tier-1 and ``tools/fdtd_lint.py`` set up).
+
+* ``donation-safety`` generalizes the per-kernel structural tests
+  (``test_h_inputs_never_donated``, ``test_tb_donation_fetch_before_
+  write``) into ONE parameterized rule over EVERY Pallas kernel: each
+  aliased (donated) operand's in-map must be monotone and fetch every
+  HBM block no later than the aliased out-map's first visit of it —
+  otherwise a backward read can observe a block its own output already
+  flushed (a hazard interpreter mode can never surface at runtime).
+* ``scope-coverage`` promotes the comm lane's >=95% statistical
+  attribution to an ENUMERATED zero: every collective
+  (ppermute/psum/pmax/pmin/all_gather/...) in every sharded step
+  kind's traced jaxpr must carry a named scope from
+  ``telemetry.GRAPH_SPANS`` (the docs/OBSERVABILITY.md table). The
+  report counts unscoped collectives per kind; the bar is 0.
+* ``readback-discipline`` drives a real (tiny, CPU) Simulation chunk
+  and asserts the flight recorder's contract: <=1 ``jax.device_get``
+  per chunk and never a full-field transfer (every leaf scalar-sized).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from fdtd3d_tpu.analysis import Context, Finding, Rule
+
+# -------------------------------------------------------------------------
+# donation-safety
+# -------------------------------------------------------------------------
+
+# Every Pallas kernel builder in the repo, with a canonical CPU-
+# buildable config that engages it (CPML + mid-grid point source where
+# the kernel supports them, so the full operand set — psi stacks,
+# source masks, walls — is present in the capture). Adding a kernel
+# module without registering it here fails the rule (see run()).
+_KERNEL_TARGETS: Tuple[Tuple[str, str, str], ...] = (
+    ("pallas",           "fdtd3d_tpu.ops.pallas3d",        "make_pallas_step"),
+    ("pallas_fused",     "fdtd3d_tpu.ops.pallas_fused",    "make_fused_eh_step"),
+    ("pallas_packed",    "fdtd3d_tpu.ops.pallas_packed",   "make_packed_eh_step"),
+    ("pallas_packed_tb", "fdtd3d_tpu.ops.pallas_packed_tb", "make_packed_tb_step"),
+    ("pallas_packed_ds", "fdtd3d_tpu.ops.pallas_packed_ds", "make_packed_ds_step"),
+)
+
+
+def _target_config(label: str):
+    from fdtd3d_tpu import costs
+    from fdtd3d_tpu.config import (PmlConfig, PointSourceConfig,
+                                   SimConfig)
+    if label == "pallas_packed_tb":
+        # the temporal-blocked kernel needs x-extent >= a few tiles and
+        # an interior source with >=1-tile margin (its eligibility gate)
+        return SimConfig(
+            scheme="3D", size=(48, 16, 16), time_steps=8, dx=1e-3,
+            courant_factor=0.4, wavelength=8e-3, use_pallas=True,
+            pml=PmlConfig(size=(3, 3, 3)),
+            point_source=PointSourceConfig(enabled=True, component="Ez",
+                                           position=(24, 8, 8)))
+    kind = label if label in costs.STEP_KINDS else "pallas"
+    cfg = costs.config_for_kind(kind)
+    import dataclasses
+    return dataclasses.replace(cfg, use_pallas=True)
+
+
+def _index_tuple(index_map, idx: Tuple[int, ...]) -> Tuple[int, ...]:
+    res = index_map(*idx)
+    if not isinstance(res, tuple):
+        res = (res,)
+    return tuple(int(v) for v in res)
+
+
+def check_pallas_capture(label: str, kw: Dict[str, Any]) -> List[str]:
+    """Donation-safety check of one captured ``pl.pallas_call``'s
+    keyword arguments -> list of problem strings (empty = safe).
+
+    For every aliased (donated) operand pair: the in-map's block
+    sequence over the grid iteration order must be monotone
+    (1-D grids; per-block contiguous for multi-D), and each block must
+    be fetched no later than the aliased out-map's FIRST visit of it —
+    the generalized form of the tb kernel's fetch-before-write test.
+    Non-aliased operands are unconstrained (never flushed under the
+    call). An aliased pair whose block shapes differ is unverifiable
+    and reported as such.
+    """
+    problems: List[str] = []
+    aliases = dict(kw.get("input_output_aliases") or {})
+    if not aliases:
+        return problems
+    grid = kw.get("grid") or ()
+    if isinstance(grid, int):
+        grid = (grid,)
+    grid = tuple(int(g) for g in grid)
+    in_specs = list(kw.get("in_specs") or ())
+    out_specs = list(kw.get("out_specs") or ())
+    if not grid or not in_specs or not out_specs:
+        # an aliased call we cannot introspect must FAIL the gate, not
+        # silently pass it (e.g. a kernel migrated to pl.GridSpec /
+        # grid_spec= — teach this checker the new shape, don't skip)
+        problems.append(
+            f"{label}: pallas_call donates operands "
+            f"({sorted(aliases)}) but its grid/in_specs/out_specs "
+            f"kwargs are not retrievable — donation-safety "
+            f"unverifiable; update check_pallas_capture for this "
+            f"call form")
+        return problems
+    iters = list(itertools.product(*(range(g) for g in grid)))
+    for j_in, k_out in sorted(aliases.items()):
+        try:
+            in_spec = in_specs[j_in]
+            out_spec = out_specs[k_out]
+        except IndexError:
+            problems.append(f"{label}: alias {j_in}->{k_out} out of "
+                            f"range ({len(in_specs)} inputs, "
+                            f"{len(out_specs)} outputs)")
+            continue
+        if getattr(in_spec, "block_shape", None) != \
+                getattr(out_spec, "block_shape", None):
+            problems.append(
+                f"{label}: aliased operand {j_in} and output {k_out} "
+                f"have different block shapes — donation unverifiable")
+            continue
+        fetches = [_index_tuple(in_spec.index_map, idx)
+                   for idx in iters]
+        visits = [_index_tuple(out_spec.index_map, idx)
+                  for idx in iters]
+        if len(grid) == 1 and fetches != sorted(fetches):
+            problems.append(
+                f"{label}: donated operand {j_in} has a NON-MONOTONE "
+                f"in-map {fetches} — a later iteration re-fetches an "
+                f"earlier HBM block the aliased output may already "
+                f"have flushed")
+        else:
+            # multi-dim grids: each block's fetches must at least be
+            # one contiguous run (no leave-and-return re-fetch)
+            runs: Dict[Tuple[int, ...], List[int]] = {}
+            for i, b in enumerate(fetches):
+                runs.setdefault(b, []).append(i)
+            for b, ii in runs.items():
+                if ii[-1] - ii[0] + 1 != len(ii):
+                    problems.append(
+                        f"{label}: donated operand {j_in} re-fetches "
+                        f"block {b} non-contiguously at iterations "
+                        f"{ii}")
+        first_fetch: Dict[Tuple[int, ...], int] = {}
+        for i, b in enumerate(fetches):
+            first_fetch.setdefault(b, i)
+        first_visit: Dict[Tuple[int, ...], int] = {}
+        for i, b in enumerate(visits):
+            first_visit.setdefault(b, i)
+        for b, fi in sorted(first_fetch.items()):
+            vi = first_visit.get(b)
+            if vi is not None and fi > vi:
+                problems.append(
+                    f"{label}: donated operand {j_in} fetches block "
+                    f"{b} at iteration {fi}, AFTER the aliased "
+                    f"output {k_out} first visits it at iteration "
+                    f"{vi} — the read can observe flushed output "
+                    f"(donation hazard)")
+    return problems
+
+
+def capture_kernel_calls(module, builder_name: str, static
+                         ) -> List[Dict[str, Any]]:
+    """Build the kernel with ``pl.pallas_call`` spied, returning every
+    captured call's kwargs (a builder may issue several calls — the
+    two-pass kernels build one per family)."""
+    captured: List[Dict[str, Any]] = []
+    pl = module.pl
+    real_call = pl.pallas_call
+
+    def spy(kernel, **kw):
+        captured.append(dict(kw))
+        return real_call(kernel, **kw)
+
+    pl.pallas_call = spy
+    try:
+        step = getattr(module, builder_name)(static)
+    finally:
+        pl.pallas_call = real_call
+    if step is None:
+        raise RuntimeError(
+            f"{builder_name} returned None for its canonical config — "
+            f"the kernel is ineligible and its donation structure "
+            f"cannot be verified (update _target_config)")
+    if not captured:
+        raise RuntimeError(
+            f"{builder_name} built no pallas_call — nothing captured")
+    return captured
+
+
+class DonationSafetyRule(Rule):
+    name = "donation-safety"
+    engine = "structural"
+    doc = ("every Pallas kernel's aliased (donated) operands have "
+           "monotone in-maps and fetch each block before the aliased "
+           "output's first visit — one parameterized rule, all kernels")
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        import importlib
+        import os
+
+        from fdtd3d_tpu.solver import build_static
+        findings: List[Finding] = []
+        stats: Dict[str, Any] = {}
+        registered = {mod for _l, mod, _b in _KERNEL_TARGETS}
+        ops_dir = os.path.join(ctx.root, "fdtd3d_tpu", "ops")
+        if os.path.isdir(ops_dir):
+            for fn in sorted(os.listdir(ops_dir)):
+                if fn.startswith("pallas") and fn.endswith(".py"):
+                    mod = f"fdtd3d_tpu.ops.{fn[:-3]}"
+                    if mod not in registered:
+                        findings.append(Finding(
+                            self.name, f"fdtd3d_tpu/ops/{fn}", None,
+                            f"Pallas kernel module {mod} is not "
+                            f"registered in the donation-safety "
+                            f"targets — add it to _KERNEL_TARGETS "
+                            f"with a canonical config"))
+        for label, modname, builder in _KERNEL_TARGETS:
+            module = importlib.import_module(modname)
+            cfg = _target_config(label)
+            static = build_static(cfg)
+            try:
+                calls = capture_kernel_calls(module, builder, static)
+            except RuntimeError as exc:
+                findings.append(Finding(
+                    self.name, modname.replace(".", "/") + ".py", None,
+                    str(exc)))
+                continue
+            n_aliased = 0
+            for kw in calls:
+                n_aliased += len(kw.get("input_output_aliases") or {})
+                for problem in check_pallas_capture(label, kw):
+                    findings.append(Finding(
+                        self.name, modname.replace(".", "/") + ".py",
+                        None, problem))
+            stats[label] = {"pallas_calls": len(calls),
+                            "aliased_operands": n_aliased}
+        return findings, stats
+
+
+# -------------------------------------------------------------------------
+# scope-coverage: ZERO unscoped collectives
+# -------------------------------------------------------------------------
+
+_COLLECTIVES = frozenset(("ppermute", "psum", "pmax", "pmin",
+                          "all_gather", "all_to_all", "reduce_scatter"))
+
+_SCOPE_TOPOLOGY = (2, 2, 2)
+
+
+def collect_collectives(jaxpr, prefix: str = ""
+                        ) -> List[Tuple[str, str, str]]:
+    """-> [(primitive, section, name_stack)] for every collective eqn,
+    walking ALL control-flow branches (coverage must not skip the
+    branch a cond rarely takes)."""
+    from fdtd3d_tpu.costs import _INNER_JAXPR_PARAMS, _section_of
+    out: List[Tuple[str, str, str]] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        stack = f"{prefix}/{eqn.source_info.name_stack}"
+        if name in _COLLECTIVES:
+            out.append((name, _section_of(stack), stack))
+        if name == "cond":
+            for br in eqn.params.get("branches", ()):
+                out += collect_collectives(br.jaxpr, stack)
+            continue
+        if name == "while":
+            for p in ("cond_jaxpr", "body_jaxpr"):
+                if p in eqn.params:
+                    out += collect_collectives(eqn.params[p].jaxpr,
+                                               stack)
+            continue
+        if name == "pallas_call":
+            out += collect_collectives(
+                getattr(eqn.params["jaxpr"], "jaxpr",
+                        eqn.params["jaxpr"]), stack)
+            continue
+        for p in _INNER_JAXPR_PARAMS:
+            if p in eqn.params:
+                inner = eqn.params[p]
+                out += collect_collectives(getattr(inner, "jaxpr",
+                                                   inner), stack)
+                break
+    return out
+
+
+def unscoped_collectives(colls):
+    """The scope bar, per collective kind: ppermute IS the halo
+    exchange — the docs/OBSERVABILITY.md table assigns EVERY
+    neighbor-plane ppermute to the ``halo-exchange`` scope, and the
+    comm lane's attribution rides exactly that — so a ppermute merely
+    inheriting an outer E-update/H-update scope is a MIS-ATTRIBUTED
+    exchange, not a scoped one. Other collectives (health psums/pmax,
+    the per-chip all_gather) need any GRAPH_SPANS scope."""
+    return [(prim, sec, stack) for prim, sec, stack in colls
+            if (sec != "halo-exchange" if prim == "ppermute"
+                else sec == "unattributed")]
+
+
+class ScopeCoverageRule(Rule):
+    name = "scope-coverage"
+    engine = "structural"
+    doc = ("every collective (ppermute/psum/all_gather/...) in every "
+           "sharded step kind's traced chunk names a GRAPH_SPANS scope "
+           "— zero unscoped collectives, enumerated (not a percentage)")
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        import jax
+
+        from fdtd3d_tpu import costs
+        n_need = 1
+        for p in _SCOPE_TOPOLOGY:
+            n_need *= p
+        if jax.device_count() < n_need:
+            raise RuntimeError(
+                f"scope-coverage needs {n_need} devices for the "
+                f"{_SCOPE_TOPOLOGY} trace; set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n_need} "
+                f"before jax initializes (tools/fdtd_lint.py does)")
+        findings: List[Finding] = []
+        stats: Dict[str, Any] = {}
+        for kind in costs.SHARDED_STEP_KINDS:
+            # pml=2 keeps the CPML slabs inside the 8-cell shards of a
+            # 16^3 grid on (2,2,2) (solver.slab_axes needs
+            # local_n > 2*(pml+1)) — the tests/test_comm_costs.py probe
+            cfg = costs.config_for_kind(kind, n=16, pml=2)
+            _runner, closed, _static, _topo, _spc = costs.trace_chunk(
+                cfg, n_steps=8, kind=kind, topology=_SCOPE_TOPOLOGY)
+            colls = collect_collectives(closed.jaxpr)
+            unscoped = unscoped_collectives(colls)
+            stats[kind] = {"collectives": len(colls),
+                           "unscoped_collectives": len(unscoped)}
+            for prim, sec, stack in unscoped:
+                want = ("the halo-exchange scope"
+                        if prim == "ppermute"
+                        else "a telemetry.GRAPH_SPANS scope")
+                findings.append(Finding(
+                    self.name, "", None,
+                    f"step kind {kind!r} on {_SCOPE_TOPOLOGY}: "
+                    f"{prim} does not carry {want} (attributed: "
+                    f"{sec}; stack: "
+                    f"{stack.strip('/')[:110] or '<empty>'}) — wrap "
+                    f"it in telemetry.named(...) per the "
+                    f"docs/OBSERVABILITY.md scope table"))
+        return findings, stats
+
+
+# -------------------------------------------------------------------------
+# readback-discipline
+# -------------------------------------------------------------------------
+
+# Any device->host transfer bigger than this is a field, not a health
+# scalar (the per-chip lane's all_gathered vectors stay <= n_chips).
+_SCALAR_ELEMS = 64
+
+
+def check_transfer_log(calls: Sequence[Sequence[int]],
+                       n_chunks: int) -> List[str]:
+    """Validate a per-advance log of device_get leaf sizes against the
+    flight-recorder budget: <=1 device_get per chunk, every leaf
+    scalar-class (never a field array)."""
+    problems: List[str] = []
+    if len(calls) > n_chunks:
+        problems.append(
+            f"{len(calls)} device_get calls across {n_chunks} "
+            f"chunk(s) — the budget is <=1 scalar-tuple readback per "
+            f"chunk (telemetry.readback)")
+    for i, sizes in enumerate(calls):
+        big = [s for s in sizes if s > _SCALAR_ELEMS]
+        if big:
+            problems.append(
+                f"device_get #{i} transfers leaves of {big} elements "
+                f"— a full-field host transfer; health counters must "
+                f"reduce in-graph")
+    return problems
+
+
+class ReadbackDisciplineRule(Rule):
+    name = "readback-discipline"
+    engine = "structural"
+    doc = ("a telemetering advance() performs <=1 device_get per chunk "
+           "and never transfers a field array (in-graph health "
+           "reduction contract)")
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        import tempfile
+
+        import jax
+        import numpy as np
+
+        from fdtd3d_tpu.config import (OutputConfig, PmlConfig,
+                                       PointSourceConfig, SimConfig)
+        from fdtd3d_tpu.sim import Simulation
+        findings: List[Finding] = []
+        with tempfile.TemporaryDirectory() as td:
+            cfg = SimConfig(
+                scheme="3D", size=(16, 16, 16), time_steps=6, dx=1e-3,
+                courant_factor=0.4, wavelength=8e-3,
+                pml=PmlConfig(size=(2, 2, 2)),
+                point_source=PointSourceConfig(
+                    enabled=True, component="Ez", position=(8, 8, 8)),
+                output=OutputConfig(
+                    telemetry_path=f"{td}/telemetry.jsonl"))
+            sim = Simulation(cfg)
+            try:
+                sim.advance(3)  # compile outside the counting window
+                calls: List[List[int]] = []
+                real_get = jax.device_get
+
+                def counting_get(tree):
+                    calls.append([int(np.size(x))
+                                  for x in jax.tree.leaves(tree)])
+                    return real_get(tree)
+
+                jax.device_get = counting_get
+                try:
+                    sim.advance(3)
+                finally:
+                    jax.device_get = real_get
+            finally:
+                sim.close()
+            for problem in check_transfer_log(calls, n_chunks=1):
+                findings.append(Finding(self.name, "fdtd3d_tpu/sim.py",
+                                        None, problem))
+            stats = {"device_gets_per_chunk": len(calls),
+                     "max_leaf_elems": max(
+                         (s for c in calls for s in c), default=0)}
+        return findings, stats

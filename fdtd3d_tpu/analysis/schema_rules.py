@@ -1,0 +1,362 @@
+"""schema-drift: every key a writer emits exists in its validator.
+
+The telemetry JSONL validators deliberately ALLOW extra keys at read
+time (old readers must not choke on new files), which means a writer
+can silently start emitting keys no validator version knows about —
+the reader side then has no contract for them and the two drift apart.
+This rule closes the loop statically, with no runtime scenario needed
+(triggering a ``retry`` record takes a fault-injection run; reading
+the emit call takes an AST walk):
+
+* every ``*.emit("<type>", key=...)`` / supervisor ``_emit`` call and
+  every dict-literal record (``{"v": ..., "type": "<type>", ...}``,
+  the tools/trace_attribution.py pattern) may only use keys from
+  ``telemetry.RECORD_SCHEMA[type]`` ∪ ``telemetry.RECORD_OPTIONAL
+  [type]``; ``**expansions`` are resolved through the producing
+  function's returned-dict keys (``provenance``,
+  ``imbalance_summary``, call-site keywords for parameters) and an
+  UNRESOLVABLE expansion is itself a finding — explicit beats silent;
+* the cost-ledger writers (``costs.chunk_ledger`` / ``costs._comm_
+  lane``) must emit exactly ``costs.LEDGER_KEYS`` / ``costs.COMM_
+  KEYS`` (declared beside the validators);
+* the overlap-artifact writer (``tools/aot_overlap.py analyze()``)
+  must emit exactly the ``costs._OVERLAP_KEYS`` the ledger embed and
+  the perf sentinel read.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from fdtd3d_tpu.analysis import (Context, Finding, Rule, SourceFile,
+                                 walk_shallow)
+
+_EMIT_NAMES = frozenset(("emit", "_emit"))
+
+
+def _func_defs(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _const_keys(d: ast.Dict) -> Set[str]:
+    return {k.value for k in d.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+
+
+def dict_keys_produced(fn: ast.AST,
+                       varname: Optional[str] = None) -> Set[str]:
+    """Union of string keys a function's returned dict(s) can carry:
+    dict literals returned (directly or via a variable), subscript
+    stores ``var["k"] = ...`` and ``var.update(k=...)`` keyword names.
+    ``varname`` restricts the harvest to one variable (the ledger's
+    ``ledger``/``comm`` accumulators)."""
+    names: Set[str] = set()
+    if varname is None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) \
+                    and isinstance(node.value, ast.Name):
+                names.add(node.value.id)
+    else:
+        names.add(varname)
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and varname is None \
+                and isinstance(node.value, ast.Dict):
+            keys |= _const_keys(node.value)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in names \
+                        and isinstance(node.value, ast.Dict):
+                    keys |= _const_keys(node.value)
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in names \
+                        and isinstance(t.slice, ast.Constant) \
+                        and isinstance(t.slice.value, str):
+                    keys.add(t.slice.value)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "update" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in names:
+            keys |= {kw.arg for kw in node.keywords
+                     if kw.arg is not None}
+    return keys
+
+
+def _popped_keys(fn: ast.AST, param: str) -> Set[str]:
+    """Keys ``param.pop("k", ...)``-consumed inside ``fn`` — they never
+    reach a ``**param`` re-expansion."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "pop" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == param \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant):
+            out.add(str(node.args[0].value))
+    return out
+
+
+def _declared_params(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in a.args + a.kwonlyargs + a.posonlyargs}
+    return names
+
+
+class _Surface:
+    """Cross-file resolution tables for the **expansion resolver."""
+
+    def __init__(self, files: List[SourceFile]):
+        self.files = files
+        # last-name -> union of producible dict keys, for every
+        # function in the surface (used to resolve `**f(...)`)
+        self.producers: Dict[str, Set[str]] = {}
+        # enclosing-callable last-name -> [(file, Call node)] call sites
+        self.calls: Dict[str, List[Tuple[SourceFile, ast.Call]]] = {}
+        for sf in files:
+            for fn in _func_defs(sf.tree):
+                keys = dict_keys_produced(fn)
+                if keys:
+                    self.producers.setdefault(fn.name, set()).update(
+                        keys)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    last = None
+                    if isinstance(node.func, ast.Name):
+                        last = node.func.id
+                    elif isinstance(node.func, ast.Attribute):
+                        last = node.func.attr
+                    if last:
+                        self.calls.setdefault(last, []).append(
+                            (sf, node))
+
+
+def _resolve_expr_keys(expr: ast.AST, fn: ast.AST, owner: str,
+                       surface: _Surface) -> Optional[Set[str]]:
+    """Keys a ``**expr`` expansion can contribute; None = unresolvable.
+
+    Handles: dict literals; calls to a known producer function;
+    variables assigned either of those in the enclosing function; and
+    function PARAMETERS, resolved through the surface's call sites of
+    the enclosing callable (``owner``: the function name, or the class
+    name for ``__init__``) minus ``.pop()``-consumed keys.
+    """
+    if isinstance(expr, ast.Dict):
+        if any(k is None or not isinstance(k, ast.Constant)
+               for k in expr.keys):
+            return None
+        return _const_keys(expr)
+    if isinstance(expr, ast.Call):
+        last = None
+        if isinstance(expr.func, ast.Name):
+            last = expr.func.id
+        elif isinstance(expr.func, ast.Attribute):
+            last = expr.func.attr
+        return surface.producers.get(last)
+    if isinstance(expr, ast.Name):
+        # locally assigned?
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == expr.id:
+                        return _resolve_expr_keys(node.value, fn,
+                                                  owner, surface)
+        # a parameter (declared or the **kwargs catch-all): gather the
+        # keyword names call sites pass beyond the declared params
+        is_param = expr.id in _declared_params(fn) or (
+            fn.args.kwarg is not None and fn.args.kwarg.arg == expr.id)
+        if is_param:
+            declared = _declared_params(fn)
+            popped = _popped_keys(fn, expr.id)
+            keys: Set[str] = set()
+            for _sf, call in surface.calls.get(owner, ()):
+                for kw in call.keywords:
+                    if kw.arg is None:
+                        # a **forward at the call site: opaque
+                        return None
+                    if kw.arg == expr.id:
+                        sub = _resolve_expr_keys(kw.value, fn, owner,
+                                                 surface)
+                        if sub is None:
+                            return None
+                        keys |= sub
+                    elif kw.arg not in declared:
+                        keys.add(kw.arg)
+            return keys - popped
+    return None
+
+
+class SchemaDriftRule(Rule):
+    name = "schema-drift"
+    engine = "structural"
+    doc = ("every key each telemetry/ledger/overlap writer emits "
+           "exists in the matching validator's key table — writer and "
+           "reader provably cannot drift")
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _check_telemetry(self, files: List[SourceFile],
+                         surface: _Surface) -> Tuple[List[Finding], int]:
+        from fdtd3d_tpu.telemetry import RECORD_OPTIONAL, RECORD_SCHEMA
+        findings: List[Finding] = []
+        n_sites = 0
+
+        def allowed_for(rtype: str) -> Set[str]:
+            return (set(RECORD_SCHEMA[rtype])
+                    | set(RECORD_OPTIONAL.get(rtype, ()))
+                    | {"v", "type"})
+
+        for sf in files:
+            # the schema tables themselves live in telemetry.py as
+            # dict literals; only CALL/record construction sites count
+            for fn in _func_defs(sf.tree):
+                owner = fn.name
+                if fn.name == "__init__":
+                    # resolve call sites by the class name
+                    for cls in ast.walk(sf.tree):
+                        if isinstance(cls, ast.ClassDef) \
+                                and fn in cls.body:
+                            owner = cls.name
+                            break
+                for node in walk_shallow(fn):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in _EMIT_NAMES \
+                            and node.args \
+                            and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        rtype = node.args[0].value
+                        n_sites += 1
+                        if rtype not in RECORD_SCHEMA:
+                            findings.append(Finding(
+                                self.name, sf.relpath, node.lineno,
+                                f"emit of unknown record type "
+                                f"{rtype!r} — add it to "
+                                f"telemetry.RECORD_SCHEMA"))
+                            continue
+                        ok = allowed_for(rtype)
+                        for kw in node.keywords:
+                            if kw.arg is not None:
+                                if kw.arg not in ok:
+                                    findings.append(Finding(
+                                        self.name, sf.relpath,
+                                        node.lineno,
+                                        f"{rtype} writer emits key "
+                                        f"{kw.arg!r} that no validator "
+                                        f"version knows — declare it "
+                                        f"in RECORD_SCHEMA or "
+                                        f"RECORD_OPTIONAL"))
+                                continue
+                            keys = _resolve_expr_keys(kw.value, fn,
+                                                      owner, surface)
+                            if keys is None:
+                                findings.append(Finding(
+                                    self.name, sf.relpath, node.lineno,
+                                    f"{rtype} writer expands "
+                                    f"**{ast.unparse(kw.value)[:40]} "
+                                    f"that static analysis cannot "
+                                    f"resolve — emit literal keys or "
+                                    f"route through a dict-returning "
+                                    f"function"))
+                                continue
+                            for k in sorted(keys - ok):
+                                findings.append(Finding(
+                                    self.name, sf.relpath, node.lineno,
+                                    f"{rtype} writer emits key {k!r} "
+                                    f"(via **expansion) that no "
+                                    f"validator version knows — "
+                                    f"declare it in RECORD_SCHEMA or "
+                                    f"RECORD_OPTIONAL"))
+                    # dict-literal record construction (the
+                    # trace_attribution pattern): {"v":..., "type": T}
+                    if isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Dict) \
+                            and len(node.targets) == 1 \
+                            and isinstance(node.targets[0], ast.Name):
+                        d = node.value
+                        ks = _const_keys(d)
+                        if not {"v", "type"} <= ks:
+                            continue
+                        rtype = None
+                        for k, v in zip(d.keys, d.values):
+                            if isinstance(k, ast.Constant) \
+                                    and k.value == "type" \
+                                    and isinstance(v, ast.Constant):
+                                rtype = v.value
+                        if not isinstance(rtype, str) \
+                                or rtype not in RECORD_SCHEMA:
+                            continue
+                        n_sites += 1
+                        var = node.targets[0].id
+                        emitted = ks | dict_keys_produced(fn, var)
+                        for k in sorted(emitted - allowed_for(rtype)):
+                            findings.append(Finding(
+                                self.name, sf.relpath, node.lineno,
+                                f"{rtype} record literal emits key "
+                                f"{k!r} that no validator version "
+                                f"knows — declare it in RECORD_SCHEMA "
+                                f"or RECORD_OPTIONAL"))
+        return findings, n_sites
+
+    # -- ledger + overlap --------------------------------------------------
+
+    def _check_keyset(self, sf: SourceFile, fn_name: str, var: str,
+                      declared: Set[str], declared_name: str
+                      ) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in _func_defs(sf.tree):
+            if fn.name != fn_name:
+                continue
+            produced = dict_keys_produced(fn, var) if var else \
+                dict_keys_produced(fn)
+            if not produced:
+                findings.append(Finding(
+                    self.name, sf.relpath, fn.lineno,
+                    f"{fn_name}: no emitted keys found for {var or 'the returned dict'} "
+                    f"— the schema-drift extraction rotted"))
+                return findings
+            for k in sorted(produced - declared):
+                findings.append(Finding(
+                    self.name, sf.relpath, fn.lineno,
+                    f"{fn_name} emits key {k!r} missing from "
+                    f"{declared_name}"))
+            for k in sorted(declared - produced):
+                findings.append(Finding(
+                    self.name, sf.relpath, fn.lineno,
+                    f"{declared_name} declares key {k!r} that "
+                    f"{fn_name} never emits (dead schema entry)"))
+            return findings
+        findings.append(Finding(
+            self.name, sf.relpath, None,
+            f"writer function {fn_name} not found — the schema-drift "
+            f"rule's target table rotted"))
+        return findings
+
+    def run(self, ctx: Context) -> Tuple[List[Finding], Dict[str, Any]]:
+        from fdtd3d_tpu import costs
+        files = list(ctx.files()) + ctx.extra_files("bench.py")
+        surface = _Surface(files)
+        findings, n_sites = self._check_telemetry(files, surface)
+        by_rel = {sf.relpath.replace("\\", "/"): sf for sf in files}
+        costs_sf = by_rel.get("fdtd3d_tpu/costs.py")
+        if costs_sf is not None:
+            findings += self._check_keyset(
+                costs_sf, "chunk_ledger", "ledger",
+                set(costs.LEDGER_KEYS), "costs.LEDGER_KEYS")
+            findings += self._check_keyset(
+                costs_sf, "_comm_lane", "comm",
+                set(costs.COMM_KEYS), "costs.COMM_KEYS")
+        overlap_sf = by_rel.get("tools/aot_overlap.py")
+        if overlap_sf is not None:
+            findings += self._check_keyset(
+                overlap_sf, "analyze", None,
+                set(costs._OVERLAP_KEYS), "costs._OVERLAP_KEYS")
+        return findings, {"emit_sites_checked": n_sites}

@@ -16,10 +16,93 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from fdtd3d_tpu import physics
 from fdtd3d_tpu.layout import get_mode
+
+
+# ---------------------------------------------------------------------------
+# environment-knob registry (the single source of truth for FDTD3D_* vars)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvKnob:
+    """One declared ``FDTD3D_*`` environment knob.
+
+    ``kind``: "flag" (presence/any non-empty value = on), "int"
+    (numeric value), "str" (free-form value), "path" (filesystem path).
+    ``default`` is the effective behavior when the variable is unset.
+    The ``env-registry`` static-analysis rule (fdtd3d_tpu/analysis/)
+    enforces that every literal ``os.environ``/``os.getenv`` read of a
+    ``FDTD3D_*`` name in the repo appears here, and that every entry
+    here is actually read somewhere — so this table can neither rot nor
+    under-document (docs/STATIC_ANALYSIS.md renders it).
+    """
+
+    name: str
+    kind: str
+    default: Any
+    doc: str
+
+
+def _knob(name: str, kind: str, default: Any, doc: str) -> EnvKnob:
+    if kind not in ("flag", "int", "str", "path"):
+        raise ValueError(f"bad env-knob kind {kind!r} for {name}")
+    return EnvKnob(name=name, kind=kind, default=default, doc=doc)
+
+
+ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
+    _knob("FDTD3D_NO_PACKED", "flag", False,
+          "Escape hatch: skip the packed pipelined Pallas kernels "
+          "(ops/pallas_packed*.py); dispatch falls to fused/two-pass/"
+          "jnp. Supervisor degrade rung; measurement A/B lever."),
+    _knob("FDTD3D_NO_TEMPORAL", "flag", False,
+          "Escape hatch: skip the temporal-blocked kernel "
+          "(ops/pallas_packed_tb.py), forcing the round-6 single-step "
+          "packed kernel bit-for-bit. Supervisor tb->packed rung."),
+    _knob("FDTD3D_NO_FUSED", "flag", False,
+          "Escape hatch: skip the recompute-fused single-pass kernel "
+          "(ops/pallas_fused.py), forcing the two-pass family kernels "
+          "where both are eligible (measurement A/B lever)."),
+    _knob("FDTD3D_FORCE_FUSED", "flag", False,
+          "Bypass the tile>=4 fused-kernel dispatch heuristic AND skip "
+          "the packed kernel, forcing ops/pallas_fused.py (the "
+          "crossover was measured on one throttled chip; other TPU "
+          "generations may sit elsewhere)."),
+    _knob("FDTD3D_FORCE_PAIRED_COMPLEX", "flag", False,
+          "Test hook: route complex_fields through the paired-real "
+          "two-leg step even on backends with native complex (CPU), "
+          "so the TPU complex path is exercisable in tier-1."),
+    _knob("FDTD3D_VMEM_BUDGET_MB", "int", None,
+          "Override the per-kernel VMEM budget (MiB) the Pallas tile "
+          "pickers model against (ops/pallas3d.py, ops/pallas_packed"
+          ".py). Default: the kernel's physical-VMEM model; the "
+          "runtime ladder (sim._vmem_fallback) shrinks on compile "
+          "failure."),
+    _knob("FDTD3D_FAULT_PLAN", "str", None,
+          "Deterministic fault-injection plan spec (fdtd3d_tpu/faults"
+          ".py), e.g. 'nan@t=8,field=Ez; preempt@t=16'. Adopted once "
+          "per process by Simulation.__init__; docs/ROBUSTNESS.md "
+          "documents the grammar."),
+    _knob("FDTD3D_TEST_TPU", "flag", False,
+          "Run the test suite against the real TPU backend instead of "
+          "the 8-device virtual CPU mesh (tests/conftest.py skips the "
+          "CPU pin; opens the chip-lane-only skips)."),
+    _knob("FDTD3D_BENCH_TELEMETRY", "path", None,
+          "bench.py: append flight-recorder JSONL for every bench "
+          "stage to this path (telemetry schema; summarize with "
+          "tools/telemetry_report.py)."),
+    _knob("FDTD3D_BENCH_PER_CHIP", "flag", False,
+          "bench.py: enable the per-chip telemetry lane (schema v4 "
+          "per_chip/imbalance records) for multi-chip bench windows; "
+          "needs FDTD3D_BENCH_TELEMETRY."),
+    _knob("FDTD3D_BENCH_PROFILE", "path", None,
+          "bench.py: capture a device trace per stage into "
+          "DIR/<path>_<dtype>_<n>/ subdirectories (attribute with "
+          "tools/trace_attribution.py)."),
+)}
 
 
 @dataclasses.dataclass

@@ -532,28 +532,18 @@ def _comm_lane(cfg, acc: _Acc, topo, n_chips: int,
 # the ledger
 # --------------------------------------------------------------------------
 
-def chunk_ledger(cfg, n_steps: int = 8,
-                 hbm_gbps: Optional[float] = None,
-                 kind: Optional[str] = None,
-                 topology: Optional[Sequence[int]] = None,
-                 ici_gbps: Optional[float] = None,
-                 overlap: Optional[Dict[str, Any]] = None
-                 ) -> Dict[str, Any]:
-    """Trace cfg's chunk runner and attribute per-step flops/bytes.
+def trace_chunk(cfg, n_steps: int = 8, kind: Optional[str] = None,
+                topology: Optional[Sequence[int]] = None):
+    """Trace cfg's PRODUCTION chunk runner (no compile, no execution)
+    -> ``(runner, closed_jaxpr, static, topo, steps_per_call)``.
 
-    ``kind`` forces one of STEP_KINDS via the same environment knobs
-    the measurement tools use (and raises if the forced kind did not
-    engage — a silent fallback would attribute the wrong graph).
-    Pure tracing: no compile, no device execution, CPU-deterministic.
-
-    ``topology=(px,py,pz)`` traces the runner INSIDE shard_map over a
-    host-device mesh (still tracing only — works on the virtual CPU
-    mesh): section/per_step tables are then PER-CHIP (``cells`` is the
-    local cell count) and the ledger carries the v2 ``comm`` lane —
-    traced ppermute bytes/messages per section, the plan.py halo
-    model, the per-topology table and the modeled overlap window.
-    ``overlap`` embeds a tools/aot_overlap.py artifact's async window
-    counts; ``ici_gbps`` overrides the modeled ICI bandwidth.
+    The shared tracing substrate of the cost ledger (:func:`chunk_
+    ledger`) and the static-analysis structural rules
+    (fdtd3d_tpu/analysis/graph_rules.py — scope coverage walks the
+    SAME jaxpr the ledger charges). ``kind`` forces one of STEP_KINDS
+    via the measurement env knobs and raises if it did not engage;
+    ``topology`` traces inside shard_map over the host-device mesh
+    (CPU-deterministic on the virtual mesh).
     """
     import jax
 
@@ -561,11 +551,6 @@ def chunk_ledger(cfg, n_steps: int = 8,
     from fdtd3d_tpu.solver import (build_coeffs, build_static,
                                    init_state, make_chunk_runner)
 
-    if overlap is not None and topology is None:
-        raise ValueError("overlap= only rides the comm lane: pass "
-                         "topology= too (the artifact embeds under "
-                         "comm.async_windows; silently dropping it "
-                         "would disable the sentinel's overlap gates)")
     topo = None
     with _forced_env(kind):
         static = build_static(cfg)
@@ -662,6 +647,42 @@ def chunk_ledger(cfg, n_steps: int = 8,
             traced, mesh, in_specs=(specs, coeff_specs),
             out_specs=(specs, {k: P() for k in telemetry.HEALTH_KEYS}))
     closed = jax.make_jaxpr(traced)(state_sh, coeffs_sh)
+    return runner, closed, static, topo, spc
+
+
+def chunk_ledger(cfg, n_steps: int = 8,
+                 hbm_gbps: Optional[float] = None,
+                 kind: Optional[str] = None,
+                 topology: Optional[Sequence[int]] = None,
+                 ici_gbps: Optional[float] = None,
+                 overlap: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Trace cfg's chunk runner and attribute per-step flops/bytes.
+
+    ``kind`` forces one of STEP_KINDS via the same environment knobs
+    the measurement tools use (and raises if the forced kind did not
+    engage — a silent fallback would attribute the wrong graph).
+    Pure tracing: no compile, no device execution, CPU-deterministic
+    (:func:`trace_chunk` is the substrate).
+
+    ``topology=(px,py,pz)`` traces the runner INSIDE shard_map over a
+    host-device mesh (still tracing only — works on the virtual CPU
+    mesh): section/per_step tables are then PER-CHIP (``cells`` is the
+    local cell count) and the ledger carries the v2 ``comm`` lane —
+    traced ppermute bytes/messages per section, the plan.py halo
+    model, the per-topology table and the modeled overlap window.
+    ``overlap`` embeds a tools/aot_overlap.py artifact's async window
+    counts; ``ici_gbps`` overrides the modeled ICI bandwidth.
+    """
+    from fdtd3d_tpu import telemetry
+
+    if overlap is not None and topology is None:
+        raise ValueError("overlap= only rides the comm lane: pass "
+                         "topology= too (the artifact embeds under "
+                         "comm.async_windows; silently dropping it "
+                         "would disable the sentinel's overlap gates)")
+    runner, closed, static, topo, spc = trace_chunk(
+        cfg, n_steps=n_steps, kind=kind, topology=topology)
     acc = _Acc(n_steps // spc)
     _walk(acc, closed.jaxpr, "", 1.0, False, True)
     if not acc.step_scan_seen:
@@ -742,6 +763,23 @@ def chunk_ledger(cfg, n_steps: int = 8,
     else:
         ledger["roofline"] = None
     return ledger
+
+
+# The COMPLETE top-level key sets the writers may emit — owned here,
+# beside the validators, so writer and reader provably cannot drift:
+# the schema-drift static-analysis rule (fdtd3d_tpu/analysis/
+# schema_rules.py) extracts chunk_ledger's / _comm_lane's actually-
+# emitted keys from the AST and asserts emitted ⊆ declared AND
+# validator-required ⊆ emitted. Adding a ledger key without declaring
+# it here fails the lint gate.
+LEDGER_KEYS = frozenset((
+    "schema", "ledger_version", "step_kind", "scheme", "grid", "dtype",
+    "cells", "n_steps", "steps_per_call", "topology", "sections",
+    "per_chunk_sections", "per_step", "comm", "model", "roofline"))
+COMM_KEYS = frozenset((
+    "topology", "n_chips", "per_step", "per_chunk",
+    "collectives_per_step", "plan", "topology_table", "overlap_model",
+    "async_windows"))
 
 
 def validate_ledger(led: Dict[str, Any]) -> None:

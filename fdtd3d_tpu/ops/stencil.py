@@ -35,6 +35,12 @@ from jax import lax
 # axis index (0/1/2) -> mesh axis name, or None when that axis is unsharded.
 MeshAxes = Dict[int, Optional[str]]
 
+# Graph-safe region marker (tracer-hostility lint rule): the
+# difference/shift closures and the halo helpers run inside every
+# traced step — host calls are banned in them (fdtd3d_tpu/analysis/).
+GRAPH_SAFE_FNS = ("diff_b", "diff_f", "shift_b", "shift_f",
+                  "_neighbor_plane", "_pad_plane", "_pad_to_extent")
+
 
 def _neighbor_plane(plane: jnp.ndarray, axis_name: Optional[str],
                     n_shards: int, downstream: bool) -> jnp.ndarray:

@@ -44,6 +44,16 @@ from fdtd3d_tpu.ops.stencil import make_diff_ops
 
 AXES = "xyz"
 
+# Graph-safe region marker (tracer-hostility lint rule, fdtd3d_tpu/
+# analysis/ast_rules.py): every function of these names — the traced
+# step closures and their helpers, at any nesting depth — is GRAPH
+# code; the rule bans host calls (float()/.item()/np.asarray/
+# time.time()/os.*) inside them and in every same-module function
+# they call by name. The paired-complex pack/unpack are deliberately
+# NOT listed: they route through host numpy by design.
+GRAPH_SAFE_FNS = ("step", "_half_update", "_slab_delta", "_pad_slab",
+                  "_bcast1d", "_slab_delta_ds", "ds_diff")
+
 
 @dataclasses.dataclass(frozen=True)
 class StaticSetup:

@@ -99,6 +99,13 @@ GRAPH_SPANS = ("E-update", "H-update", "cpml", "halo-exchange", "source",
                "tfsf", "packed-kernel", "packed-kernel-tb", "health",
                "prepare")
 
+# Graph-safe region marker (tracer-hostility rule, fdtd3d_tpu/
+# analysis/ast_rules.py): these functions run under jit/scan tracing —
+# host calls (float()/.item()/np.asarray/time.time()) would pin
+# trace-time constants or crash on tracers, and the lint enforces
+# their absence here and in every same-module function they call.
+GRAPH_SAFE_FNS = ("health", "_one")
+
 
 def span(name: str):
     """Host-side trace span: wraps ``jax.profiler.TraceAnnotation`` so
@@ -462,6 +469,28 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "max": _NUM, "mean": _NUM, "ratio": _OPT_NUM, "argmax": (int,),
         "n_chips": (int,),
     },
+}
+
+
+# Documented OPTIONAL keys per record type: the validator never
+# requires them (extra keys are always allowed at read time), but the
+# WRITERS may emit exactly required ∪ optional ∪ {v, type} — enforced
+# by the schema-drift static-analysis rule (fdtd3d_tpu/analysis/
+# schema_rules.py), which extracts every emit call's keys from the AST
+# and checks them against this table. A writer emitting a key listed
+# nowhere fails the lint gate, so the schema tables can never silently
+# lag the writers.
+RECORD_OPTIONAL: Dict[str, tuple] = {
+    # provenance() enriches run_start with the sim's identity when one
+    # is attached (CLI/bench runs); header-only sinks omit them
+    "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
+                  "vmem_rung", "tile"),
+    # tools/trace_attribution.py: host-span table, per-core straggler
+    # lane (round 10), and the ledger echo keys
+    "attribution": ("host_spans_ms", "per_core", "imbalance",
+                    "ledger_step_kind", "roofline"),
+    # imbalance_summary(): present only when a chip diverged
+    "imbalance": ("nonfinite_chips",),
 }
 
 

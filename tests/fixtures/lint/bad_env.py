@@ -1,0 +1,7 @@
+"""Known-bad fixture for the env-registry rule: a literal FDTD3D_*
+environment read that fdtd3d_tpu.config.ENV_KNOBS does not declare."""
+
+import os
+
+FLAG = os.environ.get("FDTD3D_NOT_IN_REGISTRY")
+OTHER = os.getenv("FDTD3D_ALSO_UNDECLARED", "0")

@@ -1,0 +1,17 @@
+"""Known-bad fixture for the exception-hygiene rule: a bare except
+and an except BaseException that never re-raises — both can swallow
+SimulatedPreemption-family kills."""
+
+
+def swallow_everything(fn):
+    try:
+        return fn()
+    except:  # noqa: E722 — the offense under test
+        return None
+
+
+def swallow_kills(fn):
+    try:
+        return fn()
+    except BaseException:
+        return None

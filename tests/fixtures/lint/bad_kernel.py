@@ -1,0 +1,28 @@
+"""Known-bad fixture for the donation-safety rule: a pallas_call
+capture whose donated operand reads BACKWARD (block i-1) while its
+aliased output writes block i — the fetch of block b happens at
+iteration b+1, after the output first visited b, so the read can
+observe flushed output. This is exactly the hazard class the fused
+kernel's H operands would hit if donated (test_h_inputs_never_donated
+history)."""
+
+
+def bad_capture():
+    from jax.experimental import pallas as pl
+    return {
+        "grid": (4,),
+        "in_specs": [pl.BlockSpec((8, 8),
+                                  lambda i: (max(i - 1, 0), 0))],
+        "out_specs": [pl.BlockSpec((8, 8), lambda i: (i, 0))],
+        "input_output_aliases": {0: 0},
+    }
+
+
+def nonmonotone_capture():
+    from jax.experimental import pallas as pl
+    return {
+        "grid": (4,),
+        "in_specs": [pl.BlockSpec((8, 8), lambda i: (3 - i, 0))],
+        "out_specs": [pl.BlockSpec((8, 8), lambda i: (3 - i, 0))],
+        "input_output_aliases": {0: 0},
+    }

@@ -1,0 +1,6 @@
+"""Known-bad fixture for the no-bare-print rule: a stray print() call
+site outside log.py (tests/test_analysis.py proves the rule fires)."""
+
+
+def shout(msg):
+    print(msg)  # the offense: unsilenceable every-rank output

@@ -1,0 +1,27 @@
+"""Known-bad fixture for the schema-drift rule: writers emitting keys
+no telemetry validator version knows — a literal kwarg, a **expansion,
+and a dict-literal record (all three detection pathways)."""
+
+
+def emit_bogus_literal(sink):
+    sink.emit("degrade", t=1, old_kind="a", new_kind="b", reason="r",
+              chip=None, host=None, extra_mystery=1)
+
+
+def emit_bogus_expansion(sink):
+    sink.emit("run_start", **build_meta())
+
+
+def build_meta():
+    rec = {"wall_time": "now", "git_sha": "x", "jax_version": "0",
+           "platform": "cpu"}
+    rec["sneaky_extra"] = 1
+    return rec
+
+
+def build_bogus_record():
+    rec = {"v": 5, "type": "attribution", "source": "s",
+           "sections": {}, "measured_total_ms": None,
+           "coverage_bytes": None}
+    rec["undeclared_lane"] = {}
+    return rec

@@ -1,0 +1,22 @@
+"""Known-bad fixture for the scope-coverage rule: a shard_map'd
+function whose ppermute carries NO fdtd3d/ named scope — the traced
+jaxpr must show one unscoped collective."""
+
+
+def build_unscoped_jaxpr():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from jax.sharding import Mesh
+
+    from fdtd3d_tpu.parallel.mesh import shard_map_compat
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+
+    def exchange(x):
+        return jax.lax.ppermute(x, "x", [(0, 1), (1, 0)])
+
+    f = shard_map_compat(exchange, mesh, in_specs=(P("x"),),
+                         out_specs=P("x"))
+    return jax.make_jaxpr(f)(jnp.ones((4, 4), jnp.float32))

@@ -1,0 +1,16 @@
+"""Known-bad fixture for the tracer-hostility rule: a marked
+graph-safe function making a host call, plus one reached transitively
+(proving the same-module reachability walk, not just the direct
+check)."""
+
+import time
+
+GRAPH_SAFE_FNS = ("stepper",)
+
+
+def stepper(x):
+    return helper(x) + time.time()  # host clock pinned at trace time
+
+
+def helper(x):
+    return float(x)  # forces a concrete value — crashes on a tracer

@@ -1,0 +1,11 @@
+"""Known-bad fixture for the atomic-write rule: a truncating open()
+outside io.py's atomic primitives — torn-file-on-crash behavior."""
+
+
+def save(path, data):
+    with open(path, "w") as fh:
+        fh.write(data)
+
+
+def dump(arr, path):
+    arr.tofile(path)

@@ -1,0 +1,367 @@
+"""The unified static-analysis pass (ISSUE 9 acceptance).
+
+Load-bearing claims under test:
+
+* the FULL rule set is CLEAN over the repo with an empty suppression
+  baseline — tier-1's zero-tolerance gate (the CLI form is smoked in
+  tests/test_tools_cli.py);
+* every rule demonstrably FIRES on its checked-in known-bad fixture
+  (tests/fixtures/lint/) — no rule can go vacuously green;
+* scope coverage is reported as an ENUMERATED 0 unscoped collectives
+  (not a percentage) for every sharded step kind on the (2,2,2) CPU
+  mesh;
+* the env-knob registry (config.ENV_KNOBS) covers the previously
+  undeclared knobs and stays read-alive both ways;
+* the suppression baseline is schema-checked, requires per-entry
+  reasons, and actually suppresses;
+* the --json report round-trips.
+"""
+
+import json
+import os
+
+import pytest
+
+from fdtd3d_tpu.analysis import (REPORT_SCHEMA, Context, Finding,
+                                 apply_baseline, load_baseline,
+                                 run_rules, rules_by_name)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(ROOT, "tests", "fixtures", "lint")
+
+AST_RULES = ("no-bare-print", "atomic-write", "env-registry",
+             "tracer-hostility", "exception-hygiene")
+STRUCTURAL_RULES = ("schema-drift", "donation-safety",
+                    "scope-coverage", "readback-discipline")
+
+
+def _fixture_ctx(fname, label=None):
+    path = os.path.join(FIX, fname)
+    return Context(root=FIX, paths=[(label or fname, path)])
+
+
+def _fmt(findings):
+    return "\n".join(f["message"] if isinstance(f, dict) else f.format()
+                     for f in findings)
+
+
+# -------------------------------------------------------------------------
+# the repo is clean (zero-tolerance gate)
+# -------------------------------------------------------------------------
+
+def test_registry_covers_both_engines():
+    names = set(rules_by_name())
+    assert names == set(AST_RULES) | set(STRUCTURAL_RULES)
+
+
+def test_ast_rules_clean_over_repo():
+    rep = run_rules(list(AST_RULES))
+    assert rep["clean"], _fmt(rep["findings"])
+
+
+@pytest.fixture(scope="module")
+def structural_report():
+    """One run of the heavy rules (module-scoped: the scope rule
+    traces all four sharded kinds; readback drives a real sim)."""
+    return run_rules(list(STRUCTURAL_RULES))
+
+
+def test_structural_rules_clean_over_repo(structural_report):
+    assert structural_report["clean"], _fmt(
+        structural_report["findings"])
+
+
+def test_scope_coverage_is_enumerated_zero(structural_report):
+    """ISSUE 9 acceptance: 0 unscoped collectives (a COUNT, not a
+    percentage) for every sharded step kind on the (2,2,2) mesh."""
+    from fdtd3d_tpu import costs
+    stats = structural_report["rules"]["scope-coverage"]["stats"]
+    assert set(stats) == set(costs.SHARDED_STEP_KINDS)
+    for kind, row in stats.items():
+        assert row["unscoped_collectives"] == 0, (kind, row)
+        assert row["collectives"] > 0, (kind, row)   # lane not empty
+
+
+def test_donation_rule_covered_every_kernel(structural_report):
+    stats = structural_report["rules"]["donation-safety"]["stats"]
+    assert set(stats) == {"pallas", "pallas_fused", "pallas_packed",
+                          "pallas_packed_tb", "pallas_packed_ds"}
+    for label, row in stats.items():
+        assert row["aliased_operands"] > 0, (label, row)
+
+
+def test_readback_budget_reported(structural_report):
+    stats = structural_report["rules"]["readback-discipline"]["stats"]
+    assert stats["device_gets_per_chunk"] == 1
+    assert stats["max_leaf_elems"] <= 8
+
+
+# -------------------------------------------------------------------------
+# every rule fires on its known-bad fixture (rules proven live)
+# -------------------------------------------------------------------------
+
+def test_no_bare_print_fires_on_fixture():
+    from fdtd3d_tpu.analysis.ast_rules import NoBarePrintRule
+    findings, _ = NoBarePrintRule().run(_fixture_ctx("bad_print.py"))
+    assert len(findings) == 1 and "print" in findings[0].message
+
+
+def test_atomic_write_fires_on_fixture():
+    from fdtd3d_tpu.analysis.ast_rules import AtomicWriteRule
+    ctx = _fixture_ctx("bad_write.py", "fdtd3d_tpu/bad_write.py")
+    findings, _ = AtomicWriteRule().run(ctx)
+    msgs = _fmt(findings)
+    assert "open(..., 'w')" in msgs
+    assert ".tofile()" in msgs
+
+
+def test_env_registry_fires_on_fixture():
+    from fdtd3d_tpu.analysis.ast_rules import EnvRegistryRule
+    findings, _ = EnvRegistryRule().run(_fixture_ctx("bad_env.py"))
+    msgs = _fmt(findings)
+    assert "FDTD3D_NOT_IN_REGISTRY" in msgs
+    assert "FDTD3D_ALSO_UNDECLARED" in msgs   # os.getenv form too
+
+
+def test_tracer_hostility_fires_on_fixture():
+    from fdtd3d_tpu.analysis.ast_rules import TracerHostilityRule
+    findings, _ = TracerHostilityRule().run(
+        _fixture_ctx("bad_tracer.py"))
+    msgs = _fmt(findings)
+    assert "time.time()" in msgs
+    # transitively reached helper, not just the marked root:
+    assert "float()" in msgs and "'helper'" in msgs
+
+
+def test_exception_hygiene_fires_on_fixture():
+    from fdtd3d_tpu.analysis.ast_rules import ExceptionHygieneRule
+    findings, _ = ExceptionHygieneRule().run(
+        _fixture_ctx("bad_except.py"))
+    msgs = _fmt(findings)
+    assert "bare 'except:'" in msgs
+    assert "BaseException" in msgs
+
+
+def test_exception_hygiene_sees_raise_past_nested_defs(tmp_path):
+    """Regression: a re-raise AFTER a lambda/def inside the same
+    handler statement must still count (the scan skips nested-def
+    subtrees, it does not abort on them)."""
+    from fdtd3d_tpu.analysis.ast_rules import ExceptionHygieneRule
+    p = tmp_path / "ok.py"
+    p.write_text(
+        "def f(ctx, fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except BaseException:\n"
+        "        with ctx(on_err=lambda: None):\n"
+        "            raise\n")
+    ctx = Context(root=str(tmp_path), paths=[("ok.py", str(p))])
+    findings, _ = ExceptionHygieneRule().run(ctx)
+    assert not findings, _fmt(findings)
+    # ...while a raise ONLY inside the nested lambda/def still flags
+    p2 = tmp_path / "bad.py"
+    p2.write_text(
+        "def f(fn):\n"
+        "    try:\n"
+        "        return fn()\n"
+        "    except BaseException:\n"
+        "        cb = lambda: (_ for _ in ()).throw(ValueError())\n"
+        "        return cb\n")
+    ctx2 = Context(root=str(tmp_path), paths=[("bad.py", str(p2))])
+    findings2, _ = ExceptionHygieneRule().run(ctx2)
+    assert findings2 and "BaseException" in findings2[0].message
+
+
+def test_donation_unintrospectable_alias_is_a_finding():
+    """Regression: an aliased pallas_call whose grid/specs kwargs are
+    not retrievable must FAIL the gate (unverifiable), never silently
+    pass — the rule cannot go vacuously green on a call-form change."""
+    from fdtd3d_tpu.analysis.graph_rules import check_pallas_capture
+    probs = check_pallas_capture(
+        "mystery", {"input_output_aliases": {0: 0}})
+    assert probs and "unverifiable" in probs[0], probs
+
+
+def test_schema_drift_fires_on_fixture():
+    from fdtd3d_tpu.analysis.schema_rules import SchemaDriftRule
+    findings, _ = SchemaDriftRule().run(_fixture_ctx("bad_schema.py"))
+    msgs = _fmt(findings)
+    assert "'extra_mystery'" in msgs          # literal kwarg
+    assert "'sneaky_extra'" in msgs           # **expansion, resolved
+    assert "'undeclared_lane'" in msgs        # dict-literal record
+
+
+def test_donation_safety_fires_on_fixture():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bad_kernel", os.path.join(FIX, "bad_kernel.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from fdtd3d_tpu.analysis.graph_rules import check_pallas_capture
+    probs = check_pallas_capture("bad", mod.bad_capture())
+    assert any("donation hazard" in p for p in probs), probs
+    probs2 = check_pallas_capture("bad2", mod.nonmonotone_capture())
+    assert any("NON-MONOTONE" in p for p in probs2), probs2
+
+
+def test_scope_coverage_fires_on_fixture():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bad_scope", os.path.join(FIX, "bad_scope.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from fdtd3d_tpu.analysis.graph_rules import (collect_collectives,
+                                                 unscoped_collectives)
+    colls = collect_collectives(mod.build_unscoped_jaxpr().jaxpr)
+    assert [x for x in unscoped_collectives(colls)
+            if x[0] == "ppermute"], colls
+
+
+def test_scope_coverage_rejects_inherited_outer_scope():
+    """E2E-found regression: a ppermute that merely INHERITS an outer
+    E-update scope (its own halo-exchange scope stripped) is a
+    mis-attributed exchange and must fail the bar — 'any scope' was
+    too weak to catch a silently de-scoped halo exchange."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from fdtd3d_tpu.analysis.graph_rules import (collect_collectives,
+                                                 unscoped_collectives)
+    from fdtd3d_tpu.parallel.mesh import shard_map_compat
+    from fdtd3d_tpu.telemetry import named
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+
+    def exchange(x):
+        with named("E-update"):   # outer family scope only
+            return jax.lax.ppermute(x, "x", [(0, 1), (1, 0)])
+
+    f = shard_map_compat(exchange, mesh, in_specs=(P("x"),),
+                         out_specs=P("x"))
+    colls = collect_collectives(
+        jax.make_jaxpr(f)(jnp.ones((4, 4), jnp.float32)).jaxpr)
+    bad = unscoped_collectives(colls)
+    assert bad and bad[0][0] == "ppermute" \
+        and bad[0][1] == "E-update", (colls, bad)
+    # and a properly-scoped exchange passes
+
+    def good(x):
+        with named("halo-exchange"):
+            return jax.lax.ppermute(x, "x", [(0, 1), (1, 0)])
+
+    g = shard_map_compat(good, mesh, in_specs=(P("x"),),
+                         out_specs=P("x"))
+    colls2 = collect_collectives(
+        jax.make_jaxpr(g)(jnp.ones((4, 4), jnp.float32)).jaxpr)
+    assert not unscoped_collectives(colls2), colls2
+
+
+def test_readback_discipline_fires_on_fixture():
+    from fdtd3d_tpu.analysis.graph_rules import check_transfer_log
+    with open(os.path.join(FIX, "bad_readback.json")) as f:
+        bad = json.load(f)
+    probs = check_transfer_log(bad["calls"], bad["n_chunks"])
+    assert any("full-field" in p for p in probs), probs
+    assert any("<=1 scalar-tuple" in p for p in probs), probs
+    # and the budget-compliant log passes
+    assert not check_transfer_log([[1] * 6], 1)
+
+
+# -------------------------------------------------------------------------
+# env-knob registry content (ISSUE 9 satellite)
+# -------------------------------------------------------------------------
+
+def test_env_registry_declares_the_former_strays():
+    """The knobs ISSUE 9 names as previously undeclared are now
+    registered with docs."""
+    from fdtd3d_tpu.config import ENV_KNOBS
+    for name in ("FDTD3D_TEST_TPU", "FDTD3D_BENCH_TELEMETRY",
+                 "FDTD3D_BENCH_PER_CHIP", "FDTD3D_VMEM_BUDGET_MB",
+                 "FDTD3D_FORCE_PAIRED_COMPLEX", "FDTD3D_BENCH_PROFILE",
+                 "FDTD3D_NO_PACKED", "FDTD3D_NO_TEMPORAL",
+                 "FDTD3D_NO_FUSED", "FDTD3D_FORCE_FUSED",
+                 "FDTD3D_FAULT_PLAN"):
+        assert name in ENV_KNOBS, name
+        knob = ENV_KNOBS[name]
+        assert knob.doc.strip(), name
+        assert knob.kind in ("flag", "int", "str", "path"), name
+
+
+# -------------------------------------------------------------------------
+# baseline policy + report format
+# -------------------------------------------------------------------------
+
+def test_baseline_requires_reasons(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({
+        "schema": "fdtd3d-lint-baseline", "version": 1,
+        "suppressions": [{"rule": "no-bare-print", "file": "x.py",
+                          "contains": "print", "reason": "  "}]}))
+    with pytest.raises(ValueError, match="empty reason"):
+        load_baseline(str(p))
+    p.write_text(json.dumps({"schema": "wrong", "suppressions": []}))
+    with pytest.raises(ValueError, match="schema"):
+        load_baseline(str(p))
+
+
+def test_baseline_suppresses_and_reports(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({
+        "schema": "fdtd3d-lint-baseline", "version": 1,
+        "suppressions": [{
+            "rule": "no-bare-print", "file": "bad_print.py",
+            "contains": "print", "reason": "test fixture waiver"}]}))
+    rep = run_rules(["no-bare-print"],
+                    ctx=_fixture_ctx("bad_print.py"),
+                    baseline_path=str(baseline))
+    assert rep["clean"]
+    assert len(rep["suppressed"]) == 1
+    assert rep["rules"]["no-bare-print"]["suppressed"] == 1
+    # apply_baseline unit form
+    live, sup = apply_baseline(
+        [Finding("r", "f.py", 1, "msg here")],
+        [{"rule": "r", "file": "f.py", "contains": "msg",
+          "reason": "x"}])
+    assert not live and len(sup) == 1
+
+
+def test_checked_in_baseline_is_valid_and_empty():
+    """Acceptance: the shipped baseline is empty (or every entry
+    carries its justification — load_baseline enforces the reason)."""
+    sups = load_baseline(os.path.join(ROOT, "tools",
+                                      "lint_baseline.json"))
+    assert sups == [], ("the checked-in baseline gained entries; "
+                       "each must carry a reviewed reason and the "
+                       "repo must still be clean without tier-1 "
+                       "regressions")
+
+
+def test_report_shape_and_roundtrip():
+    rep = run_rules(["no-bare-print", "exception-hygiene"])
+    assert rep["schema"] == REPORT_SCHEMA and rep["version"] == 1
+    for name in ("no-bare-print", "exception-hygiene"):
+        row = rep["rules"][name]
+        assert set(row) == {"engine", "doc", "findings", "suppressed",
+                            "stats"}
+    rt = json.loads(json.dumps(rep))
+    assert rt == rep
+    with pytest.raises(ValueError, match="unknown rule"):
+        run_rules(["does-not-exist"])
+
+
+def test_broken_rule_fails_the_gate(monkeypatch):
+    """A crashing rule must surface as analysis-error, never a silent
+    pass."""
+    from fdtd3d_tpu.analysis import ast_rules
+
+    def boom(self, ctx):
+        raise RuntimeError("kaboom")
+
+    monkeypatch.setattr(ast_rules.NoBarePrintRule, "run", boom)
+    rep = run_rules(["no-bare-print"])
+    assert not rep["clean"]
+    assert rep["findings"][0]["rule"] == "analysis-error"
+    assert "kaboom" in rep["findings"][0]["message"]

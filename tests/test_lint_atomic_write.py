@@ -1,134 +1,50 @@
 """Lint guard: every file write in fdtd3d_tpu/ routes through the
-atomic writer (ISSUE 5 satellite; pattern of test_lint_no_print.py).
+atomic writer (ISSUE 5 satellite; docs/ROBUSTNESS.md).
 
-The durability contract (docs/ROBUSTNESS.md) is only as strong as its
-least-careful call site: ONE stray ``open(path, "w")`` reintroduces
-torn-file-on-crash behavior for that artifact. This tier-1 guard makes
-the contract structural, via the AST:
-
-* truncating/creating ``open`` modes ('w', 'x', any 'b'/'+' variants)
-  are banned outside fdtd3d_tpu/io.py;
-* inside io.py they are allowed only in the atomic primitives
-  themselves (``atomic_open``) and in ``_write`` closures — the
-  documented convention for :func:`io.atomic_publish` writer callbacks,
-  which receive the primitive's tmp path;
-* ``ndarray.tofile`` / ``np.savez*`` (writers that bypass ``open``)
-  are banned outside io.py for the same reason.
-
-Append mode ('a') is the one sanctioned exception everywhere: the
-telemetry/metrics JSONL sinks append one flushed line per record, which
-is the crash-safe idiom for append-only logs — rewriting the whole file
-per record would be the fragile choice. Read and 'r+' modes never
-create/truncate and are out of scope (the fault harness's deliberate
-corruption uses 'r+b').
+The durability contract is only as strong as its least-careful call
+site: ONE stray ``open(path, "w")`` reintroduces torn-file-on-crash
+behavior for that artifact. Round 12 (ISSUE 9): the hand-rolled AST
+visitor moved into the static-analysis framework — this file is now a
+thin tier-1 wrapper over the ``atomic-write`` rule
+(fdtd3d_tpu/analysis/ast_rules.py; ``tools/fdtd_lint.py`` runs it
+too). Append mode ('a') remains the one sanctioned exception (the
+JSONL sinks); io.py's primitives and ``_write`` publish-closures
+remain the allowed w-mode sites. The rule's known-bad fixture lives in
+tests/fixtures/lint/bad_write.py.
 """
 
-import ast
 import os
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCAN_DIR = os.path.join(ROOT, "fdtd3d_tpu")
-
-# io.py hosts the primitives; inside it, w-mode opens may appear only
-# within these function names ("_write" = the atomic_publish writer-
-# closure convention).
-IO_ALLOWED_FUNCS = {"atomic_open", "_write"}
-
-_BANNED_ATTRS = {"tofile", "savez", "savez_compressed"}
-
-
-def _is_write_mode(mode: str) -> bool:
-    return "w" in mode or "x" in mode
-
-
-class _Visitor(ast.NodeVisitor):
-    def __init__(self, relpath):
-        self.relpath = relpath
-        self.is_io = os.path.basename(relpath) == "io.py"
-        self.func_stack = []
-        self.offenders = []
-
-    def _flag(self, node, what):
-        self.offenders.append(
-            f"{self.relpath}:{node.lineno}: {what}")
-
-    def visit_FunctionDef(self, node):
-        self.func_stack.append(node.name)
-        self.generic_visit(node)
-        self.func_stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def _allowed_here(self):
-        if not self.is_io:
-            return False
-        return bool(set(self.func_stack) & IO_ALLOWED_FUNCS)
-
-    def visit_Call(self, node):
-        func = node.func
-        # open(path, "w"/"wb"/"x"...) — as a bare name or io.open
-        name = None
-        if isinstance(func, ast.Name):
-            name = func.id
-        elif isinstance(func, ast.Attribute):
-            name = func.attr
-            if name in _BANNED_ATTRS and not self.is_io:
-                self._flag(node, f".{name}() writes files directly — "
-                                 f"route through fdtd3d_tpu.io's atomic "
-                                 f"writer")
-            if name == "open" and not (
-                    isinstance(func.value, ast.Name)
-                    and func.value.id in ("io", "builtins")):
-                name = None  # os.open / gzip.open etc: not builtin open
-        if name == "open":
-            mode = "r"
-            if len(node.args) >= 2 and isinstance(node.args[1],
-                                                  ast.Constant):
-                mode = str(node.args[1].value)
-            for kw in node.keywords:
-                if kw.arg == "mode" and isinstance(kw.value,
-                                                   ast.Constant):
-                    mode = str(kw.value.value)
-            literal = (len(node.args) < 2
-                       or isinstance(node.args[1], ast.Constant))
-            if (_is_write_mode(mode) or not literal) \
-                    and not self._allowed_here():
-                self._flag(node, f"open(..., {mode!r}) outside the "
-                                 f"atomic writer — use io.atomic_open/"
-                                 f"io.atomic_publish (append-mode JSONL "
-                                 f"sinks are the one exception)")
-        self.generic_visit(node)
+from fdtd3d_tpu.analysis import Context
+from fdtd3d_tpu.analysis.ast_rules import AtomicWriteRule
 
 
 def test_every_write_routes_through_atomic_writer():
-    offenders = []
-    for root, _dirs, files in os.walk(SCAN_DIR):
-        if "__pycache__" in root:
-            continue
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, ROOT)
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=rel)
-            v = _Visitor(rel)
-            v.visit(tree)
-            offenders.extend(v.offenders)
-    assert not offenders, (
+    findings, stats = AtomicWriteRule().run(Context())
+    assert stats["files_scanned"] > 15, "scan surface collapsed?"
+    assert not findings, (
         "file writes outside the atomic writer (io.atomic_open / "
         "io.atomic_publish; docs/ROBUSTNESS.md durability contract):\n"
-        + "\n".join(sorted(offenders)))
+        + "\n".join(f.format() for f in sorted(
+            findings, key=lambda f: (f.file, f.line or 0))))
 
 
 def test_lint_catches_a_plain_write(tmp_path):
     """The guard itself guards: a synthetic module with a bare
-    open(..., 'w') must be flagged."""
-    src = "def f(p):\n    with open(p, 'w') as fh:\n        fh.write('x')\n"
-    v = _Visitor("synthetic.py")
-    v.visit(ast.parse(src))
-    assert len(v.offenders) == 1 and "atomic" in v.offenders[0]
-    # and an append-mode sink is NOT flagged
-    v2 = _Visitor("synthetic.py")
-    v2.visit(ast.parse("def f(p):\n    open(p, 'a')\n"))
-    assert not v2.offenders
+    open(..., 'w') must be flagged; an append-mode sink must not."""
+    bad = tmp_path / "synthetic.py"
+    bad.write_text("def f(p):\n    with open(p, 'w') as fh:\n"
+                   "        fh.write('x')\n")
+    ctx = Context(root=str(tmp_path),
+                  paths=[(os.path.join("fdtd3d_tpu", "synthetic.py"),
+                          str(bad))])
+    findings, _ = AtomicWriteRule().run(ctx)
+    assert len(findings) == 1 and "atomic" in findings[0].message
+
+    ok = tmp_path / "sink.py"
+    ok.write_text("def f(p):\n    open(p, 'a')\n")
+    ctx2 = Context(root=str(tmp_path),
+                   paths=[(os.path.join("fdtd3d_tpu", "sink.py"),
+                           str(ok))])
+    findings2, _ = AtomicWriteRule().run(ctx2)
+    assert not findings2

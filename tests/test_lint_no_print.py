@@ -3,65 +3,26 @@ log.py.
 
 Round 3 routed every user-facing message through the one-switch leveled
 logger (fdtd3d_tpu/log.py: ``--log-level``, rank-0 gating); a stray
-print() reintroduces scattered, unsilenceable, every-rank output. This
-tier-1 guard makes the decision structural (ISSUE 2 satellite).
-Round 7 extends the guard to tools/: a tool's primary stdout product
-(reports, JSON lines) goes through the shared ``log.report()`` helper
-and progress/warnings through ``log.log()``/``log.warn()`` — argparse
-``--help`` output is argparse's own and never a bare print call site.
+print() reintroduces scattered, unsilenceable, every-rank output.
+Round 7 extended the guard to tools/ (``log.report()`` for product
+output). Round 12 (ISSUE 9): the hand-rolled tokenizer walker moved
+into the static-analysis framework — this file is now a thin tier-1
+wrapper over the ``no-bare-print`` rule
+(fdtd3d_tpu/analysis/ast_rules.py), which ``tools/fdtd_lint.py`` also
+runs; the rule's known-bad fixture lives in
+tests/fixtures/lint/bad_print.py and tests/test_analysis.py proves it
+fires.
 """
 
-import os
-
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCAN_DIRS = (os.path.join(ROOT, "fdtd3d_tpu"),
-             os.path.join(ROOT, "tools"))
-
-# log.py IS the print wrapper — the single allowed call site.
-ALLOWED = {"log.py"}
-
-# Quarantined LEGACY tools (round 10): superseded by the attribution
-# layer (PR 3) and gated behind --i-know-this-is-legacy; they are
-# frozen historical reproduction scripts, not part of the maintained
-# tools surface this lint guards.
-LEGACY = {"measure_r3.py", "measure_r4.py"}
-
-# a call site: "print(" not preceded by a word char or dot (so
-# pprint(, x.print( and docstring prose mentioning print() with a
-# preceding backtick/quote still need the line-level filters below)
-import re
-
-_CALL = re.compile(r"(?<![\w.])print\(")
-
-
-def _code_lines(path):
-    """-> [(lineno, code)] with strings and # comments stripped via the
-    tokenizer, so docstring prose mentioning print() never trips."""
-    import tokenize
-    from collections import defaultdict
-    lines = defaultdict(str)
-    with open(path, "rb") as f:
-        for tok in tokenize.tokenize(f.readline):
-            if tok.type in (tokenize.STRING, tokenize.COMMENT):
-                continue
-            lines[tok.start[0]] += tok.string
-    return sorted(lines.items())
+from fdtd3d_tpu.analysis import Context
+from fdtd3d_tpu.analysis.ast_rules import NoBarePrintRule
 
 
 def test_no_bare_print_outside_log():
-    offenders = []
-    for scan_root in SCAN_DIRS:
-        for root, _dirs, files in os.walk(scan_root):
-            for fname in files:
-                if not fname.endswith(".py") or fname in ALLOWED \
-                        or fname in LEGACY:
-                    continue
-                path = os.path.join(root, fname)
-                for lineno, tok in _code_lines(path):
-                    if _CALL.search(tok):
-                        rel = os.path.relpath(path, ROOT)
-                        offenders.append(f"{rel}:{lineno}: {tok.strip()}")
-    assert not offenders, (
+    findings, stats = NoBarePrintRule().run(Context())
+    assert stats["files_scanned"] > 20, "scan surface collapsed?"
+    assert not findings, (
         "bare print() outside fdtd3d_tpu/log.py — route through "
         "log.log()/log.warn()/log.report() (one-switch logging, "
-        "rounds 3+7):\n" + "\n".join(offenders))
+        "rounds 3+7):\n"
+        + "\n".join(f.format() for f in findings))

@@ -173,6 +173,35 @@ def test_aot_overlap_runs_on_fixture(tmp_path):
     assert art["windows_with_compute"] == 2
 
 
+def test_fdtd_lint_full_run_is_clean():
+    """ISSUE 9 acceptance: tools/fdtd_lint.py exits 0 over the repo
+    with ALL rules enabled and the checked-in (empty) baseline — the
+    operator form of the tier-1 gate in tests/test_analysis.py. The
+    CLI pins the CPU backend + 8 virtual devices itself."""
+    proc = _run([os.path.join(TOOLS, "fdtd_lint.py")], timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CLEAN" in proc.stdout
+
+
+def test_fdtd_lint_env_registry_json_roundtrips():
+    proc = _run([os.path.join(TOOLS, "fdtd_lint.py"),
+                 "--rule", "env-registry", "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["schema"] == "fdtd3d-lint-report" and rep["clean"]
+    assert rep["rules"]["env-registry"]["stats"]["registered"] >= 11
+
+
+def test_fdtd_lint_findings_exit_one(tmp_path):
+    """Exit-code contract: findings -> 1 (a gate, not a report)."""
+    bad = tmp_path / "offender.py"
+    bad.write_text("def f(x):\n    print(x)\n")
+    proc = _run([os.path.join(TOOLS, "fdtd_lint.py"),
+                 "--path", str(tmp_path)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "no-bare-print" in proc.stdout
+
+
 def test_costs_module_cli_runs():
     """python -m fdtd3d_tpu.costs is the ledger's operator entry —
     smoke the sharded comm-lane form too (8 virtual devices)."""

@@ -1,0 +1,132 @@
+"""fdtd_lint — the unified static-analysis gate (docs/STATIC_ANALYSIS.md).
+
+One CLI over the two rule engines in ``fdtd3d_tpu/analysis/``:
+
+* AST rules (no-bare-print, atomic-write, env-registry,
+  tracer-hostility, exception-hygiene) — pure stdlib, instant;
+* structural rules (schema-drift, donation-safety, scope-coverage,
+  readback-discipline) — trace the PRODUCTION chunk runner on the CPU
+  backend (8 virtual host devices for the sharded checks, set up
+  below); chip-free and deterministic.
+
+Exit codes: 0 = clean (suppressed findings do not fail), 1 = findings,
+2 = usage error. ``--json`` emits the full machine-readable report
+(schema ``fdtd3d-lint-report``); ``--rule`` narrows to specific rules;
+the suppression baseline (``tools/lint_baseline.json``) may waive
+findings WITH a per-entry reason — the checked-in baseline ships
+empty, and tier-1 (tests/test_analysis.py) asserts the full rule set
+is clean over the repo.
+
+Usage:
+    python tools/fdtd_lint.py                      # everything
+    python tools/fdtd_lint.py --rule env-registry --json
+    python tools/fdtd_lint.py --list-rules
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+_DEFAULT_BASELINE = os.path.join(ROOT, "tools", "lint_baseline.json")
+
+
+def _pin_cpu_backend() -> None:
+    """Chip-free determinism: the structural rules trace on the CPU
+    backend over 8 virtual host devices (the (2,2,2) sharded checks),
+    exactly tier-1's environment (tests/conftest.py). Must run before
+    jax initializes a backend."""
+    force_tpu = bool(os.environ.get("FDTD3D_TEST_TPU"))
+    if not force_tpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    if not force_tpu and os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the environment's TPU plugin overrides JAX_PLATFORMS at
+        # registration (tests/conftest.py rationale) — pin via config
+        jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdtd_lint",
+        description="unified static-analysis gate: AST + jaxpr/"
+                    "structural rules over fdtd3d_tpu/ and tools/ "
+                    "(chip-free; exit 0 clean / 1 findings / 2 usage)")
+    ap.add_argument("--rule", action="append", metavar="NAME",
+                    help="run only this rule (repeatable; default: "
+                         "all rules — see --list-rules)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list the registered rules and exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full JSON report instead of text "
+                         "findings")
+    ap.add_argument("--out", metavar="PATH", default=None,
+                    help="also write the JSON report to PATH "
+                         "(atomic)")
+    ap.add_argument("--baseline", metavar="PATH",
+                    default=_DEFAULT_BASELINE,
+                    help="suppression baseline (default tools/"
+                         "lint_baseline.json; every entry needs a "
+                         "reason — docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("--path", metavar="DIR", default=None,
+                    help="run the AST rules over this tree instead of "
+                         "the repo (structural rules are repo-bound "
+                         "and are skipped unless named via --rule)")
+    args = ap.parse_args(argv)
+
+    _pin_cpu_backend()
+    from fdtd3d_tpu.analysis import Context, all_rules, run_rules
+    from fdtd3d_tpu.log import report, warn
+
+    if args.list_rules:
+        for rule in all_rules():
+            report(f"{rule.name:22s} [{rule.engine}] {rule.doc}")
+        return 0
+
+    names = args.rule
+    ctx = None
+    if args.path:
+        ctx = Context(root=os.path.abspath(args.path), scan_all=True)
+        if names is None:
+            names = [r.name for r in all_rules() if r.engine == "ast"]
+    try:
+        rep = run_rules(names, ctx=ctx, baseline_path=args.baseline)
+    except ValueError as exc:   # unknown rule / malformed baseline
+        ap.error(str(exc))      # exit 2
+
+    txt = json.dumps(rep, indent=1)
+    if args.out:
+        d = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(d, exist_ok=True)
+        from fdtd3d_tpu.io import atomic_open
+        with atomic_open(args.out, "w") as f:
+            f.write(txt + "\n")
+    if args.json:
+        report(txt)
+    else:
+        from fdtd3d_tpu.analysis import Finding
+        for f in rep["findings"]:
+            report(Finding(**f).format())
+        n_rules = len(rep["rules"])
+        n_sup = len(rep["suppressed"])
+        if rep["clean"]:
+            report(f"fdtd_lint: CLEAN — {n_rules} rule(s), "
+                   f"0 findings" +
+                   (f", {n_sup} suppressed (baseline)" if n_sup
+                    else ""))
+        else:
+            warn(f"fdtd_lint: {len(rep['findings'])} finding(s) "
+                 f"across {n_rules} rule(s)"
+                 + (f" ({n_sup} suppressed)" if n_sup else ""))
+    return 0 if rep["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
