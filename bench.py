@@ -77,15 +77,16 @@ def _no_temporal(flag: bool):
 
 def measure(n: int, steps: int, use_pallas, repeats: int = 3,
             dtype: str = "float32", require_kind: str = "",
-            stats: dict = None, no_temporal: bool = False) -> float:
+            stats: dict = None, no_temporal: bool = False,
+            topology=None) -> float:
     with _no_temporal(no_temporal):
         return _measure(n, steps, use_pallas, repeats, dtype,
-                        require_kind, stats)
+                        require_kind, stats, topology)
 
 
 def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
              dtype: str = "float32", require_kind: str = "",
-             stats: dict = None) -> float:
+             stats: dict = None, topology=None) -> float:
     """Mcells/s for one path. Import jax lazily: the parent never does.
 
     ``stats``: optional dict filled with the StepClock summary of the
@@ -125,12 +126,18 @@ def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
     prof_root = os.environ.get("FDTD3D_BENCH_PROFILE") or None
     path_tag = "jnp" if use_pallas is False else (
         "pallas_tb" if require_kind == "pallas_packed_tb" else "pallas")
+    if topology is not None:
+        path_tag += "_sharded"
     prof_tag = f"{path_tag}_{dtype}_{n}"
+    from fdtd3d_tpu.config import ParallelConfig
+    par = ParallelConfig(topology="manual",
+                         manual_topology=tuple(topology)) \
+        if topology is not None else ParallelConfig()
     cfg = SimConfig(
         scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
         courant_factor=0.5, wavelength=32e-3,
         pml=PmlConfig(size=(10, 10, 10)),
-        dtype=dtype, use_pallas=use_pallas,
+        dtype=dtype, use_pallas=use_pallas, parallel=par,
         output=OutputConfig(
             profile=True,
             telemetry_path=os.environ.get("FDTD3D_BENCH_TELEMETRY")
@@ -655,6 +662,32 @@ def run_measurement() -> None:
             except Exception as e:
                 print(f"stage3c tb bf16 {bf16_n} failed: {e!r:.300}",
                       file=sys.stderr, flush=True)
+    # Stage 3d (round 11): the SHARDED temporal-blocked kernel — the
+    # depth-2 halo pipeline over the reference (2,2,2) decomposition,
+    # feeding the multichip lane (tb_sharded_* keys; perf_sentinel's
+    # f32_packed_tb_sharded path). Runs only on a >=8-chip window;
+    # require_kind so a silent fallback to the single-step sharded
+    # kernel (or jnp) can never report under this name.
+    tb_sh_mc, tb_sh_n = 0.0, 0
+    tb_sh_topo = [2, 2, 2]
+    tb_sh_stats = {}
+    tb_sh_note = None
+    if on_tpu and jax.device_count() >= 8:
+        try:
+            tb_sh_mc = sup_measure("s3d_tb_sharded", n,
+                                   90 if n >= 512 else 120,
+                                   use_pallas=True,
+                                   require_kind="pallas_packed_tb",
+                                   stats=tb_sh_stats,
+                                   topology=tuple(tb_sh_topo))
+            tb_sh_n = n
+        except Exception as e:
+            print(f"stage3d tb sharded {n} failed: {e!r:.300}",
+                  file=sys.stderr, flush=True)
+    else:
+        tb_sh_note = (f"sharded-tb stage needs >=8 chips on a TPU "
+                      f"window (have {jax.device_count()} "
+                      f"{platform} device(s))")
     # Stage 4: float32x2 on the packed-ds kernel (round 5) — the
     # accuracy mode's throughput (96 B/cell pair traffic + ~10x EFT
     # flops; ops/pallas_packed_ds.py). Smaller grids than f32: the
@@ -719,6 +752,13 @@ def run_measurement() -> None:
         "tb_n": tb_n,
         "tb_bf16_mcells": round(tb_bf16_mc, 1),
         "tb_bf16_n": tb_bf16_n,
+        # round-11 sharded temporal-blocked kernel (depth-2 halo
+        # pipeline on the reference (2,2,2) decomposition): its own
+        # keys feed perf_sentinel's f32_packed_tb_sharded path and the
+        # multichip lane below
+        "tb_sharded_mcells": round(tb_sh_mc, 1),
+        "tb_sharded_n": tb_sh_n,
+        "tb_sharded_topology": tb_sh_topo,
         "float32x2_mcells": round(ds_mc, 1),
         "float32x2_n": ds_n,
         "hbm_probe_gbps": gbps,
@@ -736,6 +776,7 @@ def run_measurement() -> None:
                         (("jnp", jnp_stats), ("f32", f32_stats),
                          ("bf16", bf16_stats), ("f32_tb", tb_stats),
                          ("bf16_tb", tb_bf16_stats),
+                         ("f32_tb_sharded", tb_sh_stats),
                          ("float32x2", ds_stats))
                         if v},
         # Per-dtype accuracy class: the RECORDED frontier measurements
@@ -813,6 +854,8 @@ def run_measurement() -> None:
     try:
         out["multichip"] = _comm_observability(
             telemetry_path=os.environ.get("FDTD3D_BENCH_TELEMETRY"))
+        if tb_sh_note:
+            out["multichip"]["tb_sharded_note"] = tb_sh_note
     except Exception as exc:  # never kill the bench
         out["multichip"] = {"error": str(exc)[:200]}
     # Perf-regression sentinel (round 7): every artifact carries its
@@ -853,14 +896,25 @@ def _comm_observability(telemetry_path=None, topology=(2, 2, 2),
     out = {"topology": list(topology)}
     try:
         from fdtd3d_tpu.config import PmlConfig, SimConfig
-        from fdtd3d_tpu.costs import halo_bytes_per_chip, \
-            halo_topology_table
+        from fdtd3d_tpu.costs import halo_topology_table
+        from fdtd3d_tpu.plan import comm_strategy, plan_for_topology
         cfg = SimConfig(scheme="3D", size=(n, n, n), time_steps=8,
                         dx=1e-3, courant_factor=0.5, wavelength=32e-3,
                         pml=PmlConfig(size=(10, 10, 10)))
         import math
+        # ONE plan build carries all three lanes (single-step model,
+        # round-11 depth-2/tb model, and the planner's strategy
+        # decision — what the sharded-tb stage above runs with)
+        p = plan_for_topology(cfg, topology)
         out["halo_bytes_per_chip_per_step"] = \
-            halo_bytes_per_chip(cfg, topology)
+            int(p.halo_bytes_per_step)
+        out["halo_bytes_per_chip_per_step_tb"] = \
+            int(p.halo_bytes_per_step_tb)
+        strat = comm_strategy(cfg, topology,
+                              step_kind="pallas_packed_tb",
+                              from_plan=p)
+        out["comm_strategy"] = strat.as_record() \
+            if strat is not None else None
         out["halo_topology_table"] = \
             halo_topology_table(cfg, math.prod(topology))
     except Exception as exc:

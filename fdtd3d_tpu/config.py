@@ -81,6 +81,13 @@ ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
           ".py). Default: the kernel's physical-VMEM model; the "
           "runtime ladder (sim._vmem_fallback) shrinks on compile "
           "failure."),
+    _knob("FDTD3D_COMM_STRATEGY", "str", None,
+          "Override the planner's communication-strategy choice "
+          "(plan.comm_strategy): comma-separated tokens from "
+          "fused/per-plane (message split) and async/sync "
+          "(scheduling), e.g. 'per-plane,sync'. Default: the "
+          "deterministic cost-model choice, recorded in the ledger "
+          "comm lane and telemetry run_start."),
     _knob("FDTD3D_FAULT_PLAN", "str", None,
           "Deterministic fault-injection plan spec (fdtd3d_tpu/faults"
           ".py), e.g. 'nan@t=8,field=Ez; preempt@t=16'. Adopted once "

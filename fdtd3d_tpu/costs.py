@@ -11,7 +11,7 @@ every equation's flops and bytes to the named section
 E-update / H-update / cpml / halo-exchange / source / tfsf /
 packed-kernel / health / prepare. Deterministic on CPU, so tier-1
 asserts the attribution coverage (≥95% of per-step flops AND bytes)
-for all four step kinds (tests/test_costs.py).
+for every production step kind (tests/test_costs.py).
 
 Cost model (recorded in the ledger's ``model`` field):
 
@@ -80,10 +80,12 @@ STEP_KINDS = ("jnp", "pallas", "pallas_packed", "pallas_packed_tb",
               "pallas_packed_ds")
 
 # Kinds whose step supports a sharded (shard_map) trace — the comm
-# lane's acceptance surface. pallas_packed_tb is unsharded-only (the
-# two-plane ghost pipeline is ROADMAP open item 1).
+# lane's acceptance surface. pallas_packed_tb joined in round 11 (the
+# depth-2 halo pipeline; ROADMAP item 1): its exchange is modeled by
+# plan.halo_bytes_per_step_tb (two ghost-plane generations per
+# neighbor per pass) and traced byte-for-byte equal.
 SHARDED_STEP_KINDS = ("jnp", "pallas", "pallas_packed",
-                      "pallas_packed_ds")
+                      "pallas_packed_tb", "pallas_packed_ds")
 
 # Default aggregate per-chip ICI bandwidth assumption for the modeled
 # sync-vs-async overlap window (GB/s). A deliberate placeholder
@@ -383,13 +385,20 @@ def config_for_kind(kind: str, n: int = 16, pml: int = 3,
 # the comm model (ledger v2 lane)
 # --------------------------------------------------------------------------
 
-def halo_bytes_per_chip(cfg, topology) -> int:
+def halo_bytes_per_chip(cfg, topology,
+                        step_kind: Optional[str] = None) -> int:
     """THE modeled halo-bytes/chip/step number (single source of truth:
-    plan.py's curl-term accounting) for cfg on a forced topology.
+    plan.py's accounting) for cfg on a forced topology.
     tools/weak_scaling.py, bench.py and the ledger comm lane all quote
-    this; tests assert the traced jaxpr matches it."""
+    this; tests assert the traced jaxpr matches it. ``step_kind=
+    "pallas_packed_tb"`` selects the depth-2 (two ghost-plane
+    generations per neighbor per pass) model; every other kind uses
+    the single-step curl-term model."""
     from fdtd3d_tpu.plan import plan_for_topology
-    return int(plan_for_topology(cfg, topology).halo_bytes_per_step)
+    p = plan_for_topology(cfg, topology)
+    if step_kind == "pallas_packed_tb":
+        return int(p.halo_bytes_per_step_tb)
+    return int(p.halo_bytes_per_step)
 
 
 def halo_topology_table(cfg, n_chips: int) -> Dict[str, int]:
@@ -473,10 +482,12 @@ def check_overlap_artifact(art: Any) -> None:
 def _comm_lane(cfg, acc: _Acc, topo, n_chips: int,
                per_chip_step_bytes: float, hbm_gbps: Optional[float],
                ici_gbps: Optional[float],
-               overlap: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+               overlap: Optional[Dict[str, Any]],
+               step_kind: Optional[str] = None) -> Dict[str, Any]:
     """Assemble the ledger's `comm` table from the sharded-walk
-    accumulators + the plan.py model."""
-    from fdtd3d_tpu.plan import plan_for_topology
+    accumulators + the plan.py model (kind-aware: the temporal-blocked
+    kernel's depth-2 exchange is modeled by halo_bytes_per_step_tb)."""
+    from fdtd3d_tpu.plan import comm_strategy, plan_for_topology
 
     def _tbl(src: Dict[str, list]) -> Dict[str, Dict[str, float]]:
         return {k: {"bytes": b, "messages": m}
@@ -486,7 +497,10 @@ def _comm_lane(cfg, acc: _Acc, topo, n_chips: int,
     pp_msgs = sum(m for _, m in acc.comm_step.values())
     halo_b, _halo_m = acc.comm_step.get("halo-exchange", (0.0, 0.0))
     p = plan_for_topology(cfg, topo)
-    modeled = int(p.halo_bytes_per_step)
+    tb_kind = step_kind == "pallas_packed_tb"
+    modeled = int(p.halo_bytes_per_step_tb if tb_kind
+                  else p.halo_bytes_per_step)
+    strat = comm_strategy(cfg, topo, step_kind=step_kind, from_plan=p)
     comm: Dict[str, Any] = {
         "topology": list(topo),
         "n_chips": int(n_chips),
@@ -505,12 +519,18 @@ def _comm_lane(cfg, acc: _Acc, topo, n_chips: int,
         "collectives_per_step": _tbl(acc.coll_step),
         "plan": {
             "halo_bytes_per_chip_per_step": modeled,
-            "by_axis": p.halo_by_axis,
+            "by_axis": (p.halo_by_axis_tb if tb_kind
+                        else p.halo_by_axis),
             # the jnp stencil path ppermutes exactly the curl-term
-            # planes plan.py counts; kernel paths add thin patch-fix
-            # planes on top, so traced >= modeled there
+            # planes plan.py counts, and the tb path exactly its
+            # depth-2 model; the single-step kernel paths add thin
+            # patch-fix planes on top, so traced >= modeled there
             "traced_minus_modeled_bytes": pp_bytes - modeled,
         },
+        # the planned communication strategy for the TRACED kind (the
+        # autotuner's deterministic decision — ROADMAP item 1): what
+        # the tb step consumes and telemetry run_start echoes
+        "strategy": strat.as_record() if strat is not None else None,
         "topology_table": halo_topology_table(cfg, n_chips),
         # interior traffic = per-step bytes minus the halo planes the
         # byte walk already charged (they move on ICI, not HBM)
@@ -750,7 +770,8 @@ def chunk_ledger(cfg, n_steps: int = 8,
         for p_ in topo:
             n_chips *= p_
         ledger["comm"] = _comm_lane(cfg, acc, topo, n_chips, step_b,
-                                    gbps, ici_gbps, overlap)
+                                    gbps, ici_gbps, overlap,
+                                    step_kind=runner.kind)
     if gbps and gbps > 0:
         t_step = step_b / (gbps * 1e9)
         ledger["roofline"] = {
@@ -778,8 +799,8 @@ LEDGER_KEYS = frozenset((
     "per_chunk_sections", "per_step", "comm", "model", "roofline"))
 COMM_KEYS = frozenset((
     "topology", "n_chips", "per_step", "per_chunk",
-    "collectives_per_step", "plan", "topology_table", "overlap_model",
-    "async_windows"))
+    "collectives_per_step", "plan", "strategy", "topology_table",
+    "overlap_model", "async_windows"))
 
 
 def validate_ledger(led: Dict[str, Any]) -> None:
@@ -850,6 +871,16 @@ def validate_comm(comm: Optional[Dict[str, Any]]) -> None:
                          "missing")
     if not isinstance(comm.get("topology_table"), dict):
         raise ValueError("ledger.comm.topology_table missing")
+    # "strategy" (round 11): the planner's CommStrategy record.
+    # OPTIONAL so pre-round-11 v2 files keep validating; when present
+    # it must be an object (or null) with the split/schedule choice.
+    strat = comm.get("strategy")
+    if strat is not None:
+        if not isinstance(strat, dict):
+            raise ValueError("ledger.comm.strategy is not an object")
+        for key in ("split", "schedule", "ghost_depth", "step_kind"):
+            if key not in strat:
+                raise ValueError(f"ledger.comm.strategy.{key} missing")
 
 
 def _best_hbm_gbps() -> Optional[float]:
@@ -898,9 +929,15 @@ def main(argv=None) -> int:
                     help=f"aggregate per-chip ICI bandwidth for the "
                          f"modeled overlap window (default "
                          f"{ICI_GBPS_DEFAULT})")
-    ap.add_argument("--overlap", metavar="PATH", default=None,
+    ap.add_argument("--overlap", metavar="PATH", nargs="?",
+                    const=True, default=None,
                     help="tools/aot_overlap.py artifact JSON whose "
-                         "async window counts ride the comm lane")
+                         "async window counts ride the comm lane; "
+                         "bare --overlap (no PATH) just asks for the "
+                         "modeled overlap window + strategy decision "
+                         "(comm.overlap_model / comm.strategy) — the "
+                         "reproducible form of the planner's "
+                         "async-two-plane choice")
     ap.add_argument("--out", metavar="PATH", default=None,
                     help="also write the ledger JSON to PATH")
     args = ap.parse_args(argv)
@@ -922,9 +959,11 @@ def main(argv=None) -> int:
             ap.error("--overlap only rides the comm lane: pass "
                      "--topology too (the artifact embeds under "
                      "comm.async_windows)")
-        with open(args.overlap) as f:
-            overlap = json.load(f)
-        check_overlap_artifact(overlap)  # fail at ingest, not ship-time
+        if args.overlap is not True:  # bare --overlap: model only
+            with open(args.overlap) as f:
+                overlap = json.load(f)
+            # fail at ingest, not ship-time
+            check_overlap_artifact(overlap)
     gbps = args.hbm_gbps if args.hbm_gbps is not None else \
         _best_hbm_gbps()
     led = chunk_ledger(cfg, n_steps=args.steps, hbm_gbps=gbps, kind=kind,

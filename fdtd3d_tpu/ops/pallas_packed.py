@@ -136,6 +136,46 @@ from fdtd3d_tpu.telemetry import named as _named
 AXES = "xyz"
 
 
+def hi_edge_h_fix(new_E_arr, new_H_arr, static, coeffs, mesh_axes,
+                  mesh_shape, sharded_axes, local_dims, e_comps,
+                  h_comps, inv_dx, split: str = "fused"):
+    """Sharded hi-edge H fix, shared by the packed and temporal-blocked
+    steps: the kernels' forward diffs used the PEC zero ghost at each
+    local hi edge; on a sharded axis the true neighbor plane is the
+    UPPER neighbor's first new-E plane — ppermute it and add the
+    missing -db*s*E_next/dx contribution on the one edge plane (thin).
+    Interior-shard slab psi profiles are identity, so no psi term needs
+    fixing; at the global hi edge ppermute delivers zeros and the fix
+    vanishes (one SPMD program). ``split`` is the planned message split
+    (plan.CommStrategy; the exchange itself re-scopes to halo-exchange
+    — innermost wins in the cost ledger / trace parser)."""
+    import jax.numpy as _jnp
+
+    from fdtd3d_tpu.ops import stencil as _stencil
+    with _named("H-update"):
+        for a in sharded_axes:
+            name = mesh_axes[a]
+            n_sh = mesh_shape[name]
+            n_a = local_dims[a]
+            first = lax.slice_in_dim(new_E_arr, 0, 1, axis=1 + a)
+            nxt = _stencil.exchange_stack(first, name, n_sh,
+                                          downstream=False, split=split)
+            for jc, c in enumerate(h_comps):
+                for (aa, jd, sg) in CURL_TERMS[component_axis(c)]:
+                    if aa != a or ("E" + AXES[jd]) not in e_comps:
+                        continue
+                    db = coeffs[f"db_{c}"]
+                    sl = [slice(None)] * 3
+                    sl[a] = slice(n_a - 1, n_a)
+                    if _jnp.ndim(db) == 3:
+                        db = db[tuple(sl)]
+                    delta = (-db * sg * inv_dx) * \
+                        nxt[jd].astype(static.compute_dtype)
+                    new_H_arr = new_H_arr.at[(jc,) + tuple(sl)].add(
+                        delta.astype(new_H_arr.dtype))
+    return new_H_arr
+
+
 def _sources_interior(static) -> bool:
     """True iff every TFSF E-correction plane and the point source sit,
     with a one-plane guard for the H-correction curls, strictly inside
@@ -1224,40 +1264,10 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None,
                                                     coeffs, t,
                                                     collect=patches)
 
-        # ---- sharded hi-edge H fix -----------------------------------
-        # the kernel's forward diffs used the PEC zero ghost at each
-        # local hi edge; on a sharded axis the true neighbor plane is
-        # the UPPER neighbor's first new-E plane — ppermute it and add
-        # the missing -db*s*E_next/dx contribution on the one edge
-        # plane (thin). Interior-shard slab psi profiles are identity,
-        # so no psi term needs fixing; at the global hi edge ppermute
-        # delivers zeros and the fix vanishes (one SPMD program).
-        # scope note (comm-lane attribution): the fix is H-update work;
-        # the ppermute itself re-scopes to halo-exchange (innermost
-        # wins in the cost ledger / trace parser)
-        with _named("H-update"):
-            for a in sharded_axes:
-                name = mesh_axes[a]
-                n_sh = mesh_shape[name]
-                n_a = (n1, n2, n3)[a]
-                first = lax.slice_in_dim(new_E_arr, 0, 1, axis=1 + a)
-                with _named("halo-exchange"):
-                    nxt = lax.ppermute(first, name,
-                                       [(r + 1, r)
-                                        for r in range(n_sh - 1)])
-                for jc, c in enumerate(h_comps):
-                    for (aa, jd, sg) in CURL_TERMS[component_axis(c)]:
-                        if aa != a or ("E" + AXES[jd]) not in e_comps:
-                            continue
-                        db = coeffs[f"db_{c}"]
-                        sl = [slice(None)] * 3
-                        sl[a] = slice(n_a - 1, n_a)
-                        if jnp.ndim(db) == 3:
-                            db = db[tuple(sl)]
-                        delta = (-db * sg * inv_dx) * \
-                            nxt[jd].astype(static.compute_dtype)
-                        new_H_arr = new_H_arr.at[(jc,) + tuple(sl)].add(
-                            delta.astype(new_H_arr.dtype))
+        # ---- sharded hi-edge H fix (shared helper, see its doc) ------
+        new_H_arr = hi_edge_h_fix(
+            new_E_arr, new_H_arr, static, coeffs, mesh_axes, mesh_shape,
+            sharded_axes, (n1, n2, n3), e_comps, h_comps, inv_dx)
 
         # ---- H corrections for the E patches -------------------------
         hview = PackedView(new_H_arr, h_comps)

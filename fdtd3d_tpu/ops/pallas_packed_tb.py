@@ -50,14 +50,51 @@ injection, keeping the fused-x argument intact. TFSF is out of scope
 (the incident-line machinery has no in-kernel port yet) and falls back
 to ``pallas_packed``.
 
+**Sharded (round 11): the depth-2 halo pipeline.** Two Yee steps per
+pass need TWO ghost-plane generations per neighbor per axis, and the
+intermediate generation t+1 never touches HBM — so the exchange is a
+four-message schedule per sharded axis per pass, every message a full
+component stack at field dtype, all BEFORE (or thin-fix AFTER) the one
+kernel dispatch:
+
+  1. ``ghost_H0``  — H(t) boundary stack, downstream (phase A's lo
+     ghost, exactly the single-step kernel's ``xgh``/``ygh``);
+  2. ``hi_E1``     — E(t+1) first-plane stack, upstream: computed by a
+     THIN jnp pre-pass on the boundary planes only (same arithmetic as
+     the jnp step, CPML slab/fused-x psi terms included, source term
+     included; cross-axis halo lines slice from the other axes'
+     already-received full ghost planes, so NO corner messages exist);
+     phase B consumes it as its hi ghost, making H(t+1) exact in-kernel
+     including the shard edges;
+  3. ``ghost_H1``  — H(t+1) boundary stack, downstream: the same thin
+     pre-pass advances the boundary H plane one step (its forward
+     diffs read hi_E1); phase C's lo ghost;
+  4. E(t+2) first-plane stack, upstream, AFTER the kernel: phase D's
+     hi edge keeps the zero ghost in-kernel and the missing
+     -db*s*E/dx contribution lands as the single-step kernel's thin
+     post-fix (``pallas_packed.hi_edge_h_fix`` — interior-shard slab
+     psi profiles are identity, so no psi term needs fixing).
+
+Per step that is (ne + nh) component planes per sharded axis — the
+SAME ICI traffic as the single-step kernel at HALF the HBM traffic;
+``plan.Plan.halo_bytes_per_step_tb`` models it to the byte and the
+ledger comm lane's sharded tb trace equals it (tests/test_comm_
+costs.py). Message split (fused stack vs per-plane) and sync-vs-async
+scheduling follow the planned ``plan.CommStrategy`` (the
+communication-strategy autotuner; ``FDTD3D_COMM_STRATEGY``
+overrides). The drain-edge ring reads mask against this two-deep
+ghost region: the i==0 phase-A and i==2 phase-C lo edges read the
+exchanged generation ghosts instead of the PEC zero, and the
+i==ntiles phase-B hi edge reads ``hi_E1``.
+
 Scope (everything else falls back to ops/pallas_packed.py): 3D, real
-f32/bf16 storage, UNSHARDED (two steps per pass need two ghost planes
-per neighbor — a halo-depth change left for a later round),
-slab-fitting CPML on any axes, scalar material coefficients only (a
-material grid would need each coefficient streamed at two tile lags;
-fall back), no Drude/metamaterial ADE, no compensated mode, no
-double-single. ``FDTD3D_NO_TEMPORAL=1`` is the escape hatch that
-forces the round-6 kernel bit-for-bit (solver.make_step).
+f32/bf16 storage, sharded or not (sharded axes need mesh axis names —
+the packed kernel's own gate), slab-fitting CPML on any axes, scalar
+material coefficients only (a material grid would need each
+coefficient streamed at two tile lags; fall back), no
+Drude/metamaterial ADE, no compensated mode, no double-single.
+``FDTD3D_NO_TEMPORAL=1`` is the escape hatch that forces the round-6
+kernel bit-for-bit (solver.make_step).
 
 The step object advances TWO steps per call: ``step.steps_per_call ==
 2`` and ``step.tail_step`` is a single-step ``pallas_packed`` step
@@ -123,8 +160,11 @@ def eligible(static, mesh_axes=None) -> bool:
     advance two exact steps for in one pass."""
     if not _pk.eligible(static, mesh_axes):
         return False
-    if static.topology != (1, 1, 1):
-        return False          # two-step halos need depth-2 ghosts
+    # sharded topologies are IN scope (round 11): the depth-2 halo
+    # pipeline exchanges two ghost-plane generations per neighbor per
+    # pass (module docstring); _pk.eligible already requires mesh axis
+    # names for every sharded axis and _sources_interior for sourced
+    # sharded runs
     if static.use_drude or static.use_drude_m:
         return False          # ADE currents: not temporally blocked
     if static.cfg.compensated:
@@ -152,7 +192,25 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
     x_pml = 0 in static.pml_axes
 
     mode = static.mode
-    n1, n2, n3 = static.grid_shape
+    topo = static.topology
+    mesh_axes = mesh_axes or {}
+    mesh_shape = mesh_shape or {}
+    sharded_axes = tuple(a for a in range(3) if topo[a] > 1)
+    yz_sharded = tuple(a for a in sharded_axes if a != 0)
+    # all kernel dims are the per-shard LOCAL extents
+    n1, n2, n3 = (static.grid_shape[a] // topo[a] for a in range(3))
+    ldims = (n1, n2, n3)
+    # the planned communication strategy (module docstring): message
+    # split + schedule for the depth-2 exchange; deterministic per
+    # (grid, topology, dtype, kind), FDTD3D_COMM_STRATEGY overrides
+    if sharded_axes:
+        from fdtd3d_tpu.plan import comm_strategy as _strategy_for
+        _strat = _strategy_for(static.cfg, topo,
+                               step_kind="pallas_packed_tb")
+        split = _strat.split
+        sync_sched = _strat.schedule == "sync"
+    else:
+        split, sync_sched = "fused", False
     inv_dx = np.float32(1.0 / static.dx)
     fdt = jnp.float32
     fst = static.field_dtype
@@ -214,9 +272,16 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
             total += 4 * 3 * t * 4                 # prof_ex(2)/prof_hx(2)
         for a in psi_axes_e + psi_axes_h:
             total += 3 * 2 * slabs[a] * 4          # y/z profile packs
+        if 0 in sharded_axes:                      # xgh0 + xgh1 + xe1
+            total += (2 * nh + ne) * plane * fbytes
+        for a in yz_sharded:                       # ygh0/ygh1/ye1
+            total += (2 * nh + ne) * t \
+                * (plane // (n2, n3)[a - 1]) * fbytes
         total += (2 * t + n2 + n3) * 4             # walls (x twice)
         if src_on:
             total += 2 * 4                         # waveform pair
+            if sharded_axes:
+                total += 3 * 4                     # srcpos
         return total
 
     def _scratch_bytes(t: int) -> int:
@@ -284,8 +349,16 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         take([f"prof_h_{a}" for a in psi_axes_h])
         if fuse_x:
             take(["prof_ex", "prof_ex2", "prof_hx", "prof_hx2"])
+        # depth-2 generation ghosts (module docstring): H(t) and
+        # H(t+1) lo stacks, E(t+1) hi stack, per sharded axis
+        if 0 in sharded_axes:
+            take(["xgh0", "xgh1", "xe1"])
+        for a in yz_sharded:
+            take([f"ygh0{a}", f"ygh1{a}", f"ye1{a}"])
         if src_on:
             take(["src"])
+            if sharded_axes:
+                take(["srcpos"])
         take(["wall_x", "wall_x2", "wall_y", "wall_z"])
         take(["e_out", "h_out"])
         take([f"psE{a}_out" for a in psi_axes_e])
@@ -320,15 +393,20 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
             in_xslab_c = in_slab(tl2)
             in_xslab_d = in_slab(tl3)
 
-        def yz_diff(f, axis, backward):
-            zero = jnp.zeros_like(lax.slice_in_dim(f, 0, 1, axis=axis))
+        def yz_diff(f, axis, backward, ghost=None):
+            # ghost: the sharded-axis neighbor plane (backward: the lo
+            # ghost; forward: the hi ghost). None = the PEC zero ghost
+            # (unsharded axes, and phase D's hi edge — post-fixed).
+            if ghost is None:
+                ghost = jnp.zeros_like(
+                    lax.slice_in_dim(f, 0, 1, axis=axis))
             if backward:
                 body = lax.slice_in_dim(f, 0, f.shape[axis] - 1,
                                         axis=axis)
-                return (f - jnp.concatenate([zero, body],
+                return (f - jnp.concatenate([ghost, body],
                                             axis=axis)) * inv_dx
             body = lax.slice_in_dim(f, 1, f.shape[axis], axis=axis)
-            return (jnp.concatenate([body, zero], axis=axis) - f) \
+            return (jnp.concatenate([body, ghost], axis=axis) - f) \
                 * inv_dx
 
         def slab_term(dfa, psi, tag, a, s):
@@ -357,10 +435,18 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
 
         def src_term(c, tile_lo, step_j):
             """In-kernel point source: amplitude*waveform at the right
-            tile offset (module docstring); zero off-component."""
+            tile offset (module docstring); zero off-component. Under
+            sharding the LOCAL position rides as a traced srcpos
+            operand (global minus the shard offset — off-shard local
+            coordinates fall outside the iota range, so the mask is
+            identically zero there and no ownership flag is needed)."""
             if not src_on or c != ps.component:
                 return None
-            px, py, pz = src_pos
+            if sharded_axes:
+                sp = idx["srcpos"]
+                px, py, pz = sp[0, 0, 0], sp[1, 0, 0], sp[2, 0, 0]
+            else:
+                px, py, pz = src_pos
             gx = lax.broadcasted_iota(jnp.int32, (T, n2, n3), 0) \
                 + tile_lo * T
             gy = lax.broadcasted_iota(jnp.int32, (T, n2, n3), 1)
@@ -378,9 +464,12 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
             return e
 
         def e_update(h_tiles, h_ghosts, e_old, psi_get, psx_get,
-                     prof_x_name, wall_x_name, tile_lo, step_j):
+                     prof_x_name, wall_x_name, tile_lo, step_j,
+                     yz_ghost=None):
             """One E-family update over one tile. Returns
-            (new e comps, {a: [new psi rows]}, [new x-psi rows])."""
+            (new e comps, {a: [new psi rows]}, [new x-psi rows]).
+            ``yz_ghost(a, jd)`` supplies the sharded y/z lo-ghost block
+            for this phase's tile (None on unsharded axes)."""
             new_psi: Dict[int, list] = {a: [None] * len(rows_e[a])
                                         for a in psi_axes_e}
             new_psx = [None] * kxe
@@ -401,7 +490,10 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                         else:
                             term = s * dfa
                     else:
-                        dfa = yz_diff(h_tiles[jd], a, backward=True)
+                        dfa = yz_diff(
+                            h_tiles[jd], a, backward=True,
+                            ghost=(yz_ghost(a, jd)
+                                   if yz_ghost is not None else None))
                         if a in slabs and a in static.pml_axes:
                             row = rows_e[a].index(c)
                             psi_new, term = slab_term(
@@ -419,8 +511,11 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
             return out, new_psi, new_psx
 
         def h_update(e_tiles, e_firsts, h_old, psi_get, psx_get,
-                     prof_x_name):
-            """One H-family update over one tile (dual of e_update)."""
+                     prof_x_name, yz_ghost=None):
+            """One H-family update over one tile (dual of e_update).
+            ``yz_ghost(a, jd)`` supplies the sharded y/z HI-ghost block
+            (the neighbor's E(t+1) boundary, phase B only — phase D
+            keeps the zero ghost and the thin post-fix)."""
             new_psi: Dict[int, list] = {a: [None] * len(rows_h[a])
                                         for a in psi_axes_h}
             new_psx = [None] * kxh
@@ -441,7 +536,10 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                         else:
                             term = s * dfa
                     else:
-                        dfa = yz_diff(e_tiles[jd], a, backward=False)
+                        dfa = yz_diff(
+                            e_tiles[jd], a, backward=False,
+                            ghost=(yz_ghost(a, jd)
+                                   if yz_ghost is not None else None))
                         if a in slabs and a in static.pml_axes:
                             row = rows_h[a].index(c)
                             psi_new, term = slab_term(
@@ -454,43 +552,74 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                            - coef(f"db_{c}") * acc)
             return out, new_psi, new_psx
 
+        # sharded y/z lo/hi ghost getters, one per consuming phase
+        # (block index maps track each phase's tile: tile_imap /
+        # lag2_imap / lag1_imap respectively)
+        if yz_sharded:
+            def ygh_a(a, jd):
+                return idx[f"ygh0{a}"][jd].astype(fdt) \
+                    if a in yz_sharded else None
+
+            def ygh_c(a, jd):
+                return idx[f"ygh1{a}"][jd].astype(fdt) \
+                    if a in yz_sharded else None
+
+            def ygh_b(a, jd):
+                return idx[f"ye1{a}"][jd].astype(fdt) \
+                    if a in yz_sharded else None
+        else:
+            ygh_a = ygh_c = ygh_b = None
+
         # ---- phase A: E(t+1) on tile i -------------------------------
         h_vals = [idx["h_in"][j].astype(fdt) for j in range(nh)]
         e_vals = [idx["e_in"][j].astype(fdt) for j in range(ne)]
+        # tile-0 lo x ghost: the x neighbor's ppermuted H(t) boundary
+        # plane when x is sharded (zeros at the global edge = PEC)
         gha = [jnp.where(i > 0, idx["sh0h"][j],
-                         jnp.zeros_like(idx["sh0h"][j]))
+                         idx["xgh0"][j].astype(fdt)
+                         if 0 in sharded_axes
+                         else jnp.zeros_like(idx["sh0h"][j]))
                for j in range(nh)]
         e1, psiE1, psxE1 = e_update(
             h_vals, gha, e_vals,
             lambda a, row: idx[f"psE{a}"][row].astype(fdt),
             (lambda row: idx["psxE"][row].astype(fdt)) if fuse_x
             else None,
-            "prof_ex", "wall_x", i, 0)
+            "prof_ex", "wall_x", i, 0, yz_ghost=ygh_a)
 
         # ---- phase B: H(t+1) on tile i-1 (ring scratch) --------------
         e1_prev = [idx["se1a"][j] for j in range(ne)]   # E1[i-1]
         h0_prev = [idx["sh0"][j] for j in range(nh)]    # H(t)[i-1]
+        # the last tile's hi x plane: the x neighbor's pre-pass E(t+1)
+        # boundary (xe1) when sharded, else the PEC zero — this is the
+        # drain-edge read masked against the two-deep ghost region
         firsts1 = [jnp.where(valid_a, e1[j][0:1],
-                             jnp.zeros_like(e1[j][0:1]))
+                             idx["xe1"][j].astype(fdt)
+                             if 0 in sharded_axes
+                             else jnp.zeros_like(e1[j][0:1]))
                    for j in range(ne)]
         h1, psiH1, psxH1 = h_update(
             e1_prev, firsts1, h0_prev,
             lambda a, row: idx[f"psH{a}"][row].astype(fdt),
             (lambda row: idx["psxH"][row].astype(fdt)) if fuse_x
             else None,
-            "prof_hx")
+            "prof_hx", yz_ghost=ygh_b)
 
         # ---- phase C: E(t+2) on tile i-2 -> HBM ----------------------
         e1_old = [idx["se1b"][j] for j in range(ne)]    # E1[i-2]
         h1_prev = [idx["sh1a"][j] for j in range(nh)]   # H1[i-2]
+        # tile-0 lo x ghost of the SECOND generation: the neighbor's
+        # pre-pass H(t+1) boundary plane (xgh1)
         ghc = [jnp.where(i > 2, idx["sh1b"][j][-1:],
-                         jnp.zeros_like(idx["sh1b"][j][-1:]))
+                         idx["xgh1"][j].astype(fdt)
+                         if 0 in sharded_axes
+                         else jnp.zeros_like(idx["sh1b"][j][-1:]))
                for j in range(nh)]
         e2, psiE2, psxE2 = e_update(
             h1_prev, ghc, e1_old,
             lambda a, row: idx[f"spe1b_{a}"][row],
             (lambda row: idx["sxe1b"][row]) if fuse_x else None,
-            "prof_ex2", "wall_x2", tl2, 1)
+            "prof_ex2", "wall_x2", tl2, 1, yz_ghost=ygh_c)
         for jc in range(ne):
             @pl.when(valid_c)
             def _(jc=jc):
@@ -632,9 +761,37 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                                 memory_space=pltpu.VMEM)
         in_specs += [prof_spec(tile_imap), prof_spec(lag2_imap),
                      prof_spec(lag1_imap), prof_spec(lag3_imap)]
+    # depth-2 generation ghosts: x ghosts are whole boundary planes
+    # (constant block), y/z ghosts are thin per-tile blocks whose index
+    # maps follow their consuming phase (A: tile, C: lag-2, B: lag-1)
+    if 0 in sharded_axes:
+        in_specs += [pl.BlockSpec((nh, 1, n2, n3),
+                                  lambda i: (0, 0, 0, 0),
+                                  memory_space=pltpu.VMEM),    # xgh0
+                     pl.BlockSpec((nh, 1, n2, n3),
+                                  lambda i: (0, 0, 0, 0),
+                                  memory_space=pltpu.VMEM),    # xgh1
+                     pl.BlockSpec((ne, 1, n2, n3),
+                                  lambda i: (0, 0, 0, 0),
+                                  memory_space=pltpu.VMEM)]    # xe1
+    for a in yz_sharded:
+        gh = [nh, T, n2, n3]
+        gh[1 + a] = 1
+        ge = [ne, T, n2, n3]
+        ge[1 + a] = 1
+        in_specs += [pl.BlockSpec(tuple(gh), tile_imap,
+                                  memory_space=pltpu.VMEM),    # ygh0
+                     pl.BlockSpec(tuple(gh), lag2_imap,
+                                  memory_space=pltpu.VMEM),    # ygh1
+                     pl.BlockSpec(tuple(ge), lag1_imap,
+                                  memory_space=pltpu.VMEM)]    # ye1
     if src_on:
         in_specs += [pl.BlockSpec((2, 1, 1), lambda i: (0, 0, 0),
                                   memory_space=pltpu.VMEM)]
+        if sharded_axes:
+            in_specs += [pl.BlockSpec((3, 1, 1),
+                                      lambda i: (0, 0, 0),
+                                      memory_space=pltpu.VMEM)]  # srcpos
     in_specs += [pl.BlockSpec((T, 1, 1),
                               lambda i: (jnp.minimum(i, ntiles - 1),
                                          0, 0),
@@ -714,8 +871,236 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
 
     # ---- the step (advances TWO steps) ----------------------------------
     from fdtd3d_tpu.ops.sources import waveform
+    from fdtd3d_tpu.ops import stencil as _stencil
 
     prepare = tail.prepare
+
+    def _coefv(key):
+        return fdt(float(np_coeffs[key]))
+
+    # ---- depth-2 halo pre-pass (sharded only; module docstring) ---------
+    # Thin jnp computations of the boundary-plane generations the
+    # kernel cannot reach: E(t+1) on each sharded axis's first/last
+    # planes (exact — CPML slab and fused-x psi terms included, source
+    # included, walls applied) and H(t+1) on the last plane. The psi
+    # recursions here are read-only scratch: the kernel recomputes
+    # psi(t+1)/psi(t+2) for the whole local domain.
+
+    def _plane_slab_term(dfa, psi, pr, ax, s):
+        """Kernel slab_term's value form on a plane array (compact
+        2m-psi along ax; pr = prepared (3, ...) profile stack)."""
+        m = slabs[ax]
+        b, cc_, ik = pr[0], pr[1], pr[2]
+        cut = lambda f, lo, hi: lax.slice_in_dim(f, lo, hi, axis=ax)  # noqa: E731
+        nloc = dfa.shape[ax]
+        d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
+        p_lo = cut(b, 0, m) * cut(psi, 0, m) + cut(cc_, 0, m) * d_lo
+        p_hi = (cut(b, m, 2 * m) * cut(psi, m, 2 * m)
+                + cut(cc_, m, 2 * m) * d_hi)
+        dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
+        dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+        mid = list(dfa.shape)
+        mid[ax] = nloc - 2 * m
+        delta = jnp.concatenate([dl, jnp.zeros(mid, fdt), dh], axis=ax)
+        return s * dfa + delta
+
+    def _psx_plane(stack4, row, a, p):
+        """Full-length x-psi of one row at plane (a, p): the
+        tile-aligned compact storage re-expanded (zeros — identity
+        no-op — between the slab regions)."""
+        st = lax.slice_in_dim(stack4[row], p, p + 1, axis=a).astype(fdt)
+        if Sx == n1:
+            return st
+        lo = lax.slice_in_dim(st, 0, m0, axis=0)
+        hi = lax.slice_in_dim(st, Sx - m0, Sx, axis=0)
+        shape = list(st.shape)
+        shape[0] = n1 - 2 * m0
+        return jnp.concatenate([lo, jnp.zeros(shape, fdt), hi], axis=0)
+
+    def _own_axis_psi_term(pstate, cc, fam, a, p, c, dfa, s):
+        """Own-axis (plane-normal) psi term at boundary plane p: the
+        slab/fused-x recursion degenerates to one compact row."""
+        rows_fam = rows_e if fam == "e" else rows_h
+        rows_x = rows_x_e if fam == "e" else rows_x_h
+        psx_key = "psxE" if fam == "e" else "psxH"
+        if a == 0 and fuse_x:
+            row = rows_x.index(c)
+            srow = 0 if p == 0 else Sx - 1
+            psi_old = pstate[psx_key][row, srow:srow + 1].astype(fdt)
+            prx = cc[f"_pk_prof_{fam}x"]
+            cutp = lambda v: lax.slice_in_dim(v, p, p + 1, axis=0)  # noqa: E731
+            psi_new = cutp(prx[0]) * psi_old + cutp(prx[1]) * dfa
+            return s * (cutp(prx[2]) * dfa + psi_new)
+        if a in slabs and a in static.pml_axes:
+            stk = "psE" if fam == "e" else "psH"
+            row = rows_fam[a].index(c)
+            rr = 0 if p == 0 else 2 * slabs[a] - 1
+            psi_old = lax.slice_in_dim(pstate[f"{stk}{a}"][row],
+                                       rr, rr + 1, axis=a).astype(fdt)
+            pr = cc[f"_pk_prof_{fam}{a}"]
+            cutr = lambda v: lax.slice_in_dim(v, rr, rr + 1, axis=a)  # noqa: E731
+            psi_new = cutr(pr[0]) * psi_old + cutr(pr[1]) * dfa
+            return s * (cutr(pr[2]) * dfa + psi_new)
+        return s * dfa
+
+    def _cross_axis_term(pstate, cc, fam, a, p, c, ax, dfa, s):
+        """Cross-axis psi term on a boundary plane of axis a."""
+        if ax == 0 and fuse_x:
+            rows_x = rows_x_e if fam == "e" else rows_x_h
+            psx_key = "psxE" if fam == "e" else "psxH"
+            row = rows_x.index(c)
+            psi_old = _psx_plane(pstate[psx_key], row, a, p)
+            prx = cc[f"_pk_prof_{fam}x"]
+            psi_new = prx[0] * psi_old + prx[1] * dfa
+            return s * (prx[2] * dfa + psi_new)
+        if ax in slabs and ax in static.pml_axes:
+            rows_fam = rows_e if fam == "e" else rows_h
+            stk = "psE" if fam == "e" else "psH"
+            row = rows_fam[ax].index(c)
+            psi_old = lax.slice_in_dim(pstate[f"{stk}{ax}"][row],
+                                       p, p + 1, axis=a).astype(fdt)
+            return _plane_slab_term(dfa, psi_old,
+                                    cc[f"_pk_prof_{fam}{ax}"], ax, s)
+        return s * dfa
+
+    def _shard_offsets():
+        offs = []
+        for a in range(3):
+            if topo[a] > 1:
+                offs.append(lax.axis_index(mesh_axes[a])
+                            * jnp.int32(ldims[a]))
+            else:
+                offs.append(jnp.int32(0))
+        return offs
+
+    def _e1_plane(pstate, cc, a, p, gh0, offs, t):
+        """E(t+1) comps on boundary plane p of sharded axis a (f32)."""
+        E_arr, H_arr = pstate["E"], pstate["H"]
+        hpl = [lax.slice_in_dim(H_arr[jd], p, p + 1, axis=a).astype(fdt)
+               for jd in range(nh)]
+        out = []
+        for jc, c in enumerate(e_comps):
+            acc = None
+            for (ax, jd, s) in CURL_TERMS[component_axis(c)]:
+                if ax == a:
+                    if p > 0:
+                        prev = lax.slice_in_dim(
+                            H_arr[jd], p - 1, p, axis=a).astype(fdt)
+                    else:
+                        prev = gh0[a][jd].astype(fdt)
+                    dfa = (hpl[jd] - prev) * inv_dx
+                    term = _own_axis_psi_term(pstate, cc, "e", a, p, c,
+                                              dfa, s)
+                else:
+                    f = hpl[jd]
+                    if ax in sharded_axes:
+                        gl = lax.slice_in_dim(gh0[ax][jd], p, p + 1,
+                                              axis=a).astype(fdt)
+                    else:
+                        gl = jnp.zeros_like(
+                            lax.slice_in_dim(f, 0, 1, axis=ax))
+                    body = lax.slice_in_dim(f, 0, f.shape[ax] - 1,
+                                            axis=ax)
+                    dfa = (f - jnp.concatenate([gl, body], axis=ax)) \
+                        * inv_dx
+                    term = _cross_axis_term(pstate, cc, "e", a, p, c,
+                                            ax, dfa, s)
+                acc = term if acc is None else acc + term
+            if src_on and c == ps.component:
+                with _named("source"):
+                    wf = waveform(ps.waveform, t, 0.5, static.omega,
+                                  static.dt, np.float32)
+                    m_ = None
+                    for b in range(3):
+                        gi = lax.broadcasted_iota(
+                            jnp.int32, acc.shape, b) + offs[b] \
+                            + jnp.int32(p if b == a else 0)
+                        mb = gi == jnp.int32(ps.position[b])
+                        m_ = mb if m_ is None else (m_ & mb)
+                    acc = acc + np.float32(ps.amplitude) * wf \
+                        * m_.astype(fdt)
+            e_old = lax.slice_in_dim(E_arr[jc], p, p + 1,
+                                     axis=a).astype(fdt)
+            e = _coefv(f"ca_{c}") * e_old + _coefv(f"cb_{c}") * acc
+            ca_ax = component_axis(c)
+            for b in range(3):
+                if b == ca_ax:
+                    continue
+                w = cc[f"_pk_wall_{AXES[b]}"].astype(fdt)
+                if b == a:
+                    w = lax.slice_in_dim(w, p, p + 1, axis=b)
+                e = e * w
+            out.append(e)
+        return out
+
+    def _h1_plane(pstate, cc, a, e1_last, hi_e1):
+        """H(t+1) comps on the LAST plane of sharded axis a (f32): the
+        forward diffs read the received neighbor E(t+1) stack."""
+        H_arr = pstate["H"]
+        p = ldims[a] - 1
+        out = []
+        for jc, c in enumerate(h_comps):
+            acc = None
+            for (ax, jd, s) in CURL_TERMS[component_axis(c)]:
+                f = e1_last[jd]
+                if ax == a:
+                    dfa = (hi_e1[a][jd].astype(fdt) - f) * inv_dx
+                    term = _own_axis_psi_term(pstate, cc, "h", a, p, c,
+                                              dfa, s)
+                else:
+                    if ax in sharded_axes:
+                        gl = lax.slice_in_dim(hi_e1[ax][jd], p, p + 1,
+                                              axis=a).astype(fdt)
+                    else:
+                        gl = jnp.zeros_like(
+                            lax.slice_in_dim(f, 0, 1, axis=ax))
+                    body = lax.slice_in_dim(f, 1, f.shape[ax], axis=ax)
+                    dfa = (jnp.concatenate([body, gl], axis=ax) - f) \
+                        * inv_dx
+                    term = _cross_axis_term(pstate, cc, "h", a, p, c,
+                                            ax, dfa, s)
+                acc = term if acc is None else acc + term
+            h_old = lax.slice_in_dim(H_arr[jc], p, p + 1,
+                                     axis=a).astype(fdt)
+            out.append(_coefv(f"da_{c}") * h_old
+                       - _coefv(f"db_{c}") * acc)
+        return out
+
+    def _exchange_ghosts(pstate, cc, t):
+        """The four-message depth-2 exchange schedule (module
+        docstring): returns the kernel's ghost operands, every
+        ppermute scoped halo-exchange and split per the planned
+        CommStrategy."""
+        H_arr = pstate["H"]
+        gh0, hi_e1, gh1 = {}, {}, {}
+        for a in sharded_axes:
+            name, n_sh = mesh_axes[a], mesh_shape[mesh_axes[a]]
+            plane = lax.slice_in_dim(H_arr, ldims[a] - 1, ldims[a],
+                                     axis=1 + a)
+            gh0[a] = _stencil.exchange_stack(plane, name, n_sh,
+                                             downstream=True,
+                                             split=split)
+        offs = _shard_offsets()
+        with _named("E-update"):
+            e1_first = {a: _e1_plane(pstate, cc, a, 0, gh0, offs, t)
+                        for a in sharded_axes}
+            e1_last = {a: _e1_plane(pstate, cc, a, ldims[a] - 1, gh0,
+                                    offs, t)
+                       for a in sharded_axes}
+        for a in sharded_axes:
+            name, n_sh = mesh_axes[a], mesh_shape[mesh_axes[a]]
+            hi_e1[a] = _stencil.exchange_stack(
+                jnp.stack(e1_first[a]).astype(fst), name, n_sh,
+                downstream=False, split=split)
+        with _named("H-update"):
+            h1_last = {a: _h1_plane(pstate, cc, a, e1_last[a], hi_e1)
+                       for a in sharded_axes}
+        for a in sharded_axes:
+            name, n_sh = mesh_axes[a], mesh_shape[mesh_axes[a]]
+            gh1[a] = _stencil.exchange_stack(
+                jnp.stack(h1_last[a]).astype(fst), name, n_sh,
+                downstream=True, split=split)
+        return gh0, gh1, hi_e1, offs
 
     def step(pstate, coeffs):
         if "_pk_wall_x" not in coeffs:
@@ -724,6 +1109,9 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
             coeffs = prepare(coeffs)
         t = pstate["t"]
         new_state = dict(pstate)
+        offs = None
+        if sharded_axes:
+            gh0, gh1, hi_e1, offs = _exchange_ghosts(pstate, coeffs, t)
         args = [pstate["E"], pstate["H"]]
         args += [pstate[f"psE{a}"] for a in psi_axes_e]
         args += [pstate[f"psH{a}"] for a in psi_axes_h]
@@ -734,6 +1122,10 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         if fuse_x:
             args += [coeffs["_pk_prof_ex"], coeffs["_pk_prof_ex"],
                      coeffs["_pk_prof_hx"], coeffs["_pk_prof_hx"]]
+        if 0 in sharded_axes:
+            args += [gh0[0], gh1[0], hi_e1[0]]
+        for a in yz_sharded:
+            args += [gh0[a], gh1[a], hi_e1[a]]
         if src_on:
             with _named("source"):
                 wf = jnp.stack([
@@ -743,8 +1135,18 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                              static.dt, np.float32)])
                 args += [(np.float32(ps.amplitude)
                           * wf).reshape(2, 1, 1)]
+                if sharded_axes:
+                    args += [jnp.stack(
+                        [jnp.int32(src_pos[k]) - offs[k]
+                         for k in range(3)]).reshape(3, 1, 1)]
         args += [coeffs["_pk_wall_x"], coeffs["_pk_wall_x"],
                  coeffs["_pk_wall_y"], coeffs["_pk_wall_z"]]
+        if sync_sched:
+            # planned "sync" schedule (plan.CommStrategy): pin the
+            # exchange results before the kernel so the scheduler
+            # cannot overlap them with compute — the measurement A/B
+            # posture the sentinel's async-window gates compare
+            args = list(lax.optimization_barrier(tuple(args)))
         with _named("packed-kernel-tb"):
             outs = call(*args)
         p = 0
@@ -757,6 +1159,14 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         if fuse_x:
             new_state["psxE"] = outs[p]; p += 1
             new_state["psxH"] = outs[p]; p += 1
+        if sharded_axes:
+            # phase D kept the PEC zero hi ghost for E(t+2): add the
+            # neighbor's first-plane contribution as the single-step
+            # kernel's thin post-fix (the fourth exchange message)
+            new_state["H"] = _pk.hi_edge_h_fix(
+                new_state["E"], new_state["H"], static, coeffs,
+                mesh_axes, mesh_shape, sharded_axes, ldims, e_comps,
+                h_comps, inv_dx, split=split)
         new_state["t"] = t + 2
         return new_state
 
@@ -771,4 +1181,6 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                  "temporal_block": 2,
                  "vmem_block_bytes": {"EH": _block_bytes(T)},
                  "vmem_scratch_bytes": _scratch_bytes(T)}
+    if sharded_axes:
+        step.diag["comm_strategy"] = _strat.as_record()
     return step

@@ -39,7 +39,41 @@ MeshAxes = Dict[int, Optional[str]]
 # difference/shift closures and the halo helpers run inside every
 # traced step — host calls are banned in them (fdtd3d_tpu/analysis/).
 GRAPH_SAFE_FNS = ("diff_b", "diff_f", "shift_b", "shift_f",
-                  "_neighbor_plane", "_pad_plane", "_pad_to_extent")
+                  "_neighbor_plane", "_pad_plane", "_pad_to_extent",
+                  "exchange_stack")
+
+
+def exchange_stack(stack: jnp.ndarray, axis_name: str, n_shards: int,
+                   downstream: bool, split: str = "fused"
+                   ) -> jnp.ndarray:
+    """Ship one stacked ghost-plane generation to the neighbor shard.
+
+    The depth-2 halo pipeline's exchange primitive (ops/
+    pallas_packed_tb.py): ``stack`` is a component-stacked boundary
+    plane ``(ncomp, ·, ·, ·)``; the result is the adjacent shard's
+    counterpart, zeros at the global edge (the PEC ghost —
+    ``_neighbor_plane``'s non-periodic convention). ``split`` is the
+    planned message split (plan.CommStrategy): "fused" sends the whole
+    stack as ONE ppermute; "per-plane" sends one ppermute per
+    component plane (same bytes, finer messages). Every ppermute is
+    scoped ``halo-exchange`` so the comm lane's attribution and the
+    scope-coverage lint rule see each message by name.
+    """
+    if downstream:
+        perm = [(i, i + 1) for i in range(n_shards - 1)]
+    else:
+        perm = [(i + 1, i) for i in range(n_shards - 1)]
+    from fdtd3d_tpu.telemetry import named
+    if split != "per-plane":
+        with named("halo-exchange"):
+            return lax.ppermute(stack, axis_name, perm)
+    rows = []
+    for j in range(stack.shape[0]):
+        with named("halo-exchange"):
+            rows.append(lax.ppermute(
+                lax.slice_in_dim(stack, j, j + 1, axis=0),
+                axis_name, perm))
+    return jnp.concatenate(rows, axis=0)
 
 
 def _neighbor_plane(plane: jnp.ndarray, axis_name: Optional[str],

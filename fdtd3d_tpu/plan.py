@@ -14,6 +14,7 @@ configs (1024^3 on 64 chips) can be validated on a laptop.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -23,6 +24,80 @@ from fdtd3d_tpu.layout import CURL_TERMS, component_axis
 from fdtd3d_tpu.parallel.mesh import resolve_topology
 
 AXES = "xyz"
+
+# Message-split crossover for the strategy chooser: below this
+# per-message stacked-plane size the exchange is latency/message-count
+# bound (fuse the component planes into ONE ppermute per generation);
+# above it, per-plane messages let the scheduler start the first
+# plane's send before the last is sliced. A modeling constant in the
+# same spirit as costs.ICI_GBPS_DEFAULT — override the whole choice
+# with FDTD3D_COMM_STRATEGY when a measured crossover exists.
+SPLIT_FUSE_MAX_BYTES = 4 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class CommStrategy:
+    """One planned halo-exchange strategy for a (grid, topology,
+    dtype, step kind) — the communication-strategy selection program
+    of PAPERS.md's 2606.06910 adapted to ICI ppermute: the planner
+    scores shard-axis assignment, message split and sync-vs-async
+    scheduling against the PR-6 cost model (costs.overlap_model /
+    halo_topology_table) and records ONE deterministic choice that the
+    temporal-blocked step consumes and every observability lane
+    (ledger comm table, telemetry run_start) echoes.
+
+    ``split``: "fused" = each ghost generation ships as ONE stacked
+    (ncomp, 1, ·, ·) ppermute per axis; "per-plane" = one ppermute per
+    component plane (same bytes, more/smaller messages).
+    ``schedule``: "async" places no ordering barrier between the
+    exchange and the kernel (XLA's latency-hiding scheduler overlaps
+    them — tools/aot_overlap.py proves the lowering); "sync" forces
+    the exchange to complete first via an optimization barrier (the
+    measurement A/B posture).
+    ``ghost_depth``: ghost-plane generations exchanged per pass —
+    2 for the temporal-blocked kernel (H(t)+H(t+1) down,
+    E(t+1)+E(t+2) up), 1 for single-step kinds.
+    """
+
+    step_kind: str
+    topology: Tuple[int, int, int]
+    shard_axes: Tuple[str, ...]      # axis letters carrying >1 shards
+    ghost_depth: int
+    split: str                       # "fused" | "per-plane"
+    schedule: str                    # "async" | "sync"
+    source: str                      # "model" | "env:FDTD3D_COMM_STRATEGY"
+    plane_bytes_max: int             # largest stacked message, bytes
+    # informational score, set only from an EXPLICIT hbm_gbps argument
+    # (never a process-global probe — the record is deterministic);
+    # the ledger's quantitative surface is comm.overlap_model
+    modeled_async_speedup: Optional[float]
+
+    def as_record(self) -> Dict[str, object]:
+        """JSON-ready dict (ledger comm lane / telemetry run_start)."""
+        d = dataclasses.asdict(self)
+        d["topology"] = list(self.topology)
+        d["shard_axes"] = list(self.shard_axes)
+        return d
+
+
+def _parse_strategy_env(value: str) -> Dict[str, str]:
+    """FDTD3D_COMM_STRATEGY: comma-separated tokens from
+    {fused, per-plane, async, sync}, e.g. "per-plane,sync" or just
+    "sync". Unknown tokens are a config error, not a silent default."""
+    out: Dict[str, str] = {}
+    for tok in value.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok in ("fused", "per-plane"):
+            out["split"] = tok
+        elif tok in ("async", "sync"):
+            out["schedule"] = tok
+        else:
+            raise ValueError(
+                f"FDTD3D_COMM_STRATEGY token {tok!r} not one of "
+                f"fused/per-plane/async/sync (comma-separated)")
+    return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +118,20 @@ class Plan:
     # sum of bytes_per_step over axes == halo_bytes_per_step.
     halo_by_axis: Dict[str, Dict[str, int]] = dataclasses.field(
         default_factory=dict)
+    # Temporal-blocked (depth-2) halo model (round 11): the tb kernel
+    # exchanges TWO ghost-plane generations per neighbor per pass —
+    # the full H stack at t and t+1 downstream, the full E stack at
+    # t+1 and t+2 upstream — so per STEP each sharded axis moves one
+    # nh-stack + one ne-stack (send+recv), at field dtype. The ledger's
+    # sharded tb trace equals this number to the byte
+    # (tests/test_comm_costs.py); invariant under weak scaling like
+    # the single-step model.
+    halo_bytes_per_step_tb: int = 0
+    halo_by_axis_tb: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    # The planned communication strategy for this decomposition
+    # (None when unsharded): see CommStrategy.
+    comm_strategy: Optional[CommStrategy] = None
 
     @property
     def hbm_per_chip(self) -> int:
@@ -65,6 +154,17 @@ class Plan:
             f"  halo exchange:       {self.halo_bytes_per_step / mib:8.3f}"
             f" MiB/chip/step",
         ]
+        if self.n_chips > 1:
+            lines.append(
+                f"  halo exchange (tb):  "
+                f"{self.halo_bytes_per_step_tb / mib:8.3f}"
+                f" MiB/chip/step (depth-2, two planes/neighbor/pass)")
+        if self.comm_strategy is not None:
+            s = self.comm_strategy
+            lines.append(
+                f"  comm strategy:       {s.split} + {s.schedule}, "
+                f"ghost depth {s.ghost_depth} ({s.step_kind}; "
+                f"source: {s.source})")
         return "\n".join(lines)
 
 
@@ -150,6 +250,10 @@ def plan(cfg, n_devices: int = 1) -> Plan:
     # crossing a sharded axis; each plane is sent AND received.
     halo = 0
     by_axis: Dict[str, Dict[str, int]] = {}
+    halo_tb = 0
+    by_axis_tb: Dict[str, Dict[str, int]] = {}
+    ne = len(mode.e_components)
+    nh = len(mode.h_components)
     for a in range(3):
         if topo[a] > 1:
             plane = cells // local[a] * fb
@@ -166,10 +270,31 @@ def plan(cfg, n_devices: int = 1) -> Plan:
                 "bytes_per_neighbor_per_step": planes * plane,
                 "bytes_per_step": axis_bytes,
             }
+            # tb (depth-2) model: per PASS (2 steps) each neighbor
+            # exchange carries TWO ghost planes — full component
+            # stacks of both generations (nh planes down at t and
+            # t+1, ne planes up at t+1 and t+2) — so per STEP the
+            # axis moves (nh + ne) component planes, each sent AND
+            # received (same accounting as the single-step model).
+            tb_planes = nh + ne
+            tb_axis_bytes = 2 * tb_planes * plane
+            halo_tb += tb_axis_bytes
+            by_axis_tb[AXES[a]] = {
+                "planes_per_step": tb_planes,
+                "plane_bytes": plane,
+                "bytes_per_neighbor_per_step": tb_planes * plane,
+                "bytes_per_step": tb_axis_bytes,
+            }
+    strat = None
+    if any(t > 1 for t in topo):
+        strat = _choose_strategy(static, topo, cells, local, fb,
+                                 halo, halo_tb)
     return Plan(topology=topo, local_shape=local, fields_bytes=fields,
                 psi_bytes=psi, drude_bytes=drude, inc_bytes=inc,
                 coeff_bytes=coeff, halo_bytes_per_step=halo,
-                n_chips=int(np.prod(topo)), halo_by_axis=by_axis)
+                n_chips=int(np.prod(topo)), halo_by_axis=by_axis,
+                halo_bytes_per_step_tb=halo_tb,
+                halo_by_axis_tb=by_axis_tb, comm_strategy=strat)
 
 
 def plan_for_topology(cfg, topology: Tuple[int, int, int]) -> Plan:
@@ -182,6 +307,112 @@ def plan_for_topology(cfg, topology: Tuple[int, int, int]) -> Plan:
         cfg, parallel=ParallelConfig(topology="manual",
                                      manual_topology=topology))
     return plan(cfg, n_devices=int(np.prod(topology)))
+
+
+def _infer_step_kind(static, topo) -> str:
+    """The best PRODUCTION kernel the config is in scope for — the
+    kind the strategy models when the caller does not pin one. Pure
+    eligibility checks (host math; no backend dispatch, so a CPU
+    planning session models the TPU production path)."""
+    from fdtd3d_tpu.parallel.mesh import mesh_axis_map
+    mesh_axes = mesh_axis_map(topo)
+    if static.cfg.ds_fields:
+        return "pallas_packed_ds"
+    from fdtd3d_tpu.ops import pallas_packed, pallas_packed_tb
+    if pallas_packed_tb.eligible(static, mesh_axes):
+        return "pallas_packed_tb"
+    if pallas_packed.eligible(static, mesh_axes):
+        return "pallas_packed"
+    return "jnp"
+
+
+def _choose_strategy(static, topo, cells: int,
+                     local: Tuple[int, int, int], fb: int,
+                     halo: int, halo_tb: int,
+                     forced_kind: Optional[str] = None,
+                     hbm_gbps: Optional[float] = None) -> CommStrategy:
+    """Score (split, schedule) for one decomposition — DETERMINISTIC
+    from its explicit inputs alone (no hidden process state: the same
+    (grid, topology, dtype, kind) always yields the same record, so
+    ledger / run_start / fixture comparisons hold field-for-field);
+    FDTD3D_COMM_STRATEGY overrides. ``forced_kind`` pins the kernel
+    the caller actually engaged: depth, halo model and scores are all
+    re-scored for it, so the record always describes the exchange it
+    claims to."""
+    mode = static.mode
+    step_kind = forced_kind or _infer_step_kind(static, topo)
+    depth = 2 if step_kind == "pallas_packed_tb" else 1
+    halo_bytes = halo_tb if depth == 2 else halo
+    stack = max(len(mode.e_components), len(mode.h_components))
+    plane_max = max((cells // local[a] * fb * stack
+                     for a in range(3) if topo[a] > 1), default=0)
+    split = "fused" if plane_max <= SPLIT_FUSE_MAX_BYTES \
+        else "per-plane"
+    # schedule: async — overlap costs nothing when comm is negligible
+    # and hides the exchange when it is not; "sync" is reachable ONLY
+    # via the env override (the measurement A/B posture the
+    # sentinel's window gates compare). modeled_async_speedup is an
+    # informational score computed only from an EXPLICITLY passed
+    # calibration (the ledger's quantitative surface is
+    # comm.overlap_model, which carries the full scored window) — a
+    # process-global probe here would make the "deterministic" record
+    # differ between a probed bench process and an unprobed CLI.
+    speedup = None
+    if hbm_gbps and hbm_gbps > 0:
+        from fdtd3d_tpu import costs
+        # fields read+write per step is the dominant HBM term; the tb
+        # kernel halves it (12 volumes per TWO steps)
+        fields_step = 2 * len(mode.components) * cells * fb / depth
+        om = costs.overlap_model(max(0.0, fields_step - halo_bytes),
+                                 halo_bytes, hbm_gbps)
+        if om is not None:
+            speedup = om["modeled_async_speedup"]
+    schedule = "async"
+    source = "model"
+    env = os.environ.get("FDTD3D_COMM_STRATEGY")
+    if env:
+        forced = _parse_strategy_env(env)
+        split = forced.get("split", split)
+        schedule = forced.get("schedule", schedule)
+        source = "env:FDTD3D_COMM_STRATEGY"
+    return CommStrategy(
+        step_kind=step_kind, topology=tuple(topo),
+        shard_axes=tuple(AXES[a] for a in range(3) if topo[a] > 1),
+        ghost_depth=depth, split=split, schedule=schedule,
+        source=source, plane_bytes_max=int(plane_max),
+        modeled_async_speedup=speedup)
+
+
+def comm_strategy(cfg, topology: Tuple[int, int, int],
+                  step_kind: Optional[str] = None,
+                  from_plan: Optional[Plan] = None
+                  ) -> Optional[CommStrategy]:
+    """THE strategy authority: the deterministic CommStrategy for cfg
+    on a forced decomposition (None when unsharded). ``step_kind``
+    pins the kernel the caller actually engaged (the ledger comm lane
+    and telemetry run_start record the RUNNING kind, which may differ
+    from the planner's best-eligible inference — e.g. a ledger forced
+    to the single-step kernel, or a supervisor degrade rung); the
+    whole choice is then RE-SCORED for that kind — depth, halo model
+    and schedule together, never a partially rewritten record.
+    ``from_plan`` reuses an already-computed Plan for the same (cfg,
+    topology) instead of building a second one."""
+    p = from_plan if from_plan is not None \
+        else plan_for_topology(cfg, topology)
+    strat = p.comm_strategy
+    if strat is None or step_kind is None \
+            or step_kind == strat.step_kind:
+        return strat
+    topo = tuple(int(t) for t in p.topology)
+    static = dataclasses.replace(solver.build_static(cfg),
+                                 topology=topo)
+    fb = np.dtype(static.field_dtype).itemsize
+    return _choose_strategy(static, topo,
+                            int(np.prod(p.local_shape)),
+                            p.local_shape, fb,
+                            p.halo_bytes_per_step,
+                            p.halo_bytes_per_step_tb,
+                            forced_kind=step_kind)
 
 
 # ---------------------------------------------------------------------------

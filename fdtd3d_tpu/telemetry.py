@@ -390,6 +390,28 @@ def provenance(sim=None) -> Dict[str, Any]:
         )
         if sim.step_diag:
             rec["tile"] = dict(sim.step_diag.get("tile") or {})
+        if tuple(sim.topology) != (1, 1, 1):
+            # the communication-strategy record (ROADMAP item 1), so a
+            # run's exchange posture is auditable from its telemetry
+            # alone. The record the STEP ACTUALLY CONSUMED at build
+            # time (step_diag, set by ops/pallas_packed_tb.py) wins —
+            # recomputing here would read the CURRENT env/process
+            # state, which may have changed since the kernel was
+            # built. Kinds that do not consume a strategy (jnp, the
+            # single-step kernels) record the planner's advisory
+            # decision for their kind instead.
+            strat_rec = (sim.step_diag or {}).get("comm_strategy")
+            if strat_rec is not None:
+                rec["comm_strategy"] = dict(strat_rec)
+            else:
+                try:
+                    from fdtd3d_tpu.plan import comm_strategy
+                    strat = comm_strategy(cfg, tuple(sim.topology),
+                                          step_kind=sim.step_kind)
+                    rec["comm_strategy"] = strat.as_record() \
+                        if strat is not None else None
+                except Exception:
+                    rec["comm_strategy"] = None
     return rec
 
 
@@ -484,7 +506,7 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # provenance() enriches run_start with the sim's identity when one
     # is attached (CLI/bench runs); header-only sinks omit them
     "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
-                  "vmem_rung", "tile"),
+                  "vmem_rung", "tile", "comm_strategy"),
     # tools/trace_attribution.py: host-span table, per-core straggler
     # lane (round 10), and the ledger echo keys
     "attribution": ("host_spans_ms", "per_core", "imbalance",

@@ -1,0 +1,32 @@
+"""Known-bad fixture for the scope-coverage rule, sharded-tb surface:
+a temporal-blocked-style GHOST GATHER — the stacked two-plane ppermute
+of the depth-2 halo pipeline — issued under the packed-kernel-tb
+family scope but WITHOUT its own halo-exchange scope. The rule's
+ppermute bar requires the halo-exchange scope SPECIFICALLY (an
+inherited outer scope is a mis-attributed exchange, not a scoped one),
+so the traced jaxpr must show one unscoped collective."""
+
+
+def build_unscoped_tb_gather_jaxpr():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from fdtd3d_tpu.parallel.mesh import shard_map_compat
+    from fdtd3d_tpu.telemetry import named
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+
+    def tb_ghost_gather(h):
+        # the depth-2 gather: two H-generation boundary planes stacked
+        # into one message — but the ppermute inherits the family
+        # scope instead of naming halo-exchange
+        with named("packed-kernel-tb"):
+            planes = jnp.concatenate([h[:, -1:], h[:, -2:-1]], axis=1)
+            return jax.lax.ppermute(planes, "x", [(0, 1)])
+
+    f = shard_map_compat(tb_ghost_gather, mesh, in_specs=(P(None, "x"),),
+                         out_specs=P(None, "x"))
+    return jax.make_jaxpr(f)(jnp.ones((3, 8, 4), jnp.float32))

@@ -217,6 +217,26 @@ def test_scope_coverage_fires_on_fixture():
             if x[0] == "ppermute"], colls
 
 
+def test_scope_coverage_fires_on_sharded_tb_fixture():
+    """ISSUE-10 satellite: the sharded-tb known-bad fixture — a
+    depth-2 ghost gather whose stacked two-plane ppermute inherits the
+    packed-kernel-tb family scope instead of naming halo-exchange —
+    must fire the rule (one unscoped ppermute, attributed to the
+    family scope)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bad_scope_tb", os.path.join(FIX, "bad_scope_tb.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from fdtd3d_tpu.analysis.graph_rules import (collect_collectives,
+                                                 unscoped_collectives)
+    colls = collect_collectives(
+        mod.build_unscoped_tb_gather_jaxpr().jaxpr)
+    bad = [x for x in unscoped_collectives(colls)
+           if x[0] == "ppermute"]
+    assert bad and bad[0][1] == "packed-kernel-tb", (colls, bad)
+
+
 def test_scope_coverage_rejects_inherited_outer_scope():
     """E2E-found regression: a ppermute that merely INHERITS an outer
     E-update scope (its own halo-exchange scope stripped) is a

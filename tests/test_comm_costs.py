@@ -56,14 +56,18 @@ def test_sharded_ledger_validates(sharded_ledgers, kind):
 @pytest.mark.parametrize("kind", costs.SHARDED_STEP_KINDS)
 def test_modeled_halo_matches_plan_exactly(sharded_ledgers, kind):
     """Acceptance: the comm lane's modeled halo-bytes/chip IS plan.py's
-    number, per topology — one source of truth, no drift possible."""
+    number, per topology — one source of truth, no drift possible.
+    The temporal-blocked kind quotes the depth-2 model
+    (halo_bytes_per_step_tb: two ghost-plane generations per neighbor
+    per pass), every other kind the single-step curl-term model."""
     comm = sharded_ledgers[kind]["comm"]
     p = plan_for_topology(_cfg(kind), TOPO)
-    assert comm["plan"]["halo_bytes_per_chip_per_step"] == \
-        p.halo_bytes_per_step
+    expect = p.halo_bytes_per_step_tb \
+        if kind == "pallas_packed_tb" else p.halo_bytes_per_step
+    assert comm["plan"]["halo_bytes_per_chip_per_step"] == expect
     # and the helper the tools quote agrees too
-    assert costs.halo_bytes_per_chip(_cfg(kind), TOPO) == \
-        p.halo_bytes_per_step
+    assert costs.halo_bytes_per_chip(_cfg(kind), TOPO,
+                                     step_kind=kind) == expect
 
 
 @pytest.mark.parametrize("kind", costs.SHARDED_STEP_KINDS)
@@ -92,6 +96,13 @@ def test_stencil_paths_trace_exactly_plan(sharded_ledgers):
         assert comm["per_step"]["ppermute_bytes_per_chip"] >= \
             comm["plan"]["halo_bytes_per_chip_per_step"], kind
         assert comm["plan"]["traced_minus_modeled_bytes"] >= 0
+    # the tb path's depth-2 exchange is modeled to the BYTE: the four
+    # generation stacks per axis per pass are the whole schedule (no
+    # patch-fix planes — sources ride in-kernel)
+    comm_tb = sharded_ledgers["pallas_packed_tb"]["comm"]
+    assert comm_tb["per_step"]["ppermute_bytes_per_chip"] == \
+        comm_tb["plan"]["halo_bytes_per_chip_per_step"]
+    assert comm_tb["plan"]["traced_minus_modeled_bytes"] == 0
 
 
 @pytest.mark.parametrize("kind", costs.SHARDED_STEP_KINDS)
@@ -101,6 +112,67 @@ def test_sharded_coverage_holds(sharded_ledgers, kind):
     ps = sharded_ledgers[kind]["per_step"]
     assert ps["coverage_flops"] >= 0.95
     assert ps["coverage_bytes"] >= 0.95
+
+
+def test_tb_sharded_roofline_moved(sharded_ledgers):
+    """ISSUE-10 acceptance, CPU-deterministic: on the SAME sharded
+    (2,2,2) config the temporal-blocked kernel's per-step field HBM
+    bytes (the packed-kernel section's pallas_call charge) must be
+    <= 0.55x the single-step packed kernel's — the depth-2 halo
+    pipeline converts the repo's best kernel into the default sharded
+    path at half the per-cell HBM cost."""
+    tb = sharded_ledgers["pallas_packed_tb"]
+    pk = sharded_ledgers["pallas_packed"]
+    assert tb["steps_per_call"] == 2
+    tb_b = tb["sections"]["packed-kernel-tb"]["bytes"] / tb["cells"]
+    pk_b = pk["sections"]["packed-kernel"]["bytes"] / pk["cells"]
+    assert tb_b <= 0.55 * pk_b, \
+        f"sharded tb kernel {tb_b:.1f} B/cell/step vs packed {pk_b:.1f}"
+
+
+def test_strategy_recorded_and_deterministic(sharded_ledgers):
+    """ISSUE-10 acceptance: the planner's strategy choice is
+    deterministic, recorded in the ledger comm lane, and the reference
+    (2,2,2) decomposition picks the ASYNC TWO-PLANE (fused depth-2)
+    exchange for the temporal-blocked kind."""
+    from fdtd3d_tpu.plan import comm_strategy, plan_for_topology
+    strat = sharded_ledgers["pallas_packed_tb"]["comm"]["strategy"]
+    assert strat is not None
+    assert strat["step_kind"] == "pallas_packed_tb"
+    assert strat["ghost_depth"] == 2          # two-plane exchange
+    assert strat["split"] == "fused"
+    assert strat["schedule"] == "async"
+    assert strat["source"] == "model"
+    assert strat["shard_axes"] == ["x", "y", "z"]
+    # plan_for_topology carries the SAME decision (the authority)
+    p = plan_for_topology(_cfg("pallas_packed_tb"), TOPO)
+    assert p.comm_strategy is not None
+    assert p.comm_strategy.as_record() == strat
+    # deterministic: a second evaluation is identical
+    s2 = comm_strategy(_cfg("pallas_packed_tb"), TOPO,
+                       step_kind="pallas_packed_tb")
+    assert s2.as_record() == strat
+    # single-step kinds record depth 1 on the same topology
+    s1 = sharded_ledgers["pallas_packed"]["comm"]["strategy"]
+    assert s1["ghost_depth"] == 1 and s1["step_kind"] == "pallas_packed"
+
+
+def test_strategy_env_override(monkeypatch):
+    """FDTD3D_COMM_STRATEGY forces split/schedule (the registered
+    knob); unknown tokens are a named config error."""
+    from fdtd3d_tpu.plan import comm_strategy
+    monkeypatch.setenv("FDTD3D_COMM_STRATEGY", "per-plane,sync")
+    s = comm_strategy(_cfg("jnp"), TOPO)
+    assert s.split == "per-plane" and s.schedule == "sync"
+    assert s.source == "env:FDTD3D_COMM_STRATEGY"
+    monkeypatch.setenv("FDTD3D_COMM_STRATEGY", "sync")
+    s2 = comm_strategy(_cfg("jnp"), TOPO)
+    assert s2.schedule == "sync" and s2.split == "fused"
+    monkeypatch.setenv("FDTD3D_COMM_STRATEGY", "bogus")
+    with pytest.raises(ValueError, match="FDTD3D_COMM_STRATEGY"):
+        comm_strategy(_cfg("jnp"), TOPO)
+    monkeypatch.delenv("FDTD3D_COMM_STRATEGY")
+    assert comm_strategy(_cfg("jnp"), (1, 1, 1)) is None
 
 
 def test_comm_lane_deterministic():

@@ -295,13 +295,18 @@ def test_packed_drude_m_in_scope():
 
 @pytest.mark.parametrize("topo", [(2, 1, 1), (1, 2, 1), (1, 2, 2),
                                   (2, 2, 2)])
-def test_packed_sharded_parity(topo):
-    """The packed kernel IS the multi-chip path (round 4): E-phase
-    halos ppermute in as ghost operands (x via the tile-0 edge, y/z as
-    thin blocks), the H phase's local hi-edge planes get the missing
-    neighbor new-E contribution as a thin post-fix, and the x-slab
-    patch curls ppermute their boundary plane. Parity vs the sharded
-    jnp step at f32 roundoff on the 8-device virtual mesh."""
+def test_packed_sharded_parity(topo, monkeypatch):
+    """The packed kernel is the single-step multi-chip path (round 4):
+    E-phase halos ppermute in as ghost operands (x via the tile-0
+    edge, y/z as thin blocks), the H phase's local hi-edge planes get
+    the missing neighbor new-E contribution as a thin post-fix, and
+    the x-slab patch curls ppermute their boundary plane. Parity vs
+    the sharded jnp step at f32 roundoff on the 8-device virtual mesh.
+    FDTD3D_NO_TEMPORAL pins the single-step kernel: since round 11 the
+    temporal-blocked kernel outranks it on sharded topologies too
+    (tests/test_pallas_packed_tb.py covers that path)."""
+    monkeypatch.setenv("FDTD3D_NO_TEMPORAL", "1")
+
     def run(up):
         # use_pallas=False IS the jnp baseline (no env juggling needed:
         # _want_pallas short-circuits before any kernel dispatch)

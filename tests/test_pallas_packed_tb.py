@@ -149,13 +149,126 @@ def test_tb_escape_hatch_bit_for_bit(monkeypatch):
 
 
 # -------------------------------------------------------------------------
+# sharded: the depth-2 halo pipeline (round 11)
+# -------------------------------------------------------------------------
+
+def _sharded_parity(topo, steps, tol=2e-6, seed=0, **kw):
+    """tb vs jnp on the SAME topology (per-shard slab-compacted psi
+    layouts coincide), fields AND psi recursion state. Seeded fields +
+    interior source: a bare Ez point source leaves Hz identically zero
+    by symmetry, and comparing that component's roundoff noise against
+    itself is a degenerate metric."""
+    from fdtd3d_tpu.parallel import distributed as pdist
+    par = ParallelConfig(topology="manual", manual_topology=topo)
+    base = dict(BASE, time_steps=steps, pml=PmlConfig(size=(2, 2, 2)),
+                point_source=PointSourceConfig(
+                    enabled=True, component="Ez", position=(8, 8, 8)),
+                parallel=par, **kw)
+    j = Simulation(SimConfig(**dict(base, use_pallas=False)))
+    _seed_fields(j, seed=seed)
+    j.run()
+    p = Simulation(SimConfig(**dict(base, use_pallas=True)))
+    _seed_fields(p, seed=seed)
+    p.run()
+    assert p.step_kind == "pallas_packed_tb", p.step_kind
+    for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < tol, f"{c}: rel {rel:.2e} on {topo}"
+    for grp in ("psi_E", "psi_H"):
+        for k in j.state[grp]:
+            a = np.asarray(pdist.gather_to_host(j.state[grp][k]))
+            b = np.asarray(pdist.gather_to_host(p.state[grp][k]))
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < tol, f"{grp}/{k}: rel {rel:.2e} on {topo}"
+    return j, p
+
+
+def test_tb_sharded_parity_222_even():
+    """ISSUE-10 acceptance: sharded tb vs sharded jnp on the (2,2,2)
+    CPU interpret mesh, even horizon, CPML + interior source."""
+    _sharded_parity((2, 2, 2), steps=8)
+
+
+def test_tb_sharded_parity_222_odd():
+    """Odd horizon: n//2 blocked passes + ONE single-step sharded
+    pallas_packed tail on the same packed carry inside one chunk."""
+    _sharded_parity((2, 2, 2), steps=7)
+
+
+def test_tb_sharded_parity_122_even_and_odd():
+    _sharded_parity((1, 2, 2), steps=8)
+    _sharded_parity((1, 2, 2), steps=7)
+
+
+def test_tb_sharded_odd_ntiles_drain_edges():
+    """Odd-ntiles two-region tiling UNDER sharding: 48-long x sharded
+    by 2 -> 24 local at tile 8 (3 tiles, two-region x-psi) — the
+    pipeline-drain edges now masked against the two-deep ghost region
+    (the exchanged generation ghosts replace the PEC zeros at i==0 /
+    i==2 / i==ntiles). x-sharded (2,1,1) isolates the xgh0/xgh1/xe1
+    operands; (2,2,2) composes them with the y/z thin-block ghosts."""
+    from fdtd3d_tpu.parallel import distributed as pdist  # noqa: F401
+    for topo in ((2, 1, 1), (2, 2, 2)):
+        par = ParallelConfig(topology="manual", manual_topology=topo)
+        base = dict(BASE, size=(48, 16, 16), time_steps=7,
+                    pml=PmlConfig(size=(2, 2, 2)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ey",
+                        position=(30, 8, 8)),
+                    parallel=par)
+        j = Simulation(SimConfig(**dict(base, use_pallas=False)))
+        _seed_fields(j, seed=3)
+        j.run()
+        p = Simulation(SimConfig(**dict(base, use_pallas=True)))
+        _seed_fields(p, seed=3)
+        p.run()
+        assert p.step_kind == "pallas_packed_tb", (topo, p.step_kind)
+        nt = (48 // topo[0]) // p.step_diag["tile"]["EH"]
+        assert nt == 3, nt   # odd ntiles: real drain-edge coverage
+        for c in ("Ey", "Hz", "Hx"):
+            a = np.asarray(j.field(c), np.float32)
+            b = np.asarray(p.field(c), np.float32)
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < 2e-6, f"{c}: rel {rel:.2e} on {topo}"
+
+
+def test_tb_sharded_comm_strategy_in_diag():
+    """The step's diag carries the planned CommStrategy record (what
+    telemetry run_start and the ledger comm lane echo)."""
+    sim = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(2, 2, 2))))
+    assert sim.step_kind == "pallas_packed_tb"
+    strat = sim.step_diag["comm_strategy"]
+    assert strat["ghost_depth"] == 2
+    assert strat["split"] == "fused" and strat["schedule"] == "async"
+
+
+def test_tb_sharded_strategy_override_parity(monkeypatch):
+    """FDTD3D_COMM_STRATEGY=per-plane,sync must change the message
+    plan WITHOUT changing the physics: parity still holds and the
+    strategy records the env source."""
+    monkeypatch.setenv("FDTD3D_COMM_STRATEGY", "per-plane,sync")
+    _, p = _sharded_parity((1, 2, 2), steps=4)
+    strat = p.step_diag["comm_strategy"]
+    assert strat["split"] == "per-plane"
+    assert strat["schedule"] == "sync"
+    assert strat["source"] == "env:FDTD3D_COMM_STRATEGY"
+
+
+# -------------------------------------------------------------------------
 # eligibility: the scope is a strict subset of the packed kernel's
 # -------------------------------------------------------------------------
 
 def test_tb_fallbacks_stay_on_packed():
     """Out-of-tb-scope configs must land on the round-6 packed kernel
-    (never jnp, never silently tb): TFSF, in-absorber source, sharded,
-    Drude."""
+    (never jnp, never silently tb): TFSF (sharded or not), in-absorber
+    source, Drude. Sharded topologies are IN tb scope since round 11
+    (the depth-2 halo pipeline) — asserted here so the dispatch can
+    never silently regress to the single-step kernel."""
     tfsf = Simulation(SimConfig(
         **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
         tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2))))
@@ -171,7 +284,15 @@ def test_tb_fallbacks_stay_on_packed():
         **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
         parallel=ParallelConfig(topology="manual",
                                 manual_topology=(1, 2, 2))))
-    assert sharded.step_kind == "pallas_packed", sharded.step_kind
+    assert sharded.step_kind == "pallas_packed_tb", sharded.step_kind
+
+    tfsf_sharded = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
+        tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(1, 2, 2))))
+    assert tfsf_sharded.step_kind == "pallas_packed", \
+        tfsf_sharded.step_kind
 
     drude = Simulation(SimConfig(
         **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),

@@ -450,6 +450,72 @@ def test_sentinel_comm_cli_exit_codes(tmp_path):
     assert "halo-bytes/chip" in bad.stderr
 
 
+def test_sentinel_comm_tb_fixture_pair():
+    """ISSUE-10 satellite: the temporal-blocked (2,2,2) ledger pair —
+    a two-plane-exchange byte/message regression (or lost async
+    lowering) on the SHARDED tb path is caught chip-free."""
+    ps = _sentinel()
+    ref = _comm_fix("comm_tb_ref.json")
+    bad = _comm_fix("comm_tb_regressed.json")
+    assert ref["step_kind"] == "pallas_packed_tb"
+    assert ref["steps_per_call"] == 2
+    # the ref encodes the depth-2 claims: traced == tb plan model,
+    # full attribution, async strategy, compute inside every window
+    assert ref["comm"]["per_step"]["ppermute_bytes_per_chip"] == \
+        ref["comm"]["plan"]["halo_bytes_per_chip_per_step"]
+    assert ref["comm"]["per_step"]["halo_attribution"] == 1.0
+    assert ref["comm"]["strategy"]["ghost_depth"] == 2
+    assert ref["comm"]["strategy"]["schedule"] == "async"
+    aw = ref["comm"]["async_windows"]
+    assert aw["sync_collective_permutes"] == 0
+    assert aw["windows"] == aw["windows_with_compute"] == 4
+    ok = ps.check_comm(ref, ref)
+    assert ok["status"] == "OK" and not ok["regressions"]
+    v = ps.check_comm(bad, ref)
+    assert v["status"] == "REGRESSION"
+    msgs = " | ".join(v["regressions"])
+    assert "halo-bytes/chip" in msgs
+    assert "messages" in msgs
+    assert "attribution" in msgs
+    assert "overlap windows" in msgs
+    assert "synchronous collective-permutes" in msgs
+
+
+def test_sentinel_tb_sharded_throughput_path():
+    """The sharded-tb throughput path is first-class: its own keys, so
+    a multichip-stage drop gates without polluting single-chip tb
+    history."""
+    ps = _sentinel()
+    assert "f32_packed_tb_sharded" in ps.PATHS
+    cur = {"platform": "tpu", "hbm_probe_gbps": 600.0,
+           "tb_sharded_mcells": 800.0, "tb_sharded_n": 256}
+    ref = {"hbm_probe_gbps": 600.0,
+           "tb_sharded_mcells": 1000.0, "tb_sharded_n": 256}
+    v = ps.check_artifact(cur, best=ref)
+    row = v["paths"]["f32_packed_tb_sharded"]
+    assert row["verdict"] == "REGRESSION"
+    cur2 = dict(cur, tb_sharded_mcells=950.0)
+    v2 = ps.check_artifact(cur2, best=ref)
+    assert v2["paths"]["f32_packed_tb_sharded"]["verdict"] == "OK"
+
+
+def test_aot_overlap_tb_hlo_fixture():
+    """ISSUE-10 satellite: --hlo on the checked-in tb scheduled-HLO
+    fixture proves the two-plane exchange lowers ASYNC with compute
+    inside EVERY window — 4 start/done pairs (H(t), E(t+1), H(t+1),
+    E(t+2)-fix generations), zero synchronous collective-permutes."""
+    ao = _load_tool("aot_overlap")
+    art = ao.overlap_artifact(
+        ao.analyze(open(os.path.join(FIX,
+                                     "overlap_tb_ref.hlo")).read()),
+        "hlo:overlap_tb_ref.hlo")
+    ao.validate_overlap(art)
+    assert art["sync_collective_permutes"] == 0
+    assert art["async_starts"] == art["async_dones"] == 4
+    assert art["windows"] == 4
+    assert art["windows_with_compute"] == 4   # every window
+
+
 def test_aot_overlap_hlo_gate_chip_free(tmp_path):
     """tools/aot_overlap.py --hlo: the async-window analysis runs on a
     checked-in scheduled-HLO fixture with no TPU toolchain at all, and

@@ -400,6 +400,42 @@ def test_transient_exhaustion_walks_topology_ladder(tmp_path):
         [([2, 2, 1], [1, 2, 1]), ([1, 2, 1], [1, 1, 1])]
 
 
+def test_topology_degrade_stays_on_tb_after_reshard(tmp_path):
+    """ISSUE-10 satellite: a supervised sharded run on the
+    temporal-blocked kernel that sheds a TOPOLOGY rung (transient
+    exhaustion — the kernel ladder is not walked on this path) must
+    come back on the smaller decomposition STILL dispatching
+    pallas_packed_tb: since round 11 every sharded topology is in tb
+    scope, so resharding alone may never silently cost the run its
+    24 B/cell kernel."""
+    from fdtd3d_tpu.config import ParallelConfig
+    cfg = SimConfig(
+        scheme="3D", size=(16, 16, 16), time_steps=24, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3, use_pallas=True,
+        pml=PmlConfig(size=(2, 2, 2)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(8, 8, 8)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(2, 2, 2)),
+        output=OutputConfig(save_dir=str(tmp_path), checkpoint_every=8,
+                            telemetry_path=str(tmp_path / "t.jsonl")))
+    faults.install("error@t=8,times=1")
+    sup = Supervisor(cfg, policy=RetryPolicy(max_retries=0,
+                                             sleep=lambda _s: None))
+    sim = sup.run(interval=8)
+    sim.close()
+    faults.clear()
+    assert sim._t_host == 24
+    assert tuple(sim.topology) == (1, 2, 2)
+    assert sim.step_kind == "pallas_packed_tb", sim.step_kind
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    tc = [r for r in recs if r["type"] == "topology_change"]
+    assert [(r["old_topology"], r["new_topology"]) for r in tc] == \
+        [([2, 2, 2], [1, 2, 2])]
+    for comp, v in sim.fields().items():
+        assert np.isfinite(np.asarray(v, np.float32)).all(), comp
+
+
 def test_supervised_resume_adopts_persisted_degraded_state(tmp_path,
                                                            monkeypatch):
     """A preemption mid-degrade: the next supervised --resume reads the

@@ -82,6 +82,40 @@ def test_telemetry_jsonl_schema_and_contents(tmp_path):
     # the source has injected energy by chunk 2
     assert recs[2]["energy"] > 0.0
     assert recs[2]["max_e"] > 0.0
+
+
+def test_run_start_records_comm_strategy_when_sharded(tmp_path):
+    """Round 11: a sharded run's run_start carries the planner's
+    communication-strategy record (the ledger comm lane's `strategy`
+    twin — the run's exchange posture is auditable from telemetry
+    alone); unsharded runs omit the key."""
+    from fdtd3d_tpu.config import ParallelConfig
+    cfg = _cfg3d(tmp_path)
+    import dataclasses
+    cfg = dataclasses.replace(
+        cfg, pml=PmlConfig(size=(2, 2, 2)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(1, 2, 2)))
+    sim = Simulation(cfg)
+    sim.advance(4)
+    sim.close_telemetry()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
+    start = recs[0]
+    strat = start["comm_strategy"]
+    assert strat is not None
+    assert strat["step_kind"] == sim.step_kind
+    assert strat["topology"] == [1, 2, 2]
+    assert strat["split"] in ("fused", "per-plane")
+    assert strat["schedule"] in ("async", "sync")
+
+
+def test_run_end_and_counters_match_diag(tmp_path):
+    cfg = _cfg3d(tmp_path)
+    sim = Simulation(cfg)
+    sim.advance(4)
+    sim.advance(4)
+    sim.close_telemetry()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)
     end = recs[3]
     assert end["steps"] == 8 and end["t"] == 8
     assert end["first_unhealthy_t"] is None

@@ -173,6 +173,42 @@ def test_aot_overlap_runs_on_fixture(tmp_path):
     assert art["windows_with_compute"] == 2
 
 
+def test_aot_overlap_runs_on_tb_fixture(tmp_path):
+    """ISSUE-10 satellite: --hlo on the temporal-blocked scheduled-HLO
+    fixture proves the depth-2 (two-plane) exchange lowers async with
+    compute inside EVERY window, end-to-end through the real CLI."""
+    out = tmp_path / "overlap_tb.json"
+    proc = _run([os.path.join(TOOLS, "aot_overlap.py"),
+                 "--hlo", os.path.join(FIX, "overlap_tb_ref.hlo"),
+                 "--out", str(out)])
+    assert proc.returncode == 0, proc.stderr
+    art = json.loads(out.read_text())
+    assert art["schema"] == "fdtd3d-overlap"
+    assert art["sync_collective_permutes"] == 0
+    assert art["async_starts"] == 4
+    assert art["windows"] == art["windows_with_compute"] == 4
+
+
+def test_costs_cli_topology_overlap_strategy():
+    """ISSUE-10 acceptance: `python -m fdtd3d_tpu.costs --topology
+    2,2,2 --overlap` reproduces the planner's decision — the comm lane
+    prints the deterministic async two-plane strategy + the modeled
+    overlap window, no artifact file needed (bare --overlap)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "fdtd3d_tpu.costs",
+         "--same-size", "16", "--pml-size", "2",
+         "--topology", "2,2,2", "--hbm-gbps", "600", "--overlap"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    led = json.loads(proc.stdout)
+    strat = led["comm"]["strategy"]
+    assert strat["schedule"] == "async"
+    assert strat["split"] == "fused"
+    assert led["comm"]["overlap_model"] is not None
+
+
 def test_fdtd_lint_full_run_is_clean():
     """ISSUE 9 acceptance: tools/fdtd_lint.py exits 0 over the repo
     with ALL rules enabled and the checked-in (empty) baseline — the

@@ -102,6 +102,51 @@ def test_halo_traffic_invariant_under_weak_scaling():
     assert len(set(hbm.values())) == 1, hbm
 
 
+def test_tb_halo_model_invariant_and_matches_ledger():
+    """ISSUE-10 satellite: the temporal-blocked kernel's depth-2 halo
+    model (plan.halo_bytes_per_step_tb — two ghost-plane generations
+    per neighbor per pass = (ne+nh) component planes per axis per
+    STEP) is (a) invariant 8 -> 512 chips under weak scaling, (b) the
+    number the ledger's sharded tb trace equals to the byte, and
+    (c) carried by the weak-scaling harness rows."""
+    from fdtd3d_tpu import costs
+    from fdtd3d_tpu.costs import halo_bytes_per_chip
+
+    tile = 16
+    plans = {n: _plan_for(tile, n) for n in (8, 64, 512)}
+    halos_tb = {n: p.halo_bytes_per_step_tb for n, p in plans.items()}
+    assert len(set(halos_tb.values())) == 1, halos_tb
+    # independent magnitude oracle: per sharded axis, send+recv x
+    # (ne + nh) component planes x tile^2 x 4 B per STEP — the full
+    # stacks of BOTH generations per pass, halved per step
+    expect = 3 * 2 * 6 * tile * tile * 4
+    assert halos_tb[512] == expect, (halos_tb[512], expect)
+    # per-axis tb breakdown sums to the total
+    bya = plans[8].halo_by_axis_tb
+    assert sum(r["bytes_per_step"] for r in bya.values()) == halos_tb[8]
+
+    # (b) the ledger's sharded tb trace == this model, per topology
+    cfg = costs.config_for_kind("pallas_packed_tb", n=16, pml=2)
+    led = costs.chunk_ledger(cfg, n_steps=8, kind="pallas_packed_tb",
+                             topology=(2, 2, 2))
+    comm = led["comm"]
+    from fdtd3d_tpu.plan import plan_for_topology
+    p222 = plan_for_topology(cfg, (2, 2, 2))
+    assert comm["per_step"]["ppermute_bytes_per_chip"] == \
+        p222.halo_bytes_per_step_tb
+    assert comm["plan"]["halo_bytes_per_chip_per_step"] == \
+        p222.halo_bytes_per_step_tb
+    assert halo_bytes_per_chip(cfg, (2, 2, 2),
+                               step_kind="pallas_packed_tb") == \
+        p222.halo_bytes_per_step_tb
+
+    # (c) the harness row carries it
+    r8 = run_point(8, tile=16, steps=4)
+    p8 = _plan_for(16, 8)
+    assert r8["halo_bytes_per_chip_per_step_tb"] == \
+        p8.halo_bytes_per_step_tb
+
+
 def test_plan_matches_live_run_topology():
     """The planner's chosen topology agrees with what the live 8-device
     run resolves (the accounting is about THAT decomposition), and the

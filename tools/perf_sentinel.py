@@ -60,6 +60,11 @@ PATHS = {
     # so neither history pollutes the other's reference
     "f32_packed_tb": ("tb_mcells", ("tb_mcells",)),
     "bf16_tb": ("tb_bf16_mcells", ("tb_bf16_mcells",)),
+    # round-11 SHARDED temporal-blocked kernel (depth-2 halo pipeline):
+    # bench.py's multichip stage on a >=8-chip window; its own path so
+    # single-chip history cannot mask a sharded-dispatch cliff
+    "f32_packed_tb_sharded": ("tb_sharded_mcells",
+                              ("tb_sharded_mcells",)),
     "float32x2": ("float32x2_mcells", ("float32x2_mcells",)),
 }
 
@@ -75,6 +80,7 @@ PATH_N_KEYS = {
     "bf16": ("bf16_n", "n"),
     "f32_packed_tb": ("tb_n",),
     "bf16_tb": ("tb_bf16_n",),
+    "f32_packed_tb_sharded": ("tb_sharded_n",),
     "float32x2": ("float32x2_n",),
 }
 

@@ -64,9 +64,16 @@ def run_point(n_devices: int, tile: int, steps: int, use_pallas=None):
     # scaling once all axes shard, which tests/test_weak_scaling.py
     # asserts up to 512 chips
     halo = 0
+    halo_tb = 0
     if n_devices > 1:
-        from fdtd3d_tpu.costs import halo_bytes_per_chip
-        halo = halo_bytes_per_chip(cfg, tuple(sim.topology))
+        # one plan build for both rows: the single-step curl model and
+        # the temporal-blocked depth-2 exchange model (two ghost-plane
+        # generations per neighbor per pass) — the kind a sharded TPU
+        # run of this config dispatches since round 11
+        from fdtd3d_tpu.plan import plan_for_topology
+        p = plan_for_topology(cfg, tuple(sim.topology))
+        halo = int(p.halo_bytes_per_step)
+        halo_tb = int(p.halo_bytes_per_step_tb)
     return {
         "n_devices": n_devices,
         "topology": list(sim.topology),
@@ -75,6 +82,7 @@ def run_point(n_devices: int, tile: int, steps: int, use_pallas=None):
         "mcells_per_s": cells * steps / dt / 1e6,
         "mcells_per_s_per_device": cells * steps / dt / 1e6 / n_devices,
         "halo_bytes_per_chip_per_step": halo,
+        "halo_bytes_per_chip_per_step_tb": halo_tb,
     }
 
 
