@@ -75,18 +75,40 @@ def _no_temporal(flag: bool):
             os.environ["FDTD3D_NO_TEMPORAL"] = saved
 
 
+@contextlib.contextmanager
+def _tb_depth_pin(depth: int):
+    """Pin FDTD3D_TB_DEPTH for one stage (the round-12 k-sweep, stage
+    3e): the registered knob routes the dispatch to one pipeline depth;
+    _measure double-checks the ENGAGED diag depth so a silent auto-pick
+    can never report under a pinned-depth key."""
+    if not depth:
+        yield
+        return
+    saved = os.environ.get("FDTD3D_TB_DEPTH")
+    os.environ["FDTD3D_TB_DEPTH"] = str(depth)
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop("FDTD3D_TB_DEPTH", None)
+        else:
+            os.environ["FDTD3D_TB_DEPTH"] = saved
+
+
 def measure(n: int, steps: int, use_pallas, repeats: int = 3,
             dtype: str = "float32", require_kind: str = "",
             stats: dict = None, no_temporal: bool = False,
-            topology=None) -> float:
-    with _no_temporal(no_temporal):
+            topology=None, tb_depth: int = 0) -> float:
+    with _no_temporal(no_temporal), _tb_depth_pin(tb_depth):
         return _measure(n, steps, use_pallas, repeats, dtype,
-                        require_kind, stats, topology)
+                        require_kind, stats, topology,
+                        require_depth=tb_depth)
 
 
 def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
              dtype: str = "float32", require_kind: str = "",
-             stats: dict = None, topology=None) -> float:
+             stats: dict = None, topology=None,
+             require_depth: int = 0) -> float:
     """Mcells/s for one path. Import jax lazily: the parent never does.
 
     ``stats``: optional dict filled with the StepClock summary of the
@@ -173,6 +195,11 @@ def _measure(n: int, steps: int, use_pallas, repeats: int = 3,
             raise StageRequirementError(
                 f"stage requires step_kind {require_kind}, got "
                 f"{sim.step_kind}")
+        if require_depth and (sim.step_diag or {}).get(
+                "temporal_block") != require_depth:
+            raise StageRequirementError(
+                f"stage requires temporal-block depth {require_depth},"
+                f" got {(sim.step_diag or {}).get('temporal_block')}")
         # Warm up: compile AND force one real device->host readback
         # (async dispatch through the device tunnel can make a bare
         # block_until_ready return before execution — measured 0.3ms
@@ -360,7 +387,12 @@ def accuracy_spotcheck(n: int = 32, steps: int = 60) -> dict:
 # the same provenance against ITS 24 B/cell roof (two steps per pass).
 F32_GOAL_MCELLS = 1e4
 F32_BYTES_PER_CELL = 48.0
-TB_BYTES_PER_CELL = 24.0
+# temporal-blocked per-depth field-traffic roofs (B/cell/step f32):
+# 12 field volumes per k steps (ops/pallas_packed_tb.py), derived
+# from the one depth-domain authority (config.TB_DEPTHS)
+from fdtd3d_tpu.config import TB_DEPTHS as _TB_DEPTHS  # noqa: E402
+TB_K_BYTES_PER_CELL = {k: F32_BYTES_PER_CELL / k for k in _TB_DEPTHS}
+TB_BYTES_PER_CELL = TB_K_BYTES_PER_CELL[2]
 
 
 def f32_goal_record(pallas_mc: float, gbps: float,
@@ -688,6 +720,37 @@ def run_measurement() -> None:
         tb_sh_note = (f"sharded-tb stage needs >=8 chips on a TPU "
                       f"window (have {jax.device_count()} "
                       f"{platform} device(s))")
+    # Stage 3e (round 12): the DEPTH-k sweep — k=3/4 Yee steps per HBM
+    # pass (~16/12 B/cell/step f32 roofs, TB_K_BYTES_PER_CELL) at the
+    # grid the legacy stage settled on, each depth pinned via the
+    # FDTD3D_TB_DEPTH knob and double-checked against the ENGAGED diag
+    # depth (require_depth) so a silent auto-pick or k-ladder
+    # downgrade can never report under a pinned-depth key. Chunk
+    # lengths divisible by every k (no tail steps in the timed
+    # chunks). Off-chip windows record an explanatory note instead of
+    # silent zeros (tb_k_note).
+    tb_k_mc = {3: 0.0, 4: 0.0}
+    tb_k_n = {3: 0, 4: 0}
+    tb_k_stats = {3: {}, 4: {}}
+    tb_k_note = None
+    if on_tpu and pallas_mc >= GATE_MCELLS_512:
+        for kk in (3, 4):
+            try:
+                tb_k_mc[kk] = sup_measure(
+                    f"s3e_tb_k{kk}", n, 96 if n >= 512 else 120,
+                    use_pallas=True,
+                    require_kind="pallas_packed_tb",
+                    stats=tb_k_stats[kk], tb_depth=kk)
+                tb_k_n[kk] = n
+            except Exception as e:
+                print(f"stage3e tb k={kk} {n} failed: {e!r:.300}",
+                      file=sys.stderr, flush=True)
+    else:
+        tb_k_note = (f"depth-k sweep (stage 3e) needs a TPU window "
+                     f"past the 512^3 gate; not measured on this "
+                     f"{platform} window — the per-depth byte-ratio "
+                     f"gates stay chip-free in tier-1 "
+                     f"(tests/test_costs.py)")
     # Stage 4: float32x2 on the packed-ds kernel (round 5) — the
     # accuracy mode's throughput (96 B/cell pair traffic + ~10x EFT
     # flops; ops/pallas_packed_ds.py). Smaller grids than f32: the
@@ -759,6 +822,13 @@ def run_measurement() -> None:
         "tb_sharded_mcells": round(tb_sh_mc, 1),
         "tb_sharded_n": tb_sh_n,
         "tb_sharded_topology": tb_sh_topo,
+        # round-12 depth-k sweep (stage 3e): per-depth keys feed
+        # perf_sentinel's f32_packed_tb_k3/k4 paths; the auto-depth
+        # default's history stays on tb_mcells (stage 3c)
+        "tb_k3_mcells": round(tb_k_mc[3], 1),
+        "tb_k3_n": tb_k_n[3],
+        "tb_k4_mcells": round(tb_k_mc[4], 1),
+        "tb_k4_n": tb_k_n[4],
         "float32x2_mcells": round(ds_mc, 1),
         "float32x2_n": ds_n,
         "hbm_probe_gbps": gbps,
@@ -776,6 +846,8 @@ def run_measurement() -> None:
                         (("jnp", jnp_stats), ("f32", f32_stats),
                          ("bf16", bf16_stats), ("f32_tb", tb_stats),
                          ("bf16_tb", tb_bf16_stats),
+                         ("f32_tb_k3", tb_k_stats[3]),
+                         ("f32_tb_k4", tb_k_stats[4]),
                          ("f32_tb_sharded", tb_sh_stats),
                          ("float32x2", ds_stats))
                         if v},
@@ -811,7 +883,22 @@ def run_measurement() -> None:
                      "note": "no TPU backend" if not on_tpu else
                              "stage 3c did not produce a tb number "
                              "this window"}),
+        # round-12 per-depth goal provenance (stage 3e): the same goal
+        # recomputed against each depth's ~16/12 B/cell/step roof —
+        # MET / HBM-ROOF-PROOF / MISSED, never silent
+        "tb_k_goal": {
+            f"k{kk}": (f32_goal_record(
+                           tb_k_mc[kk], gbps,
+                           bytes_per_cell=TB_K_BYTES_PER_CELL[kk])
+                       if on_tpu and tb_k_n[kk] else
+                       {"status": "NOT-MEASURED",
+                        "note": tb_k_note or
+                                f"stage 3e did not produce a k={kk} "
+                                f"number this window"})
+            for kk in (3, 4)},
     }
+    if tb_k_note:
+        out["tb_k_note"] = tb_k_note
     ref_dtype = spot.get("reference_dtype")
     if ref_dtype and ref_dtype != "float64":
         # the fallback reference dtype could not be verified against

@@ -81,6 +81,22 @@ ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
           ".py). Default: the kernel's physical-VMEM model; the "
           "runtime ladder (sim._vmem_fallback) shrinks on compile "
           "failure."),
+    _knob("FDTD3D_VMEM_TEMPS_TABLE", "str", None,
+          "Override entries of the central Mosaic-temporaries "
+          "calibration table (config.VMEM_TEMPS_DEFAULTS, f32 per cell "
+          "per tile plane) the Pallas tile pickers model against: "
+          "comma-separated key=int pairs, e.g. 'tb3=44,tb4=58'. Keys: "
+          "packed, packed_ds, tb2/tb3/tb4 (temporal-blocked per "
+          "pipeline depth). The first chip window recalibrates ONE "
+          "table instead of scattered per-module constants."),
+    _knob("FDTD3D_TB_DEPTH", "int", None,
+          "Pin the temporal-blocked kernel's pipeline depth k (2, 3 or "
+          "4 Yee steps per HBM pass) instead of the VMEM-calibrated "
+          "auto-depth pick (ops/pallas_packed_tb.py); bench's k-sweep "
+          "and the per-depth ledger fixtures use it. A pin the VMEM "
+          "model or sharded wedge extents cannot honor is a NAMED "
+          "config error, never a silent single-step fallback. Unset: "
+          "deepest depth whose budgeted tile stays viable."),
     _knob("FDTD3D_COMM_STRATEGY", "str", None,
           "Override the planner's communication-strategy choice "
           "(plan.comm_strategy): comma-separated tokens from "
@@ -110,6 +126,95 @@ ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
           "DIR/<path>_<dtype>_<n>/ subdirectories (attribute with "
           "tools/trace_attribution.py)."),
 )}
+
+
+# ---------------------------------------------------------------------------
+# Mosaic-temporaries calibration table (VMEM tile-picker model)
+# ---------------------------------------------------------------------------
+
+# f32 temporaries per (cell x tile plane) that Mosaic's kernel body
+# holds beyond the modeled operand blocks + scratch, per kernel kind —
+# THE central calibration surface the Pallas tile pickers consume
+# (ops/pallas_packed.py `_pick_tile_packed`). One table, one chip-window
+# recalibration (`FDTD3D_VMEM_TEMPS_TABLE`), instead of the scattered
+# per-module constants PR 4 flagged.
+#
+#   packed    — MEASURED on the v5e tunnel (128^3 T=32 fail / 512^3
+#               T=2 pass boundary; ops/pallas_packed.py comment).
+#   packed_ds — double-single kernel (ops/pallas_packed_ds.py's own
+#               pass/fail probe).
+#   tb2/3/4   — temporal-blocked kernel per pipeline depth k:
+#               UNCALIBRATED scale-ups of the measured 25 (the 2k-phase
+#               body holds ~k generations of live values); re-run the
+#               128^3/512^3 probe per depth on the first chip window.
+VMEM_TEMPS_DEFAULTS: Dict[str, int] = {
+    "packed": 25,
+    "packed_ds": 80,
+    "tb2": 40,
+    "tb3": 52,
+    "tb4": 64,
+}
+
+
+def vmem_temps(kind: str, depth: Optional[int] = None) -> int:
+    """Calibrated Mosaic-temporaries constant for one kernel kind
+    (``depth`` selects the temporal-blocked per-k row, e.g.
+    ``vmem_temps("tb", 3)`` -> the ``tb3`` entry). Env override:
+    ``FDTD3D_VMEM_TEMPS_TABLE=key=int,key=int`` — unknown keys or
+    non-integer values are a config error, never a silent default."""
+    import os
+    key = f"{kind}{depth}" if depth is not None else kind
+    table = dict(VMEM_TEMPS_DEFAULTS)
+    env = os.environ.get("FDTD3D_VMEM_TEMPS_TABLE")
+    if env:
+        for tok in env.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            name, sep, val = tok.partition("=")
+            if not sep or name.strip() not in VMEM_TEMPS_DEFAULTS:
+                raise ValueError(
+                    f"FDTD3D_VMEM_TEMPS_TABLE token {tok!r}: expected "
+                    f"key=int with key one of "
+                    f"{sorted(VMEM_TEMPS_DEFAULTS)}")
+            try:
+                table[name.strip()] = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"FDTD3D_VMEM_TEMPS_TABLE value for {name!r} is "
+                    f"not an integer: {val!r}") from None
+    if key not in table:
+        raise KeyError(f"no VMEM temps calibration row {key!r} "
+                       f"(known: {sorted(table)})")
+    return table[key]
+
+
+# Supported temporal-blocked pipeline depths (Yee steps per HBM pass)
+# — THE single domain authority: ops/pallas_packed_tb.DEPTHS aliases
+# it, plan.halo_bytes_per_step_tb_at validates against it, and
+# bench.py derives the per-depth byte roofs (48/k) from it.
+TB_DEPTHS: Tuple[int, ...] = (2, 3, 4)
+
+
+def tb_depth_env() -> Optional[int]:
+    """The pinned temporal-blocked pipeline depth, or None (auto).
+    Out-of-domain or non-numeric values are a NAMED config error at
+    dispatch time (the registered-knob convention)."""
+    import os
+    v = os.environ.get("FDTD3D_TB_DEPTH")
+    if not v:
+        return None
+    try:
+        k = int(v)
+    except ValueError:
+        raise ValueError(
+            f"FDTD3D_TB_DEPTH={v!r}: pipeline depth must be an "
+            f"integer, one of {'/'.join(map(str, TB_DEPTHS))}") \
+            from None
+    if k not in TB_DEPTHS:
+        raise ValueError(f"FDTD3D_TB_DEPTH={v!r}: pipeline depth must "
+                         f"be one of {'/'.join(map(str, TB_DEPTHS))}")
+    return k
 
 
 @dataclasses.dataclass

@@ -80,10 +80,11 @@ STEP_KINDS = ("jnp", "pallas", "pallas_packed", "pallas_packed_tb",
               "pallas_packed_ds")
 
 # Kinds whose step supports a sharded (shard_map) trace — the comm
-# lane's acceptance surface. pallas_packed_tb joined in round 11 (the
-# depth-2 halo pipeline; ROADMAP item 1): its exchange is modeled by
-# plan.halo_bytes_per_step_tb (two ghost-plane generations per
-# neighbor per pass) and traced byte-for-byte equal.
+# lane's acceptance surface. pallas_packed_tb joined in round 11 and
+# generalized to depth k in round 12: its exchange is modeled by
+# plan.halo_bytes_per_step_tb (k ghost-plane generations per neighbor
+# per pass; per-step bytes depth-invariant) and traced byte-for-byte
+# equal at every k.
 SHARDED_STEP_KINDS = ("jnp", "pallas", "pallas_packed",
                       "pallas_packed_tb", "pallas_packed_ds")
 
@@ -391,9 +392,10 @@ def halo_bytes_per_chip(cfg, topology,
     plan.py's accounting) for cfg on a forced topology.
     tools/weak_scaling.py, bench.py and the ledger comm lane all quote
     this; tests assert the traced jaxpr matches it. ``step_kind=
-    "pallas_packed_tb"`` selects the depth-2 (two ghost-plane
-    generations per neighbor per pass) model; every other kind uses
-    the single-step curl-term model."""
+    "pallas_packed_tb"`` selects the depth-k model (k ghost-plane
+    generations per neighbor per pass; per-step bytes invariant in k —
+    plan.Plan.halo_bytes_per_step_tb_at); every other kind uses the
+    single-step curl-term model."""
     from fdtd3d_tpu.plan import plan_for_topology
     p = plan_for_topology(cfg, topology)
     if step_kind == "pallas_packed_tb":
@@ -637,18 +639,20 @@ def trace_chunk(cfg, n_steps: int = 8, kind: Optional[str] = None,
         else:
             specs = pmesh.state_specs(state_sh, topo)
 
-    # Multi-step kernels (pallas_packed_tb advances steps_per_call=2
-    # steps per scan iteration): the step scan's length is
-    # n_steps // spc and its body carries spc steps of cost — matched
-    # at the shorter length, then normalized to PER-STEP below so tb
-    # ledgers compare against single-step ones (the "roofline moved"
-    # gate in tests/test_costs.py divides the two).
+    # Multi-step kernels (pallas_packed_tb advances steps_per_call = k
+    # steps per scan iteration, k its pipeline depth): the step scan's
+    # length is n_steps // spc and its body carries spc steps of cost
+    # — matched at the shorter length, then normalized to PER-STEP
+    # below so tb ledgers compare against single-step ones (the
+    # "roofline moved" gates in tests/test_costs.py divide the two,
+    # per depth).
     spc = int(getattr(runner, "steps_per_call", 1))
     if n_steps % spc:
         raise ValueError(
             f"n_steps={n_steps} is not a multiple of the runner's "
-            f"steps_per_call={spc}: the tail steps would blur the "
-            f"per-step/per-chunk split — trace an even horizon")
+            f"steps_per_call={spc} (the temporal-blocked pipeline "
+            f"depth k={spc}): the n mod k tail steps would blur the "
+            f"per-step/per-chunk split — trace a k-divisible horizon")
 
     traced = lambda s, c: runner(s, c, n=n_steps)  # noqa: E731
     if topo is not None:

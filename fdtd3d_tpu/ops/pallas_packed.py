@@ -343,10 +343,12 @@ def psi_rows(static, slabs, family: str) -> Dict[int, List[str]]:
 #   modeled) and measures 8% faster than T=1 (same traffic, fewer
 #   per-iteration DMA setups);  256^3 T=8 compiles (~114M modeled).
 # 25 f32 per (cell x tile plane) separates the measured pass/fail
-# boundary. Re-calibrate if the kernel body changes materially.
+# boundary. Re-calibrate if the kernel body changes materially — via
+# the CENTRAL calibration table (config.VMEM_TEMPS_DEFAULTS /
+# FDTD3D_VMEM_TEMPS_TABLE), which this module reads as the "packed"
+# row; the temporal-blocked kernel reads its per-depth tb2/3/4 rows.
 _VMEM_TOTAL = 128 << 20
 _VMEM_MARGIN = 10 << 20       # compile-to-compile variance headroom
-_TEMPS_F32_PER_CELL = 25
 
 # Runtime fallback budget (bytes) — set by Simulation's VMEM-failure
 # ladder when a compile of the model-picked tile fails on hardware the
@@ -369,8 +371,10 @@ def _pick_tile_packed(n1: int, plane_cells: int, block_bytes_at,
     own, larger, calibration constant — ops/pallas_packed_tb.py).
     """
     import os
+
+    from fdtd3d_tpu.config import vmem_temps
     if temps_f32_per_cell is None:
-        temps_f32_per_cell = _TEMPS_F32_PER_CELL
+        temps_f32_per_cell = vmem_temps("packed")
     env_budget = _vmem_budget() if os.environ.get(
         "FDTD3D_VMEM_BUDGET_MB") else None
     if _RUNTIME_BUDGET is not None:

@@ -106,10 +106,11 @@ AXES = "xyz"
 
 # Measured-class guess for the ds kernel body's Mosaic temporaries, in
 # f32 words per (cell x tile plane): the EFT chains hold ~3-4x the f32
-# body's live values. Folded into the scratch term of the shared tile
+# body's live values. Lives in the CENTRAL calibration table
+# (config.VMEM_TEMPS_DEFAULTS "packed_ds" row; FDTD3D_VMEM_TEMPS_TABLE
+# overrides) and is folded into the scratch term of the shared tile
 # picker; a wrong guess on other chips is caught by Simulation's
 # VMEM-failure ladder, which re-picks a strictly smaller tile.
-_TEMPS_DS_F32_PER_CELL = 80
 
 
 def eligible(static, mesh_axes=None) -> bool:
@@ -337,9 +338,11 @@ def make_packed_ds_step(static, mesh_axes=None, mesh_shape=None):
     def _scratch_bytes(t: int) -> int:
         base = 2 * (ne + nh) * t * n2 * n3 * 4 + 2 * nh * n2 * n3 * 4
         # fold the ds body's larger Mosaic temporaries into the shared
-        # tile picker's budget term (pallas_packed models 25 f32/cell
-        # separately; the delta rides here)
-        extra = (_TEMPS_DS_F32_PER_CELL - 25) * 4 * t * n2 * n3
+        # tile picker's budget term (the picker models the "packed"
+        # row separately; the ds delta rides here)
+        from fdtd3d_tpu.config import vmem_temps
+        extra = (vmem_temps("packed_ds") - vmem_temps("packed")) \
+            * 4 * t * n2 * n3
         return base + extra
 
     T = _pick_tile_packed(n1, n2 * n3, _block_bytes, _scratch_bytes)

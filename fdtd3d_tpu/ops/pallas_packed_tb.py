@@ -1,135 +1,146 @@
-"""Temporal-blocked packed kernel: TWO Yee steps per HBM pass.
+"""Temporal-blocked packed kernel: k Yee steps per HBM pass (k=2/3/4).
 
-Round 8 (docs/PERFORMANCE.md round-8 section). The round-5 overhead
-decomposition showed the packed step's marginal cell already runs at
-~72% of the same-window HBM probe, i.e. the round-6 kernel sits near
-the 48 B/cell Yee floor — the one remaining fusion lever below it is
-reusing state ACROSS TIME STEPS within one grid pass. This kernel
-deepens ops/pallas_packed.py's software pipeline from two phases to
-four: at grid iteration i it computes
+Round 8 built the hardcoded two-step/four-phase pipeline; round 12
+generalizes it into a DEPTH-k BUILDER (ROADMAP item 1, the
+communication-strategy paper's halo-depth-vs-bytes frontier made a free
+variable). At grid iteration i the kernel runs 2k phases:
 
-    phase A:  E(t+1) on tile i        (from HBM E(t), H(t))
-    phase B:  H(t+1) on tile i-1      (from VMEM ring scratch)
-    phase C:  E(t+2) on tile i-2      (from VMEM ring scratch)
-    phase D:  H(t+2) on tile i-3      (written to HBM)
+    phase E_g:  E(t+g) on tile i - 2(g-1)      (g = 1..k)
+    phase H_g:  H(t+g) on tile i - (2g-1)
 
-so the grid runs ntiles + 3 iterations (three drain iterations) and
+so the grid runs ntiles + 2k-1 iterations (2k-1 drain iterations) and
 HBM field traffic is
 
-    read E(3) + H(3); write E(3) + H(3)  =  12 volumes PER 2 STEPS
-    = ~24 B/cell/step f32, ~12 B/cell/step bf16,
+    read E(3) + H(3); write E(3) + H(3)  =  12 volumes PER k STEPS
+    = ~48/k B/cell/step f32 (24 k=2, 16 k=3, 12 k=4; half that bf16),
 
-half the single-step packed kernel's 48/24, plus the fixed
-per-dispatch floor amortized over two steps. The intermediate
-generation t+1 never touches HBM: it lives in VMEM ring buffers
-(new-E ring depth 2, new-H ring depth 2, second-step new-E depth 1,
-old-H depth 1 + one halo plane), rotated at the end of each iteration.
-The ring values that a drain-phase consumer would read before their
-producer ran are masked to the PEC zero ghost exactly like the
-single-step kernel's pipeline edges.
+plus the fixed per-dispatch floor amortized over k steps. Intermediate
+generations t+1..t+k-1 never touch HBM: they live in VMEM ring
+buffers — per generation g < k a depth-2 E ring and a depth-2 H ring
+(consumed at lag 1 by H_g / the next E phase's curl and at lag 2 as
+the next phases' old fields), one depth-1 ring for E(t+k), and the
+H(t) tile + halo plane — rotated at the end of each iteration. Ring
+values a drain-phase consumer would read before their producer ran are
+masked to the PEC zero ghost (or the exchanged generation ghosts under
+sharding) exactly like the single-step kernel's pipeline edges.
 
-**CPML runs twice in-kernel.** The y/z slab psi recursion and the
-round-6 tile-aligned x-psi stacks advance TWO generations per pass:
-phase A/B compute psi(t+1) into small ring scratch (never HBM), phase
-C/D run the second recursion over them and write psi(t+2) at the
-lagged block indices. The x stacks keep the round-6 layout
-(``pallas_packed.x_block_maps`` — interior tiles pin their block and
-read identity profiles, so the recursion is a provable no-op there)
-with lag-2/lag-3 output maps; writes are masked to slab tiles.
+**CPML runs k times in-kernel.** The y/z slab psi recursion and the
+round-6 tile-aligned x-psi stacks advance k generations per pass:
+every E/H phase below generation k computes psi(t+g) into small ring
+scratch (never HBM; depth-2 rings per generation, like the fields),
+and the generation-k phases write psi(t+k) at the lagged block
+indices. The x stacks keep the round-6 layout
+(``pallas_packed.x_block_maps``) with lag-2(k-1)/lag-(2k-1) output
+maps; writes are masked to slab tiles.
 
-**In-kernel point source.** A mid-block source injection cannot be
-post-patched (it must propagate through the second step's curls), so
-the point source rides IN-KERNEL: both E phases add
-``amplitude * waveform(t[+1]) * mask`` to their accumulator before the
-ca/cb application, with the mask built from broadcasted iotas against
-the static source position and the (traced) tile offset — exactly the
-jnp step's term, evaluated at the right tile. Eligibility still
-requires ``_sources_interior`` (the ISSUE-8 gate): inside the CPML
-identity region the in-kernel x-psi recursions provably never see the
-injection, keeping the fused-x argument intact. TFSF is out of scope
-(the incident-line machinery has no in-kernel port yet) and falls back
-to ``pallas_packed``.
+**In-kernel sources (eligibility widening, round 12).** A mid-block
+injection cannot be post-patched (it must propagate through the later
+generations' curls), so every source rides IN-KERNEL at its
+generation's lag:
 
-**Sharded (round 11): the depth-2 halo pipeline.** Two Yee steps per
-pass need TWO ghost-plane generations per neighbor per axis, and the
-intermediate generation t+1 never touches HBM — so the exchange is a
-four-message schedule per sharded axis per pass, every message a full
-component stack at field dtype, all BEFORE (or thin-fix AFTER) the one
-kernel dispatch:
+* point source — all k E phases add ``amplitude * waveform(t+g-1)
+  * mask`` before the ca/cb application (the ``srcpos`` traced-operand
+  pattern under sharding; requires ``_sources_interior``).
+* TFSF — the incident-line corrections are PLANE-VALUE operands: the
+  step advances the 1D incident line k times in thin jnp, evaluates
+  each face correction's transverse value plane per generation
+  (``tfsf.corr_plane_term`` — the same zeta/interp/gate math the jnp
+  step uses, minus the normal-axis onehot), and the kernel adds
+  ``onehot(coord == plane) * value`` inside the matching phase. The
+  corrections never enter the psi recursions (they are accumulator
+  adds, exactly the jnp form), and ``_sources_interior`` keeps the
+  fused-x argument intact. Unsharded only (the boundary wedge
+  pre-pass has no incident-line port).
+* Drude ADE — the electric current J is one extra generation stack in
+  the ring scratch: phase E_g computes J(t+g) = kj J(t+g-1) + bj
+  E(t+g-1) alongside E, generation k lands in HBM at the E lag — so
+  Drude runs get the same k-fold traffic saving on J. Magnetic Drude
+  (K) stays out of scope. Unsharded only.
+* material grids — spatially-varying ca/cb/kj/bj (da/db) stream as
+  per-generation tiled operands at each phase's lag: each grid is
+  read k times per PASS = once per step, the same per-step coefficient
+  traffic as the single-step kernel (the k-fold saving is on fields;
+  ring-buffering coefficients would buy nothing but VMEM). Unsharded
+  only (the wedge pre-pass reads scalar coefficients).
 
-  1. ``ghost_H0``  — H(t) boundary stack, downstream (phase A's lo
-     ghost, exactly the single-step kernel's ``xgh``/``ygh``);
-  2. ``hi_E1``     — E(t+1) first-plane stack, upstream: computed by a
-     THIN jnp pre-pass on the boundary planes only (same arithmetic as
-     the jnp step, CPML slab/fused-x psi terms included, source term
-     included; cross-axis halo lines slice from the other axes'
-     already-received full ghost planes, so NO corner messages exist);
-     phase B consumes it as its hi ghost, making H(t+1) exact in-kernel
-     including the shard edges;
-  3. ``ghost_H1``  — H(t+1) boundary stack, downstream: the same thin
-     pre-pass advances the boundary H plane one step (its forward
-     diffs read hi_E1); phase C's lo ghost;
-  4. E(t+2) first-plane stack, upstream, AFTER the kernel: phase D's
-     hi edge keeps the zero ghost in-kernel and the missing
-     -db*s*E/dx contribution lands as the single-step kernel's thin
-     post-fix (``pallas_packed.hi_edge_h_fix`` — interior-shard slab
-     psi profiles are identity, so no psi term needs fixing).
+**VMEM-calibrated auto-depth picker.** ``pick_depth`` scores every
+k in {4, 3, 2} against the central Mosaic-temporaries calibration
+table (``config.vmem_temps("tb", k)``, ``FDTD3D_VMEM_TEMPS_TABLE``
+overrides) through the shared tile picker and takes the DEEPEST k
+whose budgeted tile stays viable (tile >= 2; tile == 1 only when no
+depth affords 2 and the single-step kernel does not afford >= 4).
+``FDTD3D_TB_DEPTH`` pins k. The decision (chosen k, per-k candidate
+tiles, source) is recorded in ``step.diag`` — telemetry ``run_start``
+and the ledger comm lane echo it — and ``plan.CommStrategy`` scores
+``ghost_depth`` with the same host-math picker. The VMEM ladder
+(sim._vmem_fallback) re-runs the pick under each shrunken budget, so
+a failing compile downgrades k -> k-1 -> ... -> 2 -> ``pallas_packed``
+before switching kernel families.
 
-Per step that is (ne + nh) component planes per sharded axis — the
-SAME ICI traffic as the single-step kernel at HALF the HBM traffic;
-``plan.Plan.halo_bytes_per_step_tb`` models it to the byte and the
-ledger comm lane's sharded tb trace equals it (tests/test_comm_
-costs.py). Message split (fused stack vs per-plane) and sync-vs-async
-scheduling follow the planned ``plan.CommStrategy`` (the
-communication-strategy autotuner; ``FDTD3D_COMM_STRATEGY``
-overrides). The drain-edge ring reads mask against this two-deep
-ghost region: the i==0 phase-A and i==2 phase-C lo edges read the
-exchanged generation ghosts instead of the PEC zero, and the
-i==ntiles phase-B hi edge reads ``hi_E1``.
+**Sharded: the depth-k halo pipeline.** k Yee steps per pass need k
+ghost-plane generations per neighbor per axis; the exchange is a
+2k-message schedule per sharded axis per pass, every message a full
+component stack at field dtype:
+
+  1..k.   ``gh[j]`` (j = 0..k-1) — H(t+j) boundary stacks, downstream:
+          generation 0 slices the stored field; generations 1..k-1
+          come from a THIN jnp boundary-wedge pre-pass that advances
+          the outermost k-1 planes per side generation by generation
+          (same arithmetic as the jnp step — CPML slab/fused-x psi
+          terms included via a per-plane psi wedge, source term
+          included; cross-axis halo lines slice from the other axes'
+          already-received full ghost planes of the SAME generation,
+          so NO corner messages exist). Phase E_{j+1} consumes gh[j]
+          as its lo ghost.
+  k+1..2k-1. ``hi_e[j]`` (j = 1..k-1) — E(t+j) first-plane stacks,
+          upstream (from the same wedge); phase H_j consumes hi_e[j]
+          as its hi ghost, making H(t+j) exact in-kernel including
+          the shard edges.
+  2k.     E(t+k) first-plane stack, upstream, AFTER the kernel: phase
+          H_k keeps the zero ghost in-kernel and the missing
+          -db*s*E/dx contribution lands as the single-step kernel's
+          thin post-fix (``pallas_packed.hi_edge_h_fix``).
+
+Per STEP that is (ne + nh) component planes per sharded axis — the
+SAME ICI traffic as the single-step kernel, invariant in k, at 1/k-th
+the HBM traffic; ``plan.Plan.halo_bytes_per_step_tb`` (and its
+``halo_bytes_per_step_tb_at(k=)`` form) models it to the byte and the
+ledger comm lane's sharded tb trace equals it for every k
+(tests/test_comm_costs.py). Message split (fused stack vs per-plane)
+and sync-vs-async scheduling follow ``plan.CommStrategy``
+(``FDTD3D_COMM_STRATEGY`` overrides). The drain-edge ring reads mask
+against this k-deep ghost region: phase E_g's i == 2(g-1) lo edge
+reads gh[g-1] instead of the PEC zero, and phase H_g's i == ntiles-1+
+2g-1 hi edge reads hi_e[g].
 
 Scope (everything else falls back to ops/pallas_packed.py): 3D, real
-f32/bf16 storage, sharded or not (sharded axes need mesh axis names —
-the packed kernel's own gate), slab-fitting CPML on any axes, scalar
-material coefficients only (a material grid would need each
-coefficient streamed at two tile lags; fall back), no
-Drude/metamaterial ADE, no compensated mode, no double-single.
-``FDTD3D_NO_TEMPORAL=1`` is the escape hatch that forces the round-6
-kernel bit-for-bit (solver.make_step).
+f32/bf16 storage, sharded or not (sharded axes need mesh axis names),
+slab-fitting CPML on any axes; point sources inside the CPML identity
+region (sharded or not); TFSF / electric-Drude ADE / material grids
+UNSHARDED (widening their sharded wedge is open); no magnetic Drude,
+no compensated mode, no double-single. ``FDTD3D_NO_TEMPORAL=1`` is the
+escape hatch that forces the round-6 kernel bit-for-bit.
 
-The step object advances TWO steps per call: ``step.steps_per_call ==
-2`` and ``step.tail_step`` is a single-step ``pallas_packed`` step
-built at THE SAME tile (``force_tile=T``) so odd step counts run
-``n//2`` blocked passes plus one trailing single step on the identical
-packed-carry layout (solver.make_chunk_runner).
-
-VMEM: the ring scratch is ~3x the single-step kernel's (field rings:
-2 E(t+1) + 1 E(t+2) + 2 H(t+1) + 1 H(t) tiles vs 2 tiles + 1 plane),
-modeled exactly by ``_scratch_bytes`` below; the tile picker
-(`pallas_packed._pick_tile_packed`, shared so the VMEM-ladder runtime
-budget applies here too) therefore lands on a smaller tile than the
-single-step kernel at the same grid. Dispatch falls back to
-``pallas_packed`` when the budgeted tile is too thin (T == 0, or T == 1
-while the single-step kernel affords >= 4 — mirroring the measured
-fused-vs-two-pass tile heuristic). The Mosaic-temporaries constant
-(~40 f32/cell-plane) is an UNCALIBRATED scale-up of the single-step
-kernel's measured 25; the first chip window should re-calibrate it.
+The step object advances k steps per call: ``step.steps_per_call ==
+k`` and ``step.tail_step`` is a single-step ``pallas_packed`` step
+built at THE SAME tile (``force_tile=T``) so horizons not divisible by
+k run ``n//k`` blocked passes plus ``n mod k`` trailing single steps
+on the identical packed-carry layout inside ONE compiled chunk
+(solver.make_chunk_runner).
 
 Donation-safety: every aliased array's block j is read at iteration j
-(E/H/psi_E at the tile map; psi_H/x-psi-H at lag 1, i.e. j+1) and
-written only at iteration j+2 (E family) or j+3 (H family) — reads
-always precede writes, and each array enters the call exactly once.
-Out-blocks at pipeline edges are revisited with writes MASKED
-(``pl.when``): under persist-until-change semantics the window flushes
-the last valid write; under flush-every-iteration the masked visits
-flush stale window bytes over HBM blocks that are never re-read (the
-in-maps are monotone and fetch each block before its first out visit)
-and the final valid write lands last. Structural test:
+(E/H/psi_E/J at the tile map; psi_H/x-psi-H at lag 1) and written only
+at iteration j+2(k-1) (E family) or j+2k-1 (H family) — reads always
+precede writes, and each array enters the call exactly once. Out
+blocks at pipeline edges are revisited with writes MASKED (``pl.when``)
+under the same Mosaic revisiting-semantics argument as the depth-2
+kernel. Structural gate: the ``donation-safety`` lint rule +
 tests/test_pallas_packed_tb.py::test_tb_donation_fetch_before_write.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -145,119 +156,143 @@ from fdtd3d_tpu.telemetry import named as _named
 
 AXES = "xyz"
 
-# Mosaic per-tile temporaries model (f32 per cell x tile plane): the
-# four-phase body holds roughly 1.6x the single-step kernel's live
-# values; 40 is a conservative scale-up of its MEASURED 25 — not yet
-# calibrated on hardware (re-run the 128^3/512^3 pass/fail probe of
-# ops/pallas_packed.py's comment on the first chip window).
-_TEMPS_F32_PER_CELL_TB = 40
+# supported pipeline depths (Yee steps per HBM pass): aliased from the
+# config authority so plan/bench/env validation can never drift from
+# what the builder accepts; deeper rings do not fit the VMEM model on
+# any tile we have measured
+from fdtd3d_tpu.config import TB_DEPTHS as DEPTHS  # noqa: E402
+
+
+def _depth_fits_shards(static, geo, k: int) -> bool:
+    """Whether the k-generation boundary wedge fits every sharded
+    axis's LOCAL extent: generation 1 computes E planes [0, k-2] (and
+    the mirrored hi side), so a shard must hold at least k-1 planes —
+    a (1,8,1) split of a 16-cell axis (local extent 2) admits k<=3
+    only. Deeper depths are simply not candidates there (the pick
+    falls to the deepest fitting k, then to pallas_packed)."""
+    return all(k - 1 <= geo["ldims"][a] for a in geo["sharded_axes"])
+
+
+def _coeff_grids_static(static) -> bool:
+    """Whether any material coefficient is a 3D grid — the STATIC
+    inference (plan._coeff_grid_counts, asserted equal to the real
+    allocation by tests/test_plan.py), so eligibility and the planner
+    never build coefficient arrays just to decide scope."""
+    from fdtd3d_tpu.plan import _coeff_grid_counts
+    per_e, per_h = _coeff_grid_counts(static)
+    return per_e > 0 or per_h > 0
 
 
 def eligible(static, mesh_axes=None) -> bool:
     """Temporal-blocked scope: a strict subset of the packed kernel's
     (module docstring). The dispatch falls back to ``pallas_packed``
     outside it, so this must never admit a config the kernel cannot
-    advance two exact steps for in one pass."""
+    advance k exact steps for in one pass.
+
+    Round-12 widening: TFSF (in-kernel plane-value corrections),
+    electric-Drude ADE (J in the ring scratch) and material grids
+    (per-generation tiled operands) are IN scope unsharded; sharded
+    topologies keep the round-11 plain scope (+ interior point
+    sources) — the boundary-wedge pre-pass reads scalar coefficients
+    and has no incident-line/J port yet."""
     if not _pk.eligible(static, mesh_axes):
         return False
-    # sharded topologies are IN scope (round 11): the depth-2 halo
-    # pipeline exchanges two ghost-plane generations per neighbor per
-    # pass (module docstring); _pk.eligible already requires mesh axis
-    # names for every sharded axis and _sources_interior for sourced
-    # sharded runs
-    if static.use_drude or static.use_drude_m:
-        return False          # ADE currents: not temporally blocked
     if static.cfg.compensated:
         return False          # Kahan residuals would double traffic
-    if static.tfsf_setup is not None:
-        return False          # no in-kernel incident-line port yet
-    if static.cfg.point_source.enabled \
-            and not _pk._sources_interior(static):
+    if static.use_drude_m:
+        return False          # magnetic ADE K: not temporally blocked
+    src_like = static.tfsf_setup is not None \
+        or static.cfg.point_source.enabled
+    if src_like and not _pk._sources_interior(static):
         return False          # in-absorber injection: legacy path only
+    if static.topology != (1, 1, 1):
+        if static.use_drude or static.tfsf_setup is not None:
+            return False      # wedge pre-pass: no J / incident line
+        if _coeff_grids_static(static):
+            return False      # wedge pre-pass reads scalar coefficients
     return True
 
 
-def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
-    """Two-steps-per-pass pipelined step, or None if out of scope."""
-    from fdtd3d_tpu import solver as solver_mod
+# ---------------------------------------------------------------------------
+# VMEM model + auto-depth picker
+# ---------------------------------------------------------------------------
 
-    if not eligible(static, mesh_axes):
-        return None
+
+def _geometry(static):
+    """Shared trace-static geometry for the VMEM models and builder."""
+    from fdtd3d_tpu import solver as solver_mod
     slabs = solver_mod.slab_axes(static)
     for a in static.pml_axes:
         if a not in slabs:
             return None       # thin-grid full-length psi: not covered
-    np_coeffs = solver_mod.build_coeffs(static)
-    interpret = jax.default_backend() not in ("tpu", "axon")
-    x_pml = 0 in static.pml_axes
-
     mode = static.mode
     topo = static.topology
-    mesh_axes = mesh_axes or {}
-    mesh_shape = mesh_shape or {}
     sharded_axes = tuple(a for a in range(3) if topo[a] > 1)
-    yz_sharded = tuple(a for a in sharded_axes if a != 0)
-    # all kernel dims are the per-shard LOCAL extents
     n1, n2, n3 = (static.grid_shape[a] // topo[a] for a in range(3))
-    ldims = (n1, n2, n3)
-    # the planned communication strategy (module docstring): message
-    # split + schedule for the depth-2 exchange; deterministic per
-    # (grid, topology, dtype, kind), FDTD3D_COMM_STRATEGY overrides
-    if sharded_axes:
-        from fdtd3d_tpu.plan import comm_strategy as _strategy_for
-        _strat = _strategy_for(static.cfg, topo,
-                               step_kind="pallas_packed_tb")
-        split = _strat.split
-        sync_sched = _strat.schedule == "sync"
-    else:
-        split, sync_sched = "fused", False
-    inv_dx = np.float32(1.0 / static.dx)
-    fdt = jnp.float32
-    fst = static.field_dtype
-    fbytes = np.dtype(fst).itemsize
     e_comps = list(mode.e_components)
     h_comps = list(mode.h_components)
-    ne, nh = len(e_comps), len(h_comps)
-
-    rows_e = _pk.psi_rows(static, slabs, "E")
-    rows_h = _pk.psi_rows(static, slabs, "H")
-    psi_axes_e = sorted(rows_e)
-    psi_axes_h = sorted(rows_h)
-
-    # scalar coefficients only (eligibility falls back on grids)
-    for c in e_comps:
-        for p in ("ca", "cb"):
-            if np.ndim(np_coeffs[f"{p}_{c}"]) == 3:
-                return None
-    for c in h_comps:
-        for p in ("da", "db"):
-            if np.ndim(np_coeffs[f"{p}_{c}"]) == 3:
-                return None
-
-    # fused x-slab CPML is MANDATORY here whenever x has a PML: a
-    # two-step pass admits no post-kernel psi recursion. Eligibility
-    # already guarantees the fuse condition (sourceless or interior
-    # sources), mirroring pallas_packed's fuse_x gate.
-    ps = static.cfg.point_source
-    src_on = bool(ps.enabled)
-    fuse_x = x_pml
-    m0 = slabs.get(0, 0)
+    fuse_x = 0 in static.pml_axes
     rows_x_e = [c for c in e_comps
                 if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
                 ] if fuse_x else []
     rows_x_h = [c for c in h_comps
                 if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
                 ] if fuse_x else []
-    kxe, kxh = len(rows_x_e), len(rows_x_h)
+    return {
+        "slabs": slabs,
+        "ldims": (n1, n2, n3),
+        "e_comps": e_comps, "h_comps": h_comps,
+        "ne": len(e_comps), "nh": len(h_comps),
+        "rows_e": _pk.psi_rows(static, slabs, "E"),
+        "rows_h": _pk.psi_rows(static, slabs, "H"),
+        "fuse_x": fuse_x,
+        "kxe": len(rows_x_e), "kxh": len(rows_x_h),
+        "rows_x_e": rows_x_e, "rows_x_h": rows_x_h,
+        "m0": slabs.get(0, 0),
+        "sharded_axes": sharded_axes,
+        "yz_sharded": tuple(a for a in sharded_axes if a != 0),
+    }
 
-    def _stack_shape(a: int, k: int):
-        s = [k, n1, n2, n3]
-        s[1 + a] = 2 * slabs[a]
-        return tuple(s)
 
-    def _psi_block_cells(a: int, t: int) -> int:
-        s = _stack_shape(a, 1)
-        return t * s[2] * s[3]
+def _tf_group_sizes(static) -> Dict[Tuple[str, int], int]:
+    """(family, face axis) -> correction count, polarization-filtered
+    (tfsf.POL_EPS — the shared threshold, so a record the value
+    builder drops never reaches the kernel)."""
+    from fdtd3d_tpu.ops import tfsf as tfsf_mod
+    setup = static.tfsf_setup
+    out: Dict[Tuple[str, int], int] = {}
+    if setup is None:
+        return out
+    for corr in setup.corrections:
+        pol = (setup.ehat if corr.src[0] == "E"
+               else setup.hhat)[component_axis(corr.src)]
+        if abs(pol) < tfsf_mod.POL_EPS:
+            continue
+        out[(corr.field, corr.axis)] = out.get((corr.field, corr.axis),
+                                               0) + 1
+    return out
+
+
+def _vmem_models(static, geo, k: int, n_arr_e: int, n_arr_h: int):
+    """(block_bytes_at, scratch_bytes_at) closures for depth k."""
+    slabs = geo["slabs"]
+    n1, n2, n3 = geo["ldims"]
+    ne, nh = geo["ne"], geo["nh"]
+    rows_e, rows_h = geo["rows_e"], geo["rows_h"]
+    psi_axes_e, psi_axes_h = sorted(rows_e), sorted(rows_h)
+    fuse_x, kxe, kxh = geo["fuse_x"], geo["kxe"], geo["kxh"]
+    sharded_axes, yz_sharded = geo["sharded_axes"], geo["yz_sharded"]
+    fbytes = np.dtype(static.field_dtype).itemsize
+    drude = static.use_drude
+    src_on = bool(static.cfg.point_source.enabled)
+    tf_sizes = _tf_group_sizes(static)
+
+    def _psi_cells(a: int, t: int) -> int:
+        """Cells of one psi-stack row's block: (t, n2, n3) with axis a
+        compacted to the 2m slab planes."""
+        s = [t, n2, n3]
+        s[a] = 2 * slabs[a]
+        return s[0] * s[1] * s[2]
 
     def _block_bytes(t: int) -> int:
         plane = n2 * n3
@@ -266,20 +301,29 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         total += 2 * nh * t * plane * fbytes       # H in + out
         for (axes, rows) in ((psi_axes_e, rows_e), (psi_axes_h, rows_h)):
             for a in axes:                         # psi stacks in + out
-                total += 2 * len(rows[a]) * _psi_block_cells(a, t) * 4
+                total += 2 * len(rows[a]) * _psi_cells(a, t) * 4
         if fuse_x:
             total += 2 * (kxe + kxh) * t * plane * 4   # x-psi in + out
-            total += 4 * 3 * t * 4                 # prof_ex(2)/prof_hx(2)
+            total += 2 * k * 3 * t * 4             # prof_ex/hx per gen
         for a in psi_axes_e + psi_axes_h:
             total += 3 * 2 * slabs[a] * 4          # y/z profile packs
-        if 0 in sharded_axes:                      # xgh0 + xgh1 + xe1
-            total += (2 * nh + ne) * plane * fbytes
-        for a in yz_sharded:                       # ygh0/ygh1/ye1
-            total += (2 * nh + ne) * t \
+        if drude:
+            total += 2 * ne * t * plane * 4        # J in + out
+        total += (n_arr_e + n_arr_h) * k * t * plane * 4   # coeff grids
+        for (fam, ax), ncorr in tf_sizes.items():  # TFSF value planes
+            gens = k
+            if ax == 0:
+                total += gens * ncorr * plane * 4
+            else:
+                total += gens * ncorr * t * (n3, n2)[ax - 1] * 4
+        if 0 in sharded_axes:                      # xgh[0..k-1], xe[1..k-1]
+            total += (k * nh + (k - 1) * ne) * plane * fbytes
+        for a in yz_sharded:                       # ygh/ye thin blocks
+            total += (k * nh + (k - 1) * ne) * t \
                 * (plane // (n2, n3)[a - 1]) * fbytes
-        total += (2 * t + n2 + n3) * 4             # walls (x twice)
+        total += (k * t + n2 + n3) * 4             # walls (x per gen)
         if src_on:
-            total += 2 * 4                         # waveform pair
+            total += k * 4                         # waveform stack
             if sharded_axes:
                 total += 3 * 4                     # srcpos
         return total
@@ -287,38 +331,224 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
     def _scratch_bytes(t: int) -> int:
         plane = n2 * n3
         total = 0
-        total += 3 * ne * t * plane * 4            # E1 ring x2 + E2
-        total += 3 * nh * t * plane * 4            # H1 ring x2 + H0
-        total += nh * plane * 4                    # H0 halo plane
+        total += (2 * (k - 1) + 1) * ne * t * plane * 4   # E rings + E(t+k)
+        total += (2 * (k - 1) + 1) * nh * t * plane * 4   # H rings + H(t)
+        total += nh * plane * 4                    # H(t) halo plane
         for (axes, rows) in ((psi_axes_e, rows_e), (psi_axes_h, rows_h)):
-            for a in axes:                         # psi(t+1) rings x2
-                total += 2 * len(rows[a]) * _psi_block_cells(a, t) * 4
+            for a in axes:                         # psi rings per gen
+                total += 2 * (k - 1) * len(rows[a]) * _psi_cells(a, t) * 4
         if fuse_x:
-            total += 2 * (kxe + kxh) * t * plane * 4   # x-psi rings
+            total += 2 * (k - 1) * (kxe + kxh) * t * plane * 4
+        if drude:
+            total += 2 * (k - 1) * ne * t * plane * 4     # J rings
         return total
 
-    T = _pk._pick_tile_packed(
-        n1, n2 * n3, _block_bytes, _scratch_bytes,
-        temps_f32_per_cell=_TEMPS_F32_PER_CELL_TB)
-    if T == 0:
-        return None
+    return _block_bytes, _scratch_bytes
 
-    # odd-step tail at the SAME tile => identical packed-carry layout
-    # (the x-psi stacks are tile-aligned); it also supplies pack/unpack
-    # and the chunk-entry prepare() for both kernels.
+
+def _arr_counts_static(static, geo) -> Tuple[int, int]:
+    """Streamed-coefficient-grid operand counts per family (one per
+    grid per component), from the static inference."""
+    from fdtd3d_tpu.plan import _coeff_grid_counts
+    per_e, per_h = _coeff_grid_counts(static)
+    return per_e * geo["ne"], per_h * geo["nh"]
+
+
+def pick_depth(static, mesh_axes=None):
+    """The VMEM-calibrated auto-depth pick (host math only; no coeffs
+    are built, no backend touched — plan.CommStrategy scores the same
+    function). Returns ``(k, tile, candidates, source)`` or None when
+    no depth is viable:
+
+    * candidates: {k: budgeted tile} for every allowed depth;
+    * the pick is the DEEPEST k with tile >= 2, else the deepest with
+      tile == 1 (the caller applies the single-step tile>=4 bail),
+      honoring the ``FDTD3D_TB_DEPTH`` pin (source records it).
+    """
+    from fdtd3d_tpu.config import tb_depth_env, vmem_temps
+    if not eligible(static, mesh_axes):
+        return None
+    geo = _geometry(static)
+    if geo is None:
+        return None
+    pinned = tb_depth_env()
+    cands = (pinned,) if pinned else tuple(sorted(DEPTHS, reverse=True))
+    n1, n2, n3 = geo["ldims"]
+    n_arr_e, n_arr_h = _arr_counts_static(static, geo)
+    tiles: Dict[int, int] = {}
+    for k in cands:
+        if not _depth_fits_shards(static, geo, k):
+            tiles[k] = 0      # wedge wider than a local shard extent
+            continue
+        bb, sb = _vmem_models(static, geo, k, n_arr_e, n_arr_h)
+        tiles[k] = _pk._pick_tile_packed(
+            n1, n2 * n3, bb, sb,
+            temps_f32_per_cell=vmem_temps("tb", k))
+    source = f"env:FDTD3D_TB_DEPTH={pinned}" if pinned else "auto"
+    best = max((k for k, t in tiles.items() if t >= 2), default=None)
+    if best is None:
+        best = max((k for k, t in tiles.items() if t == 1),
+                   default=None)
+    if best is None:
+        if pinned:
+            # a pin the kernel cannot honor must be a NAMED config
+            # error, never a silent 48 B/cell family switch (the
+            # registered-knob convention; a user A/B-ing depths would
+            # otherwise blame the kernel for the fallback's slowdown)
+            raise ValueError(
+                f"FDTD3D_TB_DEPTH={pinned}: the pinned temporal-block "
+                f"depth is not viable for this configuration — the "
+                f"k-1-plane boundary wedge must fit every sharded "
+                f"axis's local extent and the depth-{pinned} ring "
+                f"scratch must fit a VMEM tile (candidates: {tiles}). "
+                f"Unset the pin for the auto-depth pick, or force the "
+                f"single-step kernel with FDTD3D_NO_TEMPORAL=1.")
+        return None
+    return best, tiles[best], tiles, source
+
+
+def planned_depth(static) -> Optional[int]:
+    """ghost_depth the planner records for the tb kind (plan.py's
+    CommStrategy scoring) — the same deterministic pick the builder
+    makes, or None when the kernel is not viable at any depth. Mesh
+    axis names are derived from the static topology (the planner has
+    no live mesh; eligibility only needs the NAMES to exist)."""
+    from fdtd3d_tpu.parallel.mesh import mesh_axis_map
+    pick = pick_depth(static, mesh_axis_map(static.topology))
+    return pick[0] if pick is not None else None
+
+
+# ---------------------------------------------------------------------------
+# the builder
+# ---------------------------------------------------------------------------
+
+
+def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
+                        depth: Optional[int] = None):
+    """k-steps-per-pass pipelined step, or None if out of scope.
+    ``depth`` pins k (tests / the bench k-sweep); default: pick_depth.
+    """
+    from fdtd3d_tpu import solver as solver_mod
+    from fdtd3d_tpu.config import vmem_temps
+
+    if not eligible(static, mesh_axes):
+        return None
+    geo = _geometry(static)
+    if geo is None:
+        return None
+    np_coeffs = solver_mod.build_coeffs(static)
+    interpret = jax.default_backend() not in ("tpu", "axon")
+
+    mode = static.mode
+    topo = static.topology
+    mesh_axes = mesh_axes or {}
+    mesh_shape = mesh_shape or {}
+    slabs = geo["slabs"]
+    sharded_axes = geo["sharded_axes"]
+    yz_sharded = geo["yz_sharded"]
+    n1, n2, n3 = geo["ldims"]
+    ldims = (n1, n2, n3)
+    inv_dx = np.float32(1.0 / static.dx)
+    fdt = jnp.float32
+    fst = static.field_dtype
+    e_comps, h_comps = geo["e_comps"], geo["h_comps"]
+    ne, nh = geo["ne"], geo["nh"]
+    rows_e, rows_h = geo["rows_e"], geo["rows_h"]
+    psi_axes_e = sorted(rows_e)
+    psi_axes_h = sorted(rows_h)
+    drude = static.use_drude
+    setup = static.tfsf_setup
+
+    # fused x-slab CPML is MANDATORY here whenever x has a PML: a
+    # k-step pass admits no post-kernel psi recursion. Eligibility
+    # already guarantees the fuse condition (sourceless or interior
+    # sources), mirroring pallas_packed's fuse_x gate.
+    ps = static.cfg.point_source
+    src_on = bool(ps.enabled)
+    fuse_x = geo["fuse_x"]
+    m0 = geo["m0"]
+    rows_x_e, rows_x_h = geo["rows_x_e"], geo["rows_x_h"]
+    kxe, kxh = geo["kxe"], geo["kxh"]
+
+    # spatially-varying material coefficients: streamed per-generation
+    # tiled operands (module docstring); scalars embed as constants
+    pairs_e = ["ca", "cb"] + (["kj", "bj"] if drude else [])
+    pairs_h = ["da", "db"]
+    coeff_is_array = {}
+    for c in e_comps:
+        for p_ in pairs_e:
+            coeff_is_array[f"{p_}_{c}"] = \
+                np.ndim(np_coeffs[f"{p_}_{c}"]) == 3
+    for c in h_comps:
+        for p_ in pairs_h:
+            coeff_is_array[f"{p_}_{c}"] = \
+                np.ndim(np_coeffs[f"{p_}_{c}"]) == 3
+    arr_e = [key for key, v in coeff_is_array.items()
+             if v and key.split("_")[0] in pairs_e]
+    arr_h = [key for key, v in coeff_is_array.items()
+             if v and key.split("_")[0] in pairs_h]
+    if sharded_axes and (arr_e or arr_h or drude or setup is not None):
+        return None           # guarded by eligible(); belt and braces
+
+    # ---- depth + tile ----------------------------------------------------
+    if depth is not None:
+        if depth not in DEPTHS:
+            raise ValueError(f"temporal-block depth {depth} not in "
+                             f"{DEPTHS}")
+        if not _depth_fits_shards(static, geo, depth):
+            return None       # wedge wider than a local shard extent
+        bb, sb = _vmem_models(static, geo, depth, len(arr_e),
+                              len(arr_h))
+        T = _pk._pick_tile_packed(
+            n1, n2 * n3, bb, sb,
+            temps_f32_per_cell=vmem_temps("tb", depth))
+        if T == 0:
+            return None
+        k = depth
+        depth_diag = {"candidates": {depth: T}, "source": "arg"}
+    else:
+        pick = pick_depth(static, mesh_axes)
+        if pick is None:
+            return None
+        k, T, cands, source = pick
+        depth_diag = {"candidates": cands, "source": source}
+        if T == 1 and source == "auto":
+            # too thin: the deep pipeline at T=1 multiplies per-
+            # iteration setup cost and ring-rotation VPU work; if the
+            # single-step kernel affords a healthy tile, take its 48
+            # B/cell instead (the measured fused-vs-two-pass tile>=4
+            # heuristic). An explicit depth pin skips the bail.
+            free = _pk.make_packed_eh_step(static, mesh_axes, mesh_shape)
+            if free is not None and free.diag["tile"]["EH"] >= 4:
+                return None
+    bb_k, sb_k = _vmem_models(static, geo, k, len(arr_e), len(arr_h))
+
+    # the planned communication strategy (module docstring): message
+    # split + schedule for the depth-k exchange; deterministic per
+    # (grid, topology, dtype, kind), FDTD3D_COMM_STRATEGY overrides
+    if sharded_axes:
+        import dataclasses as _dc
+
+        from fdtd3d_tpu.plan import comm_strategy as _strategy_for
+        _strat = _strategy_for(static.cfg, topo,
+                               step_kind="pallas_packed_tb")
+        if _strat.ghost_depth != k:
+            # the step consumed a pinned/arg depth the planner did not
+            # model — the record must describe THIS exchange
+            _strat = _dc.replace(_strat, ghost_depth=k)
+        split = _strat.split
+        sync_sched = _strat.schedule == "sync"
+    else:
+        split, sync_sched = "fused", False
+
+    # odd-horizon tail at the SAME tile => identical packed-carry
+    # layout (the x-psi stacks are tile-aligned); it also supplies
+    # pack/unpack and the chunk-entry prepare() for both kernels.
     tail = _pk.make_packed_eh_step(static, mesh_axes, mesh_shape,
                                    force_tile=T)
     if tail is None:
         return None
     tail.kind = "pallas_packed"
-    if T == 1:
-        # too thin: the deep pipeline at T=1 multiplies per-iteration
-        # setup cost and ring-rotation VPU work; if the single-step
-        # kernel affords a healthy tile, take its 48 B/cell instead
-        # (mirrors the measured fused-vs-two-pass tile>=4 heuristic).
-        free = _pk.make_packed_eh_step(static, mesh_axes, mesh_shape)
-        if free is not None and free.diag["tile"]["EH"] >= 4:
-            return None
 
     ntiles = n1 // T
     if fuse_x:
@@ -328,8 +558,253 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         Sx, Lx, x_two_region, xblk = 0, 0, False, None
 
     src_pos = tuple(int(v) for v in ps.position) if src_on else None
+    lagE = 2 * (k - 1)        # E-family output lag
+    lagH = 2 * k - 1          # H-family output lag
 
-    # ---- the kernel -----------------------------------------------------
+    # TFSF in-kernel records (unsharded): per family, face-axis groups
+    # of polarization-filtered corrections; per component the static
+    # (axis, row, plane) triples the kernel masks with.
+    from fdtd3d_tpu.ops import tfsf as tfsf_mod
+    tf_groups: Dict[str, Dict[int, List]] = {"E": {}, "H": {}}
+    tf_records: Dict[str, Dict[str, List[Tuple[int, int, int]]]] = \
+        {"E": {}, "H": {}}
+    if setup is not None:
+        for corr in setup.corrections:
+            pol = (setup.ehat if corr.src[0] == "E"
+                   else setup.hhat)[component_axis(corr.src)]
+            if abs(pol) < tfsf_mod.POL_EPS:
+                continue
+            grp = tf_groups[corr.field].setdefault(corr.axis, [])
+            tf_records[corr.field].setdefault(corr.comp, []).append(
+                (corr.axis, len(grp), corr.plane))
+            grp.append(corr)
+
+    # ---- operand plan (ONE ordered authority for take/specs/args) -------
+    in_names: List[str] = []
+    in_specs: List = []
+
+    def add_in(name, spec):
+        in_names.append(name)
+        in_specs.append(spec)
+
+    def stack_spec(kk, last2, imap):
+        return pl.BlockSpec((kk, T, last2[0], last2[1]), imap,
+                            memory_space=pltpu.VMEM)
+
+    def lag_imap(lag):
+        if lag >= lagH:
+            return lambda i, _l=lag: (0, jnp.maximum(i - _l, 0), 0, 0)
+        # clamped at BOTH ends: the tb grid runs ntiles + 2k-1
+        # iterations, so an unclamped max(i-l, 0) would hand Mosaic
+        # out-of-range block indices on the drain iterations. Pinning
+        # to the last block keeps the window (no refetch) and the
+        # phase consuming it is masked there.
+        return lambda i, _l=lag: (
+            0, jnp.minimum(jnp.maximum(i - _l, 0), ntiles - 1), 0, 0)
+
+    tile_imap = lag_imap(0)
+
+    def psi_last2(a):
+        s = [1, n1, n2, n3]
+        s[1 + a] = 2 * slabs[a]
+        return (s[2], s[3])
+
+    if fuse_x:
+        def xpsi_lag_imap(lag):
+            if lag >= lagH:
+                return lambda i, _l=lag: (
+                    0, xblk(jnp.maximum(i - _l, 0)), 0, 0)
+            return lambda i, _l=lag: (
+                0, xblk(jnp.minimum(jnp.maximum(i - _l, 0),
+                                    ntiles - 1)), 0, 0)
+
+    const4 = lambda i: (0, 0, 0, 0)  # noqa: E731
+    const3 = lambda i: (0, 0, 0)     # noqa: E731
+
+    add_in("e_in", stack_spec(ne, (n2, n3), tile_imap))
+    add_in("h_in", stack_spec(nh, (n2, n3), tile_imap))
+    for a in psi_axes_e:
+        add_in(f"psE{a}", stack_spec(len(rows_e[a]), psi_last2(a),
+                                     tile_imap))
+    for a in psi_axes_h:
+        add_in(f"psH{a}", stack_spec(len(rows_h[a]), psi_last2(a),
+                                     lag_imap(1)))
+    if fuse_x:
+        add_in("psxE", pl.BlockSpec((kxe, T, n2, n3), xpsi_tile_imap,
+                                    memory_space=pltpu.VMEM))
+        add_in("psxH", pl.BlockSpec((kxh, T, n2, n3), xpsi_lag_imap(1),
+                                    memory_space=pltpu.VMEM))
+    if drude:
+        add_in("j_in", stack_spec(ne, (n2, n3), tile_imap))
+    for a in psi_axes_e:
+        s = [3, 1, 1, 1]
+        s[1 + a] = 2 * slabs[a]
+        add_in(f"prof_e_{a}", pl.BlockSpec(tuple(s), const4,
+                                           memory_space=pltpu.VMEM))
+    for a in psi_axes_h:
+        s = [3, 1, 1, 1]
+        s[1 + a] = 2 * slabs[a]
+        add_in(f"prof_h_{a}", pl.BlockSpec(tuple(s), const4,
+                                           memory_space=pltpu.VMEM))
+    if fuse_x:
+        def prof_spec(lag):
+            m4 = lag_imap(lag)
+            return pl.BlockSpec(
+                (3, T, 1, 1),
+                lambda i, _m=m4: (0, _m(i)[1], 0, 0),
+                memory_space=pltpu.VMEM)
+        for g in range(1, k + 1):
+            add_in(f"prof_ex{g}", prof_spec(2 * (g - 1)))
+        for g in range(1, k + 1):
+            add_in(f"prof_hx{g}", prof_spec(2 * g - 1))
+    # depth-k generation ghosts: x ghosts are whole boundary planes
+    # (constant block), y/z ghosts are thin per-tile blocks whose index
+    # maps follow their consuming phase's lag
+    if 0 in sharded_axes:
+        for j in range(k):
+            add_in(f"xgh{j}", pl.BlockSpec((nh, 1, n2, n3), const4,
+                                           memory_space=pltpu.VMEM))
+        for j in range(1, k):
+            add_in(f"xe{j}", pl.BlockSpec((ne, 1, n2, n3), const4,
+                                          memory_space=pltpu.VMEM))
+    for a in yz_sharded:
+        gh = [nh, T, n2, n3]
+        gh[1 + a] = 1
+        ge = [ne, T, n2, n3]
+        ge[1 + a] = 1
+        for j in range(k):
+            add_in(f"ygh{j}{a}", pl.BlockSpec(tuple(gh), lag_imap(2 * j),
+                                              memory_space=pltpu.VMEM))
+        for j in range(1, k):
+            add_in(f"ye{j}{a}", pl.BlockSpec(tuple(ge),
+                                             lag_imap(2 * j - 1),
+                                             memory_space=pltpu.VMEM))
+    # streamed material-coefficient grids, once per consuming phase
+    def coeff_spec(lag):
+        m4 = lag_imap(lag)
+        return pl.BlockSpec((T, n2, n3),
+                            lambda i, _m=m4: (_m(i)[1], 0, 0),
+                            memory_space=pltpu.VMEM)
+    for g in range(1, k + 1):
+        for key in arr_e:
+            add_in(f"ce{g}_{key}", coeff_spec(2 * (g - 1)))
+    for g in range(1, k + 1):
+        for key in arr_h:
+            add_in(f"ch{g}_{key}", coeff_spec(2 * g - 1))
+    # TFSF correction value planes, one stacked operand per (family,
+    # face axis, generation); x-face planes are constant blocks, y/z
+    # faces stream at the consuming phase's tile lag
+    for fam, tag in (("E", "tfe"), ("H", "tfh")):
+        for g in range(1, k + 1):
+            lag = 2 * (g - 1) if fam == "E" else 2 * g - 1
+            for ax_, grp in sorted(tf_groups[fam].items()):
+                ncorr = len(grp)
+                if ax_ == 0:
+                    add_in(f"{tag}{g}_{ax_}",
+                           pl.BlockSpec((ncorr, 1, n2, n3), const4,
+                                        memory_space=pltpu.VMEM))
+                else:
+                    bs = [ncorr, T, n2, n3]
+                    bs[1 + ax_] = 1
+                    add_in(f"{tag}{g}_{ax_}",
+                           pl.BlockSpec(tuple(bs), lag_imap(lag),
+                                        memory_space=pltpu.VMEM))
+    if src_on:
+        add_in("src", pl.BlockSpec((k, 1, 1), const3,
+                                   memory_space=pltpu.VMEM))
+        if sharded_axes:
+            add_in("srcpos", pl.BlockSpec((3, 1, 1), const3,
+                                          memory_space=pltpu.VMEM))
+    for g in range(1, k + 1):
+        m4 = lag_imap(2 * (g - 1))
+        add_in(f"wall_x{g}",
+               pl.BlockSpec((T, 1, 1),
+                            lambda i, _m=m4: (_m(i)[1], 0, 0),
+                            memory_space=pltpu.VMEM))
+    add_in("wall_y", pl.BlockSpec((1, n2, 1), const3,
+                                  memory_space=pltpu.VMEM))
+    add_in("wall_z", pl.BlockSpec((1, 1, n3), const3,
+                                  memory_space=pltpu.VMEM))
+
+    # ---- outputs ---------------------------------------------------------
+    def _stack_shape(a: int, kk: int):
+        s = [kk, n1, n2, n3]
+        s[1 + a] = 2 * slabs[a]
+        return tuple(s)
+
+    out_names: List[str] = ["e_out", "h_out"]
+    out_specs: List = [stack_spec(ne, (n2, n3), lag_imap(lagE)),
+                       stack_spec(nh, (n2, n3), lag_imap(lagH))]
+    out_shape = [jax.ShapeDtypeStruct((ne, n1, n2, n3), fst),
+                 jax.ShapeDtypeStruct((nh, n1, n2, n3), fst)]
+    for a in psi_axes_e:
+        out_names.append(f"psE{a}_out")
+        out_specs.append(stack_spec(len(rows_e[a]), psi_last2(a),
+                                    lag_imap(lagE)))
+        out_shape.append(jax.ShapeDtypeStruct(
+            _stack_shape(a, len(rows_e[a])), np.float32))
+    for a in psi_axes_h:
+        out_names.append(f"psH{a}_out")
+        out_specs.append(stack_spec(len(rows_h[a]), psi_last2(a),
+                                    lag_imap(lagH)))
+        out_shape.append(jax.ShapeDtypeStruct(
+            _stack_shape(a, len(rows_h[a])), np.float32))
+    if fuse_x:
+        out_names += ["psxE_out", "psxH_out"]
+        out_specs += [pl.BlockSpec((kxe, T, n2, n3), xpsi_lag_imap(lagE),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((kxh, T, n2, n3), xpsi_lag_imap(lagH),
+                                   memory_space=pltpu.VMEM)]
+        out_shape += [jax.ShapeDtypeStruct((kxe, Sx, n2, n3), np.float32),
+                      jax.ShapeDtypeStruct((kxh, Sx, n2, n3), np.float32)]
+    if drude:
+        out_names.append("j_out")
+        out_specs.append(stack_spec(ne, (n2, n3), lag_imap(lagE)))
+        out_shape.append(jax.ShapeDtypeStruct((ne, n1, n2, n3),
+                                              np.float32))
+
+    # Donation: module docstring — reads always precede the (lag-2(k-1)
+    # / lag-(2k-1)) writes of the same block, every array enters once.
+    aliases = {j: j for j in range(len(out_names))}
+
+    # ---- scratch ---------------------------------------------------------
+    scratch_names: List[str] = []
+    scratch: List = []
+
+    def add_scratch(name, shape):
+        scratch_names.append(name)
+        scratch.append(pltpu.VMEM(shape, jnp.float32))
+
+    for g in range(1, k):
+        add_scratch(f"se{g}a", (ne, T, n2, n3))
+        add_scratch(f"se{g}b", (ne, T, n2, n3))
+    add_scratch("sek", (ne, T, n2, n3))
+    add_scratch("sh0", (nh, T, n2, n3))
+    add_scratch("sh0h", (nh, 1, n2, n3))
+    for g in range(1, k):
+        add_scratch(f"sh{g}a", (nh, T, n2, n3))
+        add_scratch(f"sh{g}b", (nh, T, n2, n3))
+    for g in range(1, k):
+        for a in psi_axes_e:
+            s2, s3 = psi_last2(a)
+            add_scratch(f"spe{g}a_{a}", (len(rows_e[a]), T, s2, s3))
+            add_scratch(f"spe{g}b_{a}", (len(rows_e[a]), T, s2, s3))
+        for a in psi_axes_h:
+            s2, s3 = psi_last2(a)
+            add_scratch(f"sph{g}a_{a}", (len(rows_h[a]), T, s2, s3))
+            add_scratch(f"sph{g}b_{a}", (len(rows_h[a]), T, s2, s3))
+    if fuse_x:
+        for g in range(1, k):
+            add_scratch(f"sxe{g}a", (kxe, T, n2, n3))
+            add_scratch(f"sxe{g}b", (kxe, T, n2, n3))
+            add_scratch(f"sxh{g}a", (kxh, T, n2, n3))
+            add_scratch(f"sxh{g}b", (kxh, T, n2, n3))
+    if drude:
+        for g in range(1, k):
+            add_scratch(f"sj{g}a", (ne, T, n2, n3))
+            add_scratch(f"sj{g}b", (ne, T, n2, n3))
+
+    # ---- the kernel ------------------------------------------------------
     def kernel(*refs):
         idx = {}
         pos = 0
@@ -340,49 +815,21 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                 idx[nm] = refs[pos]
                 pos += 1
 
-        take(["e_in", "h_in"])
-        take([f"psE{a}" for a in psi_axes_e])
-        take([f"psH{a}" for a in psi_axes_h])
-        if fuse_x:
-            take(["psxE", "psxH"])
-        take([f"prof_e_{a}" for a in psi_axes_e])
-        take([f"prof_h_{a}" for a in psi_axes_h])
-        if fuse_x:
-            take(["prof_ex", "prof_ex2", "prof_hx", "prof_hx2"])
-        # depth-2 generation ghosts (module docstring): H(t) and
-        # H(t+1) lo stacks, E(t+1) hi stack, per sharded axis
-        if 0 in sharded_axes:
-            take(["xgh0", "xgh1", "xe1"])
-        for a in yz_sharded:
-            take([f"ygh0{a}", f"ygh1{a}", f"ye1{a}"])
-        if src_on:
-            take(["src"])
-            if sharded_axes:
-                take(["srcpos"])
-        take(["wall_x", "wall_x2", "wall_y", "wall_z"])
-        take(["e_out", "h_out"])
-        take([f"psE{a}_out" for a in psi_axes_e])
-        take([f"psH{a}_out" for a in psi_axes_h])
-        if fuse_x:
-            take(["psxE_out", "psxH_out"])
-        take(["se1a", "se1b", "se2", "sh0", "sh1a", "sh1b", "sh0h"])
-        take([f"spe1a_{a}" for a in psi_axes_e])
-        take([f"spe1b_{a}" for a in psi_axes_e])
-        take([f"sph1a_{a}" for a in psi_axes_h])
-        take([f"sph1b_{a}" for a in psi_axes_h])
-        if fuse_x:
-            take(["sxe1a", "sxe1b", "sxh1a", "sxh1b"])
+        take(in_names)
+        take(out_names)
+        take(scratch_names)
 
         i = pl.program_id(0)
-        # Phases A (E(t+1), tile i) and B (H(t+1), tile i-1) write only
-        # VMEM rings, so they need no write mask: out-of-range ring
-        # values are masked at their CONSUMERS (the jnp.where ghosts
-        # below). Phases C/D write HBM blocks and mask with pl.when.
-        valid_a = i < ntiles                       # E(t+1) tile i
-        valid_c = (i >= 2) & (i <= ntiles + 1)     # E(t+2) tile i-2
-        valid_d = i >= 3                           # H(t+2) tile i-3
-        tl2 = jnp.minimum(jnp.maximum(i - 2, 0), ntiles - 1)
-        tl3 = jnp.maximum(i - 3, 0)
+
+        def lagv(lag):
+            v = jnp.maximum(i - lag, 0)
+            return v if lag >= lagH else jnp.minimum(v, ntiles - 1)
+
+        valid_e = {g: (i >= 2 * (g - 1))
+                   & (i <= ntiles - 1 + 2 * (g - 1))
+                   for g in range(1, k + 1)}
+        valid_h = {g: (i >= 2 * g - 1) & (i <= ntiles - 1 + 2 * g - 1)
+                   for g in range(1, k + 1)}
         if fuse_x:
             if x_two_region:
                 def in_slab(tj):
@@ -390,13 +837,11 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
             else:
                 def in_slab(tj):
                     return tj >= 0                 # every tile
-            in_xslab_c = in_slab(tl2)
-            in_xslab_d = in_slab(tl3)
 
         def yz_diff(f, axis, backward, ghost=None):
             # ghost: the sharded-axis neighbor plane (backward: the lo
             # ghost; forward: the hi ghost). None = the PEC zero ghost
-            # (unsharded axes, and phase D's hi edge — post-fixed).
+            # (unsharded axes, and phase H_k's hi edge — post-fixed).
             if ghost is None:
                 ghost = jnp.zeros_like(
                     lax.slice_in_dim(f, 0, 1, axis=axis))
@@ -430,16 +875,19 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                 [dl, jnp.zeros(mid, fdt), dh], axis=a)
             return jnp.concatenate([p_lo, p_hi], axis=a), s * dfa + delta
 
-        def coef(key):
+        def coef(fam, g, key):
+            if coeff_is_array.get(key):
+                tag = "ce" if fam == "e" else "ch"
+                return idx[f"{tag}{g}_{key}"][:].astype(fdt)
             return fdt(float(np_coeffs[key]))
 
         def src_term(c, tile_lo, step_j):
-            """In-kernel point source: amplitude*waveform at the right
-            tile offset (module docstring); zero off-component. Under
-            sharding the LOCAL position rides as a traced srcpos
-            operand (global minus the shard offset — off-shard local
-            coordinates fall outside the iota range, so the mask is
-            identically zero there and no ownership flag is needed)."""
+            """In-kernel point source at generation step_j (0-based):
+            amplitude*waveform at the right tile offset; zero
+            off-component. Under sharding the LOCAL position rides as
+            a traced srcpos operand (off-shard local coordinates fall
+            outside the iota range, so the mask is identically zero
+            there)."""
             if not src_on or c != ps.component:
                 return None
             if sharded_axes:
@@ -454,6 +902,26 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
             mask = ((gx == px) & (gy == py) & (gz == pz)).astype(fdt)
             return idx["src"][step_j:step_j + 1] * mask
 
+        def tfsf_term(fam, c, g, tile_lo):
+            """Sum of comp c's TFSF plane-value corrections at
+            generation g: onehot(static face plane) x the traced value
+            plane (module docstring). Unsharded only (local == global
+            coordinates)."""
+            recs = tf_records[fam].get(c) if setup is not None else None
+            if not recs:
+                return None
+            tag = "tfe" if fam == "E" else "tfh"
+            tot = None
+            for (ax_, row, plane) in recs:
+                blk = idx[f"{tag}{g}_{ax_}"]
+                gi = lax.broadcasted_iota(jnp.int32, (T, n2, n3), ax_)
+                if ax_ == 0:
+                    gi = gi + tile_lo * T
+                mask = (gi == plane).astype(fdt)
+                term = mask * blk[row]
+                tot = term if tot is None else tot + term
+            return tot
+
         def wall_mask(e, c, wall_x_vals):
             ca_ax = component_axis(c)
             if ca_ax != 0:
@@ -463,16 +931,14 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                     e = e * idx[f"wall_{AXES[a2]}"][:].astype(fdt)
             return e
 
-        def e_update(h_tiles, h_ghosts, e_old, psi_get, psx_get,
-                     prof_x_name, wall_x_name, tile_lo, step_j,
-                     yz_ghost=None):
-            """One E-family update over one tile. Returns
-            (new e comps, {a: [new psi rows]}, [new x-psi rows]).
-            ``yz_ghost(a, jd)`` supplies the sharded y/z lo-ghost block
-            for this phase's tile (None on unsharded axes)."""
+        def e_update(g, h_tiles, h_ghosts, e_old, psi_get, psx_get,
+                     tile_lo, j_old, yz_ghost=None):
+            """Phase E_g over one tile. Returns (new e comps,
+            {a: [new psi rows]}, [new x-psi rows], [new J comps])."""
             new_psi: Dict[int, list] = {a: [None] * len(rows_e[a])
                                         for a in psi_axes_e}
             new_psx = [None] * kxe
+            new_j = [None] * ne if drude else None
             out = []
             for jc, c in enumerate(e_comps):
                 acc = None
@@ -483,7 +949,7 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                         dfa = (full[1:] - full[:-1]) * inv_dx
                         if fuse_x:
                             row = rows_x_e.index(c)
-                            pr = idx[prof_x_name]
+                            pr = idx[f"prof_ex{g}"]
                             psi_new = pr[0] * psx_get(row) + pr[1] * dfa
                             new_psx[row] = psi_new
                             term = s * (pr[2] * dfa + psi_new)
@@ -502,20 +968,27 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                         else:
                             term = s * dfa
                     acc = term if acc is None else acc + term
-                sv = src_term(c, tile_lo, step_j)
+                tv = tfsf_term("E", c, g, tile_lo)
+                if tv is not None:
+                    acc = acc + tv
+                old = e_old[jc]
+                if drude:
+                    jn = coef("e", g, f"kj_{c}") * j_old[jc] \
+                        + coef("e", g, f"bj_{c}") * old
+                    new_j[jc] = jn
+                    acc = acc - jn
+                sv = src_term(c, tile_lo, g - 1)
                 if sv is not None:
                     acc = acc + sv
-                e = coef(f"ca_{c}") * e_old[jc] + coef(f"cb_{c}") * acc
+                e = coef("e", g, f"ca_{c}") * old \
+                    + coef("e", g, f"cb_{c}") * acc
                 out.append(wall_mask(
-                    e, c, idx[wall_x_name][:].astype(fdt)))
-            return out, new_psi, new_psx
+                    e, c, idx[f"wall_x{g}"][:].astype(fdt)))
+            return out, new_psi, new_psx, new_j
 
-        def h_update(e_tiles, e_firsts, h_old, psi_get, psx_get,
-                     prof_x_name, yz_ghost=None):
-            """One H-family update over one tile (dual of e_update).
-            ``yz_ghost(a, jd)`` supplies the sharded y/z HI-ghost block
-            (the neighbor's E(t+1) boundary, phase B only — phase D
-            keeps the zero ghost and the thin post-fix)."""
+        def h_update(g, e_tiles, e_firsts, h_old, psi_get, psx_get,
+                     tile_lo, yz_ghost=None):
+            """Phase H_g over one tile (dual of e_update)."""
             new_psi: Dict[int, list] = {a: [None] * len(rows_h[a])
                                         for a in psi_axes_h}
             new_psx = [None] * kxh
@@ -529,7 +1002,7 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                         dfa = (ext[1:] - ext[:-1]) * inv_dx
                         if fuse_x:
                             row = rows_x_h.index(c)
-                            pr = idx[prof_x_name]
+                            pr = idx[f"prof_hx{g}"]
                             psi_new = pr[0] * psx_get(row) + pr[1] * dfa
                             new_psx[row] = psi_new
                             term = s * (pr[2] * dfa + psi_new)
@@ -548,317 +1021,209 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                         else:
                             term = s * dfa
                     acc = term if acc is None else acc + term
-                out.append(coef(f"da_{c}") * h_old[jc]
-                           - coef(f"db_{c}") * acc)
+                tv = tfsf_term("H", c, g, tile_lo)
+                if tv is not None:
+                    acc = acc + tv
+                out.append(coef("h", g, f"da_{c}") * h_old[jc]
+                           - coef("h", g, f"db_{c}") * acc)
             return out, new_psi, new_psx
 
-        # sharded y/z lo/hi ghost getters, one per consuming phase
-        # (block index maps track each phase's tile: tile_imap /
-        # lag2_imap / lag1_imap respectively)
-        if yz_sharded:
-            def ygh_a(a, jd):
-                return idx[f"ygh0{a}"][jd].astype(fdt) \
+        # sharded y/z ghost getters per generation (block index maps
+        # already track each phase's tile lag)
+        def ygh_for(j):
+            if not yz_sharded:
+                return None
+
+            def f(a, jd, _j=j):
+                return idx[f"ygh{_j}{a}"][jd].astype(fdt) \
                     if a in yz_sharded else None
+            return f
 
-            def ygh_c(a, jd):
-                return idx[f"ygh1{a}"][jd].astype(fdt) \
+        def ye_for(g):
+            if not yz_sharded:
+                return None
+
+            def f(a, jd, _g=g):
+                return idx[f"ye{_g}{a}"][jd].astype(fdt) \
                     if a in yz_sharded else None
+            return f
 
-            def ygh_b(a, jd):
-                return idx[f"ye1{a}"][jd].astype(fdt) \
-                    if a in yz_sharded else None
-        else:
-            ygh_a = ygh_c = ygh_b = None
+        h0_vals = [idx["h_in"][j].astype(fdt) for j in range(nh)]
+        e0_vals = [idx["e_in"][j].astype(fdt) for j in range(ne)]
 
-        # ---- phase A: E(t+1) on tile i -------------------------------
-        h_vals = [idx["h_in"][j].astype(fdt) for j in range(nh)]
-        e_vals = [idx["e_in"][j].astype(fdt) for j in range(ne)]
-        # tile-0 lo x ghost: the x neighbor's ppermuted H(t) boundary
-        # plane when x is sharded (zeros at the global edge = PEC)
-        gha = [jnp.where(i > 0, idx["sh0h"][j],
-                         idx["xgh0"][j].astype(fdt)
-                         if 0 in sharded_axes
-                         else jnp.zeros_like(idx["sh0h"][j]))
-               for j in range(nh)]
-        e1, psiE1, psxE1 = e_update(
-            h_vals, gha, e_vals,
-            lambda a, row: idx[f"psE{a}"][row].astype(fdt),
-            (lambda row: idx["psxE"][row].astype(fdt)) if fuse_x
-            else None,
-            "prof_ex", "wall_x", i, 0, yz_ghost=ygh_a)
+        # per-generation results stashed for the ring rotation
+        e_gen: Dict[int, list] = {}
+        h_gen: Dict[int, list] = {}
+        psiE_gen: Dict[int, Dict[int, list]] = {}
+        psiH_gen: Dict[int, Dict[int, list]] = {}
+        psxE_gen: Dict[int, list] = {}
+        psxH_gen: Dict[int, list] = {}
+        j_gen: Dict[int, list] = {}
 
-        # ---- phase B: H(t+1) on tile i-1 (ring scratch) --------------
-        e1_prev = [idx["se1a"][j] for j in range(ne)]   # E1[i-1]
-        h0_prev = [idx["sh0"][j] for j in range(nh)]    # H(t)[i-1]
-        # the last tile's hi x plane: the x neighbor's pre-pass E(t+1)
-        # boundary (xe1) when sharded, else the PEC zero — this is the
-        # drain-edge read masked against the two-deep ghost region
-        firsts1 = [jnp.where(valid_a, e1[j][0:1],
-                             idx["xe1"][j].astype(fdt)
-                             if 0 in sharded_axes
-                             else jnp.zeros_like(e1[j][0:1]))
-                   for j in range(ne)]
-        h1, psiH1, psxH1 = h_update(
-            e1_prev, firsts1, h0_prev,
-            lambda a, row: idx[f"psH{a}"][row].astype(fdt),
-            (lambda row: idx["psxH"][row].astype(fdt)) if fuse_x
-            else None,
-            "prof_hx", yz_ghost=ygh_b)
+        for g in range(1, k + 1):
+            le = 2 * (g - 1)
+            # ---- phase E_g: E(t+g) on tile i - 2(g-1) ----------------
+            if g == 1:
+                h_tiles = h0_vals
+                e_old = e0_vals
+                ring_last = [idx["sh0h"][j] for j in range(nh)]
+                psi_get = lambda a, row: idx[f"psE{a}"][row].astype(fdt)  # noqa: E731
+                psx_get = ((lambda row: idx["psxE"][row].astype(fdt))
+                           if fuse_x else None)
+                j_old = ([idx["j_in"][j].astype(fdt) for j in range(ne)]
+                         if drude else None)
+            else:
+                h_tiles = [idx[f"sh{g - 1}a"][j] for j in range(nh)]
+                e_old = [idx[f"se{g - 1}b"][j] for j in range(ne)]
+                ring_last = [idx[f"sh{g - 1}b"][j][-1:]
+                             for j in range(nh)]
+                psi_get = (lambda a, row, _g=g:
+                           idx[f"spe{_g - 1}b_{a}"][row])
+                psx_get = ((lambda row, _g=g: idx[f"sxe{_g - 1}b"][row])
+                           if fuse_x else None)
+                j_old = ([idx[f"sj{g - 1}b"][j] for j in range(ne)]
+                         if drude else None)
+            # lo x ghost: ring last plane of H(t+g-1)[tile-1], or the
+            # exchanged generation ghost at the drain edge (tile 0)
+            gh_lo = [jnp.where(i > le, ring_last[j],
+                               idx[f"xgh{g - 1}"][j].astype(fdt)
+                               if 0 in sharded_axes
+                               else jnp.zeros_like(ring_last[j]))
+                     for j in range(nh)]
+            tl_e = lagv(le)
+            e_g, psiE_g, psxE_g, j_g = e_update(
+                g, h_tiles, gh_lo, e_old, psi_get, psx_get, tl_e,
+                j_old, yz_ghost=ygh_for(g - 1))
+            e_gen[g], psiE_gen[g], psxE_gen[g] = e_g, psiE_g, psxE_g
+            if drude:
+                j_gen[g] = j_g
+            if g == k:
+                for jc in range(ne):
+                    @pl.when(valid_e[k])
+                    def _(jc=jc):
+                        idx["e_out"][jc] = e_g[jc].astype(fst)
+                for a in psi_axes_e:
+                    for row in range(len(rows_e[a])):
+                        @pl.when(valid_e[k])
+                        def _(a=a, row=row):
+                            idx[f"psE{a}_out"][row] = \
+                                psiE_g[a][row].astype(fdt)
+                if fuse_x:
+                    for row in range(kxe):
+                        @pl.when(valid_e[k] & in_slab(lagv(lagE)))
+                        def _(row=row):
+                            idx["psxE_out"][row] = \
+                                psxE_g[row].astype(fdt)
+                if drude:
+                    for jc in range(ne):
+                        @pl.when(valid_e[k])
+                        def _(jc=jc):
+                            idx["j_out"][jc] = j_g[jc].astype(fdt)
 
-        # ---- phase C: E(t+2) on tile i-2 -> HBM ----------------------
-        e1_old = [idx["se1b"][j] for j in range(ne)]    # E1[i-2]
-        h1_prev = [idx["sh1a"][j] for j in range(nh)]   # H1[i-2]
-        # tile-0 lo x ghost of the SECOND generation: the neighbor's
-        # pre-pass H(t+1) boundary plane (xgh1)
-        ghc = [jnp.where(i > 2, idx["sh1b"][j][-1:],
-                         idx["xgh1"][j].astype(fdt)
-                         if 0 in sharded_axes
-                         else jnp.zeros_like(idx["sh1b"][j][-1:]))
-               for j in range(nh)]
-        e2, psiE2, psxE2 = e_update(
-            h1_prev, ghc, e1_old,
-            lambda a, row: idx[f"spe1b_{a}"][row],
-            (lambda row: idx["sxe1b"][row]) if fuse_x else None,
-            "prof_ex2", "wall_x2", tl2, 1, yz_ghost=ygh_c)
-        for jc in range(ne):
-            @pl.when(valid_c)
-            def _(jc=jc):
-                idx["e_out"][jc] = e2[jc].astype(fst)
-        for a in psi_axes_e:
-            for row in range(len(rows_e[a])):
-                @pl.when(valid_c)
-                def _(a=a, row=row):
-                    idx[f"psE{a}_out"][row] = psiE2[a][row].astype(fdt)
-        if fuse_x:
-            for row in range(kxe):
-                @pl.when(valid_c & in_xslab_c)
-                def _(row=row):
-                    idx["psxE_out"][row] = psxE2[row].astype(fdt)
-
-        # ---- phase D: H(t+2) on tile i-3 -> HBM ----------------------
-        h1_old = [idx["sh1b"][j] for j in range(nh)]    # H1[i-3]
-        e2_prev = [idx["se2"][j] for j in range(ne)]    # E2[i-3]
-        firsts2 = [jnp.where(valid_c, e2[j][0:1],
-                             jnp.zeros_like(e2[j][0:1]))
-                   for j in range(ne)]
-        h2, psiH2, psxH2 = h_update(
-            e2_prev, firsts2, h1_old,
-            lambda a, row: idx[f"sph1b_{a}"][row],
-            (lambda row: idx["sxh1b"][row]) if fuse_x else None,
-            "prof_hx2")
-        for jc in range(nh):
-            @pl.when(valid_d)
-            def _(jc=jc):
-                idx["h_out"][jc] = h2[jc].astype(fst)
-        for a in psi_axes_h:
-            for row in range(len(rows_h[a])):
-                @pl.when(valid_d)
-                def _(a=a, row=row):
-                    idx[f"psH{a}_out"][row] = psiH2[a][row].astype(fdt)
-        if fuse_x:
-            for row in range(kxh):
-                @pl.when(valid_d & in_xslab_d)
-                def _(row=row):
-                    idx["psxH_out"][row] = psxH2[row].astype(fdt)
+            # ---- phase H_g: H(t+g) on tile i - (2g-1) ----------------
+            if g < k:
+                e_tiles = [idx[f"se{g}a"][j] for j in range(ne)]
+                firsts = [jnp.where(valid_e[g], e_g[j][0:1],
+                                    idx[f"xe{g}"][j].astype(fdt)
+                                    if 0 in sharded_axes
+                                    else jnp.zeros_like(e_g[j][0:1]))
+                          for j in range(ne)]
+                yzg = ye_for(g)
+            else:
+                e_tiles = [idx["sek"][j] for j in range(ne)]
+                # phase H_k's hi edge keeps the zero ghost in-kernel;
+                # the missing neighbor contribution is the thin
+                # post-fix (pallas_packed.hi_edge_h_fix)
+                firsts = [jnp.where(valid_e[k], e_g[j][0:1],
+                                    jnp.zeros_like(e_g[j][0:1]))
+                          for j in range(ne)]
+                yzg = None
+            if g == 1:
+                h_old = [idx["sh0"][j] for j in range(nh)]
+                psi_get_h = lambda a, row: idx[f"psH{a}"][row].astype(fdt)  # noqa: E731
+                psx_get_h = ((lambda row: idx["psxH"][row].astype(fdt))
+                             if fuse_x else None)
+            else:
+                h_old = [idx[f"sh{g - 1}b"][j] for j in range(nh)]
+                psi_get_h = (lambda a, row, _g=g:
+                             idx[f"sph{_g - 1}b_{a}"][row])
+                psx_get_h = ((lambda row, _g=g:
+                              idx[f"sxh{_g - 1}b"][row])
+                             if fuse_x else None)
+            tl_h = lagv(2 * g - 1)
+            h_g, psiH_g, psxH_g = h_update(
+                g, e_tiles, firsts, h_old, psi_get_h, psx_get_h, tl_h,
+                yz_ghost=yzg)
+            h_gen[g], psiH_gen[g], psxH_gen[g] = h_g, psiH_g, psxH_g
+            if g == k:
+                for jc in range(nh):
+                    @pl.when(valid_h[k])
+                    def _(jc=jc):
+                        idx["h_out"][jc] = h_g[jc].astype(fst)
+                for a in psi_axes_h:
+                    for row in range(len(rows_h[a])):
+                        @pl.when(valid_h[k])
+                        def _(a=a, row=row):
+                            idx[f"psH{a}_out"][row] = \
+                                psiH_g[a][row].astype(fdt)
+                if fuse_x:
+                    for row in range(kxh):
+                        @pl.when(valid_h[k] & in_slab(lagv(lagH)))
+                        def _(row=row):
+                            idx["psxH_out"][row] = \
+                                psxH_g[row].astype(fdt)
 
         # ---- phase R: rotate the rings for the next iteration --------
-        # (the "a" slots were read into values above, so the b <- a,
+        # (a slots were read into values above, so the b <- a,
         # a <- fresh order is race-free)
+        for g in range(1, k):
+            prev = [idx[f"se{g}a"][j] for j in range(ne)]
+            for j in range(ne):
+                idx[f"se{g}b"][j] = prev[j]
+                idx[f"se{g}a"][j] = e_gen[g][j]
         for j in range(ne):
-            idx["se1b"][j] = e1_prev[j]
-            idx["se1a"][j] = e1[j]
-            idx["se2"][j] = e2[j]
+            idx["sek"][j] = e_gen[k][j]
         for j in range(nh):
-            idx["sh1b"][j] = h1_prev[j]
-            idx["sh1a"][j] = h1[j]
-            idx["sh0"][j] = h_vals[j]
-            idx["sh0h"][j] = h_vals[j][-1:]
-        for a in psi_axes_e:
-            prev = [idx[f"spe1a_{a}"][row]
-                    for row in range(len(rows_e[a]))]
-            for row in range(len(rows_e[a])):
-                idx[f"spe1b_{a}"][row] = prev[row]
-                idx[f"spe1a_{a}"][row] = psiE1[a][row]
-        for a in psi_axes_h:
-            prev = [idx[f"sph1a_{a}"][row]
-                    for row in range(len(rows_h[a]))]
-            for row in range(len(rows_h[a])):
-                idx[f"sph1b_{a}"][row] = prev[row]
-                idx[f"sph1a_{a}"][row] = psiH1[a][row]
+            idx["sh0"][j] = h0_vals[j]
+            idx["sh0h"][j] = h0_vals[j][-1:]
+        for g in range(1, k):
+            prev = [idx[f"sh{g}a"][j] for j in range(nh)]
+            for j in range(nh):
+                idx[f"sh{g}b"][j] = prev[j]
+                idx[f"sh{g}a"][j] = h_gen[g][j]
+        for g in range(1, k):
+            for a in psi_axes_e:
+                prev = [idx[f"spe{g}a_{a}"][row]
+                        for row in range(len(rows_e[a]))]
+                for row in range(len(rows_e[a])):
+                    idx[f"spe{g}b_{a}"][row] = prev[row]
+                    idx[f"spe{g}a_{a}"][row] = psiE_gen[g][a][row]
+            for a in psi_axes_h:
+                prev = [idx[f"sph{g}a_{a}"][row]
+                        for row in range(len(rows_h[a]))]
+                for row in range(len(rows_h[a])):
+                    idx[f"sph{g}b_{a}"][row] = prev[row]
+                    idx[f"sph{g}a_{a}"][row] = psiH_gen[g][a][row]
         if fuse_x:
-            prev = [idx["sxe1a"][row] for row in range(kxe)]
-            for row in range(kxe):
-                idx["sxe1b"][row] = prev[row]
-                idx["sxe1a"][row] = psxE1[row]
-            prev = [idx["sxh1a"][row] for row in range(kxh)]
-            for row in range(kxh):
-                idx["sxh1b"][row] = prev[row]
-                idx["sxh1a"][row] = psxH1[row]
-
-    # ---- specs ----------------------------------------------------------
-    def stack_spec(k, last2, imap):
-        return pl.BlockSpec((k, T, last2[0], last2[1]), imap,
-                            memory_space=pltpu.VMEM)
-
-    def tile_imap(i):
-        return (0, jnp.minimum(i, ntiles - 1), 0, 0)
-
-    def lag1_imap(i):
-        # clamped at BOTH ends: the tb grid runs ntiles + 3 iterations
-        # (vs the single-step kernel's ntiles + 1), so an unclamped
-        # max(i-1, 0) would hand Mosaic out-of-range block indices on
-        # the last two (drain) iterations. Pinning to the last block
-        # keeps the window (no refetch) and the phase consuming it is
-        # masked there.
-        return (0, jnp.minimum(jnp.maximum(i - 1, 0), ntiles - 1), 0, 0)
-
-    def lag2_imap(i):
-        return (0, jnp.minimum(jnp.maximum(i - 2, 0), ntiles - 1), 0, 0)
-
-    def lag3_imap(i):
-        return (0, jnp.maximum(i - 3, 0), 0, 0)
-
-    def psi_last2(a):
-        s = _stack_shape(a, 1)
-        return (s[2], s[3])
-
-    if fuse_x:
-        def xpsi_lag1_imap(i):
-            # clamped like lag1_imap (pallas_packed.x_block_maps's own
-            # lag map is sized for the ntiles+1 grid, not ntiles+3)
-            return (0, xblk(jnp.minimum(jnp.maximum(i - 1, 0),
-                                        ntiles - 1)), 0, 0)
-
-        def xpsi_lag2_imap(i):
-            return (0, xblk(jnp.minimum(jnp.maximum(i - 2, 0),
-                                        ntiles - 1)), 0, 0)
-
-        def xpsi_lag3_imap(i):
-            return (0, xblk(jnp.maximum(i - 3, 0)), 0, 0)
-
-    in_specs = [
-        stack_spec(ne, (n2, n3), tile_imap),                  # E in
-        stack_spec(nh, (n2, n3), tile_imap),                  # H in
-    ]
-    in_specs += [stack_spec(len(rows_e[a]), psi_last2(a),
-                            tile_imap) for a in psi_axes_e]
-    in_specs += [stack_spec(len(rows_h[a]), psi_last2(a),
-                            lag1_imap) for a in psi_axes_h]
-    if fuse_x:
-        in_specs += [pl.BlockSpec((kxe, T, n2, n3), xpsi_tile_imap,
-                                  memory_space=pltpu.VMEM),
-                     pl.BlockSpec((kxh, T, n2, n3), xpsi_lag1_imap,
-                                  memory_space=pltpu.VMEM)]
-    for a in psi_axes_e + psi_axes_h:
-        s = [3, 1, 1, 1]
-        s[1 + a] = 2 * slabs[a]
-        in_specs += [pl.BlockSpec(tuple(s), lambda i: (0, 0, 0, 0),
-                                  memory_space=pltpu.VMEM)]
-    if fuse_x:  # full-length per-plane x profiles at both tile lags
-        def prof_spec(imap4):
-            return pl.BlockSpec((3, T, 1, 1),
-                                lambda i, _m=imap4: (0, _m(i)[1], 0, 0),
-                                memory_space=pltpu.VMEM)
-        in_specs += [prof_spec(tile_imap), prof_spec(lag2_imap),
-                     prof_spec(lag1_imap), prof_spec(lag3_imap)]
-    # depth-2 generation ghosts: x ghosts are whole boundary planes
-    # (constant block), y/z ghosts are thin per-tile blocks whose index
-    # maps follow their consuming phase (A: tile, C: lag-2, B: lag-1)
-    if 0 in sharded_axes:
-        in_specs += [pl.BlockSpec((nh, 1, n2, n3),
-                                  lambda i: (0, 0, 0, 0),
-                                  memory_space=pltpu.VMEM),    # xgh0
-                     pl.BlockSpec((nh, 1, n2, n3),
-                                  lambda i: (0, 0, 0, 0),
-                                  memory_space=pltpu.VMEM),    # xgh1
-                     pl.BlockSpec((ne, 1, n2, n3),
-                                  lambda i: (0, 0, 0, 0),
-                                  memory_space=pltpu.VMEM)]    # xe1
-    for a in yz_sharded:
-        gh = [nh, T, n2, n3]
-        gh[1 + a] = 1
-        ge = [ne, T, n2, n3]
-        ge[1 + a] = 1
-        in_specs += [pl.BlockSpec(tuple(gh), tile_imap,
-                                  memory_space=pltpu.VMEM),    # ygh0
-                     pl.BlockSpec(tuple(gh), lag2_imap,
-                                  memory_space=pltpu.VMEM),    # ygh1
-                     pl.BlockSpec(tuple(ge), lag1_imap,
-                                  memory_space=pltpu.VMEM)]    # ye1
-    if src_on:
-        in_specs += [pl.BlockSpec((2, 1, 1), lambda i: (0, 0, 0),
-                                  memory_space=pltpu.VMEM)]
-        if sharded_axes:
-            in_specs += [pl.BlockSpec((3, 1, 1),
-                                      lambda i: (0, 0, 0),
-                                      memory_space=pltpu.VMEM)]  # srcpos
-    in_specs += [pl.BlockSpec((T, 1, 1),
-                              lambda i: (jnp.minimum(i, ntiles - 1),
-                                         0, 0),
-                              memory_space=pltpu.VMEM),      # wall_x
-                 pl.BlockSpec((T, 1, 1),
-                              lambda i: (jnp.minimum(
-                                  jnp.maximum(i - 2, 0), ntiles - 1),
-                                  0, 0),
-                              memory_space=pltpu.VMEM),      # wall_x2
-                 pl.BlockSpec((1, n2, 1), lambda i: (0, 0, 0),
-                              memory_space=pltpu.VMEM),      # wall_y
-                 pl.BlockSpec((1, 1, n3), lambda i: (0, 0, 0),
-                              memory_space=pltpu.VMEM)]      # wall_z
-
-    out_specs = [stack_spec(ne, (n2, n3), lag2_imap),        # E out
-                 stack_spec(nh, (n2, n3), lag3_imap)]        # H out
-    out_specs += [stack_spec(len(rows_e[a]), psi_last2(a),
-                             lag2_imap) for a in psi_axes_e]
-    out_specs += [stack_spec(len(rows_h[a]), psi_last2(a),
-                             lag3_imap) for a in psi_axes_h]
-    if fuse_x:
-        out_specs += [pl.BlockSpec((kxe, T, n2, n3), xpsi_lag2_imap,
-                                   memory_space=pltpu.VMEM),
-                      pl.BlockSpec((kxh, T, n2, n3), xpsi_lag3_imap,
-                                   memory_space=pltpu.VMEM)]
-
-    out_shape = [jax.ShapeDtypeStruct((ne, n1, n2, n3), fst),
-                 jax.ShapeDtypeStruct((nh, n1, n2, n3), fst)]
-    out_shape += [jax.ShapeDtypeStruct(_stack_shape(a, len(rows_e[a])),
-                                       np.float32) for a in psi_axes_e]
-    out_shape += [jax.ShapeDtypeStruct(_stack_shape(a, len(rows_h[a])),
-                                       np.float32) for a in psi_axes_h]
-    if fuse_x:
-        out_shape += [jax.ShapeDtypeStruct((kxe, Sx, n2, n3),
-                                           np.float32),
-                      jax.ShapeDtypeStruct((kxh, Sx, n2, n3),
-                                           np.float32)]
-
-    # Donation: module docstring — reads always precede the (lag-2 /
-    # lag-3) writes of the same block, every array enters once.
-    n_psi = len(psi_axes_e) + len(psi_axes_h) + (2 if fuse_x else 0)
-    aliases = {j: j for j in range(2 + n_psi)}
-
-    # allocation order mirrors take(): field rings, then spe1a for all
-    # e axes, spe1b for all e axes, sph1a / sph1b likewise, x-psi rings
-    scratch = [pltpu.VMEM((ne, T, n2, n3), jnp.float32),    # se1a
-               pltpu.VMEM((ne, T, n2, n3), jnp.float32),    # se1b
-               pltpu.VMEM((ne, T, n2, n3), jnp.float32),    # se2
-               pltpu.VMEM((nh, T, n2, n3), jnp.float32),    # sh0
-               pltpu.VMEM((nh, T, n2, n3), jnp.float32),    # sh1a
-               pltpu.VMEM((nh, T, n2, n3), jnp.float32),    # sh1b
-               pltpu.VMEM((nh, 1, n2, n3), jnp.float32)]    # sh0h
-    for rows, axes in ((rows_e, psi_axes_e), (rows_h, psi_axes_h)):
-        for _slot in ("a", "b"):
-            for a in axes:
-                s2, s3 = psi_last2(a)
-                scratch += [pltpu.VMEM((len(rows[a]), T, s2, s3),
-                                       jnp.float32)]
-    if fuse_x:
-        scratch += [pltpu.VMEM((kxe, T, n2, n3), jnp.float32),
-                    pltpu.VMEM((kxe, T, n2, n3), jnp.float32),
-                    pltpu.VMEM((kxh, T, n2, n3), jnp.float32),
-                    pltpu.VMEM((kxh, T, n2, n3), jnp.float32)]
+            for g in range(1, k):
+                prev = [idx[f"sxe{g}a"][row] for row in range(kxe)]
+                for row in range(kxe):
+                    idx[f"sxe{g}b"][row] = prev[row]
+                    idx[f"sxe{g}a"][row] = psxE_gen[g][row]
+                prev = [idx[f"sxh{g}a"][row] for row in range(kxh)]
+                for row in range(kxh):
+                    idx[f"sxh{g}b"][row] = prev[row]
+                    idx[f"sxh{g}a"][row] = psxH_gen[g][row]
+        if drude:
+            for g in range(1, k):
+                prev = [idx[f"sj{g}a"][j] for j in range(ne)]
+                for j in range(ne):
+                    idx[f"sj{g}b"][j] = prev[j]
+                    idx[f"sj{g}a"][j] = j_gen[g][j]
 
     call = pl.pallas_call(
         kernel,
-        grid=(ntiles + 3,),
+        grid=(ntiles + 2 * k - 1,),
         in_specs=in_specs,
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shape),
@@ -869,40 +1234,42 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         interpret=interpret,
     )
 
-    # ---- the step (advances TWO steps) ----------------------------------
-    from fdtd3d_tpu.ops.sources import waveform
+    # ---- the step (advances k steps) -------------------------------------
     from fdtd3d_tpu.ops import stencil as _stencil
+    from fdtd3d_tpu.ops.sources import waveform
 
     prepare = tail.prepare
 
     def _coefv(key):
         return fdt(float(np_coeffs[key]))
 
-    # ---- depth-2 halo pre-pass (sharded only; module docstring) ---------
+    # ---- depth-k boundary-wedge pre-pass (sharded only) ------------------
     # Thin jnp computations of the boundary-plane generations the
-    # kernel cannot reach: E(t+1) on each sharded axis's first/last
-    # planes (exact — CPML slab and fused-x psi terms included, source
-    # included, walls applied) and H(t+1) on the last plane. The psi
-    # recursions here are read-only scratch: the kernel recomputes
-    # psi(t+1)/psi(t+2) for the whole local domain.
+    # kernel cannot reach: generation by generation, E(t+j) on each
+    # sharded axis's outermost k-j planes per side and H(t+j) on the
+    # outermost k-j (hi) / k-1-j (lo) planes, each exact — CPML slab
+    # and fused-x psi terms included via a per-plane psi wedge, source
+    # included, walls applied. The psi wedge is throwaway scratch: the
+    # kernel recomputes every psi generation for the whole local
+    # domain.
 
-    def _plane_slab_term(dfa, psi, pr, ax, s):
-        """Kernel slab_term's value form on a plane array (compact
-        2m-psi along ax; pr = prepared (3, ...) profile stack)."""
-        m = slabs[ax]
-        b, cc_, ik = pr[0], pr[1], pr[2]
-        cut = lambda f, lo, hi: lax.slice_in_dim(f, lo, hi, axis=ax)  # noqa: E731
-        nloc = dfa.shape[ax]
-        d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
-        p_lo = cut(b, 0, m) * cut(psi, 0, m) + cut(cc_, 0, m) * d_lo
-        p_hi = (cut(b, m, 2 * m) * cut(psi, m, 2 * m)
-                + cut(cc_, m, 2 * m) * d_hi)
-        dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
-        dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
-        mid = list(dfa.shape)
-        mid[ax] = nloc - 2 * m
-        delta = jnp.concatenate([dl, jnp.zeros(mid, fdt), dh], axis=ax)
-        return s * dfa + delta
+    def _slab_row(p: int, m: int, n_loc: int):
+        """Field plane -> compact slab-psi row (None = identity
+        region, psi identically zero)."""
+        if p < m:
+            return p
+        if p >= n_loc - m:
+            return 2 * m - (n_loc - p)
+        return None
+
+    def _psx_row(p: int):
+        """Field x plane -> tile-aligned x-psi storage row (None =
+        identity region)."""
+        if p < m0:
+            return p
+        if p >= n1 - m0:
+            return Sx - (n1 - p)
+        return None
 
     def _psx_plane(stack4, row, a, p):
         """Full-length x-psi of one row at plane (a, p): the
@@ -917,50 +1284,105 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         shape[0] = n1 - 2 * m0
         return jnp.concatenate([lo, jnp.zeros(shape, fdt), hi], axis=0)
 
-    def _own_axis_psi_term(pstate, cc, fam, a, p, c, dfa, s):
-        """Own-axis (plane-normal) psi term at boundary plane p: the
-        slab/fused-x recursion degenerates to one compact row."""
-        rows_fam = rows_e if fam == "e" else rows_h
-        rows_x = rows_x_e if fam == "e" else rows_x_h
-        psx_key = "psxE" if fam == "e" else "psxH"
+    def _plane_slab_term(dfa, psi, pr, ax, s):
+        """Kernel slab_term's form on a plane array -> (new compact
+        psi, accumulator term)."""
+        m = slabs[ax]
+        b, cc_, ik = pr[0], pr[1], pr[2]
+        cut = lambda f, lo, hi: lax.slice_in_dim(f, lo, hi, axis=ax)  # noqa: E731
+        nloc = dfa.shape[ax]
+        d_lo, d_hi = cut(dfa, 0, m), cut(dfa, nloc - m, nloc)
+        p_lo = cut(b, 0, m) * cut(psi, 0, m) + cut(cc_, 0, m) * d_lo
+        p_hi = (cut(b, m, 2 * m) * cut(psi, m, 2 * m)
+                + cut(cc_, m, 2 * m) * d_hi)
+        dl = s * ((cut(ik, 0, m) - 1.0) * d_lo + p_lo)
+        dh = s * ((cut(ik, m, 2 * m) - 1.0) * d_hi + p_hi)
+        mid = list(dfa.shape)
+        mid[ax] = nloc - 2 * m
+        delta = jnp.concatenate([dl, jnp.zeros(mid, fdt), dh], axis=ax)
+        return (jnp.concatenate([p_lo, p_hi], axis=ax),
+                s * dfa + delta)
+
+    def _mk_psi_get(pstate, fam, a, p, store):
+        """psi reader at plane (a, p): the packed state for generation
+        1, the previous generation's wedge store after; None means an
+        identity region (psi == 0 there, profiles identity)."""
+        def get(c, ax):
+            if store is not None:
+                return store.get((c, ax))
+            if ax == 0 and fuse_x:
+                rows_x = rows_x_e if fam == "e" else rows_x_h
+                row = rows_x.index(c)
+                key = "psxE" if fam == "e" else "psxH"
+                if a == 0:
+                    srow = _psx_row(p)
+                    if srow is None:
+                        return None
+                    return pstate[key][row, srow:srow + 1].astype(fdt)
+                return _psx_plane(pstate[key], row, a, p)
+            rows_fam = rows_e if fam == "e" else rows_h
+            stk = ("psE" if fam == "e" else "psH") + str(ax)
+            row = rows_fam[ax].index(c)
+            if ax == a:
+                rr = _slab_row(p, slabs[ax], ldims[ax])
+                if rr is None:
+                    return None
+                return lax.slice_in_dim(pstate[stk][row], rr, rr + 1,
+                                        axis=ax).astype(fdt)
+            return lax.slice_in_dim(pstate[stk][row], p, p + 1,
+                                    axis=a).astype(fdt)
+        return get
+
+    def _own_psi_term(cc, fam, c, a, p, dfa, s, psi_get, psi_set):
+        """Plane-normal psi term at plane (a, p): the slab / fused-x
+        recursion degenerates to one compact row."""
         if a == 0 and fuse_x:
-            row = rows_x.index(c)
-            srow = 0 if p == 0 else Sx - 1
-            psi_old = pstate[psx_key][row, srow:srow + 1].astype(fdt)
+            srow = _psx_row(p)
             prx = cc[f"_pk_prof_{fam}x"]
             cutp = lambda v: lax.slice_in_dim(v, p, p + 1, axis=0)  # noqa: E731
+            if srow is None:
+                return s * cutp(prx[2]) * dfa      # identity: ik == 1
+            psi_old = psi_get(c, 0)
+            if psi_old is None:
+                psi_old = jnp.zeros_like(dfa)
             psi_new = cutp(prx[0]) * psi_old + cutp(prx[1]) * dfa
+            psi_set(c, 0, psi_new)
             return s * (cutp(prx[2]) * dfa + psi_new)
         if a in slabs and a in static.pml_axes:
-            stk = "psE" if fam == "e" else "psH"
-            row = rows_fam[a].index(c)
-            rr = 0 if p == 0 else 2 * slabs[a] - 1
-            psi_old = lax.slice_in_dim(pstate[f"{stk}{a}"][row],
-                                       rr, rr + 1, axis=a).astype(fdt)
+            rr = _slab_row(p, slabs[a], ldims[a])
+            if rr is None:
+                return s * dfa
             pr = cc[f"_pk_prof_{fam}{a}"]
             cutr = lambda v: lax.slice_in_dim(v, rr, rr + 1, axis=a)  # noqa: E731
+            psi_old = psi_get(c, a)
+            if psi_old is None:
+                psi_old = jnp.zeros_like(dfa)
             psi_new = cutr(pr[0]) * psi_old + cutr(pr[1]) * dfa
+            psi_set(c, a, psi_new)
             return s * (cutr(pr[2]) * dfa + psi_new)
         return s * dfa
 
-    def _cross_axis_term(pstate, cc, fam, a, p, c, ax, dfa, s):
+    def _cross_psi_term(cc, fam, c, a, p, ax, dfa, s, psi_get,
+                        psi_set):
         """Cross-axis psi term on a boundary plane of axis a."""
         if ax == 0 and fuse_x:
-            rows_x = rows_x_e if fam == "e" else rows_x_h
-            psx_key = "psxE" if fam == "e" else "psxH"
-            row = rows_x.index(c)
-            psi_old = _psx_plane(pstate[psx_key], row, a, p)
+            psi_old = psi_get(c, 0)
+            if psi_old is None:
+                psi_old = jnp.zeros_like(dfa)
             prx = cc[f"_pk_prof_{fam}x"]
             psi_new = prx[0] * psi_old + prx[1] * dfa
+            psi_set(c, 0, psi_new)
             return s * (prx[2] * dfa + psi_new)
         if ax in slabs and ax in static.pml_axes:
-            rows_fam = rows_e if fam == "e" else rows_h
-            stk = "psE" if fam == "e" else "psH"
-            row = rows_fam[ax].index(c)
-            psi_old = lax.slice_in_dim(pstate[f"{stk}{ax}"][row],
-                                       p, p + 1, axis=a).astype(fdt)
-            return _plane_slab_term(dfa, psi_old,
-                                    cc[f"_pk_prof_{fam}{ax}"], ax, s)
+            psi_old = psi_get(c, ax)
+            if psi_old is None:
+                psi_old = jnp.zeros(
+                    tuple(2 * slabs[ax] if d == ax else dfa.shape[d]
+                          for d in range(3)), fdt)
+            psi_new, term = _plane_slab_term(
+                dfa, psi_old, cc[f"_pk_prof_{fam}{ax}"], ax, s)
+            psi_set(c, ax, psi_new)
+            return term
         return s * dfa
 
     def _shard_offsets():
@@ -973,28 +1395,25 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                 offs.append(jnp.int32(0))
         return offs
 
-    def _e1_plane(pstate, cc, a, p, gh0, offs, t):
-        """E(t+1) comps on boundary plane p of sharded axis a (f32)."""
-        E_arr, H_arr = pstate["E"], pstate["H"]
-        hpl = [lax.slice_in_dim(H_arr[jd], p, p + 1, axis=a).astype(fdt)
-               for jd in range(nh)]
+    def _wedge_e_plane(cc, a, p, h_at, gh_prev, e_old_pl, psi_get,
+                       psi_set, offs, tstep):
+        """E(t+j) comps on plane (a, p) of a sharded axis (f32).
+        ``h_at(jd, q)`` returns H(t+j-1) comp jd at plane q (q == -1:
+        the received downstream ghost); ``gh_prev[ax]`` the other
+        sharded axes' generation-(j-1) ghost stacks (cross-axis lo
+        ghost lines slice from them — no corner messages)."""
         out = []
         for jc, c in enumerate(e_comps):
             acc = None
             for (ax, jd, s) in CURL_TERMS[component_axis(c)]:
                 if ax == a:
-                    if p > 0:
-                        prev = lax.slice_in_dim(
-                            H_arr[jd], p - 1, p, axis=a).astype(fdt)
-                    else:
-                        prev = gh0[a][jd].astype(fdt)
-                    dfa = (hpl[jd] - prev) * inv_dx
-                    term = _own_axis_psi_term(pstate, cc, "e", a, p, c,
-                                              dfa, s)
+                    dfa = (h_at(jd, p) - h_at(jd, p - 1)) * inv_dx
+                    term = _own_psi_term(cc, "e", c, a, p, dfa, s,
+                                         psi_get, psi_set)
                 else:
-                    f = hpl[jd]
+                    f = h_at(jd, p)
                     if ax in sharded_axes:
-                        gl = lax.slice_in_dim(gh0[ax][jd], p, p + 1,
+                        gl = lax.slice_in_dim(gh_prev[ax][jd], p, p + 1,
                                               axis=a).astype(fdt)
                     else:
                         gl = jnp.zeros_like(
@@ -1003,12 +1422,12 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                                             axis=ax)
                     dfa = (f - jnp.concatenate([gl, body], axis=ax)) \
                         * inv_dx
-                    term = _cross_axis_term(pstate, cc, "e", a, p, c,
-                                            ax, dfa, s)
+                    term = _cross_psi_term(cc, "e", c, a, p, ax, dfa,
+                                           s, psi_get, psi_set)
                 acc = term if acc is None else acc + term
             if src_on and c == ps.component:
                 with _named("source"):
-                    wf = waveform(ps.waveform, t, 0.5, static.omega,
+                    wf = waveform(ps.waveform, tstep, 0.5, static.omega,
                                   static.dt, np.float32)
                     m_ = None
                     for b in range(3):
@@ -1019,9 +1438,8 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                         m_ = mb if m_ is None else (m_ & mb)
                     acc = acc + np.float32(ps.amplitude) * wf \
                         * m_.astype(fdt)
-            e_old = lax.slice_in_dim(E_arr[jc], p, p + 1,
-                                     axis=a).astype(fdt)
-            e = _coefv(f"ca_{c}") * e_old + _coefv(f"cb_{c}") * acc
+            e = _coefv(f"ca_{c}") * e_old_pl[jc] \
+                + _coefv(f"cb_{c}") * acc
             ca_ax = component_axis(c)
             for b in range(3):
                 if b == ca_ax:
@@ -1033,23 +1451,25 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
             out.append(e)
         return out
 
-    def _h1_plane(pstate, cc, a, e1_last, hi_e1):
-        """H(t+1) comps on the LAST plane of sharded axis a (f32): the
-        forward diffs read the received neighbor E(t+1) stack."""
-        H_arr = pstate["H"]
-        p = ldims[a] - 1
+    def _wedge_h_plane(cc, a, p, e_at, hi_cross, h_old_pl, psi_get,
+                       psi_set):
+        """H(t+j) comps on plane (a, p): ``e_at(jd, q)`` returns the
+        SAME generation's E at plane q (q == n_a: the received
+        upstream ghost); ``hi_cross[ax]`` its cross-axis hi-ghost
+        stacks."""
         out = []
         for jc, c in enumerate(h_comps):
             acc = None
             for (ax, jd, s) in CURL_TERMS[component_axis(c)]:
-                f = e1_last[jd]
                 if ax == a:
-                    dfa = (hi_e1[a][jd].astype(fdt) - f) * inv_dx
-                    term = _own_axis_psi_term(pstate, cc, "h", a, p, c,
-                                              dfa, s)
+                    dfa = (e_at(jd, p + 1) - e_at(jd, p)) * inv_dx
+                    term = _own_psi_term(cc, "h", c, a, p, dfa, s,
+                                         psi_get, psi_set)
                 else:
+                    f = e_at(jd, p)
                     if ax in sharded_axes:
-                        gl = lax.slice_in_dim(hi_e1[ax][jd], p, p + 1,
+                        gl = lax.slice_in_dim(hi_cross[ax][jd], p,
+                                              p + 1,
                                               axis=a).astype(fdt)
                     else:
                         gl = jnp.zeros_like(
@@ -1057,50 +1477,134 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
                     body = lax.slice_in_dim(f, 1, f.shape[ax], axis=ax)
                     dfa = (jnp.concatenate([body, gl], axis=ax) - f) \
                         * inv_dx
-                    term = _cross_axis_term(pstate, cc, "h", a, p, c,
-                                            ax, dfa, s)
+                    term = _cross_psi_term(cc, "h", c, a, p, ax, dfa,
+                                           s, psi_get, psi_set)
                 acc = term if acc is None else acc + term
-            h_old = lax.slice_in_dim(H_arr[jc], p, p + 1,
-                                     axis=a).astype(fdt)
-            out.append(_coefv(f"da_{c}") * h_old
+            out.append(_coefv(f"da_{c}") * h_old_pl[jc]
                        - _coefv(f"db_{c}") * acc)
         return out
 
     def _exchange_ghosts(pstate, cc, t):
-        """The four-message depth-2 exchange schedule (module
-        docstring): returns the kernel's ghost operands, every
-        ppermute scoped halo-exchange and split per the planned
-        CommStrategy."""
-        H_arr = pstate["H"]
-        gh0, hi_e1, gh1 = {}, {}, {}
-        for a in sharded_axes:
-            name, n_sh = mesh_axes[a], mesh_shape[mesh_axes[a]]
-            plane = lax.slice_in_dim(H_arr, ldims[a] - 1, ldims[a],
-                                     axis=1 + a)
-            gh0[a] = _stencil.exchange_stack(plane, name, n_sh,
-                                             downstream=True,
-                                             split=split)
+        """The 2k-1-message depth-k exchange schedule (module
+        docstring; message 2k is the post-kernel hi-edge fix): returns
+        (gh, hi_e, offs) with gh[j][a] the H(t+j) downstream stacks
+        and hi_e[j][a] (j >= 1) the E(t+j) upstream stacks."""
+        E_arr, H_arr = pstate["E"], pstate["H"]
         offs = _shard_offsets()
-        with _named("E-update"):
-            e1_first = {a: _e1_plane(pstate, cc, a, 0, gh0, offs, t)
-                        for a in sharded_axes}
-            e1_last = {a: _e1_plane(pstate, cc, a, ldims[a] - 1, gh0,
-                                    offs, t)
-                       for a in sharded_axes}
-        for a in sharded_axes:
-            name, n_sh = mesh_axes[a], mesh_shape[mesh_axes[a]]
-            hi_e1[a] = _stencil.exchange_stack(
-                jnp.stack(e1_first[a]).astype(fst), name, n_sh,
-                downstream=False, split=split)
-        with _named("H-update"):
-            h1_last = {a: _h1_plane(pstate, cc, a, e1_last[a], hi_e1)
-                       for a in sharded_axes}
-        for a in sharded_axes:
-            name, n_sh = mesh_axes[a], mesh_shape[mesh_axes[a]]
-            gh1[a] = _stencil.exchange_stack(
-                jnp.stack(h1_last[a]).astype(fst), name, n_sh,
-                downstream=True, split=split)
-        return gh0, gh1, hi_e1, offs
+
+        def _ex(stack, a, down):
+            name = mesh_axes[a]
+            return _stencil.exchange_stack(stack, name,
+                                           mesh_shape[name],
+                                           downstream=down, split=split)
+
+        gh = [{a: _ex(lax.slice_in_dim(H_arr, ldims[a] - 1, ldims[a],
+                                       axis=1 + a), a, True)
+               for a in sharded_axes}]
+        hi_e: List[Optional[Dict[int, jnp.ndarray]]] = [None]
+        Ew: Dict[int, Dict[int, list]] = {a: {} for a in sharded_axes}
+        Hw: Dict[int, Dict[int, list]] = {a: {} for a in sharded_axes}
+        psiwE: Dict[int, Dict[int, dict]] = {a: {} for a in sharded_axes}
+        psiwH: Dict[int, Dict[int, dict]] = {a: {} for a in sharded_axes}
+        for j in range(1, k):
+            newE: Dict[int, Dict[int, list]] = {a: {}
+                                                for a in sharded_axes}
+            newPsiE: Dict[int, Dict[int, dict]] = {a: {}
+                                                   for a in sharded_axes}
+            with _named("E-update"):
+                for a in sharded_axes:
+                    n_a = ldims[a]
+                    planes = sorted(set(range(0, k - j))
+                                    | set(range(max(n_a - (k - j), 0),
+                                                n_a)))
+                    for p in planes:
+                        def h_at(jd, q, a=a, j=j):
+                            if q < 0:
+                                return gh[j - 1][a][jd].astype(fdt)
+                            if j == 1:
+                                return lax.slice_in_dim(
+                                    H_arr[jd], q, q + 1,
+                                    axis=a).astype(fdt)
+                            return Hw[a][q][jd]
+                        if j == 1:
+                            e_old_pl = [lax.slice_in_dim(
+                                E_arr[jc], p, p + 1,
+                                axis=a).astype(fdt)
+                                for jc in range(ne)]
+                            store = None
+                        else:
+                            e_old_pl = Ew[a][p]
+                            store = psiwE[a][p]
+                        new_store: dict = {}
+                        pset = (lambda c, ax, v, _ns=new_store:
+                                _ns.__setitem__((c, ax), v))
+                        newE[a][p] = _wedge_e_plane(
+                            cc, a, p, h_at, gh[j - 1], e_old_pl,
+                            _mk_psi_get(pstate, "e", a, p, store),
+                            pset, offs, t + (j - 1))
+                        newPsiE[a][p] = new_store
+            Ew, psiwE = newE, newPsiE
+            hi_e.append({a: _ex(jnp.stack(Ew[a][0]).astype(fst), a,
+                                False)
+                         for a in sharded_axes})
+            newH: Dict[int, Dict[int, list]] = {a: {}
+                                                for a in sharded_axes}
+            newPsiH: Dict[int, Dict[int, dict]] = {a: {}
+                                                   for a in sharded_axes}
+            with _named("H-update"):
+                for a in sharded_axes:
+                    n_a = ldims[a]
+                    planes = sorted(set(range(0, max(k - 1 - j, 0)))
+                                    | set(range(max(n_a - (k - j), 0),
+                                                n_a)))
+                    for p in planes:
+                        def e_at(jd, q, a=a, j=j, n_a=n_a):
+                            if q >= n_a:
+                                return hi_e[j][a][jd].astype(fdt)
+                            return Ew[a][q][jd]
+                        if j == 1:
+                            h_old_pl = [lax.slice_in_dim(
+                                H_arr[jc], p, p + 1,
+                                axis=a).astype(fdt)
+                                for jc in range(nh)]
+                            store = None
+                        else:
+                            h_old_pl = Hw[a][p]
+                            store = psiwH[a][p]
+                        new_store = {}
+                        pset = (lambda c, ax, v, _ns=new_store:
+                                _ns.__setitem__((c, ax), v))
+                        newH[a][p] = _wedge_h_plane(
+                            cc, a, p, e_at, hi_e[j], h_old_pl,
+                            _mk_psi_get(pstate, "h", a, p, store),
+                            pset)
+                        newPsiH[a][p] = new_store
+            Hw, psiwH = newH, newPsiH
+            gh.append({a: _ex(jnp.stack(Hw[a][ldims[a] - 1])
+                              .astype(fst), a, True)
+                       for a in sharded_axes})
+        return gh, hi_e, offs
+
+    # ---- TFSF value-plane builder (unsharded; module docstring) ----------
+    if setup is not None:
+        active_axes = mode.active_axes
+
+        def _tf_stacks(fam, inc_d, coeffs):
+            out = {}
+            for ax_, grp in sorted(tf_groups[fam].items()):
+                rows = []
+                shape = [n1, n2, n3]
+                shape[ax_] = 1
+                for corr in grp:
+                    term = tfsf_mod.corr_plane_term(
+                        corr, setup, coeffs, inc_d, active_axes,
+                        static.dx)
+                    rows.append(jnp.broadcast_to(
+                        term.astype(fdt) if term is not None
+                        else jnp.zeros(()), tuple(shape)).astype(fdt))
+                out[f"{'tfe' if fam == 'E' else 'tfh'}"
+                    f"{{g}}_{ax_}"] = jnp.stack(rows)
+            return out
 
     def step(pstate, coeffs):
         if "_pk_wall_x" not in coeffs:
@@ -1111,36 +1615,78 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         new_state = dict(pstate)
         offs = None
         if sharded_axes:
-            gh0, gh1, hi_e1, offs = _exchange_ghosts(pstate, coeffs, t)
-        args = [pstate["E"], pstate["H"]]
-        args += [pstate[f"psE{a}"] for a in psi_axes_e]
-        args += [pstate[f"psH{a}"] for a in psi_axes_h]
+            gh, hi_e, offs = _exchange_ghosts(pstate, coeffs, t)
+        operands: Dict[str, jnp.ndarray] = {
+            "e_in": pstate["E"], "h_in": pstate["H"],
+            "wall_y": coeffs["_pk_wall_y"],
+            "wall_z": coeffs["_pk_wall_z"],
+        }
+        for a in psi_axes_e:
+            operands[f"psE{a}"] = pstate[f"psE{a}"]
+        for a in psi_axes_h:
+            operands[f"psH{a}"] = pstate[f"psH{a}"]
         if fuse_x:
-            args += [pstate["psxE"], pstate["psxH"]]
-        args += [coeffs[f"_pk_prof_e{a}"] for a in psi_axes_e]
-        args += [coeffs[f"_pk_prof_h{a}"] for a in psi_axes_h]
+            operands["psxE"] = pstate["psxE"]
+            operands["psxH"] = pstate["psxH"]
+        if drude:
+            operands["j_in"] = pstate["J"]
+        for a in psi_axes_e:
+            operands[f"prof_e_{a}"] = coeffs[f"_pk_prof_e{a}"]
+        for a in psi_axes_h:
+            operands[f"prof_h_{a}"] = coeffs[f"_pk_prof_h{a}"]
         if fuse_x:
-            args += [coeffs["_pk_prof_ex"], coeffs["_pk_prof_ex"],
-                     coeffs["_pk_prof_hx"], coeffs["_pk_prof_hx"]]
+            for g in range(1, k + 1):
+                operands[f"prof_ex{g}"] = coeffs["_pk_prof_ex"]
+                operands[f"prof_hx{g}"] = coeffs["_pk_prof_hx"]
         if 0 in sharded_axes:
-            args += [gh0[0], gh1[0], hi_e1[0]]
+            for j in range(k):
+                operands[f"xgh{j}"] = gh[j][0]
+            for j in range(1, k):
+                operands[f"xe{j}"] = hi_e[j][0]
         for a in yz_sharded:
-            args += [gh0[a], gh1[a], hi_e1[a]]
+            for j in range(k):
+                operands[f"ygh{j}{a}"] = gh[j][a]
+            for j in range(1, k):
+                operands[f"ye{j}{a}"] = hi_e[j][a]
+        for g in range(1, k + 1):
+            for key in arr_e:
+                operands[f"ce{g}_{key}"] = coeffs[key]
+            for key in arr_h:
+                operands[f"ch{g}_{key}"] = coeffs[key]
+        if setup is not None:
+            # advance the 1D incident line k times; the per-generation
+            # correction value planes ride as traced operands (E side
+            # samples Hinc at t+g-1/2 — before the Hinc advance — and
+            # the H side Einc at t+g, mirroring the jnp ordering)
+            with _named("tfsf"):
+                inc_d = pstate["inc"]
+                for g in range(1, k + 1):
+                    inc_d = tfsf_mod.advance_einc(
+                        inc_d, coeffs, t + (g - 1), static.dt,
+                        static.omega, setup)
+                    for nm, v in _tf_stacks("E", inc_d,
+                                            coeffs).items():
+                        operands[nm.format(g=g)] = v
+                    inc_d = tfsf_mod.advance_hinc(inc_d, coeffs, setup)
+                    for nm, v in _tf_stacks("H", inc_d,
+                                            coeffs).items():
+                        operands[nm.format(g=g)] = v
+                new_state["inc"] = inc_d
         if src_on:
             with _named("source"):
                 wf = jnp.stack([
-                    waveform(ps.waveform, t, 0.5, static.omega,
-                             static.dt, np.float32),
-                    waveform(ps.waveform, t + 1, 0.5, static.omega,
-                             static.dt, np.float32)])
-                args += [(np.float32(ps.amplitude)
-                          * wf).reshape(2, 1, 1)]
+                    waveform(ps.waveform, t + j, 0.5, static.omega,
+                             static.dt, np.float32)
+                    for j in range(k)])
+                operands["src"] = (np.float32(ps.amplitude)
+                                   * wf).reshape(k, 1, 1)
                 if sharded_axes:
-                    args += [jnp.stack(
-                        [jnp.int32(src_pos[k]) - offs[k]
-                         for k in range(3)]).reshape(3, 1, 1)]
-        args += [coeffs["_pk_wall_x"], coeffs["_pk_wall_x"],
-                 coeffs["_pk_wall_y"], coeffs["_pk_wall_z"]]
+                    operands["srcpos"] = jnp.stack(
+                        [jnp.int32(src_pos[b]) - offs[b]
+                         for b in range(3)]).reshape(3, 1, 1)
+        for g in range(1, k + 1):
+            operands[f"wall_x{g}"] = coeffs["_pk_wall_x"]
+        args = [operands[nm] for nm in in_names]
         if sync_sched:
             # planned "sync" schedule (plan.CommStrategy): pin the
             # exchange results before the kernel so the scheduler
@@ -1159,28 +1705,31 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None):
         if fuse_x:
             new_state["psxE"] = outs[p]; p += 1
             new_state["psxH"] = outs[p]; p += 1
+        if drude:
+            new_state["J"] = outs[p]; p += 1
         if sharded_axes:
-            # phase D kept the PEC zero hi ghost for E(t+2): add the
+            # phase H_k kept the PEC zero hi ghost for E(t+k): add the
             # neighbor's first-plane contribution as the single-step
-            # kernel's thin post-fix (the fourth exchange message)
+            # kernel's thin post-fix (the 2k-th exchange message)
             new_state["H"] = _pk.hi_edge_h_fix(
                 new_state["E"], new_state["H"], static, coeffs,
                 mesh_axes, mesh_shape, sharded_axes, ldims, e_comps,
                 h_comps, inv_dx, split=split)
-        new_state["t"] = t + 2
+        new_state["t"] = t + k
         return new_state
 
     step.pack = tail.pack
     step.unpack = tail.unpack
     step.packed = True
     step.prepare = prepare
-    step.steps_per_call = 2
+    step.steps_per_call = k
     step.tail_step = tail
     step.diag = {"tile": {"EH": T},
                  "fused_x": fuse_x,
-                 "temporal_block": 2,
-                 "vmem_block_bytes": {"EH": _block_bytes(T)},
-                 "vmem_scratch_bytes": _scratch_bytes(T)}
+                 "temporal_block": k,
+                 "depth_pick": depth_diag,
+                 "vmem_block_bytes": {"EH": bb_k(T)},
+                 "vmem_scratch_bytes": sb_k(T)}
     if sharded_axes:
         step.diag["comm_strategy"] = _strat.as_record()
     return step

@@ -274,8 +274,14 @@ def _corr_gate_transverse(corr: Correction, setup: TfsfSetup, gs,
                           active_axes, dtype):
     """Staggered transverse box membership (no normal-axis onehot) as a
     broadcastable 0/1 mask, or None when no transverse axis is active.
-    Split out of _corr_gate for consumers that carry the normal plane
-    index statically (the packed-ds kernel's per-plane records)."""
+    THE single authority for which cells a correction touches
+    transversely — consumed by corr_plane_term (f32: the jnp step AND
+    the temporal-blocked kernel's plane-value operands), by
+    record_term_ds (float32x2), and by consumers that carry the normal
+    plane index statically (the packed-ds kernel's per-plane records;
+    mirrored by pallas3d.plane_corrections' patch gating) — so the
+    box-membership rule (half-offset components occupy [lo, hi-1])
+    can never drift between paths."""
     gate = None
     m_off = YEE_OFFSETS[corr.mask_comp]
     for b in range(3):
@@ -290,20 +296,51 @@ def _corr_gate_transverse(corr: Correction, setup: TfsfSetup, gs,
     return gate
 
 
-def _corr_gate(corr: Correction, setup: TfsfSetup, gs, active_axes,
-               dtype):
-    """Plane-onehot x staggered transverse box membership, as a
-    broadcastable 0/1 mask. THE single authority for which cells a
-    correction touches — shared by the f32 and float32x2 paths (and
-    mirrored by pallas3d.plane_corrections' patch gating) so the
-    box-membership rule (half-offset components occupy [lo, hi-1])
-    can never drift between dtypes."""
-    onehot_shape = [1, 1, 1]
-    onehot_shape[corr.axis] = gs[corr.axis].shape[0]
-    gate = (gs[corr.axis] == corr.plane).reshape(onehot_shape)
-    gate = gate.astype(dtype)
-    tg = _corr_gate_transverse(corr, setup, gs, active_axes, dtype)
-    return gate if tg is None else gate * tg
+def corr_plane_term(corr: Correction, setup: TfsfSetup, coeffs,
+                    inc: Dict[str, jnp.ndarray], active_axes,
+                    dx: float) -> Optional[jnp.ndarray]:
+    """ONE correction's accumulator term on its face plane — the
+    transverse box gate applied but WITHOUT the normal-axis onehot —
+    or None when the polarization projection vanishes (POL_EPS).
+
+    The single authority for the per-correction f32 math (the ds twin
+    is record_term_ds): corrections_for consumes it through the
+    normal-axis onehot, and the temporal-blocked kernel
+    (ops/pallas_packed_tb.py) consumes it directly as per-generation
+    plane-value operands, carrying the plane index statically — so the
+    jnp and in-kernel paths cannot drift."""
+    gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
+    # zeta is a REAL line coordinate even when the fields are complex
+    # (complex_fields mode): interpolation clips/floors it.
+    rdt = jnp.real(inc["Einc"]).dtype
+    # zeta at the sample position, as broadcastable sum of 1D arrays.
+    off = YEE_OFFSETS[corr.src]
+    zeta = setup.zeta0 + setup.khat[corr.axis] * (
+        corr.pos_a - setup.origin[corr.axis])
+    zeta = jnp.asarray(zeta, dtype=rdt)
+    for b in range(3):
+        if b == corr.axis or b not in active_axes:
+            continue
+        pb = gs[b].astype(rdt) + off[b]
+        shape = [1, 1, 1]
+        shape[b] = pb.shape[0]
+        # khat/origin are strong-typed f64 scalars: cast to rdt so
+        # an f32 run stays f32 even with jax_enable_x64 on
+        zeta = zeta + jnp.asarray(setup.khat[b], rdt) * (
+            pb - jnp.asarray(setup.origin[b], rdt)).reshape(shape)
+    if corr.src[0] == "E":
+        val = _interp_line(inc["Einc"], zeta)
+        pol = setup.ehat[component_axis(corr.src)]
+    else:
+        # Hinc samples live at half positions on the line.
+        val = _interp_line(inc["Hinc"], zeta - 0.5)
+        pol = setup.hhat[component_axis(corr.src)]
+    if abs(pol) < POL_EPS:
+        return None
+    gate = _corr_gate_transverse(corr, setup, gs, active_axes,
+                                 val.dtype)
+    term = jnp.asarray(corr.sign * pol / dx, rdt) * val
+    return term if gate is None else term * gate
 
 
 def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
@@ -312,42 +349,22 @@ def corrections_for(field: str, comp: str, setup: TfsfSetup, coeffs,
     """Sum of this component's TFSF curl-accumulator corrections (or None).
 
     Built as sum over face planes of onehot_1d(axis) * slab(transverse),
-    everything derived from the sharded coordinate arrays gx/gy/gz.
+    everything derived from the sharded coordinate arrays gx/gy/gz
+    (corr_plane_term supplies each face's transverse value plane).
     """
     gs = (coeffs["gx"], coeffs["gy"], coeffs["gz"])
-    # zeta is a REAL line coordinate even when the fields are complex
-    # (complex_fields mode): interpolation clips/floors it.
-    rdt = jnp.real(inc["Einc"]).dtype
     total = None
     for corr in setup.corrections:
         if corr.field != field or corr.comp != comp:
             continue
-        # zeta at the sample position, as broadcastable sum of 1D arrays.
-        off = YEE_OFFSETS[corr.src]
-        zeta = setup.zeta0 + setup.khat[corr.axis] * (
-            corr.pos_a - setup.origin[corr.axis])
-        zeta = jnp.asarray(zeta, dtype=rdt)
-        for b in range(3):
-            if b == corr.axis or b not in active_axes:
-                continue
-            pb = gs[b].astype(rdt) + off[b]
-            shape = [1, 1, 1]
-            shape[b] = pb.shape[0]
-            # khat/origin are strong-typed f64 scalars: cast to rdt so
-            # an f32 run stays f32 even with jax_enable_x64 on
-            zeta = zeta + jnp.asarray(setup.khat[b], rdt) * (
-                pb - jnp.asarray(setup.origin[b], rdt)).reshape(shape)
-        if corr.src[0] == "E":
-            val = _interp_line(inc["Einc"], zeta)
-            pol = setup.ehat[component_axis(corr.src)]
-        else:
-            # Hinc samples live at half positions on the line.
-            val = _interp_line(inc["Hinc"], zeta - 0.5)
-            pol = setup.hhat[component_axis(corr.src)]
-        if abs(pol) < POL_EPS:
+        term = corr_plane_term(corr, setup, coeffs, inc, active_axes,
+                               dx)
+        if term is None:
             continue
-        gate = _corr_gate(corr, setup, gs, active_axes, val.dtype)
-        term = jnp.asarray(corr.sign * pol / dx, rdt) * gate * val
+        onehot_shape = [1, 1, 1]
+        onehot_shape[corr.axis] = gs[corr.axis].shape[0]
+        onehot = (gs[corr.axis] == corr.plane).reshape(onehot_shape)
+        term = term * onehot.astype(term.dtype)
         total = term if total is None else total + term
     return total
 

@@ -54,9 +54,16 @@ class CommStrategy:
     them — tools/aot_overlap.py proves the lowering); "sync" forces
     the exchange to complete first via an optimization barrier (the
     measurement A/B posture).
-    ``ghost_depth``: ghost-plane generations exchanged per pass —
-    2 for the temporal-blocked kernel (H(t)+H(t+1) down,
-    E(t+1)+E(t+2) up), 1 for single-step kinds.
+    ``ghost_depth``: ghost-plane generations exchanged per pass — the
+    temporal-blocked kernel's pipeline depth k (H(t)..H(t+k-1) down,
+    E(t+1)..E(t+k) up), scored as a FREE VARIABLE by the VMEM-
+    calibrated auto-depth picker (ops/pallas_packed_tb.pick_depth:
+    deepest k in {2,3,4} whose budgeted tile stays viable;
+    ``FDTD3D_TB_DEPTH`` pins); 1 for single-step kinds. Per-STEP ICI
+    bytes are depth-invariant (k stacks per pass / k steps), so depth
+    trades only VMEM ring scratch against HBM bytes — the halo-depth-
+    vs-bytes frontier of PAPERS.md's 2606.06910 with the bytes axis
+    flat.
     """
 
     step_kind: str
@@ -118,20 +125,37 @@ class Plan:
     # sum of bytes_per_step over axes == halo_bytes_per_step.
     halo_by_axis: Dict[str, Dict[str, int]] = dataclasses.field(
         default_factory=dict)
-    # Temporal-blocked (depth-2) halo model (round 11): the tb kernel
-    # exchanges TWO ghost-plane generations per neighbor per pass —
-    # the full H stack at t and t+1 downstream, the full E stack at
-    # t+1 and t+2 upstream — so per STEP each sharded axis moves one
-    # nh-stack + one ne-stack (send+recv), at field dtype. The ledger's
-    # sharded tb trace equals this number to the byte
-    # (tests/test_comm_costs.py); invariant under weak scaling like
-    # the single-step model.
+    # Temporal-blocked (depth-k) halo model (rounds 11/12): the tb
+    # kernel exchanges k ghost-plane generations per neighbor per pass
+    # — the full H stacks at t..t+k-1 downstream, the full E stacks at
+    # t+1..t+k upstream — so per STEP each sharded axis moves one
+    # nh-stack + one ne-stack (send+recv), at field dtype, INVARIANT
+    # in the pipeline depth k (k stacks per pass / k steps). The
+    # ledger's sharded tb trace equals this number to the byte at
+    # every k (tests/test_comm_costs.py); invariant under weak scaling
+    # like the single-step model. ``halo_bytes_per_step_tb_at(k=)``
+    # exposes the per-depth form (and the per-pass bytes).
     halo_bytes_per_step_tb: int = 0
     halo_by_axis_tb: Dict[str, Dict[str, int]] = dataclasses.field(
         default_factory=dict)
     # The planned communication strategy for this decomposition
     # (None when unsharded): see CommStrategy.
     comm_strategy: Optional[CommStrategy] = None
+
+    def halo_bytes_per_step_tb_at(self, k: int = 2) -> int:
+        """Per-step tb halo bytes at pipeline depth ``k`` — the model
+        the traced ppermute bytes must equal for EVERY k. The per-pass
+        schedule is k H-stacks down + k E-stacks up (the k-th E stack
+        is the post-kernel hi-edge fix), so per step the traffic is
+        depth-invariant; the k= form exists so callers (and tests)
+        assert that invariance instead of assuming it, and so the
+        per-PASS bytes (``k * halo_bytes_per_step_tb_at(k)``) are
+        derivable."""
+        from fdtd3d_tpu.config import TB_DEPTHS
+        if k not in TB_DEPTHS:
+            raise ValueError(f"tb pipeline depth {k} not in "
+                             f"{TB_DEPTHS}")
+        return int(self.halo_bytes_per_step_tb)
 
     @property
     def hbm_per_chip(self) -> int:
@@ -158,7 +182,8 @@ class Plan:
             lines.append(
                 f"  halo exchange (tb):  "
                 f"{self.halo_bytes_per_step_tb / mib:8.3f}"
-                f" MiB/chip/step (depth-2, two planes/neighbor/pass)")
+                f" MiB/chip/step (depth-k invariant: k ghost-plane "
+                f"generations/neighbor/pass)")
         if self.comm_strategy is not None:
             s = self.comm_strategy
             lines.append(
@@ -341,8 +366,16 @@ def _choose_strategy(static, topo, cells: int,
     claims to."""
     mode = static.mode
     step_kind = forced_kind or _infer_step_kind(static, topo)
-    depth = 2 if step_kind == "pallas_packed_tb" else 1
-    halo_bytes = halo_tb if depth == 2 else halo
+    if step_kind == "pallas_packed_tb":
+        # ghost_depth is the SCORED pipeline depth (the VMEM-calibrated
+        # auto-depth pick, FDTD3D_TB_DEPTH pins) — pure host math, so
+        # the record stays deterministic per (grid, topology, dtype,
+        # kind) and environment
+        from fdtd3d_tpu.ops import pallas_packed_tb
+        depth = pallas_packed_tb.planned_depth(static) or 2
+    else:
+        depth = 1
+    halo_bytes = halo_tb if depth >= 2 else halo
     stack = max(len(mode.e_components), len(mode.h_components))
     plane_max = max((cells // local[a] * fb * stack
                      for a in range(3) if topo[a] > 1), default=0)

@@ -616,14 +616,18 @@ class Simulation:
                 # the rebuild routes it through the dict form below.)
                 raise exc
             new_tile = (runner.diag.get("tile") or {}).get("EH")
-            if new_kind == kind and failed_tile is not None \
+            new_depth = (runner.diag or {}).get("temporal_block")
+            old_depth = (self.step_diag or {}).get("temporal_block")
+            if new_kind == kind and new_depth == old_depth \
+                    and failed_tile is not None \
                     and new_tile is not None \
                     and new_tile >= failed_tile:
-                # same-kernel rebuild at the same/bigger tile would
-                # fail again; across a tb -> packed downgrade the tile
-                # is NOT comparable (the single-step kernel's scratch
-                # is ~1/3 the tb ring's, so an equal or bigger tile can
-                # be perfectly viable — don't skip the rung)
+                # same-kernel same-depth rebuild at the same/bigger
+                # tile would fail again; across a tb -> packed
+                # downgrade OR a tb depth downgrade (k -> k-1: the
+                # shallower ring scratch is smaller per tile, so an
+                # equal or bigger tile can be perfectly viable) the
+                # tile is NOT comparable — don't skip the rung
                 continue
             break
         _log.warn(
@@ -634,12 +638,16 @@ class Simulation:
             f"{str(exc)[:200]}")
         if self.telemetry is not None:
             # structured event so post-mortems can see the silent perf
-            # cliff (the print above scrolls away; this persists)
+            # cliff (the print above scrolls away; this persists).
+            # ghost_depth: the tb pipeline depth before/after — a
+            # k -> k-1 downgrade is a perf event of its own class
+            # (extra keys are schema-legal; null for non-tb kinds)
             self.telemetry.emit(
                 "ladder_downgrade", t=int(self._t_host),
                 old_budget_mb=old_mb,
                 new_budget_mb=nxt >> 20,
                 old_tile=failed_tile, new_tile=new_tile,
+                old_ghost_depth=old_depth, new_ghost_depth=new_depth,
                 vmem_rung=int(self._vmem_rung))
         # The packed carry's x-psi stacks are TILE-ALIGNED (round 6,
         # ops/pallas_packed.py), so a different tile means a different

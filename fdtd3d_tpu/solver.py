@@ -468,7 +468,7 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
     pure-jnp step below (identical semantics) is built.
 
     ``allow_multistep=False`` skips the temporal-blocked kernel
-    (ops/pallas_packed_tb.py), whose step advances TWO steps per call —
+    (ops/pallas_packed_tb.py), whose step advances k steps per call —
     callers that require the one-step contract (the paired-complex leg
     builder) pass it; make_chunk_runner handles multi-step steps via
     ``step.steps_per_call`` / ``step.tail_step``.
@@ -512,10 +512,11 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
         # fused kernel needs only the one variable.
         if not _os.environ.get("FDTD3D_NO_PACKED") \
                 and not _os.environ.get("FDTD3D_FORCE_FUSED"):
-            # Temporal-blocked kernel (round 8): TWO Yee steps per HBM
-            # pass (~24 B/cell f32) on its (stricter) scope; its step
-            # advances 2 steps per call (steps_per_call), with a
-            # same-tile pallas_packed tail for odd counts.
+            # Temporal-blocked kernel (rounds 8/12): k Yee steps per
+            # HBM pass (~48/k B/cell f32, k in {2,3,4} from the VMEM-
+            # calibrated auto-depth pick) on its (stricter) scope; its
+            # step advances k steps per call (steps_per_call), with a
+            # same-tile pallas_packed tail for non-multiple horizons.
             # FDTD3D_NO_TEMPORAL forces the round-6 single-step kernel
             # bit-for-bit (the escape hatch mirroring FDTD3D_NO_PACKED).
             if allow_multistep \
@@ -1152,11 +1153,12 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
     """
     step = make_step(static, mesh_axes, mesh_shape)
     prep = getattr(step, "prepare", None)
-    # Temporal-blocked steps advance steps_per_call (=2) steps per call:
-    # the scan runs n // spc blocked calls and the remainder runs on
-    # tail_step — a single-step pallas_packed built at the SAME tile,
-    # so both share one packed-carry layout and one prepared coeffs
-    # dict (ops/pallas_packed_tb.py).
+    # Temporal-blocked steps advance steps_per_call (= the pipeline
+    # depth k in {2, 3, 4}) steps per call: the scan runs n // k
+    # blocked passes and the n mod k remainder runs as single steps on
+    # tail_step — a pallas_packed step built at the SAME tile, so both
+    # share one packed-carry layout and one prepared coeffs dict
+    # (ops/pallas_packed_tb.py) INSIDE one compiled chunk.
     spc = int(getattr(step, "steps_per_call", 1))
     tail_step = getattr(step, "tail_step", None)
     if spc > 1 and tail_step is None:
@@ -1191,7 +1193,9 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
             nb, rem = divmod(n, spc)
             out, _ = jax.lax.scan(body, state, None, length=nb)
             for _ in range(rem):
-                out = tail_step(out, cc)   # trailing single step(s)
+                # n mod k trailing single steps (up to k-1 of them) on
+                # the identical packed-carry layout
+                out = tail_step(out, cc)
         else:
             out, _ = jax.lax.scan(body, state, None, length=n)
         if health_fn is not None:

@@ -390,6 +390,11 @@ def provenance(sim=None) -> Dict[str, Any]:
         )
         if sim.step_diag:
             rec["tile"] = dict(sim.step_diag.get("tile") or {})
+            if sim.step_diag.get("temporal_block") is not None:
+                # the temporal-blocked pipeline depth the step consumed
+                # (the auto-depth decision, ops/pallas_packed_tb.py)
+                rec["ghost_depth"] = int(
+                    sim.step_diag["temporal_block"])
         if tuple(sim.topology) != (1, 1, 1):
             # the communication-strategy record (ROADMAP item 1), so a
             # run's exchange posture is auditable from its telemetry
@@ -504,9 +509,15 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
 # lag the writers.
 RECORD_OPTIONAL: Dict[str, tuple] = {
     # provenance() enriches run_start with the sim's identity when one
-    # is attached (CLI/bench runs); header-only sinks omit them
+    # is attached (CLI/bench runs); header-only sinks omit them.
+    # ghost_depth (round 12): the temporal-blocked pipeline depth k
+    # the engaged step consumed (null/absent for single-step kinds) —
+    # the auto-depth pick is auditable from run_start alone.
     "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
-                  "vmem_rung", "tile", "comm_strategy"),
+                  "vmem_rung", "tile", "comm_strategy", "ghost_depth"),
+    # sim._vmem_fallback (round 12): a tb depth downgrade (k -> k-1)
+    # is its own perf-event class beside the tile shrink
+    "ladder_downgrade": ("old_ghost_depth", "new_ghost_depth"),
     # tools/trace_attribution.py: host-span table, per-core straggler
     # lane (round 10), and the ledger echo keys
     "attribution": ("host_spans_ms", "per_core", "imbalance",

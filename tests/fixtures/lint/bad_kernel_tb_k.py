@@ -1,0 +1,49 @@
+"""Known-bad fixture for the donation-safety rule, DEPTH-k temporal-
+blocked surface (round 12): a six-phase (k=3) pipeline capture whose
+H-family aliased operand keeps the lag-1 in-map but whose aliased
+output lost its 2k-1 lag — the output visits block i while the input
+only fetches block i-1 at iteration i+1, i.e. every block is fetched
+ONE ITERATION AFTER the aliased output first visited it. This is
+exactly the hazard class a depth-k generalization reintroduces if a
+generation's output lag is miscounted (lagH = 2k-1, not 2k-2), and
+the generalized check must name it a donation hazard.
+
+A second capture drops the drain-iteration min-clamp from a lag-4
+(E-family, k=3) in-map: over the ntiles + 2k-1 grid the unclamped map
+walks past the last block and back under modular wrap, making the
+fetch sequence non-monotone.
+"""
+
+
+def bad_lag_capture():
+    from jax.experimental import pallas as pl
+    ntiles, k = 4, 3
+    grid = ntiles + 2 * k - 1          # the depth-k pipeline grid
+    return {
+        "grid": (grid,),
+        "in_specs": [pl.BlockSpec(
+            (8, 8), lambda i: (min(max(i - 1, 0), ntiles - 1), 0))],
+        # BROKEN: the H-family output must lag 2k-1 = 5; lag 0 visits
+        # block b at iteration b, before the lag-1 fetch at b+1
+        "out_specs": [pl.BlockSpec((8, 8), lambda i: (min(i, ntiles - 1),
+                                                      0))],
+        "input_output_aliases": {0: 0},
+    }
+
+
+def unclamped_drain_capture():
+    from jax.experimental import pallas as pl
+    ntiles, k = 4, 3
+    grid = ntiles + 2 * k - 1
+    lag = 2 * (k - 1)
+
+    def imap(i, _n=ntiles, _l=lag):
+        # BROKEN: no min-clamp — drain iterations wrap modulo ntiles
+        return (max(i - _l, 0) % _n, 0)
+
+    return {
+        "grid": (grid,),
+        "in_specs": [pl.BlockSpec((8, 8), imap)],
+        "out_specs": [pl.BlockSpec((8, 8), imap)],
+        "input_output_aliases": {0: 0},
+    }

@@ -204,6 +204,25 @@ def test_donation_safety_fires_on_fixture():
     assert any("NON-MONOTONE" in p for p in probs2), probs2
 
 
+def test_donation_safety_fires_on_depth_k_fixture():
+    """ISSUE-11 satellite: the depth-k known-bad fixture — a k=3
+    pipeline whose H-family output lost its 2k-1 lag (fetch lands one
+    iteration after the aliased output's first visit), and a lag-4
+    in-map missing the drain-iteration clamp (non-monotone fetches) —
+    must fire the generalized donation-safety check."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bad_kernel_tb_k", os.path.join(FIX, "bad_kernel_tb_k.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from fdtd3d_tpu.analysis.graph_rules import check_pallas_capture
+    probs = check_pallas_capture("tb_k", mod.bad_lag_capture())
+    assert any("donation hazard" in p for p in probs), probs
+    probs2 = check_pallas_capture("tb_k2",
+                                  mod.unclamped_drain_capture())
+    assert any("NON-MONOTONE" in p for p in probs2), probs2
+
+
 def test_scope_coverage_fires_on_fixture():
     import importlib.util
     spec = importlib.util.spec_from_file_location(

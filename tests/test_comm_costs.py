@@ -115,31 +115,63 @@ def test_sharded_coverage_holds(sharded_ledgers, kind):
 
 
 def test_tb_sharded_roofline_moved(sharded_ledgers):
-    """ISSUE-10 acceptance, CPU-deterministic: on the SAME sharded
+    """ISSUE-10/12 acceptance, CPU-deterministic: on the SAME sharded
     (2,2,2) config the temporal-blocked kernel's per-step field HBM
     bytes (the packed-kernel section's pallas_call charge) must be
-    <= 0.55x the single-step packed kernel's — the depth-2 halo
-    pipeline converts the repo's best kernel into the default sharded
-    path at half the per-cell HBM cost."""
+    within the per-depth bound ({2: 0.55, 3: 0.40, 4: 0.32}) of the
+    single-step packed kernel's — the depth-k halo pipeline converts
+    the repo's best kernel into the default sharded path at 1/k-th the
+    per-cell HBM cost. The engaged depth is the auto pick's."""
+    from tests.test_costs import TB_RATIO_BOUNDS
     tb = sharded_ledgers["pallas_packed_tb"]
     pk = sharded_ledgers["pallas_packed"]
-    assert tb["steps_per_call"] == 2
+    depth = tb["steps_per_call"]
+    assert depth in TB_RATIO_BOUNDS
     tb_b = tb["sections"]["packed-kernel-tb"]["bytes"] / tb["cells"]
     pk_b = pk["sections"]["packed-kernel"]["bytes"] / pk["cells"]
-    assert tb_b <= 0.55 * pk_b, \
-        f"sharded tb kernel {tb_b:.1f} B/cell/step vs packed {pk_b:.1f}"
+    bound = TB_RATIO_BOUNDS[depth]
+    assert tb_b <= bound * pk_b, \
+        f"sharded tb (k={depth}) {tb_b:.1f} B/cell/step vs packed " \
+        f"{pk_b:.1f} (bound {bound})"
+
+
+@pytest.mark.parametrize("depth", (2, 3, 4))
+def test_tb_sharded_traced_equals_model_every_k(monkeypatch, depth):
+    """Round-12 acceptance: the traced ppermute bytes equal the plan
+    model TO THE BYTE for EVERY pipeline depth k on the (2,2,2) mesh —
+    the per-pass schedule is k H-stacks down + k-1 E-stacks up + the
+    post-fix E stack, so per STEP the bytes are depth-invariant
+    (plan.Plan.halo_bytes_per_step_tb_at)."""
+    monkeypatch.setenv("FDTD3D_TB_DEPTH", str(depth))
+    cfg = _cfg("pallas_packed_tb")
+    led = costs.chunk_ledger(cfg, n_steps=12, kind="pallas_packed_tb",
+                             topology=TOPO)
+    assert led["steps_per_call"] == depth
+    comm = led["comm"]
+    assert comm["strategy"]["ghost_depth"] == depth
+    p = plan_for_topology(cfg, TOPO)
+    assert comm["per_step"]["ppermute_bytes_per_chip"] == \
+        p.halo_bytes_per_step_tb_at(depth)
+    assert p.halo_bytes_per_step_tb_at(depth) == \
+        p.halo_bytes_per_step_tb        # the invariance, asserted
+    assert comm["plan"]["traced_minus_modeled_bytes"] == 0
 
 
 def test_strategy_recorded_and_deterministic(sharded_ledgers):
-    """ISSUE-10 acceptance: the planner's strategy choice is
+    """ISSUE-10/12 acceptance: the planner's strategy choice is
     deterministic, recorded in the ledger comm lane, and the reference
-    (2,2,2) decomposition picks the ASYNC TWO-PLANE (fused depth-2)
-    exchange for the temporal-blocked kind."""
+    (2,2,2) decomposition picks the ASYNC fused exchange for the
+    temporal-blocked kind with ghost_depth scored by the VMEM-
+    calibrated auto-depth picker (== the engaged steps_per_call)."""
     from fdtd3d_tpu.plan import comm_strategy, plan_for_topology
-    strat = sharded_ledgers["pallas_packed_tb"]["comm"]["strategy"]
+    led_tb = sharded_ledgers["pallas_packed_tb"]
+    strat = led_tb["comm"]["strategy"]
     assert strat is not None
     assert strat["step_kind"] == "pallas_packed_tb"
-    assert strat["ghost_depth"] == 2          # two-plane exchange
+    # ghost_depth is the SCORED free variable: it equals the depth the
+    # step actually engaged (steps_per_call), picked deepest-viable
+    assert strat["ghost_depth"] == led_tb["steps_per_call"]
+    assert strat["ghost_depth"] in (2, 3, 4)
     assert strat["split"] == "fused"
     assert strat["schedule"] == "async"
     assert strat["source"] == "model"

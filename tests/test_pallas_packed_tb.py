@@ -1,15 +1,23 @@
 """Temporal-blocked packed kernel (ops/pallas_packed_tb.py) vs jnp.
 
-Round 8: TWO Yee steps per HBM pass — the kernel deepens the packed
-pipeline to four phases (E(t+1) on tile i, H(t+1) on i-1, E(t+2) on
-i-2, H(t+2) on i-3 from VMEM ring scratch) and runs the CPML psi
-recursion twice per pass, halving per-step field traffic (48 -> ~24
-B/cell f32). Parity with the jnp step must hold at f32 roundoff
-INCLUDING the psi recursion state, for even AND odd total step counts
-(odd counts append one single-step ``pallas_packed`` tail built at the
-SAME tile) and for odd / two-region tilings (pipeline-drain edges).
-``FDTD3D_NO_TEMPORAL=1`` is the escape hatch that forces the round-6
-single-step kernel bit-for-bit.
+Round 12: the kernel is a DEPTH-k BUILDER — k Yee steps per HBM pass
+(k in {2, 3, 4}; 2k phases, per-generation VMEM rings, k-generation
+CPML psi recursion, ~48/k B/cell/step f32) with a VMEM-calibrated
+auto-depth picker (deepest viable k; ``FDTD3D_TB_DEPTH`` pins) and
+WIDENED eligibility: in-kernel TFSF plane-value corrections, electric-
+Drude ADE J in the ring scratch, and material grids as per-generation
+tiled operands all run at blocked speed instead of falling back.
+Parity with the jnp step must hold at f32 roundoff INCLUDING the psi
+recursion (and Drude J) state, for k-divisible AND non-divisible step
+counts (the tail appends n mod k single-step ``pallas_packed`` calls
+at the SAME tile) and for odd / two-region tilings (pipeline-drain
+edges). ``FDTD3D_NO_TEMPORAL=1`` is the escape hatch that forces the
+round-6 single-step kernel bit-for-bit.
+
+Coverage split (tier-1 wall budget, PR 4/9 precedent): tier-1 spreads
+the widened scenarios across depths (TFSF@k3, Drude@k4, grids@k2) so
+every scenario and every depth is exercised once; the full scenario x
+depth matrix rides the slow lane.
 """
 
 import os
@@ -26,6 +34,19 @@ from fdtd3d_tpu.sim import Simulation
 
 BASE = dict(scheme="3D", size=(16, 16, 16), time_steps=8, dx=1e-3,
             courant_factor=0.4, wavelength=8e-3)
+
+DEPTHS = (2, 3, 4)
+
+
+@pytest.fixture
+def tb_depth(monkeypatch):
+    """Pin the pipeline depth for one test via the registered knob."""
+    def pin(k):
+        if k is None:
+            monkeypatch.delenv("FDTD3D_TB_DEPTH", raising=False)
+        else:
+            monkeypatch.setenv("FDTD3D_TB_DEPTH", str(k))
+    return pin
 
 
 def _seed_fields(sim, seed=0):
@@ -45,66 +66,80 @@ def _run(use_pallas, seed=0, **kw):
     return sim
 
 
-def _parity(tol=2e-6, seed=0, psi=True, **kw):
+def _parity(tol=2e-6, seed=0, psi=True, depth=None, extra_state=(),
+            **kw):
     j = _run(False, seed=seed, **kw)
     p = _run(True, seed=seed, **kw)
     assert p.step_kind == "pallas_packed_tb", p.step_kind
+    if depth is not None:
+        assert p.step_diag["temporal_block"] == depth
     for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
         a = np.asarray(j.field(c), np.float32)
         b = np.asarray(p.field(c), np.float32)
         rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
         assert rel < tol, f"{c}: rel {rel:.2e}"
-    if psi and "psi_E" in j.state:
-        for grp in ("psi_E", "psi_H"):
-            for k in j.state[grp]:
-                a = np.asarray(j.state[grp][k])
-                b = np.asarray(p.state[grp][k])
-                rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
-                assert rel < tol, f"{grp}/{k}: rel {rel:.2e}"
+    groups = (("psi_E", "psi_H") if psi and "psi_E" in j.state else ())
+    for grp in tuple(groups) + tuple(extra_state):
+        for k in j.state[grp]:
+            a = np.asarray(j.state[grp][k])
+            b = np.asarray(p.state[grp][k])
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < tol, f"{grp}/{k}: rel {rel:.2e}"
     return j, p
 
 
-def test_tb_vacuum_parity():
-    _parity()
+@pytest.mark.parametrize("k", DEPTHS)
+def test_tb_vacuum_parity(tb_depth, k):
+    tb_depth(k)
+    _parity(depth=k)
 
 
 @pytest.mark.slow
 def test_tb_cpml_parity_even():
     """Subsumed in tier-1 by test_tb_odd_ntiles_and_two_region_x_psi
     (even horizon + full CPML at a two-region tiling); kept in the slow
-    lane as the minimal single-region repro."""
+    lane as the minimal single-region repro (auto depth)."""
     _parity(pml=PmlConfig(size=(3, 3, 3)))
 
 
-def test_tb_cpml_parity_odd_steps():
-    """Odd horizon: n//2 blocked passes + ONE single-step tail on the
-    identical packed-carry layout (solver.make_chunk_runner)."""
-    _parity(pml=PmlConfig(size=(3, 3, 3)), time_steps=7)
+@pytest.mark.parametrize("k", (3, 4))
+def test_tb_cpml_parity_tail_steps(tb_depth, k):
+    """Non-divisible horizon: n//k blocked passes + n mod k single-step
+    tails on the identical packed-carry layout inside ONE compiled
+    chunk (solver.make_chunk_runner) — 7 steps = 2x3+1 at k=3,
+    1x4+3 at k=4. k=2 rides the two-region test below."""
+    tb_depth(k)
+    _parity(pml=PmlConfig(size=(3, 3, 3)), time_steps=7, depth=k)
 
 
 def test_tb_odd_ntiles_and_two_region_x_psi():
     """48-long x at tile 16 -> 3 tiles with the two-region tile-aligned
-    x-psi layout (interior tile pins its block; lag-2/lag-3 output
-    maps): the pipeline-drain edges the ISSUE names."""
+    x-psi layout (interior tile pins its block; lag-2(k-1)/lag-(2k-1)
+    output maps): the pipeline-drain edges the ISSUE names. Auto depth:
+    the picker must choose the DEEPEST viable k here (the VMEM model
+    affords tile >= 2 at every depth on this grid)."""
     j, p = _parity(pml=PmlConfig(size=(3, 3, 3)), size=(48, 16, 16))
-    assert p.step_diag["temporal_block"] == 2
+    assert p.step_diag["temporal_block"] == max(DEPTHS)
+    pick = p.step_diag["depth_pick"]
+    assert pick["source"] == "auto"
+    assert set(pick["candidates"]) == set(DEPTHS)
 
 
-def test_tb_two_region_odd_steps_sourced():
+def test_tb_two_region_odd_steps_sourced(tb_depth):
+    tb_depth(2)
     _parity(pml=PmlConfig(size=(3, 3, 3)), size=(48, 16, 16),
-            time_steps=7,
+            time_steps=7, depth=2,
             point_source=PointSourceConfig(enabled=True, component="Ey",
                                            position=(30, 8, 8)))
 
 
 @pytest.mark.slow
 def test_tb_point_source_parity_even():
-    """The mid-grid injection rides IN-KERNEL (both E phases add the
-    masked waveform term before ca/cb — a post-patch cannot reach the
-    second step's curls). Tier-1 coverage of that path lives in
-    test_tb_two_region_odd_steps_sourced, whose blocked passes inject
-    in both phases too; this pure-even single-region variant rides the
-    slow lane (tier-1 wall budget)."""
+    """The mid-grid injection rides IN-KERNEL (every E phase adds the
+    masked waveform term at its generation's lag — a post-patch cannot
+    reach the later steps' curls). Tier-1 coverage of that path lives
+    in test_tb_two_region_odd_steps_sourced; this pure-even
+    single-region variant rides the slow lane (tier-1 wall budget)."""
     src = PointSourceConfig(enabled=True, component="Ez",
                             position=(8, 8, 8))
     _parity(pml=PmlConfig(size=(3, 3, 3)), point_source=src)
@@ -149,10 +184,95 @@ def test_tb_escape_hatch_bit_for_bit(monkeypatch):
 
 
 # -------------------------------------------------------------------------
-# sharded: the depth-2 halo pipeline (round 11)
+# the VMEM-calibrated auto-depth picker
 # -------------------------------------------------------------------------
 
-def _sharded_parity(topo, steps, tol=2e-6, seed=0, **kw):
+def test_tb_depth_pick_env_pin(tb_depth):
+    """FDTD3D_TB_DEPTH pins the pipeline depth; the decision record
+    names the env source; out-of-domain values are a config error."""
+    from fdtd3d_tpu import solver
+    from fdtd3d_tpu.ops import pallas_packed_tb
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)))
+    static = solver.build_static(cfg)
+    tb_depth(3)
+    step = pallas_packed_tb.make_packed_tb_step(static)
+    assert step.steps_per_call == 3
+    assert step.diag["temporal_block"] == 3
+    assert step.diag["depth_pick"]["source"] == "env:FDTD3D_TB_DEPTH=3"
+    tb_depth(None)
+    os.environ["FDTD3D_TB_DEPTH"] = "5"
+    try:
+        with pytest.raises(ValueError, match="FDTD3D_TB_DEPTH"):
+            pallas_packed_tb.pick_depth(static)
+    finally:
+        del os.environ["FDTD3D_TB_DEPTH"]
+
+
+def test_tb_depth_pick_downgrades_on_vmem(monkeypatch):
+    """The calibration-table knob drives the depth ladder: a k=4 temps
+    row too large for any tile must downgrade the AUTO pick to k=3
+    (k -> k-1 before leaving the kernel family), and poisoning k=3
+    too must land on k=2."""
+    from fdtd3d_tpu import solver
+    from fdtd3d_tpu.ops import pallas_packed_tb
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)))
+    static = solver.build_static(cfg)
+    monkeypatch.setenv("FDTD3D_VMEM_TEMPS_TABLE", "tb4=99999999")
+    k, tile, cands, source = pallas_packed_tb.pick_depth(static)
+    assert k == 3 and cands[4] == 0 and source == "auto"
+    monkeypatch.setenv("FDTD3D_VMEM_TEMPS_TABLE",
+                       "tb4=99999999,tb3=99999999")
+    k2, _, cands2, _ = pallas_packed_tb.pick_depth(static)
+    assert k2 == 2 and cands2[3] == 0
+    monkeypatch.setenv("FDTD3D_VMEM_TEMPS_TABLE", "bogus=1")
+    with pytest.raises(ValueError, match="FDTD3D_VMEM_TEMPS_TABLE"):
+        pallas_packed_tb.pick_depth(static)
+
+
+def test_tb_pinned_depth_not_viable_is_named_error(monkeypatch):
+    """Review finding: an explicit FDTD3D_TB_DEPTH pin the VMEM model
+    (or a thin sharded wedge) cannot honor must raise a NAMED config
+    error, never silently dispatch the 48 B/cell single-step kernel —
+    a user A/B-ing depths would blame the kernel for the fallback."""
+    from fdtd3d_tpu import solver
+    from fdtd3d_tpu.ops import pallas_packed_tb
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)))
+    static = solver.build_static(cfg)
+    monkeypatch.setenv("FDTD3D_TB_DEPTH", "4")
+    monkeypatch.setenv("FDTD3D_VMEM_TEMPS_TABLE", "tb4=99999999")
+    with pytest.raises(ValueError, match="FDTD3D_TB_DEPTH=4"):
+        pallas_packed_tb.pick_depth(static)
+    with pytest.raises(ValueError, match="FDTD3D_TB_DEPTH=4"):
+        Simulation(cfg)
+    # the AUTO pick under the same poisoned table still degrades
+    # gracefully to k=3 (the depth ladder, not an error)
+    monkeypatch.delenv("FDTD3D_TB_DEPTH")
+    sim = Simulation(cfg)
+    assert sim.step_kind == "pallas_packed_tb"
+    assert sim.step_diag["temporal_block"] == 3
+
+
+def test_tb_vmem_temps_table_central():
+    """Satellite 1: the scattered per-module temps constants are gone —
+    every kernel kind reads the ONE config table."""
+    from fdtd3d_tpu import config as config_mod
+    from fdtd3d_tpu.ops import pallas_packed, pallas_packed_tb
+    for k in DEPTHS:
+        assert config_mod.vmem_temps("tb", k) == \
+            config_mod.VMEM_TEMPS_DEFAULTS[f"tb{k}"]
+    assert config_mod.vmem_temps("packed") == 25   # the MEASURED row
+    assert not hasattr(pallas_packed, "_TEMPS_F32_PER_CELL")
+    assert not hasattr(pallas_packed_tb, "_TEMPS_F32_PER_CELL_TB")
+
+
+# -------------------------------------------------------------------------
+# sharded: the depth-k halo pipeline
+# -------------------------------------------------------------------------
+
+def _sharded_parity(topo, steps, tol=2e-6, seed=0, depth=None, **kw):
     """tb vs jnp on the SAME topology (per-shard slab-compacted psi
     layouts coincide), fields AND psi recursion state. Seeded fields +
     interior source: a bare Ez point source leaves Hz identically zero
@@ -171,6 +291,8 @@ def _sharded_parity(topo, steps, tol=2e-6, seed=0, **kw):
     _seed_fields(p, seed=seed)
     p.run()
     assert p.step_kind == "pallas_packed_tb", p.step_kind
+    if depth is not None:
+        assert p.step_diag["temporal_block"] == depth
     for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
         a = np.asarray(j.field(c), np.float32)
         b = np.asarray(p.field(c), np.float32)
@@ -185,32 +307,53 @@ def _sharded_parity(topo, steps, tol=2e-6, seed=0, **kw):
     return j, p
 
 
-def test_tb_sharded_parity_222_even():
-    """ISSUE-10 acceptance: sharded tb vs sharded jnp on the (2,2,2)
-    CPU interpret mesh, even horizon, CPML + interior source."""
-    _sharded_parity((2, 2, 2), steps=8)
+def test_tb_sharded_parity_222_even_auto():
+    """ISSUE-11 acceptance: sharded tb vs sharded jnp on the (2,2,2)
+    CPU interpret mesh at the AUTO depth pick (deepest viable — the
+    k-generation boundary-wedge pre-pass and 2k-message exchange),
+    even horizon, CPML + interior source."""
+    _, p = _sharded_parity((2, 2, 2), steps=8)
+    assert p.step_diag["temporal_block"] == max(DEPTHS)
+    strat = p.step_diag["comm_strategy"]
+    assert strat["ghost_depth"] == p.step_diag["temporal_block"]
 
 
-def test_tb_sharded_parity_222_odd():
-    """Odd horizon: n//2 blocked passes + ONE single-step sharded
-    pallas_packed tail on the same packed carry inside one chunk."""
-    _sharded_parity((2, 2, 2), steps=7)
+def test_tb_sharded_parity_222_odd_k3(tb_depth):
+    """Non-divisible horizon under sharding at k=3: 2 blocked passes +
+    ONE single-step sharded pallas_packed tail on the same packed
+    carry inside one chunk."""
+    tb_depth(3)
+    _sharded_parity((2, 2, 2), steps=7, depth=3)
 
 
-def test_tb_sharded_parity_122_even_and_odd():
-    _sharded_parity((1, 2, 2), steps=8)
-    _sharded_parity((1, 2, 2), steps=7)
+def test_tb_sharded_parity_122_k2(tb_depth):
+    tb_depth(2)
+    _sharded_parity((1, 2, 2), steps=8, depth=2)
+    _sharded_parity((1, 2, 2), steps=7, depth=2)
 
 
-def test_tb_sharded_odd_ntiles_drain_edges():
+@pytest.mark.slow
+def test_tb_sharded_parity_depth_matrix(tb_depth):
+    """Full topology x depth matrix (tier-1 spreads one depth per
+    topology; the rest rides here)."""
+    for k in DEPTHS:
+        tb_depth(k)
+        _sharded_parity((2, 2, 2), steps=8, depth=k)
+        _sharded_parity((2, 1, 1), steps=8, depth=k)
+        _sharded_parity((1, 2, 2), steps=7, depth=k)
+
+
+def test_tb_sharded_odd_ntiles_drain_edges(tb_depth):
     """Odd-ntiles two-region tiling UNDER sharding: 48-long x sharded
     by 2 -> 24 local at tile 8 (3 tiles, two-region x-psi) — the
-    pipeline-drain edges now masked against the two-deep ghost region
-    (the exchanged generation ghosts replace the PEC zeros at i==0 /
-    i==2 / i==ntiles). x-sharded (2,1,1) isolates the xgh0/xgh1/xe1
-    operands; (2,2,2) composes them with the y/z thin-block ghosts."""
+    pipeline-drain edges masked against the k-deep ghost region (the
+    exchanged generation ghosts replace the PEC zeros at the i == 2g-2
+    lo edges). x-sharded (2,1,1) isolates the xgh*/xe* operands at
+    k=3; (2,2,2) composes them with the y/z thin-block ghosts at
+    k=2."""
     from fdtd3d_tpu.parallel import distributed as pdist  # noqa: F401
-    for topo in ((2, 1, 1), (2, 2, 2)):
+    for topo, k in (((2, 1, 1), 3), ((2, 2, 2), 2)):
+        tb_depth(k)
         par = ParallelConfig(topology="manual", manual_topology=topo)
         base = dict(BASE, size=(48, 16, 16), time_steps=7,
                     pml=PmlConfig(size=(2, 2, 2)),
@@ -225,25 +368,57 @@ def test_tb_sharded_odd_ntiles_drain_edges():
         _seed_fields(p, seed=3)
         p.run()
         assert p.step_kind == "pallas_packed_tb", (topo, p.step_kind)
+        assert p.step_diag["temporal_block"] == k
         nt = (48 // topo[0]) // p.step_diag["tile"]["EH"]
         assert nt == 3, nt   # odd ntiles: real drain-edge coverage
         for c in ("Ey", "Hz", "Hx"):
             a = np.asarray(j.field(c), np.float32)
             b = np.asarray(p.field(c), np.float32)
             rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
-            assert rel < 2e-6, f"{c}: rel {rel:.2e} on {topo}"
+            assert rel < 2e-6, f"{c}: rel {rel:.2e} on {topo} k={k}"
 
 
-def test_tb_sharded_comm_strategy_in_diag():
+def test_tb_thin_shard_caps_wedge_depth():
+    """Review-found regression: a thin sharded axis (16 cells over 8
+    shards -> local extent 2) cannot hold a depth-4 boundary wedge
+    (generation 1 computes planes [0, k-2]); the auto pick must CAP k
+    at the deepest fitting depth (k-1 <= local extent -> k=3 here)
+    instead of crashing the trace and burning the VMEM ladder."""
+    from fdtd3d_tpu.parallel import distributed as pdist
+    par = ParallelConfig(topology="manual", manual_topology=(1, 8, 1))
+    base = dict(BASE, pml=PmlConfig(size=(0, 0, 0)),
+                point_source=PointSourceConfig(
+                    enabled=True, component="Ez", position=(8, 8, 8)),
+                parallel=par)
+    p = Simulation(SimConfig(**dict(base, use_pallas=True)))
+    assert p.step_kind == "pallas_packed_tb", p.step_kind
+    assert p.step_diag["temporal_block"] == 3   # capped by the wedge
+    assert p.step_diag["depth_pick"]["candidates"][4] == 0
+    _seed_fields(p, seed=1)
+    p.run()
+    j = Simulation(SimConfig(**dict(base, use_pallas=False)))
+    _seed_fields(j, seed=1)
+    j.run()
+    for c in ("Ez", "Hx", "Hy"):
+        a = np.asarray(j.field(c), np.float32)
+        b = np.asarray(p.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-6, f"{c}: rel {rel:.2e}"
+    del pdist  # imported for parity with the other sharded tests
+
+
+def test_tb_sharded_comm_strategy_in_diag(tb_depth):
     """The step's diag carries the planned CommStrategy record (what
-    telemetry run_start and the ledger comm lane echo)."""
+    telemetry run_start and the ledger comm lane echo), with
+    ghost_depth the scored pipeline depth."""
+    tb_depth(3)
     sim = Simulation(SimConfig(
         **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
         parallel=ParallelConfig(topology="manual",
                                 manual_topology=(2, 2, 2))))
     assert sim.step_kind == "pallas_packed_tb"
     strat = sim.step_diag["comm_strategy"]
-    assert strat["ghost_depth"] == 2
+    assert strat["ghost_depth"] == 3
     assert strat["split"] == "fused" and strat["schedule"] == "async"
 
 
@@ -252,7 +427,8 @@ def test_tb_sharded_strategy_override_parity(monkeypatch):
     plan WITHOUT changing the physics: parity still holds and the
     strategy records the env source."""
     monkeypatch.setenv("FDTD3D_COMM_STRATEGY", "per-plane,sync")
-    _, p = _sharded_parity((1, 2, 2), steps=4)
+    monkeypatch.setenv("FDTD3D_TB_DEPTH", "3")
+    _, p = _sharded_parity((1, 2, 2), steps=6, depth=3)
     strat = p.step_diag["comm_strategy"]
     assert strat["split"] == "per-plane"
     assert strat["schedule"] == "sync"
@@ -260,20 +436,73 @@ def test_tb_sharded_strategy_override_parity(monkeypatch):
 
 
 # -------------------------------------------------------------------------
-# eligibility: the scope is a strict subset of the packed kernel's
+# eligibility: widened scenarios dispatch tb; the rest stays on packed
 # -------------------------------------------------------------------------
+
+def test_tb_tfsf_in_kernel_parity(tb_depth):
+    """ISSUE-11 acceptance: a TFSF scenario dispatches the temporal-
+    blocked kernel (in-kernel plane-value corrections at every
+    generation's lag) with parity vs jnp — tier-1 representative at
+    k=3; the full depth matrix rides the slow lane."""
+    tb_depth(3)
+    _parity(pml=PmlConfig(size=(3, 3, 3)), depth=3,
+            tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2)))
+
+
+def test_tb_drude_ring_scratch_parity(tb_depth):
+    """ISSUE-11 acceptance: a Drude scenario (sphere -> kj/bj/ca/cb
+    GRIDS + the J ADE state in the ring scratch) dispatches tb with
+    parity vs jnp INCLUDING J — tier-1 representative at k=4."""
+    tb_depth(4)
+    _parity(pml=PmlConfig(size=(0, 3, 3)), depth=4, extra_state=("J",),
+            materials=MaterialsConfig(
+                use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+                drude_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                          radius=3)))
+
+
+def test_tb_material_grid_parity(tb_depth):
+    """ISSUE-11 acceptance: a material-grid scenario (eps sphere ->
+    3D ca/cb) dispatches tb — the grids stream as per-generation tiled
+    operands — with parity vs jnp; tier-1 representative at k=2."""
+    tb_depth(2)
+    _parity(pml=PmlConfig(size=(3, 3, 3)), depth=2,
+            materials=MaterialsConfig(
+                eps=2.0, eps_sphere=SphereConfig(enabled=True,
+                                                 center=(8, 8, 8),
+                                                 radius=4, value=6.0)))
+
+
+@pytest.mark.slow
+def test_tb_widened_scenarios_depth_matrix(tb_depth):
+    """The full widened-scenario x depth matrix (tier-1 spreads one
+    depth per scenario)."""
+    for k in DEPTHS:
+        tb_depth(k)
+        _parity(pml=PmlConfig(size=(3, 3, 3)), depth=k,
+                tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2)))
+        _parity(pml=PmlConfig(size=(0, 3, 3)), depth=k,
+                extra_state=("J",),
+                materials=MaterialsConfig(
+                    use_drude=True, eps_inf=1.5, omega_p=1e11,
+                    gamma=1e10,
+                    drude_sphere=SphereConfig(enabled=True,
+                                              center=(8, 8, 8),
+                                              radius=3)))
+        _parity(pml=PmlConfig(size=(3, 3, 3)), depth=k,
+                materials=MaterialsConfig(
+                    eps=2.0,
+                    eps_sphere=SphereConfig(enabled=True,
+                                            center=(8, 8, 8),
+                                            radius=4, value=6.0)))
+
 
 def test_tb_fallbacks_stay_on_packed():
     """Out-of-tb-scope configs must land on the round-6 packed kernel
-    (never jnp, never silently tb): TFSF (sharded or not), in-absorber
-    source, Drude. Sharded topologies are IN tb scope since round 11
-    (the depth-2 halo pipeline) — asserted here so the dispatch can
-    never silently regress to the single-step kernel."""
-    tfsf = Simulation(SimConfig(
-        **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
-        tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2))))
-    assert tfsf.step_kind == "pallas_packed", tfsf.step_kind
-
+    (never jnp, never silently tb): in-absorber sources, SHARDED
+    TFSF/Drude/material grids (the wedge pre-pass has no port),
+    magnetic Drude. The widened unsharded scenarios are asserted IN
+    scope above so the dispatch can never silently regress."""
     absorber = Simulation(SimConfig(
         **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
         point_source=PointSourceConfig(enabled=True, component="Ez",
@@ -294,30 +523,29 @@ def test_tb_fallbacks_stay_on_packed():
     assert tfsf_sharded.step_kind == "pallas_packed", \
         tfsf_sharded.step_kind
 
-    drude = Simulation(SimConfig(
-        **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),
-        materials=MaterialsConfig(
-            use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
-            drude_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
-                                      radius=3))))
-    assert drude.step_kind == "pallas_packed", drude.step_kind
-
-
-def test_tb_material_grid_falls_back():
-    """A material grid would need each coefficient streamed at two tile
-    lags: out of scope, packed kernel covers it."""
-    sim = Simulation(SimConfig(
-        **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
+    grid_sharded = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
         materials=MaterialsConfig(
             eps=2.0, eps_sphere=SphereConfig(enabled=True,
                                              center=(8, 8, 8),
-                                             radius=4, value=6.0))))
-    assert sim.step_kind == "pallas_packed", sim.step_kind
+                                             radius=4, value=6.0)),
+        parallel=ParallelConfig(topology="manual",
+                                manual_topology=(1, 2, 2))))
+    assert grid_sharded.step_kind == "pallas_packed", \
+        grid_sharded.step_kind
+
+    drude_m = Simulation(SimConfig(
+        **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),
+        materials=MaterialsConfig(
+            use_drude_m=True, mu_inf=1.5, omega_pm=1e11, gamma_m=1e10,
+            drude_m_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
+                                        radius=3))))
+    assert drude_m.step_kind == "pallas_packed", drude_m.step_kind
 
 
 def test_tb_paired_complex_legs_stay_single_step(monkeypatch):
     """The paired-complex wrapper calls each leg once per step — a
-    two-steps-per-call leg would silently double-advance
+    k-steps-per-call leg would silently multi-advance
     (make_step(allow_multistep=False))."""
     monkeypatch.setenv("FDTD3D_FORCE_PAIRED_COMPLEX", "1")
     sim = Simulation(SimConfig(
@@ -341,17 +569,19 @@ def test_tb_force_tile_validation():
     assert ok is not None and ok.diag["tile"]["EH"] == 8
 
 
-def test_tb_step_contract():
+def test_tb_step_contract(tb_depth):
     """The multi-step step object's contract with make_chunk_runner:
-    steps_per_call=2, a single-step tail at the SAME tile, shared
-    pack/unpack/prepare."""
+    steps_per_call == the pipeline depth k, a single-step tail at the
+    SAME tile, shared pack/unpack/prepare."""
     from fdtd3d_tpu import solver
     cfg = SimConfig(**BASE, use_pallas=True,
                     pml=PmlConfig(size=(3, 3, 3)))
     static = solver.build_static(cfg)
+    tb_depth(3)
     step = solver.make_step(static)
     assert step.kind == "pallas_packed_tb"
-    assert step.steps_per_call == 2
+    assert step.steps_per_call == 3
+    assert step.diag["temporal_block"] == 3
     tail = step.tail_step
     assert tail.kind == "pallas_packed"
     assert tail.diag["tile"]["EH"] == step.diag["tile"]["EH"]
@@ -363,7 +593,7 @@ def test_tb_step_contract():
     # a chunk runner built on the tb step reports the multi-step shape
     runner = solver.make_chunk_runner(static)
     assert runner.kind == "pallas_packed_tb"
-    assert runner.steps_per_call == 2
+    assert runner.steps_per_call == 3
 
 
 # -------------------------------------------------------------------------
@@ -371,13 +601,14 @@ def test_tb_step_contract():
 # -------------------------------------------------------------------------
 
 def test_tb_donation_fetch_before_write(monkeypatch):
-    """Structural donation-safety: every ALIASED operand's in-map must
-    be monotone (each HBM block fetched once) and fetch each block no
-    later than the out-map's first visit of it — backward-read state
-    never sees a block its own (masked or real) output writes could
-    already have flushed. Non-field operands (profiles, source, walls)
-    must not be donated at all. Interpreter mode cannot surface the
-    hazard at runtime — assert the structure."""
+    """Structural donation-safety AT EVERY DEPTH: every ALIASED
+    operand's in-map must be monotone (each HBM block fetched once)
+    and fetch each block no later than the out-map's first visit of it
+    — backward-read state never sees a block its own (masked or real)
+    output writes could already have flushed. Non-field operands
+    (profiles, source, walls, TFSF planes) must not be donated at all.
+    Interpreter mode cannot surface the hazard at runtime — assert the
+    structure."""
     from jax.experimental import pallas as pl
 
     from fdtd3d_tpu import solver
@@ -400,49 +631,54 @@ def test_tb_donation_fetch_before_write(monkeypatch):
                         enabled=True, component="Ez",
                         position=(24, 8, 8)))
     static = solver.build_static(cfg)
-    step = pallas_packed_tb.make_packed_tb_step(static)
-    assert step is not None and captured
+    for depth in DEPTHS:
+        captured.clear()
+        step = pallas_packed_tb.make_packed_tb_step(static, depth=depth)
+        assert step is not None and captured, depth
 
-    aliases = captured["aliases"]
-    n_in = len(captured["in_specs"])
-    n_out = len(captured["out_specs"])
-    # every output is fed by a donated input with the same position;
-    # everything else (profiles/source/walls) is NOT donated
-    assert aliases == {j: j for j in range(n_out)}, aliases
-    assert n_in > n_out
+        aliases = captured["aliases"]
+        n_in = len(captured["in_specs"])
+        n_out = len(captured["out_specs"])
+        # every output is fed by a donated input with the same
+        # position; everything else (profiles/source/walls) is NOT
+        # donated
+        assert aliases == {j: j for j in range(n_out)}, (depth, aliases)
+        assert n_in > n_out
 
-    (n_iters,) = captured["grid"]
+        (n_iters,) = captured["grid"]
 
-    def blocks(spec):
-        # x-block index per grid iteration (index maps are pure)
-        return [int(spec.index_map(i)[1]) for i in range(n_iters)]
+        def blocks(spec):
+            # x-block index per grid iteration (index maps are pure)
+            return [int(spec.index_map(i)[1]) for i in range(n_iters)]
 
-    for j in sorted(aliases):
-        fetches = blocks(captured["in_specs"][j])
-        visits = blocks(captured["out_specs"][aliases[j]])
-        assert fetches == sorted(fetches), \
-            f"operand {j}: non-monotone in-map {fetches}"
-        first_fetch = {}
-        for i, b in enumerate(fetches):
-            first_fetch.setdefault(b, i)
-        first_visit = {}
-        for i, b in enumerate(visits):
-            first_visit.setdefault(b, i)
-        for b, fi in first_fetch.items():
-            assert fi <= first_visit.get(b, n_iters), (
-                f"operand {j}: block {b} fetched at iteration {fi} "
-                f"after its first out visit {first_visit.get(b)} — "
-                f"donation hazard")
+        for j in sorted(aliases):
+            fetches = blocks(captured["in_specs"][j])
+            visits = blocks(captured["out_specs"][aliases[j]])
+            assert fetches == sorted(fetches), \
+                f"k={depth} operand {j}: non-monotone in-map {fetches}"
+            first_fetch = {}
+            for i, b in enumerate(fetches):
+                first_fetch.setdefault(b, i)
+            first_visit = {}
+            for i, b in enumerate(visits):
+                first_visit.setdefault(b, i)
+            for b, fi in first_fetch.items():
+                assert fi <= first_visit.get(b, n_iters), (
+                    f"k={depth} operand {j}: block {b} fetched at "
+                    f"iteration {fi} after its first out visit "
+                    f"{first_visit.get(b)} — donation hazard")
 
 
 # -------------------------------------------------------------------------
 # chunk runner / carry / flight recorder integration
 # -------------------------------------------------------------------------
 
-def test_tb_multi_chunk_odd_chunks_carry():
-    """Odd-length chunks run blocked passes + the single-step tail
-    INSIDE one compiled chunk; several such chunks must compose to the
-    same answer as one even scan."""
+def test_tb_multi_chunk_odd_chunks_carry(tb_depth):
+    """Chunk lengths not divisible by k run blocked passes + the
+    single-step tail INSIDE one compiled chunk; several such chunks
+    must compose to the same answer as one scan (k=3: 6 = 2 blocked,
+    3 = 1 blocked + 1 tail)."""
+    tb_depth(3)
     cfg = SimConfig(**BASE, use_pallas=True,
                     pml=PmlConfig(size=(3, 3, 3)),
                     point_source=PointSourceConfig(
@@ -452,26 +688,28 @@ def test_tb_multi_chunk_odd_chunks_carry():
     many = Simulation(cfg)
     many.advance(3)   # 1 blocked + 1 tail
     _ = many.state["E"]["Ez"]      # force an unpack between chunks
-    many.advance(3)   # odd again (re-uses the compiled length)
+    many.advance(3)   # again (re-uses the compiled length)
     assert many.step_kind == "pallas_packed_tb"
+    assert many.step_diag["temporal_block"] == 3
     assert one.t == many.t == 6
     a = np.asarray(one.field("Ez"))
     b = np.asarray(many.field("Ez"))
     assert np.abs(a - b).max() / (np.abs(a).max() + 1e-30) < 2e-6
 
 
-@pytest.mark.slow
-def test_tb_checkpoint_roundtrip():
-    """Bit-exact resume across the tb carry; the tile-dependent unpack
-    it depends on is covered in tier-1 by
-    test_tb_multi_chunk_odd_chunks_carry (tier-1 wall budget)."""
+def test_tb_checkpoint_resume_mid_blocked_chunk(tb_depth):
+    """Bit-exact resume from a snapshot taken at a step count that is
+    NOT a multiple of k (t=4 at k=3: the chunk before it ran 1 blocked
+    pass + 1 tail) — the packed carry, Drude-free psi state and the
+    t mirror all restore onto the identical layout."""
+    tb_depth(3)
     cfg = SimConfig(**BASE, use_pallas=True,
                     pml=PmlConfig(size=(3, 3, 3)),
                     point_source=PointSourceConfig(
                         enabled=True, component="Ez", position=(8, 8, 8)))
     import tempfile
     sim = Simulation(cfg)
-    sim.advance(4)
+    sim.advance(4)   # 1 blocked + 1 tail: mid-blocked-chunk t
     with tempfile.TemporaryDirectory() as d:
         path = os.path.join(d, "ck.npz")
         sim.checkpoint(path)
@@ -488,7 +726,8 @@ def test_tb_checkpoint_roundtrip():
 def test_tb_health_counters_unpack_blocked_carry(tmp_path):
     """The flight recorder's in-graph health counters must unpack the
     tb packed carry (telemetry satellite): finite energy per chunk,
-    matching the jnp run's counters, odd chunk included."""
+    matching the jnp run's counters, non-divisible chunk included;
+    run_start records the ghost_depth the step consumed."""
     from fdtd3d_tpu import telemetry
 
     def run(up):
@@ -500,13 +739,17 @@ def test_tb_health_counters_unpack_blocked_carry(tmp_path):
                 telemetry_path=str(tmp_path / f"t_{up}.jsonl"),
                 check_finite=True))
         sim = Simulation(cfg)
-        sim.advance(5)   # odd: blocked passes + tail inside the chunk
+        sim.advance(5)   # non-divisible: blocked passes + tail(s)
         sim.close_telemetry()
         return sim, telemetry.read_jsonl(cfg.output.telemetry_path)
 
     sim_p, recs_p = run(True)
     assert sim_p.step_kind == "pallas_packed_tb"
+    start = [r for r in recs_p if r["type"] == "run_start"][0]
+    assert start["ghost_depth"] == sim_p.step_diag["temporal_block"]
     sim_j, recs_j = run(False)
+    starts_j = [r for r in recs_j if r["type"] == "run_start"]
+    assert "ghost_depth" not in starts_j[0]   # single-step kind: absent
     chunks_p = [r for r in recs_p if r["type"] == "chunk"]
     chunks_j = [r for r in recs_j if r["type"] == "chunk"]
     assert [c["t"] for c in chunks_p] == [5]
@@ -516,10 +759,65 @@ def test_tb_health_counters_unpack_blocked_carry(tmp_path):
         assert cp["max_e"] == pytest.approx(cj["max_e"], rel=1e-4)
 
 
+def test_tb_vmem_ladder_depth_downgrade(monkeypatch):
+    """A VMEM-ladder rebuild that lands on a SHALLOWER pipeline depth
+    (k -> k-1) is SOUND (same packed-carry family, re-packed through
+    the dict form), keeps the run alive, and emits the ghost_depth
+    pair on the ladder_downgrade event."""
+    from fdtd3d_tpu import solver, telemetry
+
+    monkeypatch.setenv("FDTD3D_TB_DEPTH", "4")
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)))
+    sim = Simulation(cfg)
+    assert sim.step_diag["temporal_block"] == 4
+    _seed_fields(sim, seed=3)
+    sim.advance(4)   # materialize the packed carry
+
+    real = solver.make_chunk_runner
+
+    def forced_k3(static, mesh_axes=None, mesh_shape=None,
+                  health=False, per_chip=False):
+        saved = os.environ.get("FDTD3D_TB_DEPTH")
+        os.environ["FDTD3D_TB_DEPTH"] = "3"
+        try:
+            return real(static, mesh_axes, mesh_shape, health=health,
+                        per_chip=per_chip)
+        finally:
+            os.environ["FDTD3D_TB_DEPTH"] = saved
+
+    events = []
+    monkeypatch.setattr(solver, "make_chunk_runner", forced_k3)
+    sim.telemetry = type("Sink", (), {
+        "emit": lambda self, typ, **kw: events.append((typ, kw)),
+    })()
+    sim._vmem_fallback(RuntimeError("mosaic vmem overflow (simulated)"))
+    sim.telemetry = None
+    assert sim.step_kind == "pallas_packed_tb"
+    assert sim.step_diag["temporal_block"] == 3
+    assert events and events[0][0] == "ladder_downgrade"
+    assert events[0][1]["old_ghost_depth"] == 4
+    assert events[0][1]["new_ghost_depth"] == 3
+    assert "old_ghost_depth" in telemetry.RECORD_OPTIONAL[
+        "ladder_downgrade"]
+    sim.advance(4)
+
+    ref = Simulation(SimConfig(**dict(BASE, use_pallas=False,
+                                      pml=PmlConfig(size=(3, 3, 3)))))
+    _seed_fields(ref, seed=3)
+    ref.advance(8)
+    for c in ("Ez", "Hy"):
+        a = np.asarray(ref.field(c), np.float32)
+        b = np.asarray(sim.field(c), np.float32)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+        assert rel < 2e-6, f"{c}: rel {rel:.2e}"
+
+
 def test_tb_vmem_ladder_downgrade_to_packed(monkeypatch):
-    """A VMEM-ladder rebuild that falls out of tb scope down to the
-    single-step packed kernel is SOUND (same packed-carry family,
-    re-packed through the dict form) and must keep the run alive."""
+    """The bottom of the depth ladder: a rebuild that falls out of tb
+    scope entirely down to the single-step packed kernel is SOUND
+    (same packed-carry family, re-packed through the dict form) and
+    must keep the run alive."""
     from fdtd3d_tpu import solver
     cfg = SimConfig(**BASE, use_pallas=True,
                     pml=PmlConfig(size=(3, 3, 3)))

@@ -294,6 +294,60 @@ def test_sentinel_tb_ledger_diff():
     assert ratio <= 0.55, ratio
 
 
+def test_sentinel_tb_depth_paths_registered():
+    """Round-12 satellite: the per-depth k-sweep paths
+    (f32_packed_tb_k3 / f32_packed_tb_k4, bench stage 3e) are first-
+    class sentinel paths with their own grid keys — absent history
+    reads NOT-MEASURED/NO-REF, never a phantom regression."""
+    ps = _sentinel()
+    cur = dict(CUR_OK, tb_k3_mcells=20000.0, tb_k3_n=640,
+               tb_k4_mcells=24000.0, tb_k4_n=640)
+    v = ps.check_artifact(cur, _best(), _history())
+    # no reference on record yet (first k-sweep window): NO-REF
+    assert v["paths"]["f32_packed_tb_k3"]["verdict"] == "NO-REF"
+    assert v["paths"]["f32_packed_tb_k4"]["verdict"] == "NO-REF"
+    assert v["status"] == "OK"
+    # once a best carries the keys, drops gate like every other path
+    best = dict(_best(), tb_k3_mcells=20000.0, tb_k3_n=640,
+                tb_k4_mcells=24000.0, tb_k4_n=640)
+    v = ps.check_artifact(dict(cur, tb_k3_mcells=15000.0), best,
+                          _history())
+    assert v["paths"]["f32_packed_tb_k3"]["verdict"] == "REGRESSION"
+    assert v["paths"]["f32_packed_tb_k4"]["verdict"] == "OK"
+
+
+def test_sentinel_tb_depth_ledger_fixture_pairs():
+    """Round-12 satellite: a checked-in ledger fixture pair PER DEPTH
+    — the byte-ratio regression is caught chip-free at k=3 and k=4,
+    and cross-depth diffs are SKIPPED (a depth change legitimately
+    moves per-step bytes; each depth gates against its own ref)."""
+    ps = _sentinel()
+    refs = {}
+    for k in (3, 4):
+        with open(os.path.join(FIX, f"ledger_tb_k{k}_ref.json")) as f:
+            ref = json.load(f)
+        with open(os.path.join(FIX,
+                               f"ledger_tb_k{k}_regressed.json")) as f:
+            cur = json.load(f)
+        refs[k] = ref
+        assert ref["steps_per_call"] == k
+        assert ps.check_ledgers(ref, ref)["status"] == "OK"
+        v = ps.check_ledgers(cur, ref)
+        assert v["status"] == "REGRESSION", k
+        assert any("packed-kernel-tb" in m for m in v["regressions"])
+    # the fixture pairs encode the per-depth roofs themselves vs the
+    # single-step packed reference (~16/12 B/cell/step classes)
+    with open(os.path.join(FIX, "ledger_ref.json")) as f:
+        pk_ref = json.load(f)
+    for k, bound in ((3, 0.40), (4, 0.32)):
+        ratio = refs[k]["per_step"]["bytes_per_cell"] \
+            / pk_ref["per_step"]["bytes_per_cell"]
+        assert ratio <= bound, (k, ratio)
+    # cross-depth diff: SKIPPED, not a fake regression
+    v = ps.check_ledgers(refs[4], refs[3])
+    assert v["status"] == "SKIPPED" and "depth" in v["note"]
+
+
 def test_sentinel_ledger_diff():
     ps = _sentinel()
     with open(os.path.join(FIX, "ledger_ref.json")) as f:

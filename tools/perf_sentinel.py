@@ -60,6 +60,11 @@ PATHS = {
     # so neither history pollutes the other's reference
     "f32_packed_tb": ("tb_mcells", ("tb_mcells",)),
     "bf16_tb": ("tb_bf16_mcells", ("tb_bf16_mcells",)),
+    # round-12 depth-k sweep (bench stage 3e): per-depth first-class
+    # paths so the auto-pick's default history can never mask a
+    # specific depth's cliff (the ~16/12 B/cell/step f32 roofs)
+    "f32_packed_tb_k3": ("tb_k3_mcells", ("tb_k3_mcells",)),
+    "f32_packed_tb_k4": ("tb_k4_mcells", ("tb_k4_mcells",)),
     # round-11 SHARDED temporal-blocked kernel (depth-2 halo pipeline):
     # bench.py's multichip stage on a >=8-chip window; its own path so
     # single-chip history cannot mask a sharded-dispatch cliff
@@ -80,6 +85,8 @@ PATH_N_KEYS = {
     "bf16": ("bf16_n", "n"),
     "f32_packed_tb": ("tb_n",),
     "bf16_tb": ("tb_bf16_n",),
+    "f32_packed_tb_k3": ("tb_k3_n",),
+    "f32_packed_tb_k4": ("tb_k4_n",),
     "f32_packed_tb_sharded": ("tb_sharded_n",),
     "float32x2": ("float32x2_n",),
 }
@@ -207,6 +214,17 @@ def check_ledgers(current: Dict[str, Any], reference: Dict[str, Any],
         out["status"] = "SKIPPED"
         out["note"] = (f"step kinds differ: {current.get('step_kind')} "
                        f"vs {reference.get('step_kind')}")
+        return out
+    if current.get("steps_per_call", 1) != \
+            reference.get("steps_per_call", 1):
+        # a temporal-block DEPTH change legitimately moves per-step
+        # bytes (~48/k B/cell); gate each depth against its own
+        # fixture (ledger_tb_k*_ref.json), never across depths
+        out["status"] = "SKIPPED"
+        out["note"] = (f"pipeline depths differ: steps_per_call "
+                       f"{current.get('steps_per_call', 1)} vs "
+                       f"{reference.get('steps_per_call', 1)} — diff "
+                       f"each depth against its own reference")
         return out
     cur_cells = float(current.get("cells") or 1)
     ref_cells = float(reference.get("cells") or 1)
