@@ -289,6 +289,61 @@ def probe_hbm_gbps() -> float:
     return 2 * passes * n * 4 / (best - rb) / 1e9  # read + write
 
 
+def compile_amortization(n: int = 24, steps: int = 8) -> dict:
+    """Cold-vs-warm compile stage (round 15, docs/SERVICE.md): wall
+    compile_ms for the FIRST build of a CPML+source chunk executable
+    (in-process cache cleared first) vs a second same-key Simulation —
+    which must trace ZERO times and read compile_ms ~0. CPU-
+    deterministic (runs whatever kernel the backend engages; no chip
+    needed); the artifact embeds the ExecKey digests so
+    tools/perf_sentinel.py's compile lane gates cold compile_ms only
+    at EQUAL comparable key (a kernel/tile/grid change legitimately
+    moves compile cost)."""
+    from fdtd3d_tpu import exec_cache
+    from fdtd3d_tpu.config import (PmlConfig, PointSourceConfig,
+                                   SimConfig)
+    from fdtd3d_tpu.sim import Simulation
+
+    cfg = SimConfig(scheme="3D", size=(n, n, n), time_steps=steps,
+                    dx=1e-3, courant_factor=0.5, wavelength=8e-3,
+                    pml=PmlConfig(size=(4, 4, 4)),
+                    point_source=PointSourceConfig(
+                        enabled=True, component="Ez",
+                        position=(n // 2,) * 3))
+    # pin the DISK layer off for the stage: an ambient warm
+    # FDTD3D_AOT_CACHE_DIR would make the "cold" number a disk hit
+    # (compile_ms 0) and the stage would measure nothing
+    saved_dir = os.environ.pop("FDTD3D_AOT_CACHE_DIR", None)
+    try:
+        exec_cache.clear_memory()
+        s0 = exec_cache.stats()
+        cold_sim = Simulation(cfg)
+        cold_sim.advance(steps)
+        s1 = exec_cache.stats()
+        warm_sim = Simulation(cfg)
+        warm_sim.advance(steps)
+        s2 = exec_cache.stats()
+        key = warm_sim.exec_key(steps)
+    finally:
+        if saved_dir is not None:
+            os.environ["FDTD3D_AOT_CACHE_DIR"] = saved_dir
+    out = {
+        "grid": n, "steps": steps, "step_kind": warm_sim.step_kind,
+        "exec_key": key.digest,
+        "exec_key_comparable": key.comparable_digest,
+        "cold_compile_ms": round(cold_sim._compile_ms, 1),
+        "warm_compile_ms": round(warm_sim._compile_ms, 1),
+        "cold_traces": s1["traces"] - s0["traces"],
+        "warm_traces": s2["traces"] - s1["traces"],
+        "warm_hits": s2["hits"] - s1["hits"],
+        "cache_enabled": s2["enabled"],
+        "disk_dir": saved_dir,
+    }
+    cold_sim.close()
+    warm_sim.close()
+    return out
+
+
 def accuracy_spotcheck(n: int = 32, steps: int = 60) -> dict:
     """Fast (<=100-step) per-dtype accuracy-class guard (VERDICT
     weak-8): a sourceless CPML run from an f32-rounded Gaussian Ez
@@ -945,6 +1000,14 @@ def run_measurement() -> None:
             out["multichip"]["tb_sharded_note"] = tb_sh_note
     except Exception as exc:  # never kill the bench
         out["multichip"] = {"error": str(exc)[:200]}
+    # Compile-amortization stage (round 15): cold-vs-warm compile_ms
+    # + exec-key digests, CPU-deterministic — feeds the sentinel's
+    # compile lane (>25% cold-compile growth at equal comparable key
+    # regresses; a warm run that traces at all regresses outright).
+    try:
+        out["compile_amortization"] = compile_amortization()
+    except Exception as exc:  # never kill the bench
+        out["compile_amortization"] = {"error": str(exc)[:200]}
     # Perf-regression sentinel (round 7): every artifact carries its
     # own verdict vs BENCH_BEST + the BENCH_r* history, so a >10%
     # per-path cliff can never ship silently — it is flagged in the
@@ -954,10 +1017,26 @@ def run_measurement() -> None:
     try:
         sentinel = _load_sentinel()
         root = os.path.dirname(os.path.abspath(__file__))
+        # one snapshot for BOTH gates: re-loading between them could
+        # let the throughput and compile lanes judge different files
+        ref_best = _load_best()
+        ref_history = sentinel.load_history(
+            os.path.join(root, "BENCH_r*.json"))
         out["perf_sentinel"] = sentinel.check_artifact(
-            out, best=_load_best(),
-            history=sentinel.load_history(
-                os.path.join(root, "BENCH_r*.json")))
+            out, best=ref_best, history=ref_history)
+        if "error" not in out["compile_amortization"]:
+            out["perf_sentinel"]["compile"] = sentinel.check_compile(
+                out, best=ref_best, history=ref_history)
+            out["perf_sentinel"]["regressions"] = \
+                out["perf_sentinel"]["regressions"] \
+                + out["perf_sentinel"]["compile"].get("regressions",
+                                                      [])
+            if out["perf_sentinel"]["regressions"]:
+                # recompute: a SKIPPED/OK throughput verdict (e.g. a
+                # CPU window, exactly where the compile lane is the
+                # active gate) must not mask compile regressions in
+                # the artifact's own status field
+                out["perf_sentinel"]["status"] = "REGRESSION"
         for msg in out["perf_sentinel"]["regressions"]:
             print(f"PERF SENTINEL REGRESSION: {msg}",
                   file=sys.stderr, flush=True)

@@ -29,6 +29,12 @@ NTFF (in Source/Scheme)      fdtd3d_tpu.ntff
 CallBacks (exact solutions)  fdtd3d_tpu.exact
 main.cpp CLI                 fdtd3d_tpu.cli (console entry `fdtd3d`)
 ==========================  =============================================
+
+Beyond the reference (docs/SERVICE.md): fdtd3d_tpu.scenario
+(ScenarioSpec — the separable scenario description),
+fdtd3d_tpu.exec_cache (AOT executable cache: repeat scenarios skip
+compile) and fdtd3d_tpu.batch (vmap-batched multi-tenant execution,
+``Simulation.run_batch`` / CLI ``--batch``).
 """
 
 __version__ = "0.1.0"

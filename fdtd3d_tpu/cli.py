@@ -241,6 +241,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "(no device needed) and exit — size pod-scale "
                         "configs on a laptop")
 
+    g = p.add_argument_group("batched execution (docs/SERVICE.md)")
+    g.add_argument("--batch", metavar="SPEC.txt", nargs="+",
+                   default=None,
+                   help="run B same-shape scenarios as ONE vmap-"
+                        "batched execution: each SPEC.txt is a "
+                        "command file (--cmd-from-file format) "
+                        "describing one lane. Lanes must share the "
+                        "graph-shaping config (grid/scheme/dtype/"
+                        "steps/sources geometry) and may differ in "
+                        "material values and point-source amplitude; "
+                        "one compiled executable, one dispatch per "
+                        "chunk for the whole batch. Per-lane health "
+                        "flags — one lane's NaN never fails the "
+                        "others. Top-level --telemetry/--check-finite "
+                        "apply to the batch; FDTD3D_BATCH_MAX bounds "
+                        "the lane count.")
+
     g = p.add_argument_group("command files")
     g.add_argument("--cmd-from-file", metavar="FILE", default=None,
                    help="read flags from a .txt command file (reference "
@@ -434,7 +451,8 @@ def save_cmd_file(args, path: str):
     lines = []
     for action in parser._actions:
         if not action.option_strings or action.dest in (
-                "help", "cmd_from_file", "save_cmd_to_file") or \
+                "help", "cmd_from_file", "save_cmd_to_file",
+                "batch") or \
                 action.help == argparse.SUPPRESS:
             # SUPPRESS'd actions are compat aliases (--no-profile):
             # re-emitting them would mis-serialize the shared dest
@@ -559,6 +577,69 @@ def _check_topology_fits(cfg, resuming: bool = False):
             f"{jax.device_count()} are available{hint}")
 
 
+def _run_batch_cli(parser, args) -> int:
+    """``--batch spec1.txt spec2.txt ...``: the multi-tenant lane of
+    docs/SERVICE.md — parse each command file into one scenario, run
+    them as one vmap batch, report per-lane health. A tripped lane is
+    a WARNED per-lane verdict, never a batch failure (exit stays 0:
+    the other tenants' runs completed)."""
+    import dataclasses as _dc
+    import time as _time
+
+    from fdtd3d_tpu.log import log, set_level, warn
+    cfgs = []
+    for path in args.batch:
+        largs = parser.parse_args(read_cmd_file(path))
+        if largs.batch:
+            raise SystemExit(
+                f"--batch: {path} itself contains --batch (nested "
+                f"batches are not a thing)")
+        cfgs.append(args_to_config(largs))
+    if args.telemetry or args.check_finite:
+        # top-level observability flags apply to the batch (lane 0's
+        # output config drives the shared sink / tripwire)
+        out0 = _dc.replace(
+            cfgs[0].output,
+            telemetry_path=args.telemetry
+            or cfgs[0].output.telemetry_path,
+            check_finite=args.check_finite
+            or cfgs[0].output.check_finite)
+        cfgs[0] = _dc.replace(cfgs[0], output=out0)
+    set_level(cfgs[0].output.log_level)
+    from fdtd3d_tpu.sim import Simulation
+    t0 = _time.time()
+    try:
+        bsim = Simulation.run_batch(cfgs)
+    except ValueError as exc:
+        raise SystemExit(f"--batch: {exc}")
+    wall = _time.time() - t0
+    # (run_batch has already run the verify_final_lanes end-of-run
+    # sweep, so the verdicts below reflect damage landing after the
+    # last chunk's in-graph measurement too)
+    cells = 1.0
+    for a in bsim.static.mode.active_axes:
+        cells *= bsim.cfg.grid_shape[a]
+    mcps = cells * bsim.batch_size * bsim.cfg.time_steps \
+        / max(wall, 1e-9) / 1e6
+    for lane in range(bsim.batch_size):
+        verdict = {True: "healthy", False: "NON-FINITE",
+                   None: "unmeasured"}[bsim.lane_finite[lane]]
+        extra = ""
+        if bsim.lane_first_unhealthy_t[lane] is not None:
+            extra = (f" (first bad step <= "
+                     f"{bsim.lane_first_unhealthy_t[lane]})")
+        log(f"batch lane {lane}: {verdict}{extra}")
+    bad = [i for i, f in enumerate(bsim.lane_finite) if f is False]
+    if bad:
+        warn(f"batch: lane(s) {bad} tripped non-finite; the other "
+             f"{bsim.batch_size - len(bad)} completed healthy "
+             f"(per-lane rows in the telemetry batch_lane records)")
+    log(f"done: {bsim.batch_size} lanes x {bsim.cfg.time_steps} steps "
+        f"in {wall:.2f}s ({mcps:.1f} Mcells/s aggregate, one "
+        f"dispatch per chunk)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = build_parser()
@@ -569,6 +650,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         args = parser.parse_args(file_argv + argv)
     if args.save_cmd_to_file:
         save_cmd_file(args, args.save_cmd_to_file)
+    if args.batch:
+        return _run_batch_cli(parser, args)
 
     if args.dry_run:
         from fdtd3d_tpu import plan as plan_mod

@@ -125,6 +125,25 @@ ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
           "bench.py: capture a device trace per stage into "
           "DIR/<path>_<dtype>_<n>/ subdirectories (attribute with "
           "tools/trace_attribution.py)."),
+    _knob("FDTD3D_AOT_CACHE_DIR", "path", None,
+          "On-disk layer of the AOT executable cache (fdtd3d_tpu/"
+          "exec_cache.py): chunk executables serialized via "
+          "jax.experimental.serialize_executable land here "
+          "(atomic-published, provenance-checked on load) so a repeat "
+          "scenario skips compile ACROSS process boundaries. Unset: "
+          "in-process layer only. Point only at trusted directories "
+          "(the payload is a pickle, like jax's own persistent "
+          "compilation cache)."),
+    _knob("FDTD3D_AOT_CACHE", "str", "on",
+          "Off-switch for the AOT executable cache: 0/off/no disables "
+          "BOTH layers (every chunk compile then traces+compiles "
+          "exactly as the pre-cache build; stats still count). Any "
+          "other value (or unset) leaves the cache on."),
+    _knob("FDTD3D_BATCH_MAX", "int", 16,
+          "Lane-count bound for vmap-batched execution "
+          "(fdtd3d_tpu/batch.py run_batch / CLI --batch): vmap is "
+          "linear in lanes for HBM and compile time, so an unbounded "
+          "batch is an OOM with extra steps."),
 )}
 
 

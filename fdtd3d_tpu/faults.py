@@ -13,7 +13,7 @@ environment (picked up once per process by ``Simulation.__init__``):
 
 Fault kinds:
 
-``nan@t=T[,field=COMP][,chip=C]``
+``nan@t=T[,field=COMP][,chip=C][,lane=L]``
     Inject a single NaN into COMP at the first chunk boundary with
     ``t >= T`` (between compiled chunks, after the auto-checkpoint
     cadence — the snapshot at the same ``t`` stays clean). The next
@@ -22,6 +22,9 @@ Fault kinds:
     index = the mesh-linearized position, telemetry.PER_CHIP_KEYS
     convention) — the deterministic stand-in for one diverging/faulty
     chip in a pod, so chip-scoped recovery paths are provable.
+    ``lane=L`` scopes the NaN to vmap lane L of a batched simulation
+    (fdtd3d_tpu/batch.py; REQUIRED there — lanes are tenants, and the
+    per-lane health isolation must be proven against a named one).
 ``preempt@t=T``
     Raise :class:`SimulatedPreemption` at the first chunk boundary with
     ``t >= T`` — the stand-in for a preempted TPU window / SIGKILL.
@@ -101,7 +104,7 @@ _KINDS = ("nan", "preempt", "error", "fail_write", "corrupt_ckpt",
 # (e.g. fail_write@...,chip=1 where host= was meant) is a plan that
 # "proves" a scenario that never ran — rejected as loudly as a typo.
 _KIND_KEYS = {
-    "nan": ("t", "field", "chip"),
+    "nan": ("t", "field", "chip", "lane"),
     "preempt": ("t",),
     "error": ("t", "times"),
     "fail_write": ("n", "host"),
@@ -122,6 +125,7 @@ class Fault:
     mode: str = "truncate"  # corrupt_ckpt damage mode: truncate | zero
     chip: Optional[int] = None  # chip scope (nan): mesh-linearized id
     host: Optional[int] = None  # host scope (fail_write)
+    lane: Optional[int] = None  # batch-lane scope (nan): vmap lane id
     fired: int = 0        # firings so far (one-shot bookkeeping)
 
 
@@ -158,13 +162,14 @@ class FaultPlan:
                     continue
                 key, _, val = kv.partition("=")
                 key, val = key.strip(), val.strip()
-                if key in ("t", "n", "times", "chip", "host", "field",
-                           "mode") and key not in _KIND_KEYS[kind]:
+                if key in ("t", "n", "times", "chip", "host", "lane",
+                           "field", "mode") \
+                        and key not in _KIND_KEYS[kind]:
                     raise ValueError(
                         f"fault-plan key {key!r} does not apply to "
                         f"kind {kind!r} in {entry!r} (valid for "
                         f"{kind}: {', '.join(_KIND_KEYS[kind])})")
-                if key in ("t", "n", "times", "chip", "host"):
+                if key in ("t", "n", "times", "chip", "host", "lane"):
                     try:
                         setattr(f, key, int(val))
                     except ValueError:
@@ -176,7 +181,8 @@ class FaultPlan:
                 else:
                     raise ValueError(
                         f"unknown fault-plan key {key!r} in {entry!r} "
-                        f"(valid: t, n, times, field, mode, chip, host)")
+                        f"(valid: t, n, times, field, mode, chip, "
+                        f"host, lane)")
             if f.mode not in ("truncate", "zero"):
                 raise ValueError(
                     f"fault plan entry {entry!r}: mode must be "
@@ -343,7 +349,7 @@ def on_chunk_boundary(sim) -> None:
     for f in _PLAN.faults:
         if f.kind == "nan" and not f.fired and t >= f.t:
             f.fired = 1
-            _inject_nan(sim, f.field, chip=f.chip)
+            _inject_nan(sim, f.field, chip=f.chip, lane=f.lane)
         elif f.kind == "error" and f.fired < f.times and t >= f.t:
             f.fired += 1
             raise InjectedTransientError(
@@ -355,29 +361,62 @@ def on_chunk_boundary(sim) -> None:
                 f"fault plan: simulated preemption at t={t}")
 
 
-def _inject_nan(sim, comp: str, chip: Optional[int] = None) -> None:
+def _chip_center(topology, shape, chip: int):
+    """Cell index at the CENTER of chip ``chip``'s shard of a
+    ``shape``-sized field (chip index = mesh-linearized row-major
+    position over the (x, y, z) topology — telemetry.PER_CHIP_KEYS
+    convention)."""
+    import numpy as np
+    topo = tuple(topology)
+    n_chips = int(np.prod(topo))
+    if not 0 <= chip < n_chips:
+        raise ValueError(
+            f"fault plan: chip={chip} out of range for topology "
+            f"{topo} ({n_chips} chips)")
+    pos = np.unravel_index(chip, topo)
+    local = tuple(s // p for s, p in zip(shape, topo))
+    return tuple(p * ln + ln // 2 for p, ln in zip(pos, local))
+
+
+def _inject_nan(sim, comp: str, chip: Optional[int] = None,
+                lane: Optional[int] = None) -> None:
     import numpy as np
     group = "E" if comp[:1] == "E" else "H"
     cur = np.array(sim.state[group][comp])
-    if chip is None:
+    batch = getattr(sim, "batch_size", None)
+    if batch is not None:
+        # vmap-batched executor (fdtd3d_tpu/batch.py): fields carry a
+        # leading lane axis, and the fault must name the tenant it
+        # damages — an unscoped nan on a batch would "prove" per-lane
+        # isolation a fault never exercised
+        if lane is None:
+            raise ValueError(
+                "fault plan: nan on a batched simulation needs an "
+                "explicit lane= scope (lanes are tenants; pick one)")
+        if not 0 <= lane < batch:
+            raise ValueError(
+                f"fault plan: lane={lane} out of range for batch "
+                f"of {batch}")
+        # chip= composes: the NaN lands at that chip's shard center
+        # WITHIN the lane (a silently-ignored scope would "prove" a
+        # chip-scoped scenario that never ran — the module contract)
+        tail = _chip_center(sim.topology, cur.shape[1:], chip) \
+            if chip is not None \
+            else tuple(s // 2 for s in cur.shape[1:])
+        idx = (lane,) + tail
+    elif lane is not None:
+        raise ValueError(
+            "fault plan: lane= scope only applies to a batched "
+            "simulation (Simulation.run_batch)")
+    elif chip is None:
         idx = tuple(s // 2 for s in cur.shape)
     else:
         # chip-scoped: the NaN lands at the CENTER of chip `chip`'s
-        # shard (chip index = mesh-linearized row-major position over
-        # the (x, y, z) topology — telemetry.PER_CHIP_KEYS convention),
-        # so per-chip attribution can name the faulty chip.
-        topo = tuple(sim.topology)
-        n_chips = int(np.prod(topo))
-        if not 0 <= chip < n_chips:
-            raise ValueError(
-                f"fault plan: chip={chip} out of range for topology "
-                f"{topo} ({n_chips} chips)")
-        pos = np.unravel_index(chip, topo)
-        local = tuple(s // p for s, p in zip(cur.shape, topo))
-        idx = tuple(p * ln + ln // 2
-                    for p, ln in zip(pos, local))
+        # shard, so per-chip attribution can name the faulty chip.
+        idx = _chip_center(sim.topology, cur.shape, chip)
     cur[idx] = np.nan
     sim.set_field(comp, cur)
-    where = f" (chip {chip}, cell {idx})" if chip is not None else ""
+    where = f" (chip {chip}, cell {idx})" if chip is not None else \
+        (f" (lane {lane}, cell {idx[1:]})" if lane is not None else "")
     _log.warn(f"fault plan: injected NaN into {comp}{where} "
               f"at t={sim._t_host}")

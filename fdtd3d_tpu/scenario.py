@@ -1,0 +1,116 @@
+"""ScenarioSpec: the WHAT of a run, as its own object.
+
+The multi-tenant service split (ROADMAP item 2; docs/SERVICE.md): a
+run is three separable things —
+
+1. the **scenario spec** (this module): grid / materials / sources /
+   outputs, i.e. the :class:`fdtd3d_tpu.config.SimConfig` plus the
+   derived trace-static setup and the host-built coefficient arrays;
+2. the **state pytree**: the sharded field arrays a scenario evolves
+   (``Simulation.state`` / ``adopt_state`` — already separable since
+   the reshard-on-resume work);
+3. the **compiled chunk runner**: the executable artifact, cached and
+   shared across runs by :mod:`fdtd3d_tpu.exec_cache`.
+
+``Simulation`` composes the three; the batch executor
+(:mod:`fdtd3d_tpu.batch`) stacks many specs' states/coefficients under
+ONE executable. The spec memoizes its derived products so constructing
+a Simulation from an already-used spec repeats no host work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from fdtd3d_tpu.config import SimConfig
+
+
+# cfg fields allowed to DIFFER between the lanes of one vmap batch:
+# everything else is baked into the compiled graph (trace-static), so
+# a difference there would make the shared executable wrong physics.
+# materials: values land in the traced coeffs arrays (STRUCTURE —
+# scalar-vs-grid, Drude on/off — is re-checked leaf-by-leaf at stack
+# time); point_source.amplitude: threaded through the traced
+# ``ps_amp`` coefficient (solver.build_coeffs); output: host-side
+# only, never in the graph.
+BATCH_VARIABLE_FIELDS = ("materials", "output")
+BATCH_VARIABLE_SUBFIELDS = {"point_source": ("amplitude",)}
+
+
+class ScenarioSpec:
+    """One scenario's full description + memoized derived products."""
+
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        self._static = None
+        self._coeffs_np = None
+
+    @property
+    def static(self):
+        """The trace-static setup (solver.StaticSetup) at the cfg's
+        own (unsharded) topology; Simulation re-stamps the resolved
+        topology with ``dataclasses.replace``."""
+        if self._static is None:
+            from fdtd3d_tpu.solver import build_static
+            self._static = build_static(self.cfg)
+        return self._static
+
+    def static_for(self, topology: Tuple[int, int, int]):
+        return dataclasses.replace(self.static,
+                                   topology=tuple(topology))
+
+    def build_coeffs(self, static=None) -> Dict[str, Any]:
+        """Host-built (numpy) coefficient pytree. Memoized per spec —
+        the psi slab layout depends on the topology, so a sharded
+        caller passes its re-stamped static and skips the memo."""
+        from fdtd3d_tpu.solver import build_coeffs
+        if static is not None:
+            return build_coeffs(static)
+        if self._coeffs_np is None:
+            self._coeffs_np = build_coeffs(self.static)
+        return self._coeffs_np
+
+    def init_state(self, static=None) -> Dict[str, Any]:
+        from fdtd3d_tpu.solver import init_state
+        return init_state(static if static is not None else self.static)
+
+    # -- identity ----------------------------------------------------------
+
+    def fingerprint(self) -> str:
+        """The physics fingerprint the exec-cache key carries
+        (exec_cache.config_fingerprint)."""
+        from fdtd3d_tpu.exec_cache import config_fingerprint
+        return config_fingerprint(self.cfg)
+
+    def batch_fingerprint(self) -> Dict[str, Any]:
+        """Canonical dict of every cfg field that must be EQUAL across
+        the lanes of a vmap batch (the graph-shaping fields). Lanes
+        whose batch fingerprints differ cannot share one executable;
+        :mod:`fdtd3d_tpu.batch` compares these and names the first
+        differing field in its eligibility error."""
+        d = dataclasses.asdict(self.cfg)
+        for field in BATCH_VARIABLE_FIELDS:
+            d.pop(field, None)
+        for field, subs in BATCH_VARIABLE_SUBFIELDS.items():
+            if field in d:
+                for sub in subs:
+                    d[field].pop(sub, None)
+        return d
+
+
+def batch_fingerprint_diff(a: Dict[str, Any], b: Dict[str, Any],
+                           prefix: str = "") -> Optional[str]:
+    """First dotted field path where two batch fingerprints differ
+    (None = batch-compatible) — so the eligibility error can name the
+    offending flag instead of dumping two dicts."""
+    for key in sorted(set(a) | set(b)):
+        path = f"{prefix}{key}"
+        va, vb = a.get(key), b.get(key)
+        if isinstance(va, dict) and isinstance(vb, dict):
+            sub = batch_fingerprint_diff(va, vb, prefix=f"{path}.")
+            if sub:
+                return sub
+        elif va != vb:
+            return f"{path} ({va!r} vs {vb!r})"
+    return None

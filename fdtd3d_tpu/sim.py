@@ -27,8 +27,8 @@ from fdtd3d_tpu.config import SimConfig
 from fdtd3d_tpu.parallel import mesh as pmesh
 from fdtd3d_tpu.parallel.mesh import shard_map_compat as \
     _shard_map_compat
-from fdtd3d_tpu.solver import (StaticSetup, build_coeffs, build_static,
-                               init_state, make_chunk_runner)
+from fdtd3d_tpu.solver import (StaticSetup, init_state,
+                               make_chunk_runner)
 
 _AXES_STR = "xyz"
 
@@ -59,9 +59,25 @@ def ckpt_meta_mismatch(cfg, extra) -> Optional[str]:
 
 
 class Simulation:
-    """Owns solver state + coefficients; advances the leapfrog in chunks."""
+    """Owns solver state + coefficients; advances the leapfrog in chunks.
 
-    def __init__(self, cfg: SimConfig, devices: Optional[List] = None):
+    Composes the three separable service objects (docs/SERVICE.md):
+    the scenario spec (``self.spec``, a
+    :class:`fdtd3d_tpu.scenario.ScenarioSpec` — grid / materials /
+    sources / outputs), the sharded state pytree (``self.state`` /
+    ``adopt_state``), and the compiled chunk runner (built per chunk
+    length through the AOT executable cache,
+    :mod:`fdtd3d_tpu.exec_cache` — a repeat scenario with an identical
+    ExecKey performs zero traces).
+    """
+
+    def __init__(self, cfg, devices: Optional[List] = None):
+        from fdtd3d_tpu.scenario import ScenarioSpec
+        if isinstance(cfg, ScenarioSpec):
+            self.spec = cfg
+            cfg = cfg.cfg
+        else:
+            self.spec = ScenarioSpec(cfg)
         self.cfg = cfg
         # deterministic fault-injection harness (fdtd3d_tpu/faults.py):
         # adopt FDTD3D_FAULT_PLAN once per process; a no-op otherwise
@@ -76,13 +92,14 @@ class Simulation:
         self._dstate = None
         self._dstate_ids: List[int] = []
         self._packed_specs = None
-        self.static: StaticSetup = build_static(cfg)
+        self.static: StaticSetup = self.spec.static
         # Topology must be known BEFORE coeffs/state: the CPML psi slab
         # layout (solver.slab_axes) is per-shard.
         topo = self._resolve_topology(devices)
         self.topology = topo
-        self.static = dataclasses.replace(self.static, topology=topo)
-        coeffs_np = build_coeffs(self.static)
+        self.static = self.spec.static_for(topo)
+        coeffs_np = self.spec.build_coeffs(
+            self.static if any(p > 1 for p in topo) else None)
         self.mesh = None
         mesh_axes = mesh_shape = None
         if any(p > 1 for p in topo):
@@ -151,6 +168,9 @@ class Simulation:
         # readback budget); restore() re-syncs it from the checkpoint.
         self._t_host = 0
         self._chunk_idx = 0
+        # wall ms this sim spent in lower+compile (exec-cache misses
+        # only; hits cost ~0) — surfaced as run_end `compile_ms`
+        self._compile_ms = 0.0
         # auto-checkpoint cadence (OutputConfig.checkpoint_every): the
         # step the last cadence snapshot was written at (restore()
         # re-syncs it so a resumed run does not immediately re-write)
@@ -309,16 +329,44 @@ class Simulation:
             # are tests/interpret-mode only, where the copies cost
             # nothing that matters.
             donate = jax.default_backend() in ("tpu", "axon")
-            jitted = jax.jit(fn, donate_argnums=0 if donate else ())
+            # AOT executable cache (fdtd3d_tpu/exec_cache.py): the
+            # lower+compile runs ONLY on a full miss — a repeat
+            # scenario with an identical ExecKey (same grid / kind /
+            # tile / depth / topology / comm strategy / lanes /
+            # devices / provenance AND argument avals) reuses the
+            # in-process or on-disk executable with zero traces.
+            from fdtd3d_tpu import exec_cache as _exec_cache
+            key = self.exec_key(n, donate=donate)
             try:
                 with _telemetry.span("compile"):
-                    compiled = jitted.lower(self._carry(),
-                                            self.coeffs).compile()
+                    compiled, info = _exec_cache.jit_compile(
+                        key, fn,
+                        lambda: (self._carry(), self.coeffs),
+                        donate)
             except Exception as exc:
                 self._vmem_fallback(exc)   # next rung, or re-raise
                 continue
+            self._compile_ms += float(info.get("compile_ms") or 0.0)
             self._compiled[n] = compiled
         return self._compiled[n]
+
+    def exec_key(self, n: int, donate: Optional[bool] = None):
+        """The canonical :class:`fdtd3d_tpu.exec_cache.ExecKey` of
+        this sim's ``n``-step chunk executable — what `_chunk_fn`
+        compiles under, and what bench.py's compile-amortization
+        stage / tools audit."""
+        from fdtd3d_tpu import exec_cache as _exec_cache
+        if donate is None:
+            donate = jax.default_backend() in ("tpu", "axon")
+        return _exec_cache.make_key(
+            self.cfg, step_kind=self.step_kind,
+            topology=self.topology, n_steps=n,
+            health=self._runner_health,
+            per_chip=bool(getattr(self._runner, "per_chip", False)),
+            step_diag=self.step_diag, donate=donate,
+            avals_fp=_exec_cache.avals_fingerprint(self._carry(),
+                                                   self.coeffs),
+            devices=_exec_cache.mesh_device_ids(self.mesh))
 
     def advance(self, n_steps: int):
         """Advance n_steps inside one compiled scan.
@@ -537,7 +585,14 @@ class Simulation:
         w = self.telemetry.wall_total
         mcps = (self._cells * self.telemetry.steps_total / w / 1e6) \
             if w > 0 else 0.0
-        self.telemetry.close(t=self._t_host, mcells_per_s=mcps)
+        # compile-amortization lane (docs/SERVICE.md): this run's
+        # compile wall + the process-wide cache counters, so a warm
+        # run is auditable from the telemetry alone (run_start carries
+        # the at-construction snapshot)
+        from fdtd3d_tpu import exec_cache as _exec_cache
+        self.telemetry.close(t=self._t_host, mcells_per_s=mcps,
+                             compile_ms=round(self._compile_ms, 3),
+                             aot_cache=_exec_cache.stats())
         return self
 
     def close(self):
@@ -683,6 +738,33 @@ class Simulation:
             done += n
             on_interval(self)
         return self
+
+    @staticmethod
+    def run_batch(cfgs, time_steps: Optional[int] = None,
+                  devices: Optional[List] = None):
+        """Run B same-shape scenarios as ONE vmap-batched execution.
+
+        One compiled executable, one dispatch (and one halo exchange)
+        per step for the whole batch; bit-identical per lane to B
+        sequential runs on the same step kind, with per-lane health
+        flags so one tenant's NaN trips only its lane. Returns the
+        finished :class:`fdtd3d_tpu.batch.BatchSimulation` — per-lane
+        results via ``.lane_state(i)`` / ``.lane_field(i, comp)``,
+        per-lane verdicts via ``.lane_finite`` /
+        ``.lane_first_unhealthy_t`` (the end-of-run
+        ``verify_final_lanes`` sweep has already run, so damage
+        landing after the last chunk's in-graph measurement is
+        reflected too). Batching eligibility + limits:
+        docs/SERVICE.md.
+        """
+        from fdtd3d_tpu.batch import BatchSimulation
+        bsim = BatchSimulation(cfgs, devices=devices)
+        try:
+            bsim.run(time_steps)
+            bsim.verify_final_lanes()
+        finally:
+            bsim.close()
+        return bsim
 
     # -- access ------------------------------------------------------------
 

@@ -294,6 +294,19 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
             out.update(cpml.build_slab_coeffs(full, static,
                                               slab_axes(static)))
 
+    if cfg.point_source.enabled:
+        # Traced source amplitude (round 15): the jnp step reads the
+        # drive strength from the coeffs pytree instead of baking the
+        # python float into the graph, so the vmap-batched executor
+        # (fdtd3d_tpu/batch.py) can give every lane its own amplitude
+        # under ONE compiled executable. Same value bit-for-bit for a
+        # single run (the float was rounded to rd at trace time
+        # anyway). The packed/tb kernels keep the static in-kernel
+        # amplitude (they are per-scenario executables), and the ds
+        # step its host-side hi+lo split (float32x2 does not batch —
+        # fdtd3d_tpu/batch.py names the limit).
+        out["ps_amp"] = rd(cfg.point_source.amplitude)
+
     if static.tfsf_setup is not None:
         if cfg.ds_fields:
             # double-single line coefficients: the incident line's own
@@ -679,7 +692,10 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
                                           mode.active_axes)
                         wf = waveform(ps.waveform, t, 0.5, static.omega,
                                       static.dt, static.real_dtype)
-                        acc = acc + ps.amplitude * wf \
+                        # amplitude from coeffs (build_coeffs ps_amp):
+                        # traced so the batch executor can vary it per
+                        # lane; value-identical to the old static float
+                        acc = acc + coeffs["ps_amp"] * wf \
                             * mask.astype(acc.dtype)
                 if compensated:
                     # Kahan: E' = E + u with u = (ca-1)E + cb*acc in

@@ -72,10 +72,15 @@ import numpy as np
 # failure was attributed to (nullable — a transient dispatch error has
 # no chip), and the new "topology_change" record captures the
 # supervisor's topology-degrade rung (resume on a smaller topology via
-# the reshard-on-resume checkpoint path). v1-v4 files still
-# read/validate (READ_VERSIONS).
-SCHEMA_VERSION = 5
-READ_VERSIONS = (1, 2, 3, 4, 5)
+# the reshard-on-resume checkpoint path). v6 (compile-amortized
+# scenario execution, round 15): the batched executor's per-lane
+# "batch_lane" record (one per lane per chunk — lane-scoped health so
+# one tenant's NaN is attributable to its lane), plus the optional
+# run_start/run_end compile-amortization keys (`aot_cache` counter
+# snapshots, run_end `compile_ms`). v1-v5 files still read/validate
+# (READ_VERSIONS).
+SCHEMA_VERSION = 6
+READ_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -378,6 +383,15 @@ def provenance(sim=None) -> Dict[str, Any]:
     # same-window HBM probe calibration (set_hbm_probe; null when the
     # process never probed — CLI runs, tests)
     rec["hbm_gbps"] = _hbm_probe_gbps
+    # exec-cache counter snapshot (fdtd3d_tpu/exec_cache.py): a warm
+    # repeat scenario shows its hits at run START, before any chunk
+    # dispatches — the compile-amortization audit surface
+    from fdtd3d_tpu import exec_cache as _exec_cache
+    rec["aot_cache"] = _exec_cache.stats()
+    if sim is not None:
+        nlanes = getattr(sim, "batch_size", None)
+        if nlanes:
+            rec["batch"] = int(nlanes)
     if sim is not None:
         cfg = sim.cfg
         rec.update(
@@ -496,6 +510,15 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "max": _NUM, "mean": _NUM, "ratio": _OPT_NUM, "argmax": (int,),
         "n_chips": (int,),
     },
+    # v6 (vmap-batched execution, fdtd3d_tpu/batch.py): one record per
+    # LANE per chunk — the lane-scoped health counters of the shared
+    # dispatch, so a multi-tenant batch attributes a NaN to the tenant
+    # that produced it while the other lanes keep their healthy rows.
+    "batch_lane": {
+        "chunk": (int,), "t": (int,), "lane": (int,),
+        "energy": _OPT_NUM, "div_l2": _OPT_NUM, "div_linf": _OPT_NUM,
+        "max_e": _OPT_NUM, "max_h": _OPT_NUM, "finite": (bool,),
+    },
 }
 
 
@@ -513,8 +536,17 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # ghost_depth (round 12): the temporal-blocked pipeline depth k
     # the engaged step consumed (null/absent for single-step kinds) —
     # the auto-depth pick is auditable from run_start alone.
+    # aot_cache (round 15): the exec-cache counter snapshot at sink
+    # construction (exec_cache.stats) — a warm second run shows its
+    # hits here before any chunk dispatches; batch: the vmap lane
+    # count of a batched executor's sink.
     "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
-                  "vmem_rung", "tile", "comm_strategy", "ghost_depth"),
+                  "vmem_rung", "tile", "comm_strategy", "ghost_depth",
+                  "aot_cache", "batch"),
+    # sim.close_telemetry (round 15): the run's compile wall
+    # (exec-cache misses only; a fully-warm run reads 0.0) + the final
+    # counter snapshot — the compile-amortization proof per run.
+    "run_end": ("compile_ms", "aot_cache"),
     # sim._vmem_fallback (round 12): a tb depth downgrade (k -> k-1)
     # is its own perf-event class beside the tile shrink
     "ladder_downgrade": ("old_ghost_depth", "new_ghost_depth"),
@@ -542,6 +574,8 @@ _V5_ONLY_TYPES = ("topology_change",)
 _V5_ONLY_KEYS = {"retry": ("chip", "host"),
                  "rollback": ("chip", "host"),
                  "degrade": ("chip", "host")}
+# and from v6 on: the batched executor's per-lane record
+_V6_ONLY_TYPES = ("batch_lane",)
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
@@ -558,7 +592,8 @@ def validate_record(rec: Dict[str, Any]) -> None:
             (v == 1 and rtype in _V2_ONLY_TYPES) or \
             (v < 3 and rtype in _V3_ONLY_TYPES) or \
             (v < 4 and rtype in _V4_ONLY_TYPES) or \
-            (v < 5 and rtype in _V5_ONLY_TYPES):
+            (v < 5 and rtype in _V5_ONLY_TYPES) or \
+            (v < 6 and rtype in _V6_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):

@@ -1,0 +1,296 @@
+"""vmap-batched scenario execution (fdtd3d_tpu/batch.py) — ISSUE 12.
+
+Acceptance, CPU-deterministic: a 3-scenario ``run_batch`` compiles
+ONCE (exec-cache counter-asserted) while matching sequential runs
+bit-for-bit per lane (vacuum AND a CPML+point-source case); a
+fault-injected NaN in one lane trips only that lane's health flag;
+eligibility violations are NAMED errors; the sharded batch's compiled
+module carries the same halo-exchange count as a single run (one
+exchange for the whole batch, not B of them).
+"""
+
+import dataclasses
+import json
+import re
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import exec_cache, faults, telemetry
+from fdtd3d_tpu.batch import BatchSimulation
+from fdtd3d_tpu.config import (MaterialsConfig, OutputConfig,
+                               ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig,
+                               SphereConfig)
+from fdtd3d_tpu.sim import Simulation
+
+
+def _cfg(n=12, eps=1.0, amp=1.0, steps=8, **kw):
+    kw.setdefault("pml", PmlConfig(size=(3, 3, 3)))
+    kw.setdefault("materials", MaterialsConfig(eps=eps))
+    return SimConfig(
+        scheme="3D", size=(n, n, n), time_steps=steps, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(n // 2,) * 3,
+                                       amplitude=amp), **kw)
+
+
+def _sequential(cfg, steps):
+    sim = Simulation(dataclasses.replace(cfg, use_pallas=False))
+    sim.advance(steps)
+    return sim
+
+
+def _assert_lane_equal(bsim, lane, sim):
+    for group in ("E", "H"):
+        for comp in sim.state[group]:
+            a = np.asarray(sim.state[group][comp])
+            b = bsim.lane_field(lane, comp)
+            assert np.array_equal(a, b), \
+                f"lane {lane} {comp} diverges (max " \
+                f"{np.abs(a - b).max()})"
+
+
+def test_batch_parity_cpml_source_bit_identical():
+    """3 lanes with different materials AND source amplitudes (CPML +
+    point source — the full jnp graph) == 3 sequential runs, bit for
+    bit, from ONE compiled executable."""
+    cfgs = [_cfg(eps=1.0, amp=1.0), _cfg(eps=1.5, amp=2.0),
+            _cfg(eps=2.0, amp=0.5)]
+    s0 = exec_cache.stats()
+    bsim = Simulation.run_batch(cfgs)
+    s1 = exec_cache.stats()
+    assert s1["traces"] - s0["traces"] == 1, \
+        "B scenarios must cost exactly one trace"
+    for lane, cfg in enumerate(cfgs):
+        _assert_lane_equal(bsim, lane, _sequential(cfg, 8))
+    assert bsim.lane_field(1, "Ez").max() > 0
+
+
+def test_batch_parity_vacuum_no_pml():
+    cfgs = [_cfg(pml=PmlConfig(), amp=a) for a in (1.0, 3.0)]
+    bsim = Simulation.run_batch(cfgs)
+    for lane, cfg in enumerate(cfgs):
+        _assert_lane_equal(bsim, lane, _sequential(cfg, 8))
+
+
+def test_batch_material_grid_lanes():
+    """Lanes may differ in material VALUES including sphere grids —
+    as long as every lane has the grid (structure matches)."""
+    def sphere(v):
+        return MaterialsConfig(eps_sphere=SphereConfig(
+            enabled=True, center=(6.0, 6.0, 6.0), radius=3.0, value=v))
+    cfgs = [_cfg(materials=sphere(2.0)), _cfg(materials=sphere(4.0))]
+    bsim = Simulation.run_batch(cfgs)
+    for lane, cfg in enumerate(cfgs):
+        _assert_lane_equal(bsim, lane, _sequential(cfg, 8))
+
+
+def test_batch_nan_trips_only_its_lane(tmp_path):
+    """faults ``nan@t=4,field=Ez,lane=1``: lane 1 flags non-finite,
+    lanes 0/2 stay healthy AND bit-identical to clean sequential runs;
+    the batch_lane telemetry rows carry the per-lane verdicts."""
+    path = tmp_path / "t.jsonl"
+    cfgs = [_cfg(), _cfg(), _cfg()]
+    cfgs[0] = dataclasses.replace(
+        cfgs[0], output=OutputConfig(telemetry_path=str(path),
+                                     check_finite=True))
+    faults.clear()
+    faults.install("nan@t=4,field=Ez,lane=1")
+    try:
+        bsim = BatchSimulation(cfgs)
+        bsim.advance(4)
+        bsim.advance(4)
+        bsim.close()
+    finally:
+        faults.clear()
+    assert bsim.lane_finite == [True, False, True]
+    assert bsim.lane_first_unhealthy_t == [None, 8, None]
+    # the healthy lanes' physics is untouched by lane 1's NaN
+    clean = _sequential(_cfg(), 8)
+    _assert_lane_equal(bsim, 0, clean)
+    _assert_lane_equal(bsim, 2, clean)
+    assert not np.isfinite(bsim.lane_field(1, "Ez")).all()
+    recs = telemetry.read_jsonl(str(path))
+    lanes = [r for r in recs if r["type"] == "batch_lane"]
+    assert len(lanes) == 6   # 3 lanes x 2 chunks
+    final = {r["lane"]: r for r in lanes if r["t"] == 8}
+    assert final[1]["finite"] is False and final[0]["finite"] is True
+    # a lane's NaN counters are null, not NaN literals (RFC 8259)
+    assert final[1]["max_e"] is None
+    # the aggregate chunk row says the batch was not all-finite
+    agg = [r for r in recs if r["type"] == "chunk"]
+    assert agg and agg[-1]["finite"] is False
+
+
+def test_batch_lane_scope_validation():
+    faults.clear()
+    faults.install("nan@t=0,field=Ez,lane=1")
+    try:
+        sim = Simulation(_cfg())
+        with pytest.raises(ValueError, match="lane= scope only"):
+            sim.advance(4)
+    finally:
+        faults.clear()
+    faults.install("nan@t=0,field=Ez")
+    try:
+        bsim = BatchSimulation([_cfg(), _cfg()])
+        with pytest.raises(ValueError, match="needs an explicit lane"):
+            bsim.advance(4)
+    finally:
+        faults.clear()
+
+
+def test_batch_eligibility_named_errors():
+    # graph-shaping divergence: named field in the error (the first
+    # differing field for a grid change is the source position default)
+    with pytest.raises(ValueError,
+                       match="graph-shaping config field"):
+        BatchSimulation([_cfg(n=12), _cfg(n=16)])
+    with pytest.raises(ValueError, match="time_steps"):
+        BatchSimulation([_cfg(steps=8), _cfg(steps=16)])
+    # structural materials divergence: the offending leaf is named
+    grid = MaterialsConfig(eps_sphere=SphereConfig(
+        enabled=True, center=(6.0, 6.0, 6.0), radius=3.0, value=2.0))
+    with pytest.raises(ValueError, match="not same-shape"):
+        BatchSimulation([_cfg(), _cfg(materials=grid)])
+
+
+def test_batch_max_knob(monkeypatch):
+    monkeypatch.setenv("FDTD3D_BATCH_MAX", "2")
+    with pytest.raises(ValueError, match="FDTD3D_BATCH_MAX"):
+        BatchSimulation([_cfg(), _cfg(), _cfg()])
+    monkeypatch.setenv("FDTD3D_BATCH_MAX", "nope")
+    with pytest.raises(ValueError, match="integer"):
+        BatchSimulation([_cfg(), _cfg()])
+
+
+def _count_collective_permutes(compiled) -> int:
+    txt = compiled.as_text()
+    return len(re.findall(r" collective-permute(?:-start)?\(",
+                          txt))
+
+
+def test_batch_sharded_one_halo_exchange_for_all_lanes():
+    """Sharded batch: per-lane parity vs a sharded sequential run AND
+    the compiled module's halo-exchange op count equals the single
+    run's — the lanes ride ONE exchange, not B."""
+    par = ParallelConfig(topology="manual", manual_topology=(2, 2, 2))
+    cfgs = [_cfg(n=16, amp=1.0, parallel=par),
+            _cfg(n=16, amp=2.0, parallel=par)]
+    bsim = BatchSimulation(cfgs)
+    bsim.advance(8)
+    for lane, cfg in enumerate(cfgs):
+        sim = _sequential(cfg, 8)
+        for comp in ("Ez", "Hy"):
+            a = np.asarray(sim.field(comp))
+            assert np.array_equal(a, bsim.lane_field(lane, comp))
+    single = Simulation(dataclasses.replace(cfgs[0],
+                                            use_pallas=False))
+    single.advance(8)
+    n_batch = _count_collective_permutes(bsim._compiled[8])
+    n_single = _count_collective_permutes(single._compiled[8])
+    assert n_batch > 0
+    assert n_batch == n_single, \
+        f"batched module has {n_batch} collective-permutes vs the " \
+        f"single run's {n_single} — lanes must share the exchange"
+
+
+def test_cli_batch_smoke(tmp_path, capsys):
+    from fdtd3d_tpu import cli
+    spec = ("--3d\n--same-size 12\n--time-steps 8\n--use-pml\n"
+            "--pml-size 3\n--point-source Ez\n"
+            "--point-source-amplitude {amp}\n--log-level 1\n")
+    a = tmp_path / "a.txt"
+    b = tmp_path / "b.txt"
+    a.write_text(spec.format(amp=1.0))
+    b.write_text(spec.format(amp=2.0))
+    tpath = tmp_path / "t.jsonl"
+    rc = cli.main(["--batch", str(a), str(b),
+                   "--telemetry", str(tpath), "--check-finite"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "batch lane 0: healthy" in out
+    assert "batch lane 1: healthy" in out
+    assert "2 lanes x 8 steps" in out
+    recs = telemetry.read_jsonl(str(tpath))
+    types = {r["type"] for r in recs}
+    assert {"run_start", "batch_lane", "chunk", "run_end"} <= types
+    start = next(r for r in recs if r["type"] == "run_start")
+    assert start["batch"] == 2
+
+
+def test_batch_run_chunked_matches_single_chunk():
+    """run(chunk=4) (two dispatches) == run() (one dispatch) — chunk
+    boundaries are observability seams, not physics."""
+    cfgs = [_cfg(amp=1.0), _cfg(amp=2.0)]
+    b1 = BatchSimulation(cfgs)
+    b1.run(8)
+    b2 = BatchSimulation(cfgs)
+    b2.run(8, chunk=4)
+    for lane in range(2):
+        assert np.array_equal(b1.lane_field(lane, "Ez"),
+                              b2.lane_field(lane, "Ez"))
+
+
+def test_batch_ds_refused_with_named_error():
+    """float32x2 does not batch on this jax (the ds step's
+    optimization_barrier has no vmap batching rule): a NAMED
+    eligibility error, never a raw NotImplementedError mid-compile."""
+    with pytest.raises(ValueError, match="float32x2 scenarios"):
+        BatchSimulation([_cfg(dtype="float32x2"),
+                         _cfg(dtype="float32x2")])
+
+
+def test_batch_nan_chip_and_lane_scopes_compose():
+    """Review finding (round 15): chip= must not be silently ignored
+    on a batched sim — nan@...,chip=C,lane=L lands at chip C's shard
+    center WITHIN lane L (and only that lane trips)."""
+    par = ParallelConfig(topology="manual", manual_topology=(2, 1, 1))
+    cfgs = [_cfg(n=16, parallel=par,
+                 output=OutputConfig(check_finite=True)),
+            _cfg(n=16, parallel=par)]
+    faults.clear()
+    faults.install("nan@t=4,field=Ez,chip=1,lane=1")
+    try:
+        bsim = BatchSimulation(cfgs)
+        bsim.advance(4)
+        bsim.advance(4)
+    finally:
+        faults.clear()
+    assert bsim.lane_finite == [True, False]
+    # the injected cell sat in chip 1's x-half of lane 1 (x >= 8 for
+    # the (2,1,1) split of a 16-cell axis) — lane 0 untouched
+    assert np.isfinite(bsim.lane_field(0, "Ez")).all()
+    bad = np.argwhere(~np.isfinite(bsim.lane_field(1, "Ez")))
+    assert len(bad) > 0 and bad[:, 0].min() >= 8
+
+
+def test_verify_final_lanes_catches_boundary_damage():
+    """A NaN landing at the FINAL chunk boundary (after the last
+    in-graph measurement) must not read healthy: the end-of-run
+    host sweep flips the lane's flag (the CLI calls it before
+    printing verdicts)."""
+    cfgs = [_cfg(output=OutputConfig(check_finite=True)), _cfg()]
+    faults.clear()
+    faults.install("nan@t=8,field=Ez,lane=1")   # fires at t=8 = END
+    try:
+        bsim = BatchSimulation(cfgs)
+        bsim.run(8)
+    finally:
+        faults.clear()
+    assert bsim.lane_finite == [True, True]   # in-graph never saw it
+    bsim.verify_final_lanes()
+    assert bsim.lane_finite == [True, False]
+    assert bsim.lane_first_unhealthy_t[1] == 8
+    # and the documented service API (Simulation.run_batch) runs the
+    # sweep itself — LIBRARY callers get the honest verdict too, not
+    # just the CLI
+    faults.clear()
+    faults.install("nan@t=8,field=Ez,lane=0")
+    try:
+        b2 = Simulation.run_batch([_cfg(), _cfg()])
+    finally:
+        faults.clear()
+    assert b2.lane_finite == [False, True]

@@ -1,0 +1,363 @@
+"""AOT executable cache (fdtd3d_tpu/exec_cache.py) — ISSUE 12.
+
+The compile-amortization acceptance surface, CPU-deterministic:
+
+* a second same-key Simulation performs ZERO traces (counter-asserted)
+  and reproduces the first's fields bit-for-bit;
+* the ExecKey separates every graph-shaping axis — comm strategy,
+  temporal-block depth, health/per-chip lanes, physics config — since
+  a collision would silently reuse the wrong physics;
+* the on-disk layer survives a PROCESS boundary (subprocess test),
+  and a stale-provenance or truncated entry reads as a NAMED miss
+  (warned), never a traceback.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import exec_cache, telemetry
+from fdtd3d_tpu.config import (OutputConfig, ParallelConfig, PmlConfig,
+                               PointSourceConfig, SimConfig)
+from fdtd3d_tpu.sim import Simulation
+
+
+def _cfg(n=12, **kw):
+    kw.setdefault("pml", PmlConfig(size=(3, 3, 3)))
+    return SimConfig(
+        scheme="3D", size=(n, n, n), time_steps=8, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(n // 2,) * 3), **kw)
+
+
+def test_second_sim_zero_traces_and_bit_identical():
+    """THE tentpole acceptance: a repeat scenario skips compile — the
+    second Simulation with an identical ExecKey never calls lower()."""
+    cfg = _cfg()
+    sim1 = Simulation(cfg)
+    sim1.advance(8)
+    mid = exec_cache.stats()
+    sim2 = Simulation(cfg)
+    sim2.advance(8)
+    end = exec_cache.stats()
+    assert end["traces"] == mid["traces"], \
+        "second same-key Simulation traced"
+    assert end["compiles"] == mid["compiles"]
+    assert end["hits"] == mid["hits"] + 1
+    a = np.asarray(sim1.state["E"]["Ez"])
+    b = np.asarray(sim2.state["E"]["Ez"])
+    assert a.max() > 0 and np.array_equal(a, b)
+    # the warm sim's own compile wall is ~0 (nothing compiled)
+    assert sim2._compile_ms == 0.0
+    assert sim1._compile_ms > 0.0
+
+
+def test_counters_surface_in_telemetry(tmp_path):
+    """run_start carries the at-construction aot_cache snapshot and
+    run_end the final counters + the run's compile_ms — so warm vs
+    cold is auditable from the JSONL alone."""
+    cfg = _cfg()
+    path = tmp_path / "t.jsonl"
+
+    def with_sink(c):
+        return dataclasses.replace(
+            c, output=OutputConfig(telemetry_path=str(path)))
+
+    sim1 = Simulation(with_sink(cfg))
+    sim1.advance(8)
+    sim1.close()
+    sim2 = Simulation(with_sink(cfg))
+    sim2.advance(8)
+    sim2.close()
+    recs = telemetry.read_jsonl(str(path))
+    starts = [r for r in recs if r["type"] == "run_start"]
+    ends = [r for r in recs if r["type"] == "run_end"]
+    assert len(starts) == 2 and len(ends) == 2
+    for r in starts + ends:
+        assert isinstance(r["aot_cache"], dict)
+    # the second run saw at least one more hit than the first did at
+    # ITS start, and compiled nothing itself
+    assert ends[1]["aot_cache"]["hits"] > starts[1]["aot_cache"]["hits"] \
+        or starts[1]["aot_cache"]["hits"] > starts[0]["aot_cache"]["hits"]
+    assert ends[1]["compile_ms"] == 0.0
+    assert ends[0]["compile_ms"] > 0.0
+
+
+def test_key_distinct_per_health_and_per_chip_lane():
+    cfg = _cfg()
+    base = dict(step_kind="jnp", topology=(1, 1, 1), n_steps=8)
+    k0 = exec_cache.make_key(cfg, health=False, **base)
+    k1 = exec_cache.make_key(cfg, health=True, **base)
+    k2 = exec_cache.make_key(cfg, health=True, per_chip=True, **base)
+    assert len({k0.digest, k1.digest, k2.digest}) == 3
+
+
+def test_key_distinct_per_comm_strategy(monkeypatch):
+    """Two configs differing ONLY in the comm-strategy override must
+    key separately — the compiled exchange posture differs, and a
+    collision would reuse the wrong executable."""
+    cfg = _cfg(n=16, parallel=ParallelConfig(
+        topology="manual", manual_topology=(2, 2, 2)))
+    base = dict(step_kind="jnp", topology=(2, 2, 2), n_steps=8)
+    monkeypatch.delenv("FDTD3D_COMM_STRATEGY", raising=False)
+    k0 = exec_cache.make_key(cfg, **base)
+    monkeypatch.setenv("FDTD3D_COMM_STRATEGY", "per-plane,sync")
+    k1 = exec_cache.make_key(cfg, **base)
+    assert k0.digest != k1.digest
+    assert "per-plane" in (k1.comm_strategy or "")
+
+
+def test_key_distinct_per_tb_depth(monkeypatch):
+    """FDTD3D_TB_DEPTH=2 vs 3 (same everything else) must yield
+    distinct keys for the temporal-blocked kind: the pipeline depth
+    changes the compiled kernel."""
+    cfg = _cfg(n=32)
+    base = dict(step_kind="pallas_packed_tb", topology=(1, 1, 1),
+                n_steps=8)
+    monkeypatch.setenv("FDTD3D_TB_DEPTH", "2")
+    k2 = exec_cache.make_key(cfg, **base)
+    monkeypatch.setenv("FDTD3D_TB_DEPTH", "3")
+    k3 = exec_cache.make_key(cfg, **base)
+    assert k2.ghost_depth == 2 and k3.ghost_depth == 3
+    assert k2.digest != k3.digest
+    # the provenance-free comparable digest separates them too (the
+    # perf sentinel's "equal key" must never conflate depths)
+    assert k2.comparable_digest != k3.comparable_digest
+
+
+def test_key_distinct_per_physics_and_avals():
+    base = dict(step_kind="jnp", topology=(1, 1, 1), n_steps=8)
+    k0 = exec_cache.make_key(_cfg(), **base)
+    # different PML thickness = different slab graph
+    k1 = exec_cache.make_key(_cfg(pml=PmlConfig(size=(4, 4, 4))),
+                             **base)
+    assert k0.digest != k1.digest
+    # avals axis: same cfg, different argument shapes
+    k2 = exec_cache.make_key(_cfg(), avals_fp="deadbeef", **base)
+    assert k2.digest != k0.digest
+
+
+def test_cache_off_switch(monkeypatch):
+    """FDTD3D_AOT_CACHE=0: every compile traces, nothing is shared —
+    the pre-cache behavior, still counted."""
+    monkeypatch.setenv("FDTD3D_AOT_CACHE", "0")
+    cfg = _cfg(n=10)
+    s0 = exec_cache.stats()
+    Simulation(cfg).advance(8)
+    Simulation(cfg).advance(8)
+    s1 = exec_cache.stats()
+    assert s1["traces"] == s0["traces"] + 2
+    assert s1["hits"] == s0["hits"]
+    assert not s1["enabled"]
+
+
+def test_disk_layer_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("FDTD3D_AOT_CACHE_DIR", str(tmp_path))
+    # the in-process layer may already hold this key from an earlier
+    # test — publishing happens on COMPILE, so start cold
+    exec_cache.clear_memory()
+    cfg = _cfg()
+    sim1 = Simulation(cfg)
+    sim1.advance(8)
+    entries = sorted(os.listdir(tmp_path))
+    assert any(e.endswith(".aotx") for e in entries)
+    assert any(e.endswith(".json") for e in entries)
+    # drop the in-process layer: the reload must come from disk
+    exec_cache.clear_memory()
+    s0 = exec_cache.stats()
+    sim2 = Simulation(cfg)
+    sim2.advance(8)
+    s1 = exec_cache.stats()
+    assert s1["disk_hits"] == s0["disk_hits"] + 1
+    assert s1["traces"] == s0["traces"]
+    assert np.array_equal(np.asarray(sim1.state["E"]["Ez"]),
+                          np.asarray(sim2.state["E"]["Ez"]))
+
+
+def test_disk_truncated_entry_is_named_miss(tmp_path, monkeypatch,
+                                            capsys):
+    monkeypatch.setenv("FDTD3D_AOT_CACHE_DIR", str(tmp_path))
+    exec_cache.clear_memory()
+    cfg = _cfg()
+    Simulation(cfg).advance(8)
+    aotx = [f for f in os.listdir(tmp_path) if f.endswith(".aotx")]
+    assert aotx
+    path = os.path.join(str(tmp_path), aotx[0])
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    exec_cache.clear_memory()
+    s0 = exec_cache.stats()
+    sim = Simulation(cfg)
+    sim.advance(8)   # must recompile cleanly, not crash
+    s1 = exec_cache.stats()
+    assert s1["disk_load_failures"] == s0["disk_load_failures"] + 1
+    assert s1["traces"] == s0["traces"] + 1
+    err = capsys.readouterr().err
+    assert "aot cache" in err and "miss" in err
+    assert float(np.abs(np.asarray(sim.state["E"]["Ez"])).max()) > 0
+
+
+def test_disk_stale_provenance_is_miss(tmp_path, monkeypatch, capsys):
+    """A forged/copied entry whose meta names another build must not
+    load — even under the current digest's file name."""
+    monkeypatch.setenv("FDTD3D_AOT_CACHE_DIR", str(tmp_path))
+    exec_cache.clear_memory()
+    cfg = _cfg()
+    Simulation(cfg).advance(8)
+    metas = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert metas
+    mpath = os.path.join(str(tmp_path), metas[0])
+    with open(mpath) as f:
+        meta = json.load(f)
+    meta["git_sha"] = "0000000000ff"
+    with open(mpath, "w") as f:
+        json.dump(meta, f)
+    exec_cache.clear_memory()
+    s0 = exec_cache.stats()
+    Simulation(cfg).advance(8)
+    s1 = exec_cache.stats()
+    assert s1["disk_load_failures"] == s0["disk_load_failures"] + 1
+    assert s1["traces"] == s0["traces"] + 1
+    assert "stale entry" in capsys.readouterr().err
+
+
+_CHILD = r"""
+import json, os
+import numpy as np
+from fdtd3d_tpu.config import SimConfig, PmlConfig, PointSourceConfig
+from fdtd3d_tpu.sim import Simulation
+from fdtd3d_tpu import exec_cache
+cfg = SimConfig(scheme="3D", size=(12, 12, 12), time_steps=8, dx=1e-3,
+                courant_factor=0.4, wavelength=8e-3,
+                pml=PmlConfig(size=(3, 3, 3)),
+                point_source=PointSourceConfig(enabled=True,
+                                               component="Ez",
+                                               position=(6, 6, 6)))
+sim = Simulation(cfg)
+sim.advance(8)
+s = exec_cache.stats()
+ez = np.asarray(sim.state["E"]["Ez"], dtype=np.float64)
+print(json.dumps({"traces": s["traces"], "disk_hits": s["disk_hits"],
+                  "sum": float(ez.sum()), "max": float(ez.max())}))
+"""
+
+
+def test_disk_cache_survives_process_boundary(tmp_path):
+    """ISSUE 12 acceptance: the on-disk layer works ACROSS processes —
+    the second process compiles nothing (0 traces, 1 disk hit) and
+    produces the identical field."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "FDTD3D_AOT_CACHE_DIR": str(tmp_path),
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    env.pop("FDTD3D_AOT_CACHE", None)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = []
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                              cwd=root, capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        outs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    cold, warm = outs
+    assert cold["traces"] == 1 and cold["disk_hits"] == 0
+    assert warm["traces"] == 0 and warm["disk_hits"] == 1, warm
+    assert warm["sum"] == cold["sum"] and warm["max"] == cold["max"]
+
+
+def test_scenario_spec_separable():
+    """The three-object split: one ScenarioSpec can drive several
+    Simulations (memoized host work), and its fingerprint matches the
+    exec-cache key's config axis."""
+    from fdtd3d_tpu.scenario import ScenarioSpec
+    spec = ScenarioSpec(_cfg())
+    sim1 = Simulation(spec)
+    sim2 = Simulation(spec)
+    assert sim1.spec is spec and sim2.spec is spec
+    assert spec.fingerprint() == \
+        exec_cache.config_fingerprint(spec.cfg)
+    assert sim1.exec_key(8).digest == sim2.exec_key(8).digest
+
+
+@pytest.mark.parametrize("n_steps", [4])
+def test_sharded_sims_share_executable(n_steps):
+    """Same-key SHARDED sims reuse the executable too (the mesh is
+    rebuilt per sim, but the compiled artifact is keyed, not the
+    mesh object)."""
+    cfg = _cfg(n=16, parallel=ParallelConfig(
+        topology="manual", manual_topology=(2, 2, 2)))
+    sim1 = Simulation(cfg)
+    sim1.advance(n_steps)
+    mid = exec_cache.stats()
+    sim2 = Simulation(cfg)
+    sim2.advance(n_steps)
+    end = exec_cache.stats()
+    assert end["traces"] == mid["traces"]
+    assert end["hits"] == mid["hits"] + 1
+    assert np.array_equal(np.asarray(sim1.field("Ez")),
+                          np.asarray(sim2.field("Ez")))
+
+
+def test_aot_compile_sharded_shared_build():
+    """The shared AOT build layer (tools/aot_overlap.py's former
+    private path): compiles the production runner over an explicit
+    mesh through the cache (second call = memory hit), and a
+    require_kinds mismatch raises BEFORE any lowering."""
+    import jax
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs.reshape(2, 2), ("y", "z"))
+    cfg = _cfg(n=16)
+    with pytest.raises(exec_cache.WrongStepKind, match="jnp"):
+        exec_cache.aot_compile_sharded(
+            cfg, (1, 2, 2), mesh, 8, "cpu-test",
+            require_kinds=("pallas_packed",))
+    runner, compiled, info = exec_cache.aot_compile_sharded(
+        cfg, (1, 2, 2), mesh, 8, "cpu-test")
+    assert runner.kind == "jnp" and compiled is not None
+    _r2, c2, info2 = exec_cache.aot_compile_sharded(
+        cfg, (1, 2, 2), mesh, 8, "cpu-test")
+    assert info2["source"] == "memory" and c2 is compiled
+    # the overlap tool routes through this exact function
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "aot_overlap", os.path.join(root, "tools", "aot_overlap.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import inspect
+    assert "aot_compile_sharded" in inspect.getsource(
+        mod.build_compiled)
+
+
+def test_key_distinct_per_device_subset():
+    """Review finding (round 15): compiled executables are DEVICE-
+    pinned — two sims on the same topology but different device
+    subsets must key (and compile) separately, and each runs on its
+    own devices."""
+    import jax
+    cfg = _cfg(n=16, parallel=ParallelConfig(
+        topology="manual", manual_topology=(2, 1, 1)))
+    devs = jax.devices()
+    sim_a = Simulation(cfg, devices=devs[:2])
+    sim_b = Simulation(cfg, devices=devs[2:4])
+    ka = sim_a.exec_key(4)
+    kb = sim_b.exec_key(4)
+    assert ka.devices == (devs[0].id, devs[1].id)
+    assert kb.devices == (devs[2].id, devs[3].id)
+    assert ka.digest != kb.digest
+    s0 = exec_cache.stats()
+    sim_a.advance(4)
+    sim_b.advance(4)
+    s1 = exec_cache.stats()
+    assert s1["traces"] == s0["traces"] + 2   # no cross-subset reuse
+    assert np.array_equal(np.asarray(sim_a.field("Ez")),
+                          np.asarray(sim_b.field("Ez")))
+    used_b = {sh.device.id for sh in
+              sim_b.state["E"]["Ez"].addressable_shards}
+    assert used_b == {devs[2].id, devs[3].id}

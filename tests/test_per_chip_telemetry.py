@@ -167,9 +167,9 @@ def test_sink_scrubs_nested_nonfinite(tmp_path):
     assert rec["counters"]["energy"] == [1.0, None]
 
 
-def test_fixture_corpus_round_trips_v1_to_v5():
+def test_fixture_corpus_round_trips_v1_to_v6():
     """Satellite acceptance: every checked-in telemetry JSONL fixture
-    still validates, and the corpus spans schema v1..v5 so no version
+    still validates, and the corpus spans schema v1..v6 so no version
     can silently rot out of the read path."""
     paths = sorted(glob.glob(os.path.join(FIX, "*.jsonl")))
     assert paths, "no JSONL fixtures found"
@@ -190,3 +190,14 @@ def test_fixture_corpus_round_trips_v1_to_v5():
     assert {"topology_change"} <= {r["type"] for r in v5}
     assert any(r.get("chip") is not None for r in v5
                if r["type"] == "rollback")
+    # the v6 file carries the batched executor's per-lane rows + the
+    # compile-amortization keys (run_start aot_cache snapshot, run_end
+    # compile_ms), with a non-finite lane's counters as null
+    v6 = telemetry.read_jsonl(os.path.join(FIX, "telemetry_v6.jsonl"))
+    lanes = [r for r in v6 if r["type"] == "batch_lane"]
+    assert lanes and any(not r["finite"] and r["max_e"] is None
+                         for r in lanes)
+    start = next(r for r in v6 if r["type"] == "run_start")
+    assert isinstance(start["aot_cache"], dict) and start["batch"] == 3
+    end = next(r for r in v6 if r["type"] == "run_end")
+    assert isinstance(end["compile_ms"], (int, float))

@@ -70,14 +70,14 @@ def test_schema_v2_validation_rules():
     telemetry.validate_record({"v": 2, "type": "attribution", **att})
     with pytest.raises(ValueError, match="unknown record type"):
         telemetry.validate_record({"v": 1, "type": "attribution", **att})
-    # v3 (round 9), v4 (round 10) and v5 (round 11) are valid versions
-    # now — but the v2 required keys still apply to them
-    for v in (3, 4, 5):
+    # v3 (round 9), v4 (round 10), v5 (round 11) and v6 (round 15)
+    # are valid versions now — but the v2 required keys still apply
+    for v in (3, 4, 5, 6):
         with pytest.raises(ValueError, match="device_kind"):
             telemetry.validate_record({"v": v, "type": "run_start",
                                        **base})
     with pytest.raises(ValueError, match="not in"):
-        telemetry.validate_record({"v": 6, "type": "run_start", **base})
+        telemetry.validate_record({"v": 7, "type": "run_start", **base})
 
 
 def test_fixture_jsonl_validates_and_reports():
@@ -765,3 +765,109 @@ def test_cli_no_profile_compat_and_roundtrip(tmp_path):
     lines = out.read_text().splitlines()
     assert "--profile /tmp/d" in lines
     assert p.parse_args(cli.read_cmd_file(str(out))).profile == "/tmp/d"
+
+
+# -------------------------------------------------------------------------
+# compile-amortization lane (round 15, ISSUE 12): bench stage + sentinel
+# -------------------------------------------------------------------------
+
+_CA_OK = {"grid": 24, "steps": 8, "step_kind": "jnp",
+          "exec_key": "a" * 64, "exec_key_comparable": "k" * 64,
+          "cold_compile_ms": 1000.0, "warm_compile_ms": 0.0,
+          "cold_traces": 1, "warm_traces": 0, "warm_hits": 1,
+          "cache_enabled": True, "disk_dir": None}
+
+
+def test_sentinel_compile_lane_verdicts():
+    """check_compile: >25% cold growth at equal key regresses; a warm
+    run that traces regresses outright; no equal-key reference or
+    sub-floor compiles are INCONCLUSIVE, never a silent pass."""
+    ps = _sentinel()
+    ref = {"compile_amortization": dict(_CA_OK)}
+    ok = ps.check_compile({"compile_amortization": dict(_CA_OK)},
+                          best=ref)
+    assert ok["status"] == "OK", ok
+    # +20% is within the 25% threshold
+    within = ps.check_compile(
+        {"compile_amortization": dict(_CA_OK,
+                                      cold_compile_ms=1200.0)},
+        best=ref)
+    assert within["status"] == "OK"
+    reg = ps.check_compile(
+        {"compile_amortization": dict(_CA_OK,
+                                      cold_compile_ms=1400.0)},
+        best=ref)
+    assert reg["status"] == "REGRESSION"
+    assert "equal exec key" in reg["regressions"][0]
+    # a warm same-key run that traced = the cache broke
+    warm = ps.check_compile(
+        {"compile_amortization": dict(_CA_OK, warm_traces=1,
+                                      warm_compile_ms=950.0)},
+        best=ref)
+    assert warm["status"] == "REGRESSION"
+    assert "not amortizing" in warm["regressions"][0]
+    # with the off-switch set, a traced warm run is expected — no gate
+    off = ps.check_compile(
+        {"compile_amortization": dict(_CA_OK, warm_traces=1,
+                                      cache_enabled=False)},
+        best=ref)
+    assert not any("amortizing" in r for r in off["regressions"])
+    # a DIFFERENT comparable key (kernel/tile/grid changed): the cold
+    # number is not comparable — inconclusive, not regression
+    other = ps.check_compile(
+        {"compile_amortization": dict(_CA_OK, cold_compile_ms=9000.0,
+                                      exec_key_comparable="z" * 64)},
+        best=ref)
+    assert other["status"] == "INCONCLUSIVE"
+    # sub-noise-floor compiles wobble with load: inconclusive
+    floor_ref = {"compile_amortization": dict(_CA_OK,
+                                              cold_compile_ms=50.0)}
+    floor = ps.check_compile(
+        {"compile_amortization": dict(_CA_OK, cold_compile_ms=90.0)},
+        best=floor_ref)
+    assert floor["status"] == "INCONCLUSIVE"
+    # no stage at all: skipped with a note
+    assert ps.check_compile({}, best=ref)["status"] == "SKIPPED"
+
+
+def test_bench_compile_amortization_stage():
+    """The bench stage itself, CPU-deterministic: cold run traces
+    once, warm run traces zero and hits the cache; the artifact
+    carries both ExecKey digests, and run_measurement embeds the
+    stage + the sentinel's compile lane."""
+    import inspect
+
+    import bench
+    ca = bench.compile_amortization(n=12, steps=4)
+    assert ca["cold_traces"] == 1 and ca["warm_traces"] == 0
+    assert ca["warm_hits"] == 1
+    assert ca["cold_compile_ms"] > 0.0
+    assert ca["warm_compile_ms"] == 0.0
+    assert len(ca["exec_key"]) == 64
+    assert len(ca["exec_key_comparable"]) == 64
+    assert ca["exec_key"] != ca["exec_key_comparable"]
+    src = inspect.getsource(bench.run_measurement)
+    assert "compile_amortization" in src and "check_compile" in src
+    # and the live stage passes its own sentinel gate vs itself
+    ps = _sentinel()
+    verdict = ps.check_compile({"compile_amortization": ca},
+                               best={"compile_amortization": ca})
+    assert verdict["status"] in ("OK", "INCONCLUSIVE")
+
+
+def test_sentinel_cli_compile_lane(tmp_path):
+    """A warm-traced compile stage fails the standalone sentinel CLI
+    (exit 1) even when every throughput path is OK."""
+    tool = os.path.join(ROOT, "tools", "perf_sentinel.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cur = dict(CUR_OK,
+               compile_amortization=dict(_CA_OK, warm_traces=1))
+    p = tmp_path / "cur.json"
+    p.write_text(json.dumps(cur))
+    proc = subprocess.run(
+        [sys.executable, tool, str(p),
+         "--best", os.path.join(FIX, "bench_best.json"),
+         "--history", os.path.join(FIX, "bench_history_r*.json")],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "not amortizing" in proc.stderr

@@ -70,7 +70,7 @@ def test_legacy_tools_refuse_without_flag(tool):
 
 def test_telemetry_report_runs_on_fixtures():
     for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl",
-                    "telemetry_v5.jsonl"):
+                    "telemetry_v5.jsonl", "telemetry_v6.jsonl"):
         proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
                      os.path.join(FIX, fixture), "--json"])
         assert proc.returncode == 0, (fixture, proc.stderr)
@@ -81,6 +81,13 @@ def test_telemetry_report_runs_on_fixtures():
     assert proc.returncode == 0, proc.stderr
     assert "TOPOLOGY CHANGE" in proc.stdout
     assert "[chip 3, host 0]" in proc.stdout
+    # the v6 text form names the unhealthy batch lane + compile wall
+    proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
+                 os.path.join(FIX, "telemetry_v6.jsonl")])
+    assert proc.returncode == 0, proc.stderr
+    assert "batch: 3 lanes" in proc.stdout
+    assert "lane 1" in proc.stdout
+    assert "compile:" in proc.stdout
 
 
 def test_ckpt_inspect_runs_and_verifies(tmp_path):

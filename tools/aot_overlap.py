@@ -47,8 +47,6 @@ Usage: python tools/aot_overlap.py [--n 128] [--topo v5e:2x2]
 """
 
 import argparse
-import dataclasses
-import functools
 import json
 import os
 import re
@@ -61,16 +59,19 @@ from fdtd3d_tpu.log import report  # noqa: E402
 
 
 def build_compiled(n: int, topo_name: str, dtype: str = "float32"):
+    """AOT-compile the production chunk runner on an abstract
+    topology THROUGH the shared executable-cache layer (round 15:
+    the tool's former private build path is
+    fdtd3d_tpu.exec_cache.aot_compile_sharded now, so production runs
+    and this tool share ONE AOT build — and running the tool warms the
+    FDTD3D_AOT_CACHE_DIR on-disk layer for a later real window)."""
     import numpy as np
 
-    import jax
     from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import Mesh
 
+    from fdtd3d_tpu import exec_cache
     from fdtd3d_tpu.config import PmlConfig, SimConfig
-    from fdtd3d_tpu.parallel import mesh as pmesh
-    from fdtd3d_tpu.solver import (build_coeffs, build_static, init_state,
-                                   make_chunk_runner)
 
     topo = topologies.get_topology_desc(platform="tpu",
                                         topology_name=topo_name)
@@ -81,50 +82,21 @@ def build_compiled(n: int, topo_name: str, dtype: str = "float32"):
     cfg = SimConfig(scheme="3D", size=(n, n, n), time_steps=8, dx=1e-3,
                     courant_factor=0.5, wavelength=32e-3, dtype=dtype,
                     pml=PmlConfig(size=(8, 8, 8)))
-    st = dataclasses.replace(build_static(cfg), topology=topo3)
-    mesh_axes = pmesh.mesh_axis_map(topo3)
-    mesh_shape = pmesh.mesh_shape_map(topo3)
-    coeffs_np = build_coeffs(st)
-    state_shapes = jax.eval_shape(lambda: init_state(st))
-    runner = make_chunk_runner(st, mesh_axes, mesh_shape)
     # round 11: sharded f32 configs dispatch the temporal-blocked
-    # kernel (depth-2 halo pipeline) first; the single-step kernel is
+    # kernel (depth-k halo pipeline) first; the single-step kernel is
     # reachable via FDTD3D_NO_TEMPORAL like everywhere else
     want = ("pallas_packed_ds",) if dtype == "float32x2" \
         else ("pallas_packed_tb", "pallas_packed")
-    if runner.kind not in want:
-        raise SystemExit(
-            f"step_kind {runner.kind!r}, wanted one of {want} — the "
-            f"overlap numbers would not measure the packed kernels "
-            f"this tool exists to analyze (non-TPU default backend, "
-            f"or an out-of-scope config)")
-    packed = getattr(runner, "packed", False)
-    shapes = jax.eval_shape(runner.pack, state_shapes) if packed \
-        else state_shapes
-    specs = pmesh.packed_specs(shapes, topo3) if packed \
-        else pmesh.state_specs(state_shapes, topo3)
-    coeff_specs = pmesh.coeff_specs(coeffs_np, topo3)
-
     try:
-        from jax import shard_map as _shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _shard_map
-    fn = _shard_map(functools.partial(runner, n=8), mesh=mesh,
-                    in_specs=(specs, coeff_specs), out_specs=specs,
-                    check_vma=False)
-
-    def sds(shape_tree, spec_tree):
-        return jax.tree.map(
-            lambda s, p: jax.ShapeDtypeStruct(
-                s.shape, s.dtype, sharding=NamedSharding(mesh, p)),
-            shape_tree, spec_tree)
-
-    coeff_shapes = jax.tree.map(
-        lambda v: jax.ShapeDtypeStruct(np.shape(v), np.asarray(v).dtype),
-        coeffs_np)
-    lowered = jax.jit(fn, donate_argnums=0).lower(
-        sds(shapes, specs), sds(coeff_shapes, coeff_specs))
-    return runner.kind, lowered.compile()
+        runner, compiled, _info = exec_cache.aot_compile_sharded(
+            cfg, topo3, mesh, n_steps=8,
+            backend_tag=f"aot:{topo_name}", require_kinds=want)
+    except exec_cache.WrongStepKind as exc:
+        raise SystemExit(
+            f"{exc} — the overlap numbers would not measure the "
+            f"packed kernels this tool exists to analyze (non-TPU "
+            f"default backend, or an out-of-scope config)")
+    return runner.kind, compiled
 
 
 def analyze(txt: str):

@@ -346,6 +346,82 @@ def check_comm(current: Dict[str, Any], reference: Dict[str, Any],
     return out
 
 
+# Compile-amortization lane (round 15, docs/SERVICE.md): cold compile
+# growth gates at 25% — compile wall is deterministic-ish but cheaper
+# to move than throughput, so the gate is looser than the 10% paths —
+# and sub-floor compiles are load wobble, not signal.
+COMPILE_THRESHOLD = 0.25
+COMPILE_NOISE_FLOOR_MS = 200.0
+
+
+def check_compile(current: Dict[str, Any],
+                  best: Optional[Dict[str, Any]] = None,
+                  history: Optional[List[Dict[str, Any]]] = None,
+                  threshold: float = COMPILE_THRESHOLD
+                  ) -> Dict[str, Any]:
+    """Gate the bench ``compile_amortization`` stage (CPU-
+    deterministic, no chip, no probe normalization):
+
+    * a warm same-key run that TRACES at all regresses outright — the
+      AOT executable cache (fdtd3d_tpu/exec_cache.py) stopped
+      amortizing;
+    * cold compile_ms growth beyond ``threshold`` (default 25%) vs
+      the best reference on record AT EQUAL COMPARABLE KEY regresses;
+      with no equal-key reference (kernel/tile/grid/provenance-free
+      key axes changed — compile cost legitimately moved) or below
+      the noise floor the lane is INCONCLUSIVE, never a silent pass.
+    """
+    history = history or []
+    out: Dict[str, Any] = {"threshold": threshold, "regressions": [],
+                           "inconclusive": []}
+    cur = (current or {}).get("compile_amortization")
+    if not isinstance(cur, dict) or "cold_compile_ms" not in cur:
+        out["status"] = "SKIPPED"
+        out["note"] = "no compile_amortization stage in the current " \
+                      "artifact"
+        return out
+    if cur.get("cache_enabled") and int(cur.get("warm_traces") or 0):
+        out["regressions"].append(
+            f"warm same-key run traced {cur['warm_traces']} time(s) "
+            f"(warm_compile_ms {cur.get('warm_compile_ms')}): the "
+            f"AOT executable cache is not amortizing repeat "
+            f"scenarios")
+    key = cur.get("exec_key_comparable")
+    ref = None
+    for rec in ([best] if best else []) + history:
+        ca = (rec or {}).get("compile_amortization")
+        if not isinstance(ca, dict) or \
+                ca.get("exec_key_comparable") != key:
+            continue
+        v = ca.get("cold_compile_ms")
+        if isinstance(v, (int, float)) and v > 0 and \
+                (ref is None or v < ref):
+            ref = float(v)
+    cur_cold = float(cur.get("cold_compile_ms") or 0.0)
+    out["cold_compile_ms"] = {"current": cur_cold, "reference": ref}
+    if ref is None:
+        out["inconclusive"].append(
+            "no equal-key compile reference on record (the "
+            "comparable ExecKey changed — kernel/tile/grid/lane axes "
+            "differ, so compile cost legitimately moved): cold "
+            "compile_ms not gated")
+    elif cur_cold > ref * (1.0 + threshold):
+        if max(cur_cold, ref) < COMPILE_NOISE_FLOOR_MS:
+            out["inconclusive"].append(
+                f"cold compile_ms {cur_cold:.0f} vs ref {ref:.0f} is "
+                f"under the {COMPILE_NOISE_FLOOR_MS:.0f}ms noise "
+                f"floor — load wobble, not gated")
+        else:
+            out["regressions"].append(
+                f"cold compile_ms grew "
+                f"{cur_cold / ref - 1.0:+.0%} at equal exec key "
+                f"({ref:.0f} -> {cur_cold:.0f} ms, threshold "
+                f"{threshold:.0%})")
+    out["status"] = "REGRESSION" if out["regressions"] else (
+        "INCONCLUSIVE" if out["inconclusive"] else "OK")
+    return out
+
+
 def main(argv=None) -> int:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(
@@ -385,6 +461,10 @@ def main(argv=None) -> int:
         "throughput": check_artifact(current, best,
                                      load_history(args.history),
                                      threshold=args.threshold)}
+    if isinstance(current.get("compile_amortization"), dict) and \
+            "error" not in current["compile_amortization"]:
+        verdict["compile"] = check_compile(
+            current, best, load_history(args.history))
     if args.ledger and args.ledger_ref:
         with open(args.ledger) as f:
             led_cur = json.load(f)
@@ -400,6 +480,7 @@ def main(argv=None) -> int:
         verdict["comm"] = check_comm(comm_cur, comm_ref,
                                      threshold=args.threshold)
     regressions = verdict["throughput"]["regressions"] \
+        + verdict.get("compile", {}).get("regressions", []) \
         + verdict.get("ledger", {}).get("regressions", []) \
         + verdict.get("comm", {}).get("regressions", [])
     verdict["status"] = "REGRESSION" if regressions else \
@@ -415,6 +496,8 @@ def main(argv=None) -> int:
             report(f"  {path:10s} {row['verdict']:13s} "
                    + (f"{cur:9.1f} vs ref {ref:9.1f} Mcells/s"
                       if cur is not None and ref is not None else ""))
+        if "compile" in verdict:
+            report(f"  compile: {verdict['compile']['status']}")
         if "ledger" in verdict:
             report(f"  ledger: {verdict['ledger']['status']}")
         if "comm" in verdict:
@@ -422,6 +505,7 @@ def main(argv=None) -> int:
     for msg in regressions:
         warn(f"perf sentinel: {msg}")
     for msg in verdict["throughput"]["inconclusive"] \
+            + verdict.get("compile", {}).get("inconclusive", []) \
             + verdict.get("comm", {}).get("inconclusive", []):
         warn(f"perf sentinel (inconclusive): {msg}")
     return 1 if regressions else 0

@@ -86,6 +86,28 @@ def summarize_run(run):
                                  if r["type"] == "topology_change"],
         },
     }
+    # compile-amortization lane (schema v6 optional keys): the run's
+    # compile wall + whether the exec cache was warm at start
+    if end is not None and end.get("compile_ms") is not None:
+        out["compile_ms"] = end["compile_ms"]
+    cache = start.get("aot_cache")
+    if isinstance(cache, dict):
+        out["aot_cache_at_start"] = {
+            k: cache.get(k) for k in ("hits", "misses", "disk_hits",
+                                      "traces")}
+    # batched executor (schema v6): per-lane health rollup — which
+    # tenants tripped, and when
+    lanes = [r for r in run if r["type"] == "batch_lane"]
+    if lanes:
+        n_lanes = start.get("batch") or (
+            max(r["lane"] for r in lanes) + 1)
+        bad_lanes = {}
+        for r in lanes:
+            if not r["finite"] and r["lane"] not in bad_lanes:
+                bad_lanes[r["lane"]] = r["t"]
+        out["batch"] = {"lanes": int(n_lanes),
+                        "unhealthy_lanes": {str(k): v for k, v in
+                                            sorted(bad_lanes.items())}}
     # per-chip lane (schema v4): the worst per-chunk imbalance ratio
     # and its straggler chip, when the run recorded the lane
     imb_all = [r for r in run if r["type"] == "imbalance"]
@@ -185,6 +207,24 @@ def format_text(summaries) -> str:
                          f"{d['old_tile']} -> {d['new_tile']} "
                          f"(budget {d['old_budget_mb']} -> "
                          f"{d['new_budget_mb']} MiB)")
+        if s.get("compile_ms") is not None:
+            cache = s.get("aot_cache_at_start") or {}
+            warm = cache.get("hits", 0) or cache.get("disk_hits", 0)
+            lines.append(f"  compile: {s['compile_ms']:.0f} ms this "
+                         f"run"
+                         + (" (exec cache warm at start)" if warm
+                            else ""))
+        if s.get("batch"):
+            b = s["batch"]
+            if b["unhealthy_lanes"]:
+                rows = ", ".join(f"lane {k} at t<={v}" for k, v in
+                                 b["unhealthy_lanes"].items())
+                lines.append(f"  batch: {b['lanes']} lanes, "
+                             f"NON-FINITE in {rows} (other lanes "
+                             f"completed healthy)")
+            else:
+                lines.append(f"  batch: {b['lanes']} lanes, all "
+                             f"healthy")
         if s.get("imbalance"):
             im = s["imbalance"]
             if im.get("worst_ratio") is not None:
