@@ -36,6 +36,11 @@ import time
 
 RETRIES = 2
 BACKOFF_S = 20
+
+# Records already present in the FDTD3D_BENCH_TELEMETRY file when this
+# window started (run_measurement sets it): the slo_gate embed only
+# judges runs appended after this marker.
+_TEL_RECORDS_AT_START = 0
 # Sized for BOTH stages on a healthy window: 256^3 two-path (stage 1)
 # plus 512^3 two-path (stage 2) plus a possible third 512^3 compile
 # (the raised-VMEM-budget attempt OOMs loudly, then recompiles at the
@@ -557,6 +562,23 @@ def run_measurement() -> None:
     """Child-process entry: measure both paths, print the one JSON line."""
     import jax
 
+    # run-registry kind (fdtd3d_tpu/registry.py): bench-built sims
+    # report as kind "bench" when FDTD3D_RUN_REGISTRY is set
+    from fdtd3d_tpu import registry as _run_registry
+    _run_registry.set_default_kind("bench")
+    # SLO-gate window marker: records already in the (append-mode)
+    # telemetry file belong to PRIOR windows and must not be
+    # re-gated by this artifact's slo_gate embed
+    global _TEL_RECORDS_AT_START
+    tel0 = os.environ.get("FDTD3D_BENCH_TELEMETRY")
+    if tel0 and os.path.exists(tel0):
+        try:
+            with open(tel0) as _f:
+                _TEL_RECORDS_AT_START = sum(
+                    1 for ln in _f if ln.strip())
+        except OSError:
+            _TEL_RECORDS_AT_START = 0
+
     # SIGTERM/SIGINT -> SystemExit so the finally/atexit finalizers run
     # (the telemetry run_end record survives a driver-side kill AND an
     # operator Ctrl-C — SIGINT parity, docs/ROBUSTNESS.md)
@@ -1043,6 +1065,46 @@ def run_measurement() -> None:
     except Exception as exc:  # the sentinel must never kill the bench
         out["perf_sentinel"] = {"status": "ERROR",
                                 "error": str(exc)[:200]}
+    # SLO gate (round 16, fdtd3d_tpu/slo.py): when this window
+    # recorded telemetry, the declarative service objectives are
+    # evaluated over it and the verdict embeds beside perf_sentinel —
+    # same posture (OK / VIOLATION / INCONCLUSIVE, never silent), so
+    # a throughput-floor or straggler violation ships in the very
+    # JSON line the driver records. Standalone gate (exit 1 on
+    # violation): tools/slo_gate.py.
+    tel_path = os.environ.get("FDTD3D_BENCH_TELEMETRY")
+    if tel_path and os.path.exists(tel_path):
+        try:
+            from fdtd3d_tpu import slo as _slo
+            from fdtd3d_tpu import telemetry as _t
+            # THIS window's runs only: the sink appends, so a shared
+            # long-lived telemetry path holds prior windows' runs too
+            # — a stale violation must not flip today's verdict
+            # (_TEL_RECORDS_AT_START is captured before any stage)
+            records = _t.read_jsonl(tel_path)[_TEL_RECORDS_AT_START:]
+            summaries = _slo.evaluate_stream(
+                records,
+                context={"bench_best": _load_best() or {}})
+            worst = "OK"
+            for s in summaries:
+                if s["status"] == "VIOLATION":
+                    worst = "VIOLATION"
+                elif s["status"] == "INCONCLUSIVE" \
+                        and worst == "OK":
+                    worst = "INCONCLUSIVE"
+            out["slo_gate"] = {
+                "status": worst,
+                "runs": len(summaries),
+                "violations": [r["message"] for s in summaries
+                               for r in s["results"]
+                               if r["status"] == "VIOLATION"],
+            }
+            for msg in out["slo_gate"]["violations"]:
+                print(f"SLO VIOLATION: {msg}", file=sys.stderr,
+                      flush=True)
+        except Exception as exc:  # the gate must never kill the bench
+            out["slo_gate"] = {"status": "ERROR",
+                               "error": str(exc)[:200]}
     print(json.dumps(out), flush=True)
 
 

@@ -162,7 +162,8 @@ class BatchSimulation:
             mesh_axes = pmesh.mesh_axis_map(topo)
             mesh_shape = pmesh.mesh_shape_map(topo)
         out0 = cfg0.output
-        self._health_on = bool(out0.telemetry_path) or out0.check_finite
+        self._health_on = bool(out0.telemetry_path) \
+            or bool(out0.metrics_path) or out0.check_finite
         self._check_finite = out0.check_finite
         runner = make_chunk_runner(self.static, mesh_axes, mesh_shape,
                                    health=self._health_on)
@@ -233,13 +234,46 @@ class BatchSimulation:
             [None] * self.batch_size
         self.lane_first_unhealthy_t: List[Optional[int]] = \
             [None] * self.batch_size
+        # fleet run registry + OpenMetrics exposition: the same two
+        # service-observability lanes Simulation wires (a batch is one
+        # run of kind "batch"; its lanes are the tenants)
+        from fdtd3d_tpu import registry as _registry
+        self.run_id: Optional[str] = None
+        self.run_registry = _registry.RunHandle.open_for(
+            self, kind="batch")
+        self.metrics = None
+        if out0.metrics_path:
+            from fdtd3d_tpu import metrics as _metrics
+            self.metrics = _metrics.MetricsRegistry(
+                path=out0.metrics_path)
         self.telemetry: Optional[_telemetry.TelemetrySink] = None
-        if out0.telemetry_path:
+        if out0.telemetry_path or out0.metrics_path:
             self.telemetry = _telemetry.TelemetrySink(
-                out0.telemetry_path,
-                run_meta=_telemetry.provenance(self))
+                out0.telemetry_path or None,
+                run_meta=_telemetry.provenance(self),
+                metrics=self.metrics)
 
     # -- compile (through the AOT executable cache) ------------------------
+
+    def exec_key(self, n: int, donate: Optional[bool] = None):
+        """The canonical :class:`fdtd3d_tpu.exec_cache.ExecKey` of
+        this batch's ``n``-step chunk executable (batch width in the
+        key) — what ``_chunk_fn`` compiles under, and what the run
+        registry records at the ``n=0`` sentinel
+        (``exec_cache.registry_identity``)."""
+        import jax
+
+        from fdtd3d_tpu import exec_cache as _exec_cache
+        if donate is None:
+            donate = jax.default_backend() in ("tpu", "axon")
+        return _exec_cache.make_key(
+            self.cfg, step_kind=self.step_kind, topology=self.topology,
+            n_steps=n, health=self._runner_health, per_chip=False,
+            step_diag=self.step_diag, batch=self.batch_size,
+            donate=donate,
+            avals_fp=_exec_cache.avals_fingerprint(self._state,
+                                                   self._coeffs),
+            devices=_exec_cache.mesh_device_ids(self.mesh))
 
     def _chunk_fn(self, n: int):
         import jax
@@ -264,14 +298,7 @@ class BatchSimulation:
                                             self._coeff_specs),
                                   out_specs=out_specs)
         donate = jax.default_backend() in ("tpu", "axon")
-        key = _exec_cache.make_key(
-            self.cfg, step_kind=self.step_kind, topology=self.topology,
-            n_steps=n, health=self._runner_health, per_chip=False,
-            step_diag=self.step_diag, batch=self.batch_size,
-            donate=donate,
-            avals_fp=_exec_cache.avals_fingerprint(self._state,
-                                                   self._coeffs),
-            devices=_exec_cache.mesh_device_ids(self.mesh))
+        key = self.exec_key(n, donate=donate)
         with _telemetry.span("compile"):
             compiled, info = _exec_cache.jit_compile(
                 key, fn, lambda: (self._state, self._coeffs), donate)
@@ -480,7 +507,14 @@ class BatchSimulation:
         if self._closed:
             return self
         self._closed = True
-        return self.close_telemetry()
+        self.close_telemetry()
+        if self.metrics is not None:
+            self.metrics.maybe_write()
+        if self.run_registry is not None:
+            # a batch with isolated non-finite lanes folds to
+            # "recovered" — lane isolation IS this executor's recovery
+            self.run_registry.finalize(self)
+        return self
 
 
 def _agg_max(vals) -> Optional[float]:

@@ -217,6 +217,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "wall time, run provenance, VMEM-ladder events) "
                         "to PATH; summarize with "
                         "tools/telemetry_report.py")
+    g.add_argument("--metrics", metavar="PATH", default=None,
+                   help="write an OpenMetrics/Prometheus text "
+                        "exposition of this run's counters (chunk "
+                        "throughput, wall-time histogram, recovery "
+                        "events, unhealthy lanes, cache hits) to PATH "
+                        "at exit, fed host-side from the same events "
+                        "the telemetry sink records — any scraper "
+                        "can ingest a run without parsing our JSONL; "
+                        "works with or without --telemetry")
     g.add_argument("--per-chip-telemetry",
                    action=argparse.BooleanOptionalAction, default=False,
                    help="with --telemetry: also record the UN-psummed "
@@ -254,9 +263,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "one compiled executable, one dispatch per "
                         "chunk for the whole batch. Per-lane health "
                         "flags — one lane's NaN never fails the "
-                        "others. Top-level --telemetry/--check-finite "
-                        "apply to the batch; FDTD3D_BATCH_MAX bounds "
-                        "the lane count.")
+                        "others. Top-level --telemetry/--metrics/"
+                        "--check-finite apply to the batch; "
+                        "FDTD3D_BATCH_MAX bounds the lane count.")
+    g.add_argument("--batch-chunk", type=int, default=0, metavar="N",
+                   help="advance the batch in N-step compiled chunks "
+                        "(per-chunk telemetry cadence + per-lane "
+                        "health granularity: a mid-run NaN is "
+                        "attributed to its chunk, not just the final "
+                        "state sweep); 0 = the whole horizon as one "
+                        "chunk (fastest)")
 
     g = p.add_argument_group("command files")
     g.add_argument("--cmd-from-file", metavar="FILE", default=None,
@@ -391,6 +407,7 @@ def args_to_config(args) -> SimConfig:
             log_level=args.log_level,
             profile=bool(args.profile), check_finite=args.check_finite,
             telemetry_path=args.telemetry,
+            metrics_path=args.metrics,
             per_chip_telemetry=args.per_chip_telemetry,
             # --profile DIR routes the device-trace lane; --trace is
             # the legacy alias (saved command files)
@@ -587,6 +604,13 @@ def _run_batch_cli(parser, args) -> int:
     import time as _time
 
     from fdtd3d_tpu.log import log, set_level, warn
+    if args.supervise:
+        # supervised batch: the vmap executor's recovery IS per-lane
+        # isolation (one tenant's NaN flips only its lane; the batch
+        # never dies for it) — --supervise therefore forces the
+        # in-graph tripwire on, and the run-registry row of a batch
+        # that isolated a lane folds to status "recovered"
+        args.check_finite = True
     cfgs = []
     for path in args.batch:
         largs = parser.parse_args(read_cmd_file(path))
@@ -595,13 +619,15 @@ def _run_batch_cli(parser, args) -> int:
                 f"--batch: {path} itself contains --batch (nested "
                 f"batches are not a thing)")
         cfgs.append(args_to_config(largs))
-    if args.telemetry or args.check_finite:
+    if args.telemetry or args.metrics or args.check_finite:
         # top-level observability flags apply to the batch (lane 0's
         # output config drives the shared sink / tripwire)
         out0 = _dc.replace(
             cfgs[0].output,
             telemetry_path=args.telemetry
             or cfgs[0].output.telemetry_path,
+            metrics_path=args.metrics
+            or cfgs[0].output.metrics_path,
             check_finite=args.check_finite
             or cfgs[0].output.check_finite)
         cfgs[0] = _dc.replace(cfgs[0], output=out0)
@@ -609,7 +635,7 @@ def _run_batch_cli(parser, args) -> int:
     from fdtd3d_tpu.sim import Simulation
     t0 = _time.time()
     try:
-        bsim = Simulation.run_batch(cfgs)
+        bsim = Simulation.run_batch(cfgs, chunk=args.batch_chunk)
     except ValueError as exc:
         raise SystemExit(f"--batch: {exc}")
     wall = _time.time() - t0
@@ -650,6 +676,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         args = parser.parse_args(file_argv + argv)
     if args.save_cmd_to_file:
         save_cmd_file(args, args.save_cmd_to_file)
+    # run-registry kind (fdtd3d_tpu/registry.py): which entry built
+    # this run — the batch executor stamps "batch" itself
+    from fdtd3d_tpu import registry as _run_registry
+    _run_registry.set_default_kind(
+        "supervised" if args.supervise else "cli")
     if args.batch:
         return _run_batch_cli(parser, args)
 
@@ -956,9 +987,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 pass
         if sup is not None:
             sup._restore_env()  # idempotent; run()'s finally usually did
-        if cur.telemetry is not None:
+        if cur.telemetry is not None and cfg.output.telemetry_path:
             log(f"telemetry: {n_rec + 1} records -> "
                 f"{cfg.output.telemetry_path}")
+        if cfg.output.metrics_path:
+            log(f"metrics: OpenMetrics exposition -> "
+                f"{cfg.output.metrics_path} (gate with "
+                f"tools/slo_gate.py; fleet view: "
+                f"tools/fleet_report.py)")
 
 
 if __name__ == "__main__":

@@ -144,6 +144,16 @@ ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
           "(fdtd3d_tpu/batch.py run_batch / CLI --batch): vmap is "
           "linear in lanes for HBM and compile time, so an unbounded "
           "batch is an OOM with extra steps."),
+    _knob("FDTD3D_RUN_REGISTRY", "path", None,
+          "Append-only fleet run index (fdtd3d_tpu/registry.py): "
+          "every Simulation/BatchSimulation run appends one "
+          "run_begin row at construction and one run_final row "
+          "(status completed/failed/recovered, recovery rollup) at "
+          "close to this runs.jsonl, each a single atomic O_APPEND "
+          "write; the run_id is stamped into telemetry run_start and "
+          "checkpoint metadata so streams and snapshots are "
+          "joinable. Monitor with tools/fleet_report.py. Unset: no "
+          "registry writes."),
 )}
 
 
@@ -441,6 +451,14 @@ class OutputConfig:
     # as ladder_downgrade events. CLI flag: --telemetry PATH.
     # Summarize with tools/telemetry_report.py.
     telemetry_path: Optional[str] = None
+    # OpenMetrics exposition (fdtd3d_tpu/metrics.py): when set, a
+    # MetricsRegistry observes every telemetry record host-side
+    # (counters/gauges/histograms: throughput, chunk wall, recovery
+    # events, unhealthy lanes, cache hits) and the Prometheus text
+    # exposition is written to this path at close — any scraper can
+    # ingest a run without parsing our JSONL. Works with or without
+    # telemetry_path (a file-less sink feeds it). CLI: --metrics PATH.
+    metrics_path: Optional[str] = None
     # Per-chip lane (telemetry schema v4, round 10): with a sink
     # attached, each chunk additionally records the UN-psummed per-chip
     # health counters (tiny all_gathered scalars on the same single

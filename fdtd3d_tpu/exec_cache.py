@@ -176,6 +176,22 @@ class ExecKey:
         return hashlib.sha256(blob.encode()).hexdigest()
 
 
+def registry_identity(key: ExecKey) -> Dict[str, Any]:
+    """The run registry's executable-identity block
+    (fdtd3d_tpu/registry.py): the provenance-free
+    :attr:`ExecKey.comparable_digest` (stable across commits when
+    nothing graph-shaping changed — the axis fleet_report and the SLO
+    compile-budget rule join runs on) plus the human-readable axes a
+    fleet table prints. Computed at the ``n_steps=0`` sentinel so two
+    runs of one scenario share the digest regardless of chunking."""
+    return {
+        "exec_key_comparable": key.comparable_digest,
+        "config_fp": key.config_fp,
+        "step_kind": key.step_kind,
+        "ghost_depth": key.ghost_depth,
+    }
+
+
 def mesh_device_ids(mesh) -> Optional[Tuple[int, ...]]:
     """The key's device-identity axis from a Mesh (None for no mesh:
     unsharded runs use the backend's default placement)."""

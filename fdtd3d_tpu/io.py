@@ -90,6 +90,31 @@ def _publish_tmp(path: str, tmp: str) -> None:
     _fsync_dir(path)
 
 
+def atomic_append(path: str, data: str) -> None:
+    """Whole-record append for shared JSONL indexes (the run registry,
+    ``fdtd3d_tpu/registry.py``): ONE ``os.write`` of the complete
+    record to an ``O_APPEND`` descriptor, then fsync. POSIX O_APPEND
+    makes each such write land contiguously, so several concurrent
+    runs appending to one ``runs.jsonl`` interleave whole lines —
+    never torn ones — and a crash mid-append costs at most its own
+    line. (``atomic_open`` is the whole-file flavor; append-mode
+    sinks must not rewrite the file they share.)"""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    buf = data.encode()
+    fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+    try:
+        # os.write may write fewer bytes than asked (quota, RLIMIT,
+        # network filesystems) — loop, or the no-torn-lines contract
+        # above is fiction exactly when the disk is misbehaving
+        while buf:
+            n = os.write(fd, buf)
+            buf = buf[n:]
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 @contextlib.contextmanager
 def atomic_open(path: str, mode: str = "w"):
     """Crash-safe whole-file write: tmp + flush + fsync + ``os.replace``.

@@ -1,0 +1,284 @@
+"""Metrics facade + OpenMetrics exposition (service observability).
+
+The scraper-facing half of the fleet-observability layer
+(docs/OBSERVABILITY.md "Service observability"): a tiny host-side
+counters/gauges/histograms registry fed from the events the telemetry
+layer ALREADY emits — no new device traffic, no extra readbacks — and
+rendered as Prometheus/OpenMetrics text, so any standard scraper can
+ingest a run without parsing our JSONL.
+
+Wiring: :class:`fdtd3d_tpu.telemetry.TelemetrySink` calls
+:meth:`MetricsRegistry.observe_record` on every record AFTER schema
+validation (``Simulation``/``BatchSimulation`` attach one when
+``OutputConfig.metrics_path`` / CLI ``--metrics PATH`` is set, and
+write the exposition atomically at close); :meth:`from_jsonl` builds
+the same registry from an existing telemetry or registry JSONL
+(tools/fleet_report.py's fleet rollups).
+
+Metric name table (all prefixed ``fdtd3d_``; docs/OBSERVABILITY.md
+carries the rendered version):
+
+==================================  =========  =========================
+name                                type       fed from
+==================================  =========  =========================
+runs_started_total                  counter    run_start
+runs_finished_total                 counter    run_end
+runs_total{status}                  counter    registry run_final rows
+chunks_total                        counter    chunk
+steps_total                         counter    chunk.steps
+unhealthy_chunks_total              counter    chunk.finite == false
+chunk_wall_seconds                  histogram  chunk.wall_s
+throughput_mcells_per_s             gauge      chunk.mcells_per_s (last)
+run_mcells_per_s                    gauge      run_end.mcells_per_s
+compile_ms                          gauge      run_end.compile_ms
+recovery_events_total{kind}         counter    retry/rollback/degrade/
+                                               topology_change
+vmem_ladder_downgrades_total        counter    ladder_downgrade
+lane_unhealthy_total{lane}          counter    batch_lane.finite==false
+straggler_ratio                     gauge      imbalance.ratio (worst)
+straggler_chip                      gauge      imbalance.argmax (worst)
+alerts_total{rule}                  counter    alert (fdtd3d_tpu/slo.py)
+aot_cache_hits / _misses /
+  _disk_hits / _traces              gauge      run_end.aot_cache
+==================================  =========  =========================
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+PREFIX = "fdtd3d_"
+
+# chunk-wall histogram buckets, seconds (log-ish ladder: sub-ms CPU
+# test chunks through minute-class tunnel dispatches)
+WALL_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                30.0, 60.0)
+
+
+def _esc(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"") \
+        .replace("\n", r"\n")
+
+
+def _labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class _Metric:
+    def __init__(self, name: str, mtype: str, help_: str):
+        self.name = name
+        self.mtype = mtype          # "counter" | "gauge" | "histogram"
+        self.help = help_
+        # label-tuple -> value (counter/gauge) or
+        # label-tuple -> {"sum", "count", "buckets": [n per le]}
+        self.samples: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+
+    def _key(self, labels: Dict[str, Any]
+             ) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class MetricsRegistry:
+    """Host-side metric store with OpenMetrics text rendering.
+
+    ``path`` remembers where the exposition belongs (the sim's
+    ``OutputConfig.metrics_path``); it travels WITH the registry when
+    the supervisor swaps sims, so a ladder-degraded run still writes
+    its exposition at close (:meth:`maybe_write`)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._metrics: Dict[str, _Metric] = {}
+
+    # -- primitives ----------------------------------------------------
+
+    def _get(self, name: str, mtype: str, help_: str) -> _Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = _Metric(name, mtype, help_)
+            self._metrics[name] = m
+        elif m.mtype != mtype:
+            raise ValueError(f"metric {name!r} is a {m.mtype}, not a "
+                             f"{mtype}")
+        return m
+
+    def inc(self, name: str, amount: float = 1.0, help_: str = "",
+            **labels) -> None:
+        m = self._get(name, "counter", help_)
+        k = m._key(labels)
+        m.samples[k] = m.samples.get(k, 0.0) + float(amount)
+
+    def set_gauge(self, name: str, value: float, help_: str = "",
+                  **labels) -> None:
+        m = self._get(name, "gauge", help_)
+        m.samples[m._key(labels)] = float(value)
+
+    def observe(self, name: str, value: float, help_: str = "",
+                buckets: Tuple[float, ...] = WALL_BUCKETS,
+                **labels) -> None:
+        m = self._get(name, "histogram", help_)
+        k = m._key(labels)
+        s = m.samples.get(k)
+        if s is None:
+            s = {"sum": 0.0, "count": 0, "buckets": buckets,
+                 "counts": [0] * (len(buckets) + 1)}
+            m.samples[k] = s
+        v = float(value)
+        s["sum"] += v
+        s["count"] += 1
+        for i, le in enumerate(s["buckets"]):
+            if v <= le:
+                s["counts"][i] += 1
+        s["counts"][-1] += 1        # +Inf
+
+    def value(self, name: str, **labels) -> Optional[float]:
+        """Counter/gauge readback (tests + fleet rollups)."""
+        m = self._metrics.get(name)
+        if m is None:
+            return None
+        return m.samples.get(m._key(labels))
+
+    # -- the telemetry feed --------------------------------------------
+
+    def observe_record(self, rec: Dict[str, Any]) -> None:
+        """One validated telemetry/registry record -> metric updates
+        (the mapping in the module docstring's name table)."""
+        rtype = rec.get("type")
+        if rtype == "run_start":
+            self.inc("runs_started_total",
+                     help_="telemetry run_start records seen")
+        elif rtype == "chunk":
+            self.inc("chunks_total", help_="compiled chunks dispatched")
+            self.inc("steps_total", amount=rec["steps"],
+                     help_="solver steps advanced")
+            self.observe("chunk_wall_seconds", rec["wall_s"],
+                         help_="per-chunk wall time, seconds")
+            self.set_gauge("throughput_mcells_per_s",
+                           rec["mcells_per_s"],
+                           help_="latest chunk throughput, Mcells/s")
+            if not rec.get("finite", True):
+                self.inc("unhealthy_chunks_total",
+                         help_="chunks whose non-finite flag tripped")
+        elif rtype == "batch_lane":
+            if not rec.get("finite", True):
+                self.inc("lane_unhealthy_total", lane=rec["lane"],
+                         help_="non-finite batch-lane chunk records "
+                               "per lane (tenant)")
+        elif rtype in ("retry", "rollback", "degrade",
+                       "topology_change"):
+            self.inc("recovery_events_total", kind=rtype,
+                     help_="supervisor recovery events by kind")
+        elif rtype == "ladder_downgrade":
+            self.inc("vmem_ladder_downgrades_total",
+                     help_="VMEM-ladder tile/depth downgrades")
+        elif rtype == "imbalance":
+            if rec.get("ratio") is not None:
+                self.set_gauge("straggler_ratio", rec["ratio"],
+                               help_="per-chip max/mean imbalance "
+                                     "ratio (latest)")
+            self.set_gauge("straggler_chip", rec["argmax"],
+                           help_="straggler-candidate chip id "
+                                 "(latest)")
+        elif rtype == "alert":
+            self.inc("alerts_total", rule=rec["rule"],
+                     help_="SLO alerts fired by rule id")
+        elif rtype == "run_end":
+            self.inc("runs_finished_total",
+                     help_="telemetry run_end records seen")
+            self.set_gauge("run_mcells_per_s", rec["mcells_per_s"],
+                           help_="whole-run mean throughput, Mcells/s")
+            if rec.get("compile_ms") is not None:
+                self.set_gauge("compile_ms", rec["compile_ms"],
+                               help_="wall spent in lower+compile "
+                                     "this run, ms")
+            cache = rec.get("aot_cache")
+            if isinstance(cache, dict):
+                for k in ("hits", "misses", "disk_hits", "traces"):
+                    if isinstance(cache.get(k), (int, float)):
+                        self.set_gauge(f"aot_cache_{k}", cache[k],
+                                       help_="AOT executable cache "
+                                             "counter snapshot")
+        elif rtype == "run_final":
+            # registry rows (runs.jsonl): the fleet-status counter
+            self.inc("runs_total", status=rec["status"],
+                     help_="registry run_final rows by status")
+
+    # -- exposition ----------------------------------------------------
+
+    def render(self) -> str:
+        """OpenMetrics/Prometheus text exposition (deterministic
+        ordering; ``# EOF`` terminated)."""
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            full = PREFIX + name
+            lines.append(f"# HELP {full} {m.help or name}")
+            lines.append(f"# TYPE {full} {m.mtype}")
+            for key in sorted(m.samples):
+                labels = dict(key)
+                if m.mtype == "histogram":
+                    s = m.samples[key]
+                    for le, n in zip(
+                            [*s["buckets"], float("inf")],
+                            s["counts"]):
+                        le_s = "+Inf" if le == float("inf") \
+                            else _fmt(le)
+                        lines.append(
+                            f"{full}_bucket"
+                            f"{_labels(dict(labels, le=le_s))} {n}")
+                    lines.append(f"{full}_sum{_labels(labels)} "
+                                 f"{_fmt(s['sum'])}")
+                    lines.append(f"{full}_count{_labels(labels)} "
+                                 f"{s['count']}")
+                else:
+                    lines.append(f"{full}{_labels(labels)} "
+                                 f"{_fmt(m.samples[key])}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    def write(self, path: str) -> None:
+        """Atomically publish the exposition (a scraper must never
+        read a half-written file)."""
+        import os
+
+        from fdtd3d_tpu.io import atomic_open
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with atomic_open(path, "w") as f:
+            f.write(self.render())
+
+    def maybe_write(self) -> None:
+        """Publish to the remembered ``path`` (no-op without one) —
+        the close()-time hook shared by Simulation/BatchSimulation.
+        Rank 0 only (the telemetry sink / run registry convention):
+        per-rank host timings differ, and N ranks racing one atomic
+        replace would leave whichever landed last."""
+        if not self.path:
+            return
+        try:
+            import jax
+            if jax.process_index() != 0:
+                return
+        except Exception:
+            pass
+        self.write(self.path)
+
+    @classmethod
+    def from_jsonl(cls, path: str) -> "MetricsRegistry":
+        """Build a registry by replaying an existing telemetry or
+        registry JSONL (validated) — the offline flavor the fleet
+        monitor uses."""
+        from fdtd3d_tpu import telemetry as _telemetry
+        reg = cls()
+        for rec in _telemetry.read_jsonl(path):
+            reg.observe_record(rec)
+        return reg

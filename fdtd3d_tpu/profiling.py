@@ -38,6 +38,15 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# THE shared per-chunk percentile helper (p50/p95/max) lives in
+# telemetry.py (numpy-only import surface, so the jsonl tools can use
+# it without paying a jax import); StepClock.summary, tools/
+# telemetry_report.py, bench's chunk_stats (via StepClock) and the
+# fleet rollups (tools/fleet_report.py) all compute through it, so
+# fleet-level and per-run percentiles provably cannot drift.
+from fdtd3d_tpu.telemetry import pct_summary  # noqa: F401,E402
+
+
 @dataclasses.dataclass
 class ChunkRecord:
     steps: int
@@ -80,7 +89,7 @@ class StepClock:
                     "best_mcells_per_s": 0.0, "chunks": 0,
                     "p50_mcells_per_s": 0.0, "p95_mcells_per_s": 0.0,
                     "max_mcells_per_s": 0.0}
-        rates = np.asarray([r.mcells_per_s for r in self.records])
+        pct = pct_summary([r.mcells_per_s for r in self.records])
         return {
             "steps": self.total_steps,
             "seconds": self.total_seconds,
@@ -88,9 +97,9 @@ class StepClock:
             "mcells_per_s": (sum(r.cells * r.steps for r in self.records)
                              / self.total_seconds / 1e6),
             "best_mcells_per_s": max(r.mcells_per_s for r in self.records),
-            "p50_mcells_per_s": float(np.percentile(rates, 50)),
-            "p95_mcells_per_s": float(np.percentile(rates, 95)),
-            "max_mcells_per_s": float(rates.max()),
+            "p50_mcells_per_s": pct["p50"],
+            "p95_mcells_per_s": pct["p95"],
+            "max_mcells_per_s": pct["max"],
         }
 
     def report(self) -> str:

@@ -1,0 +1,346 @@
+"""Run registry: the append-only fleet index every run reports into.
+
+The fleet-level observability substrate (ROADMAP items 2c/3: a job
+queue and a fleet scheduler both need to SEE the fleet before they can
+schedule it — the measurement-before-policy posture of PR 6's comm
+lane, applied one level up). When ``FDTD3D_RUN_REGISTRY`` names a
+path, every run — CLI, bench stage, batched executor, supervised —
+appends exactly TWO records to that shared ``runs.jsonl``:
+
+* ``run_begin`` at construction — a stable ``run_id``, the run kind,
+  provenance (git sha / platform / jax), the scenario identity
+  (config fingerprint + the provenance-free
+  :attr:`~fdtd3d_tpu.exec_cache.ExecKey.comparable_digest` at the
+  ``n_steps=0`` sentinel), topology / step kind / ghost depth / batch
+  width, and the artifact paths (telemetry / metrics / save dir /
+  trace dir) a fleet monitor joins against;
+* ``run_final`` at close — status ``completed`` / ``failed`` /
+  ``recovered``, totals (steps, wall, Mcells/s), the recovery-event
+  rollup (retries / rollbacks / degrades / topology changes, tallied
+  by the telemetry sink), per-lane unhealthy verdicts, and the
+  exec-cache counter snapshot.
+
+Both rows are schema-v7 record types validated by
+``telemetry.validate_record`` (the index can never drift from the
+telemetry toolchain) and written via :func:`fdtd3d_tpu.io.
+atomic_append` — ONE O_APPEND write per run boundary, so concurrent
+runs sharing a registry interleave whole lines, never torn ones. The
+same ``run_id`` is stamped into the telemetry ``run_start`` (schema
+v7 optional key) and into every checkpoint's ``extra_ckpt_meta``, so
+a telemetry stream or a snapshot is traceable back to its run
+(``tools/ckpt_inspect.py --json`` surfaces it).
+
+Status semantics (``tools/fleet_report.py`` folds the rows by
+run_id; the LAST row wins):
+
+* ``running`` — begin row; a fold that never sees a final row is a
+  live (or killed-without-close) run.
+* ``completed`` — closed with no recovery events and no health trip.
+* ``recovered`` — closed after surviving recovery: supervisor
+  retries/rollbacks/degrades/topology rungs, or a batch that isolated
+  one or more non-finite lanes (lane isolation IS the batch
+  executor's recovery — the other tenants' results survived).
+* ``failed`` — closed while an exception was propagating (the
+  CLI/bench finalizers run inside the raising frame), or completed
+  with an unrecovered non-finite health flag.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from fdtd3d_tpu import telemetry as _telemetry
+
+REGISTRY_KNOB = "FDTD3D_RUN_REGISTRY"
+
+_SEQ = itertools.count()
+_DEFAULT_KIND = "lib"
+_SUPPRESS = 0
+
+
+def registry_path() -> Optional[str]:
+    """The shared runs.jsonl path (``FDTD3D_RUN_REGISTRY``), or None
+    (registry off — the default; no run-boundary writes happen)."""
+    return os.environ.get(REGISTRY_KNOB) or None
+
+
+def set_default_kind(kind: str) -> None:
+    """Process-default run kind for handles opened without an explicit
+    one: the CLI sets ``cli``/``supervised``, bench sets ``bench``;
+    library constructions read ``lib``. The batched executor passes
+    ``kind="batch"`` explicitly (a batch is a batch from any entry)."""
+    global _DEFAULT_KIND
+    _DEFAULT_KIND = str(kind)
+
+
+@contextlib.contextmanager
+def suppress_registration():
+    """No new registrations inside the block: the supervisor's ladder
+    rebuilds construct REPLACEMENT sims for the same logical run — a
+    second begin row would double-count it; :func:`transfer` moves the
+    original handle onto the replacement instead."""
+    global _SUPPRESS
+    _SUPPRESS += 1
+    try:
+        yield
+    finally:
+        _SUPPRESS -= 1
+
+
+def new_run_id() -> str:
+    """Stable unique run id: wall time + pid + in-process sequence +
+    4 random hex chars (two hosts starting the same second with a
+    recycled pid must still not collide in a shared registry)."""
+    return (f"r{time.strftime('%Y%m%dT%H%M%S')}-{os.getpid()}"
+            f"-{next(_SEQ)}-{os.urandom(2).hex()}")
+
+
+class RunRegistry:
+    """Validating append-only writer for one runs.jsonl path."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def emit(self, rec_type: str, **fields) -> Dict[str, Any]:
+        from fdtd3d_tpu import io as _io
+        rec = {"v": _telemetry.SCHEMA_VERSION, "type": rec_type,
+               **fields}
+        _telemetry.validate_record(rec)
+        _io.atomic_append(self.path, json.dumps(rec) + "\n")
+        return rec
+
+
+class RunHandle:
+    """One run's registry presence: the begin row is written at
+    construction, the final row exactly once at :meth:`finalize`
+    (``Simulation.close`` / ``BatchSimulation.close`` call it on
+    every exit path)."""
+
+    def __init__(self, path: str, run_id: str, kind: str,
+                 writer: bool = True):
+        self._reg = RunRegistry(path)
+        self.run_id = run_id
+        self.kind = kind
+        self._writer = writer
+        self._finalized = False
+
+    @classmethod
+    def open_for(cls, sim, kind: Optional[str] = None
+                 ) -> Optional["RunHandle"]:
+        """Register ``sim`` (a Simulation or BatchSimulation, already
+        bound to its runner) in the env-configured registry: returns
+        the attached handle, or None when the registry is off,
+        registration is suppressed (supervisor rebuilds), or the
+        begin write failed (a broken registry must never break the
+        run it observes — warned, not raised)."""
+        path = registry_path()
+        if path is None or _SUPPRESS:
+            return None
+        writer = True
+        try:
+            import jax
+            writer = jax.process_index() == 0
+        except Exception:
+            pass
+        handle = cls(path, new_run_id(), kind or _DEFAULT_KIND,
+                     writer=writer)
+        try:
+            handle._begin(sim)
+        except (OSError, ValueError) as exc:
+            # a broken registry (unwritable path, a row failing its
+            # own validation) must never break the run it observes
+            from fdtd3d_tpu import log as _log
+            _log.warn(f"run registry: begin row not written to "
+                      f"{path} ({exc}); run continues unregistered")
+            return None
+        # stamp only AFTER the begin row landed: telemetry/checkpoints
+        # must never carry a run_id that exists in no registry row
+        handle.attach(sim)
+        return handle
+
+    def attach(self, sim) -> None:
+        """Stamp the run identity onto the sim: ``sim.run_id`` (the
+        telemetry run_start picks it up via ``provenance``) and the
+        checkpoint metadata (``extra_ckpt_meta`` — every snapshot is
+        then traceable to its run, tools/ckpt_inspect.py)."""
+        sim.run_id = self.run_id
+        sim.run_registry = self
+        meta = getattr(sim, "extra_ckpt_meta", None)
+        if meta is not None:
+            meta["run_id"] = self.run_id
+
+    # -- rows ----------------------------------------------------------
+
+    def _begin_fields(self, sim) -> Dict[str, Any]:
+        from fdtd3d_tpu import exec_cache as _exec_cache
+        cfg = sim.cfg
+        out_cfg = cfg.output
+        platform = "unknown"
+        jax_version = "unknown"
+        try:
+            import jax
+            platform = jax.default_backend()
+            jax_version = jax.__version__
+        except Exception:
+            pass
+        out: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "status": "running",
+            "kind": self.kind,
+            "wall_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "git_sha": _telemetry.git_sha(),
+            "platform": platform,
+            "jax_version": jax_version,
+            "scheme": cfg.scheme,
+            "grid": list(cfg.grid_shape),
+            "dtype": cfg.dtype,
+            "topology": list(sim.topology),
+            "batch": int(getattr(sim, "batch_size", 0) or 0),
+            "telemetry_path": out_cfg.telemetry_path,
+            "metrics_path": out_cfg.metrics_path,
+            "save_dir": out_cfg.save_dir,
+            "trace_dir": out_cfg.profile_dir,
+        }
+        # executable identity: the provenance-free comparable digest
+        # (exec_cache.registry_identity also carries step_kind and
+        # ghost_depth, the engaged step's)
+        try:
+            out.update(_exec_cache.registry_identity(sim.exec_key(0)))
+        except Exception as exc:
+            from fdtd3d_tpu import log as _log
+            _log.warn(f"run registry: exec-key identity unavailable "
+                      f"({str(exc)[:120]}); begin row carries the "
+                      f"step kind only")
+            out["step_kind"] = getattr(sim, "step_kind", "unknown")
+        return out
+
+    def _begin(self, sim) -> None:
+        if not self._writer:
+            return
+        self._reg.emit("run_begin", **self._begin_fields(sim))
+
+    def _final_fields(self, sim, status: Optional[str]
+                      ) -> Dict[str, Any]:
+        import sys
+
+        from fdtd3d_tpu import exec_cache as _exec_cache
+        sink = getattr(sim, "telemetry", None)
+        counts: Dict[str, int] = {k: 0 for k in
+                                  _telemetry.RECOVERY_TYPES}
+        if sink is not None:
+            counts.update(sink.recovery_counts)
+        if not any(counts.values()):
+            # sink-less supervised runs: the supervisor persists its
+            # counters into extra_ckpt_meta (state_dict) — use them
+            sup = (getattr(sim, "extra_ckpt_meta", None)
+                   or {}).get("supervisor") or {}
+            counts["retry"] = int(sup.get("retries", 0))
+            counts["rollback"] = int(sup.get("rollbacks", 0))
+            counts["degrade"] = int(sup.get("degrades", 0))
+            counts["topology_change"] = int(
+                sup.get("topology_rung", 0))
+        n_recoveries = sum(counts.values())
+        lanes = list(getattr(sim, "lane_finite", None) or [])
+        lane_first = list(getattr(sim, "lane_first_unhealthy_t",
+                                  None) or [])
+        unhealthy = [[i, lane_first[i] if i < len(lane_first)
+                      else None]
+                     for i, ok in enumerate(lanes) if ok is False]
+        first_bad = sink.first_unhealthy_t if sink is not None \
+            else None
+        if status is None:
+            # the CLI/bench finalizers run inside the raising frame,
+            # so a live exception here means the run died mid-flight
+            if sys.exc_info()[1] is not None:
+                status = "failed"
+            elif n_recoveries > 0 or unhealthy:
+                status = "recovered"
+            elif first_bad is not None:
+                status = "failed"
+            else:
+                status = "completed"
+        steps = sink.steps_total if sink is not None \
+            else int(getattr(sim, "_t_host", 0))
+        wall = sink.wall_total if sink is not None else 0.0
+        cells = float(getattr(sim, "_cells", 0.0)) \
+            * max(int(getattr(sim, "batch_size", 0) or 1), 1)
+        mcps = cells * steps / wall / 1e6 if wall > 0 else 0.0
+        out: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "status": status,
+            "t": int(getattr(sim, "_t_host", 0)),
+            "steps": int(steps),
+            "wall_s": float(wall),
+            "mcells_per_s": float(mcps),
+            "recovery_events": dict(counts, total=n_recoveries),
+            "first_unhealthy_t": first_bad,
+            "compile_ms": round(float(getattr(sim, "_compile_ms",
+                                              0.0)), 3),
+            "aot_cache": _exec_cache.stats(),
+        }
+        if unhealthy:
+            out["unhealthy_lanes"] = unhealthy
+        return out
+
+    def finalize(self, sim, status: Optional[str] = None) -> None:
+        """Append the final row (idempotent). ``status`` overrides the
+        derived verdict; the default derivation is documented in the
+        module docstring. Never raises — a broken registry must not
+        mask the run's own exit path."""
+        if self._finalized or not self._writer:
+            self._finalized = True
+            return
+        self._finalized = True
+        try:
+            self._reg.emit("run_final",
+                           **self._final_fields(sim, status))
+        except (OSError, ValueError) as exc:
+            from fdtd3d_tpu import log as _log
+            _log.warn(f"run registry: final row not written "
+                      f"({exc}); the fold will read this run as "
+                      f"still running")
+
+
+def transfer(old_sim, new_sim) -> None:
+    """Move a run's registry handle (and run_id stamp) onto a
+    replacement sim — the supervisor's ladder rebuilds swap the
+    Simulation under one logical run, exactly as they move the
+    telemetry sink."""
+    handle = getattr(old_sim, "run_registry", None)
+    if handle is None:
+        return
+    old_sim.run_registry = None
+    handle.attach(new_sim)
+
+
+# --------------------------------------------------------------------------
+# reading + folding (tools/fleet_report.py)
+# --------------------------------------------------------------------------
+
+
+def fold(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """run_id -> merged row: the begin row's identity/artifact fields
+    overlaid by every later row for the same run_id (LAST status
+    wins, so an append-only file still reads as current state)."""
+    runs: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        if rec.get("type") not in ("run_begin", "run_final"):
+            continue
+        rid = rec.get("run_id")
+        if not isinstance(rid, str):
+            continue
+        row = runs.setdefault(rid, {})
+        row.update({k: v for k, v in rec.items()
+                    if k not in ("v", "type")})
+    return runs
+
+
+def read(path: str) -> List[Dict[str, Any]]:
+    """Parse + validate a runs.jsonl registry (the telemetry
+    validator owns the row schema)."""
+    return _telemetry.read_jsonl(path)

@@ -128,6 +128,7 @@ class Simulation:
         # the finite tripwire wants them — both then cost one fused
         # reduction per chunk + one scalar readback, never a host pass.
         self._health_on = bool(cfg.output.telemetry_path) \
+            or bool(cfg.output.metrics_path) \
             or cfg.output.check_finite
         # Per-chip lane (telemetry v4): un-psummed per-chip counters
         # ride the same fused readback when a sink will record them.
@@ -180,11 +181,30 @@ class Simulation:
         # snapshots carry it across preemptions
         self.extra_ckpt_meta: Dict = {}
         self._closed = False
+        # Fleet run registry (fdtd3d_tpu/registry.py,
+        # FDTD3D_RUN_REGISTRY): one atomic run_begin append now, one
+        # run_final at close(); the run_id lands in the telemetry
+        # run_start below (provenance reads sim.run_id) and in every
+        # checkpoint's extra_ckpt_meta. None when the knob is unset.
+        from fdtd3d_tpu import registry as _registry
+        self.run_id: Optional[str] = None
+        self.run_registry = _registry.RunHandle.open_for(self)
+        # OpenMetrics exposition (fdtd3d_tpu/metrics.py): observes
+        # every sink record host-side; published at close(). The
+        # registry remembers its path so a supervisor sim-swap still
+        # writes the exposition.
+        self.metrics = None
+        if cfg.output.metrics_path:
+            from fdtd3d_tpu import metrics as _metrics
+            self.metrics = _metrics.MetricsRegistry(
+                path=cfg.output.metrics_path)
         self.telemetry: Optional[_telemetry.TelemetrySink] = None
-        if cfg.output.telemetry_path:
+        if cfg.output.telemetry_path or cfg.output.metrics_path:
+            # path=None -> a file-less sink: the metrics-only event bus
             self.telemetry = _telemetry.TelemetrySink(
-                cfg.output.telemetry_path,
-                run_meta=_telemetry.provenance(self))
+                cfg.output.telemetry_path or None,
+                run_meta=_telemetry.provenance(self),
+                metrics=self.metrics)
         # Device-trace lane (round 7): capture starts lazily at the
         # first advance() (so construction-time failures never leave a
         # dangling profiler session) and is finalized by close() —
@@ -597,17 +617,26 @@ class Simulation:
 
     def close(self):
         """Finalize every observability lane: stop the device-trace
-        capture (if one is live) and close the telemetry sink.
-        Idempotent — safe to call on every exit path. The CLI/bench
-        hold it in try/finally AND register it via ``atexit`` so a
-        SIGTERM-style exit (sys.exit from a signal handler) still
-        finalizes the trace directory and the run_end record."""
+        capture (if one is live), close the telemetry sink, publish
+        the OpenMetrics exposition, and append the registry's
+        run_final row (status completed/failed/recovered — derived
+        from the sink's recovery tally and whether an exception is
+        propagating through the caller's finally). Idempotent — safe
+        to call on every exit path. The CLI/bench hold it in
+        try/finally AND register it via ``atexit`` so a SIGTERM-style
+        exit (sys.exit from a signal handler) still finalizes the
+        trace directory and the run_end record."""
         if self._closed:
             return self
         self._closed = True
         if self.tracer is not None:
             self.tracer.stop()
-        return self.close_telemetry()
+        self.close_telemetry()
+        if self.metrics is not None:
+            self.metrics.maybe_write()
+        if self.run_registry is not None:
+            self.run_registry.finalize(self)
+        return self
 
     # Budget rungs for the packed kernel's VMEM-model fallback: the
     # model's Mosaic-temporaries constant is calibrated on one v5e
@@ -741,7 +770,7 @@ class Simulation:
 
     @staticmethod
     def run_batch(cfgs, time_steps: Optional[int] = None,
-                  devices: Optional[List] = None):
+                  devices: Optional[List] = None, chunk: int = 0):
         """Run B same-shape scenarios as ONE vmap-batched execution.
 
         One compiled executable, one dispatch (and one halo exchange)
@@ -754,13 +783,15 @@ class Simulation:
         ``.lane_first_unhealthy_t`` (the end-of-run
         ``verify_final_lanes`` sweep has already run, so damage
         landing after the last chunk's in-graph measurement is
-        reflected too). Batching eligibility + limits:
-        docs/SERVICE.md.
+        reflected too). ``chunk`` advances the batch in that many
+        steps per compiled dispatch (0 = one chunk): the per-chunk
+        telemetry/health cadence, CLI ``--batch-chunk``. Batching
+        eligibility + limits: docs/SERVICE.md.
         """
         from fdtd3d_tpu.batch import BatchSimulation
         bsim = BatchSimulation(cfgs, devices=devices)
         try:
-            bsim.run(time_steps)
+            bsim.run(time_steps, chunk=chunk)
             bsim.verify_final_lanes()
         finally:
             bsim.close()

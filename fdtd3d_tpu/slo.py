@@ -1,0 +1,387 @@
+"""SLO rules engine: declarative service objectives over telemetry.
+
+The alerting half of the fleet-observability layer
+(docs/OBSERVABILITY.md "Service observability"): a small set of
+DECLARATIVE rules (:class:`SloRule`) evaluated over a validated
+telemetry record stream (one run = one ``run_start``..``run_end``
+span, ``telemetry.split_runs``), each producing an explicit verdict —
+``OK`` / ``VIOLATION`` / ``INCONCLUSIVE`` (a gate that cannot judge
+must say so, never silently pass — the perf-sentinel posture) /
+``SKIPPED`` (rule not applicable to this run's record mix: a
+non-batch run has no lane rule to fail). Violations render as
+schema-v7 ``alert`` records (:func:`alerts_for`) carrying the rule id
+and the firing window, which ``tools/slo_gate.py --emit-alerts``
+appends beside the records that tripped them and
+``tools/telemetry_report.py`` prints in its survived-events summary.
+
+Default rule set (thresholds overridable via a rules JSON —
+``tools/slo_gate.py --rules``; docs/OBSERVABILITY.md carries the
+table):
+
+* ``throughput-floor`` — run mean Mcells/s >= ``threshold`` x the
+  BENCH_BEST reference for the engaged step kind (context
+  ``bench_best``); absolute floor via context ``min_mcells_per_s``.
+  INCONCLUSIVE off-TPU against a TPU reference (a CPU run's "drop"
+  vs the chip record is meaningless — the perf-sentinel rule).
+* ``chunk-wall-p95`` — p95 per-chunk wall seconds <= ``threshold``
+  (the shared ``telemetry.pct_summary`` percentiles).
+* ``unhealthy-lane-fraction`` — fraction of batch lanes ever
+  non-finite <= ``threshold`` (0.0 = any unhealthy tenant fires).
+* ``compile-budget`` — run_end ``compile_ms`` <= context
+  ``compile_budget_ms``, or <= ``threshold`` x the best equal-key
+  reference (context ``compile_refs``: comparable ExecKey digest ->
+  ms, built from a run registry) — compile cost is only comparable
+  at equal comparable key (tools/perf_sentinel.py check_compile).
+* ``recovery-rate`` — recovery events (retry/rollback/degrade/
+  topology_change) per 1000 steps <= ``threshold``.
+* ``straggler-ratio`` — worst per-chip max/mean imbalance ratio <=
+  ``threshold``; a diverged (non-finite) chip fires outright.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+from fdtd3d_tpu import telemetry as _telemetry
+
+RULE_KINDS = ("throughput_floor", "chunk_wall_p95",
+              "unhealthy_lane_fraction", "compile_budget",
+              "recovery_rate", "straggler_ratio")
+
+# step_kind -> BENCH_BEST/bench-artifact throughput keys (the
+# perf-sentinel PATHS table's run-level projection)
+_BENCH_KEYS = {
+    "pallas_packed": ("pallas_mcells", "f32_pallas_mcells"),
+    "pallas_packed_tb": ("tb_mcells",),
+    "pallas_packed_ds": ("float32x2_mcells",),
+    "pallas": ("pallas_mcells",),
+    "pallas_fused": ("pallas_mcells",),
+    "jnp": ("jnp_mcells",),
+    "jnp_ds": ("jnp_mcells",),
+}
+
+
+@dataclasses.dataclass
+class SloRule:
+    """One declarative objective: ``id`` names it in alerts/verdicts,
+    ``kind`` picks the evaluator (RULE_KINDS), ``threshold`` is the
+    kind-specific bound (floor fraction, ceiling seconds, max
+    fraction, growth multiplier, events/kstep, ratio)."""
+
+    id: str
+    kind: str
+    threshold: float
+
+    def __post_init__(self):
+        if self.kind not in RULE_KINDS:
+            raise ValueError(f"unknown SLO rule kind {self.kind!r} "
+                             f"(known: {RULE_KINDS})")
+
+
+DEFAULT_RULES = (
+    SloRule("throughput-floor", "throughput_floor", 0.5),
+    SloRule("chunk-wall-p95", "chunk_wall_p95", 30.0),
+    SloRule("unhealthy-lane-fraction", "unhealthy_lane_fraction", 0.0),
+    SloRule("compile-budget", "compile_budget", 1.25),
+    SloRule("recovery-rate", "recovery_rate", 5.0),
+    SloRule("straggler-ratio", "straggler_ratio", 2.0),
+)
+
+
+def rules_from_json(spec) -> List[SloRule]:
+    """Rules from a parsed JSON list (``[{"id", "kind", "threshold"},
+    ...]``) — the ``tools/slo_gate.py --rules`` surface. Unknown
+    kinds are named config errors, never silently-inactive rules."""
+    out = []
+    for row in spec:
+        if not isinstance(row, dict):
+            raise ValueError(f"rule entry is not an object: {row!r}")
+        try:
+            out.append(SloRule(str(row["id"]), str(row["kind"]),
+                               float(row["threshold"])))
+        except KeyError as exc:
+            raise ValueError(f"rule entry missing {exc}: {row!r}") \
+                from None
+    return out
+
+
+# --------------------------------------------------------------------------
+# evaluation
+# --------------------------------------------------------------------------
+
+
+def _frame(run):
+    start = next((r for r in run if r["type"] == "run_start"), {})
+    end = next((r for r in run if r["type"] == "run_end"), None)
+    chunks = [r for r in run if r["type"] == "chunk"]
+    t0 = (chunks[0]["t"] - chunks[0]["steps"]) if chunks else 0
+    t1 = end["t"] if end is not None else \
+        (chunks[-1]["t"] if chunks else 0)
+    steps = end["steps"] if end is not None else \
+        sum(c["steps"] for c in chunks)
+    return start, end, chunks, t0, t1, steps
+
+
+def _res(rule, status, value=None, threshold=None, window=None,
+         message=""):
+    return {"rule": rule.id, "kind": rule.kind, "status": status,
+            "value": value, "threshold": threshold,
+            "window": list(window) if window else None,
+            "message": message}
+
+
+def _eval_throughput_floor(rule, run, ctx):
+    start, end, chunks, t0, t1, _steps = _frame(run)
+    mcps = None
+    if end is not None and end.get("mcells_per_s"):
+        mcps = float(end["mcells_per_s"])
+    elif chunks:
+        rates = [c["mcells_per_s"] for c in chunks]
+        mcps = float(sum(rates) / len(rates))
+    if mcps is None:
+        return _res(rule, "SKIPPED",
+                    message="no chunk/run_end throughput recorded")
+    floor = ctx.get("min_mcells_per_s")
+    if floor is None:
+        best = ctx.get("bench_best")
+        if not isinstance(best, dict):
+            return _res(rule, "SKIPPED",
+                        message="no throughput floor configured "
+                                "(pass a BENCH_BEST reference or an "
+                                "absolute min_mcells_per_s)")
+        if start.get("platform") not in ("tpu", "axon"):
+            return _res(rule, "INCONCLUSIVE", value=mcps,
+                        message=f"run platform "
+                                f"{start.get('platform')!r} is not "
+                                f"the TPU the BENCH_BEST record was "
+                                f"set on — floor not comparable")
+        ref = None
+        for key in _BENCH_KEYS.get(start.get("step_kind"), ()):
+            v = best.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                ref = float(v)
+                break
+        if ref is None:
+            return _res(rule, "INCONCLUSIVE", value=mcps,
+                        message=f"no BENCH_BEST reference for step "
+                                f"kind {start.get('step_kind')!r}")
+        floor = rule.threshold * ref
+    floor = float(floor)
+    if mcps < floor:
+        return _res(rule, "VIOLATION", value=mcps, threshold=floor,
+                    window=(t0, t1),
+                    message=f"run throughput {mcps:.1f} Mcells/s "
+                            f"under the {floor:.1f} floor")
+    return _res(rule, "OK", value=mcps, threshold=floor)
+
+
+def _eval_chunk_wall_p95(rule, run, ctx):
+    _start, _end, chunks, t0, t1, _steps = _frame(run)
+    if not chunks:
+        return _res(rule, "SKIPPED", message="no chunk records")
+    p95 = _telemetry.pct_summary([c["wall_s"] for c in chunks])["p95"]
+    if p95 > rule.threshold:
+        return _res(rule, "VIOLATION", value=p95,
+                    threshold=rule.threshold, window=(t0, t1),
+                    message=f"p95 chunk wall {p95:.3f}s over the "
+                            f"{rule.threshold:.3f}s ceiling")
+    return _res(rule, "OK", value=p95, threshold=rule.threshold)
+
+
+def _eval_unhealthy_lane_fraction(rule, run, ctx):
+    start, _end, _chunks, _t0, t1, _steps = _frame(run)
+    lanes = [r for r in run if r["type"] == "batch_lane"]
+    if not lanes:
+        return _res(rule, "SKIPPED",
+                    message="no batch_lane records (not a batched "
+                            "run)")
+    n = int(start.get("batch") or
+            (max(r["lane"] for r in lanes) + 1))
+    bad: Dict[int, int] = {}
+    for r in lanes:
+        if not r["finite"] and r["lane"] not in bad:
+            bad[r["lane"]] = r["t"]
+    frac = len(bad) / max(n, 1)
+    if frac > rule.threshold:
+        first = min(bad.values())
+        return _res(rule, "VIOLATION", value=frac,
+                    threshold=rule.threshold, window=(first, t1),
+                    message=f"lane(s) {sorted(bad)} non-finite "
+                            f"({len(bad)}/{n} lanes, "
+                            f"{frac:.0%} > {rule.threshold:.0%})")
+    return _res(rule, "OK", value=frac, threshold=rule.threshold)
+
+
+def _eval_compile_budget(rule, run, ctx):
+    _start, end, _chunks, t0, t1, _steps = _frame(run)
+    cm = end.get("compile_ms") if end is not None else None
+    if cm is None:
+        return _res(rule, "SKIPPED",
+                    message="no run_end compile_ms recorded")
+    budget = ctx.get("compile_budget_ms")
+    if budget is None:
+        refs = ctx.get("compile_refs") or {}
+        digest = ctx.get("exec_key_comparable")
+        ref = refs.get(digest) if digest else None
+        if ref is None:
+            if refs or digest:
+                return _res(rule, "INCONCLUSIVE", value=cm,
+                            message="no equal-comparable-key compile "
+                                    "reference on record — compile "
+                                    "cost only compares at equal "
+                                    "ExecKey")
+            return _res(rule, "SKIPPED",
+                        message="no compile budget configured (pass "
+                                "compile_budget_ms or a registry of "
+                                "equal-key references)")
+        budget = rule.threshold * float(ref)
+    budget = float(budget)
+    if float(cm) > budget:
+        return _res(rule, "VIOLATION", value=float(cm),
+                    threshold=budget, window=(t0, t1),
+                    message=f"compile wall {cm:.0f} ms over the "
+                            f"{budget:.0f} ms budget at equal "
+                            f"comparable key")
+    return _res(rule, "OK", value=float(cm), threshold=budget)
+
+
+def _eval_recovery_rate(rule, run, ctx):
+    _start, _end, _chunks, t0, t1, steps = _frame(run)
+    rec = [r for r in run
+           if r["type"] in _telemetry.RECOVERY_TYPES]
+    if steps <= 0 and not rec:
+        return _res(rule, "SKIPPED",
+                    message="no steps or recovery events recorded")
+    rate = len(rec) / max(steps, 1) * 1000.0
+    if rec and rate > rule.threshold:
+        return _res(rule, "VIOLATION", value=rate,
+                    threshold=rule.threshold,
+                    window=(min(_rec_t(r) for r in rec), t1),
+                    message=f"{len(rec)} recovery events in {steps} "
+                            f"steps ({rate:.1f}/kstep > "
+                            f"{rule.threshold:.1f}/kstep)")
+    return _res(rule, "OK", value=rate, threshold=rule.threshold)
+
+
+def _rec_t(rec) -> int:
+    return int(rec.get("t", rec.get("t_failed", 0)) or 0)
+
+
+def _eval_straggler_ratio(rule, run, ctx):
+    _start, _end, _chunks, _t0, t1, _steps = _frame(run)
+    imb = [r for r in run if r["type"] == "imbalance"]
+    if not imb:
+        return _res(rule, "SKIPPED",
+                    message="no imbalance records (per-chip lane "
+                            "off, or a single chip)")
+    bad = next((r for r in imb if r.get("nonfinite_chips")), None)
+    if bad is not None:
+        return _res(rule, "VIOLATION", value=None,
+                    threshold=rule.threshold,
+                    window=(bad["t"], t1),
+                    message=f"chip(s) {bad['nonfinite_chips']} "
+                            f"non-finite (a diverged chip is the "
+                            f"worst straggler there is)")
+    rated = [r for r in imb if r.get("ratio") is not None]
+    if not rated:
+        return _res(rule, "SKIPPED",
+                    message="imbalance records carry no ratio")
+    worst = max(rated, key=lambda r: r["ratio"])
+    if worst["ratio"] > rule.threshold:
+        return _res(rule, "VIOLATION", value=worst["ratio"],
+                    threshold=rule.threshold,
+                    window=(worst["t"], t1),
+                    message=f"chip {worst['argmax']} max/mean "
+                            f"{worst['metric']} imbalance "
+                            f"{worst['ratio']:.2f}x over "
+                            f"{rule.threshold:.2f}x "
+                            f"({worst['n_chips']} chips)")
+    return _res(rule, "OK", value=worst["ratio"],
+                threshold=rule.threshold)
+
+
+_EVALUATORS = {
+    "throughput_floor": _eval_throughput_floor,
+    "chunk_wall_p95": _eval_chunk_wall_p95,
+    "unhealthy_lane_fraction": _eval_unhealthy_lane_fraction,
+    "compile_budget": _eval_compile_budget,
+    "recovery_rate": _eval_recovery_rate,
+    "straggler_ratio": _eval_straggler_ratio,
+}
+
+
+def evaluate_run(run: List[Dict[str, Any]],
+                 rules=DEFAULT_RULES,
+                 context: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """One run's record list -> ``{"results", "status"}``. Overall
+    status: VIOLATION if any rule fired; else INCONCLUSIVE if any
+    rule could not judge — including the degenerate case of EVERY
+    rule skipping (a stream with nothing gateable must not read as a
+    pass); else OK."""
+    ctx = context or {}
+    results = [_EVALUATORS[r.kind](r, run, ctx) for r in rules]
+    statuses = [r["status"] for r in results]
+    if "VIOLATION" in statuses:
+        status = "VIOLATION"
+    elif "INCONCLUSIVE" in statuses:
+        status = "INCONCLUSIVE"
+    elif all(s == "SKIPPED" for s in statuses):
+        status = "INCONCLUSIVE"
+    else:
+        status = "OK"
+    return {"results": results, "status": status}
+
+
+def evaluate_stream(records: List[Dict[str, Any]],
+                    rules=DEFAULT_RULES,
+                    context: Optional[Dict[str, Any]] = None
+                    ) -> List[Dict[str, Any]]:
+    """Per-run verdicts over a whole (possibly multi-run) stream."""
+    return [evaluate_run(run, rules=rules, context=context)
+            for run in _telemetry.split_runs(records)]
+
+
+def alerts_for(results: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Schema-v7 ``alert`` records for every VIOLATION result —
+    validated here, so a malformed alert is a bug in this engine, not
+    in the stream's readers."""
+    out = []
+    for r in results:
+        if r["status"] != "VIOLATION":
+            continue
+        window = r.get("window") or [0, 0]
+        rec = {
+            "v": _telemetry.SCHEMA_VERSION,
+            "type": "alert",
+            "rule": r["rule"],
+            "t_start": int(window[0]),
+            "t_end": int(window[1]),
+            "value": r.get("value"),
+            "threshold": r.get("threshold"),
+            "message": r.get("message", ""),
+        }
+        _telemetry.validate_record(rec)
+        out.append(rec)
+    return out
+
+
+def format_results(summary: Dict[str, Any]) -> str:
+    """Text verdict table for one run (tools/slo_gate.py)."""
+    lines = [f"slo: {summary['status']}"]
+    for r in summary["results"]:
+        val = "-" if r["value"] is None else f"{r['value']:.3g}"
+        thr = "-" if r["threshold"] is None else \
+            f"{r['threshold']:.3g}"
+        line = (f"  {r['rule']:24s} {r['status']:13s} "
+                f"value {val} / threshold {thr}")
+        if r["message"]:
+            line += f"  — {r['message']}"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def to_json(summaries) -> str:
+    return json.dumps(summaries, indent=1)

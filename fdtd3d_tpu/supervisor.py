@@ -347,20 +347,29 @@ class Supervisor:
     def _swap_sim(self, cfg):
         """Replace the supervised sim with one built on ``cfg``, moving
         the telemetry sink (ONE run_start/run_end span per supervised
-        run) and stopping the old tracer. Returns the new sim; on a
-        factory failure the sink is reattached to the surviving sim so
-        the caller's close() still writes the run_end record."""
+        run), the run-registry handle and the metrics registry (one
+        run_begin/run_final pair and one exposition per supervised
+        run — the replacement is the SAME logical run), and stopping
+        the old tracer. Returns the new sim; on a factory failure the
+        sink is reattached to the surviving sim so the caller's
+        close() still writes the run_end record."""
+        from fdtd3d_tpu import registry as _registry
         old_sim = self.sim
         sink = old_sim.telemetry
         old_sim.telemetry = None
         if old_sim.tracer is not None:
             old_sim.tracer.stop()
         try:
-            new_sim = self._factory(cfg)
+            # suppressed: the rebuild must not append a second
+            # run_begin row for the same logical run
+            with _registry.suppress_registration():
+                new_sim = self._factory(cfg)
         except BaseException:
             old_sim.telemetry = sink
             raise
         new_sim.telemetry = sink
+        new_sim.metrics = old_sim.metrics
+        _registry.transfer(old_sim, new_sim)
         return new_sim
 
     def _handle_trip(self, exc: FloatingPointError):
@@ -384,6 +393,7 @@ class Supervisor:
         self._pin_env(pins)
         cfg = cfg_fn(self._cfg) if cfg_fn is not None else self._cfg
         out = dataclasses.replace(cfg.output, telemetry_path=None,
+                                  metrics_path=None,
                                   profile_dir=None, check_finite=True)
         cfg = dataclasses.replace(cfg, output=out, require_pallas=False)
         # the sink follows the run across the rebuild: ONE
@@ -427,6 +437,7 @@ class Supervisor:
         reason = f"{type(exc).__name__}: {str(exc)[:200]}"
         cfg = _cfg_with_topology(self._cfg, new_topo)
         out = dataclasses.replace(cfg.output, telemetry_path=None,
+                                  metrics_path=None,
                                   profile_dir=None, check_finite=True)
         cfg = dataclasses.replace(cfg, output=out, require_pallas=False)
         new_sim = self._swap_sim(cfg)

@@ -77,10 +77,16 @@ import numpy as np
 # "batch_lane" record (one per lane per chunk — lane-scoped health so
 # one tenant's NaN is attributable to its lane), plus the optional
 # run_start/run_end compile-amortization keys (`aot_cache` counter
-# snapshots, run_end `compile_ms`). v1-v5 files still read/validate
-# (READ_VERSIONS).
-SCHEMA_VERSION = 6
-READ_VERSIONS = (1, 2, 3, 4, 5, 6)
+# snapshots, run_end `compile_ms`). v7 (fleet observability, round
+# 16): the SLO rules engine's "alert" record (fdtd3d_tpu/slo.py — one
+# per firing rule, carrying the rule id and firing window), the
+# run-registry row types "run_begin"/"run_final" (fdtd3d_tpu/
+# registry.py: the append-only runs.jsonl fleet index shares this
+# validator), and the optional `run_id` on run_start that makes a
+# telemetry stream joinable against its registry row. v1-v6 files
+# still read/validate (READ_VERSIONS).
+SCHEMA_VERSION = 7
+READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -389,6 +395,11 @@ def provenance(sim=None) -> Dict[str, Any]:
     from fdtd3d_tpu import exec_cache as _exec_cache
     rec["aot_cache"] = _exec_cache.stats()
     if sim is not None:
+        # run-registry stamp (fdtd3d_tpu/registry.py): joins this
+        # stream to its runs.jsonl row; absent without a registry
+        rid = getattr(sim, "run_id", None)
+        if rid:
+            rec["run_id"] = str(rid)
         nlanes = getattr(sim, "batch_size", None)
         if nlanes:
             rec["batch"] = int(nlanes)
@@ -519,6 +530,32 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "energy": _OPT_NUM, "div_l2": _OPT_NUM, "div_linf": _OPT_NUM,
         "max_e": _OPT_NUM, "max_h": _OPT_NUM, "finite": (bool,),
     },
+    # v7 (fleet observability): one record per FIRING SLO rule
+    # (fdtd3d_tpu/slo.py evaluates the declarative rule set over a
+    # telemetry stream; tools/slo_gate.py --emit-alerts appends these
+    # beside the records that tripped them). `t_start`/`t_end` bound
+    # the firing window in steps; `value` is the measured quantity
+    # that crossed `threshold` (null when the violation is
+    # non-numeric, e.g. a diverged chip).
+    "alert": {
+        "rule": (str,), "t_start": (int,), "t_end": (int,),
+        "value": _OPT_NUM, "threshold": _OPT_NUM, "message": (str,),
+    },
+    # v7: the run-registry row types (fdtd3d_tpu/registry.py). The
+    # append-only runs.jsonl fleet index holds one "run_begin"
+    # (status "running", identity + artifact paths) per run start and
+    # one "run_final" (status completed/failed/recovered, totals +
+    # recovery rollup) per run end; tools/fleet_report.py folds them
+    # by run_id. They share this validator so the index can never
+    # drift from the telemetry toolchain.
+    "run_begin": {
+        "run_id": (str,), "status": (str,), "kind": (str,),
+        "wall_time": (str,), "git_sha": (str,), "platform": (str,),
+    },
+    "run_final": {
+        "run_id": (str,), "status": (str,), "t": (int,),
+        "steps": (int,), "wall_s": _NUM, "mcells_per_s": _NUM,
+    },
 }
 
 
@@ -539,10 +576,12 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # aot_cache (round 15): the exec-cache counter snapshot at sink
     # construction (exec_cache.stats) — a warm second run shows its
     # hits here before any chunk dispatches; batch: the vmap lane
-    # count of a batched executor's sink.
+    # count of a batched executor's sink. run_id (v7): the run-
+    # registry stamp (fdtd3d_tpu/registry.py) joining this stream to
+    # its runs.jsonl row; absent when FDTD3D_RUN_REGISTRY is unset.
     "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
                   "vmem_rung", "tile", "comm_strategy", "ghost_depth",
-                  "aot_cache", "batch"),
+                  "aot_cache", "batch", "run_id"),
     # sim.close_telemetry (round 15): the run's compile wall
     # (exec-cache misses only; a fully-warm run reads 0.0) + the final
     # counter snapshot — the compile-amortization proof per run.
@@ -556,6 +595,20 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
                     "ledger_step_kind", "roofline"),
     # imbalance_summary(): present only when a chip diverged
     "imbalance": ("nonfinite_chips",),
+    # registry rows (fdtd3d_tpu/registry.py): run identity + artifact
+    # pointers on the begin row; totals + recovery rollup on the
+    # final one. exec_key_comparable is ExecKey.comparable_digest at
+    # the n_steps=0 sentinel (scenario identity, stable across
+    # commits); artifact paths are as-configured (fleet_report
+    # resolves relative ones against the registry file's directory).
+    "run_begin": ("scheme", "grid", "dtype", "topology", "step_kind",
+                  "ghost_depth", "batch", "jax_version",
+                  "device_kind", "config_fp", "exec_key_comparable",
+                  "telemetry_path", "metrics_path", "save_dir",
+                  "trace_dir"),
+    "run_final": ("recovery_events", "unhealthy_lanes",
+                  "first_unhealthy_t", "compile_ms", "aot_cache",
+                  "exit_reason"),
 }
 
 
@@ -576,11 +629,13 @@ _V5_ONLY_KEYS = {"retry": ("chip", "host"),
                  "degrade": ("chip", "host")}
 # and from v6 on: the batched executor's per-lane record
 _V6_ONLY_TYPES = ("batch_lane",)
+# and from v7 on: the SLO alert record + the run-registry row types
+_V7_ONLY_TYPES = ("alert", "run_begin", "run_final")
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
     """Raise ValueError when a record violates its declared schema
-    version (writers emit v5; v1-v4 files remain readable)."""
+    version (writers emit v7; v1-v6 files remain readable)."""
     if not isinstance(rec, dict):
         raise ValueError(f"record is not an object: {rec!r}")
     v = rec.get("v")
@@ -593,7 +648,8 @@ def validate_record(rec: Dict[str, Any]) -> None:
             (v < 3 and rtype in _V3_ONLY_TYPES) or \
             (v < 4 and rtype in _V4_ONLY_TYPES) or \
             (v < 5 and rtype in _V5_ONLY_TYPES) or \
-            (v < 6 and rtype in _V6_ONLY_TYPES):
+            (v < 6 and rtype in _V6_ONLY_TYPES) or \
+            (v < 7 and rtype in _V7_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):
@@ -616,6 +672,13 @@ def validate_record(rec: Dict[str, Any]) -> None:
 # the sink
 # --------------------------------------------------------------------------
 
+# Recovery record types the sink tallies (fleet observability,
+# round 16): the run-registry final row (fdtd3d_tpu/registry.py) and
+# the metrics facade read these counters instead of re-parsing the
+# stream they just wrote.
+RECOVERY_TYPES = ("retry", "rollback", "degrade", "topology_change")
+
+
 class TelemetrySink:
     """Append-only JSONL writer for the flight recorder.
 
@@ -624,15 +687,29 @@ class TelemetrySink:
     execute them). Records are validated at write time — a malformed
     record is a bug here, not in the reader. The file is opened in
     append mode so several runs (bench stages) can share one path, each
-    delimited by its own run_start/run_end pair."""
+    delimited by its own run_start/run_end pair.
 
-    def __init__(self, path: str, run_meta: Optional[Dict] = None):
+    ``path=None`` builds a FILE-LESS sink: records are validated,
+    tallied (steps/wall/recovery counters) and fed to ``metrics``
+    without touching disk — the event bus a metrics-only run
+    (``--metrics`` without ``--telemetry``) rides. ``metrics`` (a
+    :class:`fdtd3d_tpu.metrics.MetricsRegistry`) observes every
+    record AFTER validation, so the OpenMetrics exposition can never
+    see a record the JSONL contract would reject."""
+
+    def __init__(self, path: Optional[str],
+                 run_meta: Optional[Dict] = None, metrics=None):
         self.path = path
         self._fh = None
+        self.metrics = metrics
         self.n_records = 0
         self.steps_total = 0
         self.wall_total = 0.0
         self.first_unhealthy_t: Optional[int] = None
+        # per-type tally of the supervisor's recovery records — the
+        # run registry's final-row rollup (fdtd3d_tpu/registry.py)
+        self.recovery_counts: Dict[str, int] = {
+            k: 0 for k in RECOVERY_TYPES}
         self._closed = False
         is_writer = True
         try:
@@ -640,7 +717,7 @@ class TelemetrySink:
             is_writer = jax.process_index() == 0
         except Exception:
             pass
-        if is_writer:
+        if is_writer and path is not None:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
             self._fh = open(path, "a")
@@ -671,9 +748,13 @@ class TelemetrySink:
                 # bound, not exact: the counters are per-chunk, so the
                 # first bad step lies in (t - steps, t]
                 self.first_unhealthy_t = rec["t"]
+        if rec_type in self.recovery_counts:
+            self.recovery_counts[rec_type] += 1
         if self._fh is not None:
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()
+        if self.metrics is not None:
+            self.metrics.observe_record(rec)
         self.n_records += 1
         return rec
 
@@ -703,6 +784,45 @@ class TelemetrySink:
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+
+def pct_summary(vals) -> Dict[str, float]:
+    """``{"p50", "p95", "max"}`` percentile summary of a value list —
+    THE shared per-chunk statistics helper: ``profiling.StepClock.
+    summary`` (whose dict bench.py's ``chunk_stats`` embeds),
+    ``tools/telemetry_report.py``, the SLO engine's chunk-wall rule
+    (fdtd3d_tpu/slo.py) and the fleet rollups
+    (``tools/fleet_report.py``) all compute through here, so the
+    fleet-level and per-run percentiles provably cannot drift.
+    Empty input reads as zeros (the callers' no-chunks row)."""
+    if not vals:
+        return {"p50": 0.0, "p95": 0.0, "max": 0.0}
+    arr = np.asarray(list(vals), dtype=np.float64)
+    return {"p50": float(np.percentile(arr, 50)),
+            "p95": float(np.percentile(arr, 95)),
+            "max": float(arr.max())}
+
+
+def split_runs(records):
+    """Group a validated record list into runs at run_start markers
+    (a file may hold several — bench stages append; a truncated head
+    without a run_start still forms a run). THE shared run splitter:
+    tools/telemetry_report.py, the SLO engine (fdtd3d_tpu/slo.py) and
+    tools/fleet_report.py all consume it, so "a run" can never mean
+    different spans to different tools."""
+    runs, cur = [], None
+    for rec in records:
+        if rec["type"] == "run_start":
+            if cur:
+                runs.append(cur)
+            cur = [rec]
+        else:
+            if cur is None:
+                cur = []  # tolerate a truncated head
+            cur.append(rec)
+    if cur:
+        runs.append(cur)
+    return runs
 
 
 def read_jsonl(path: str):

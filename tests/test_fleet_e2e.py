@@ -1,0 +1,166 @@
+"""Chip-free fleet-observability e2e chain (ISSUE 13 acceptance).
+
+One supervised faulted batch run through the REAL CLI drives the whole
+stack: the fault harness injects a lane NaN mid-run, the batch
+isolates the tenant, and then — deterministically on CPU —
+
+* the run-registry row flips to ``recovered`` with the tenant named;
+* the OpenMetrics exposition shows the unhealthy-lane counter;
+* ``tools/slo_gate.py`` fires the unhealthy-lane rule with exit 1;
+* ``tools/fleet_report.py --json`` names the (run, lane) tenant.
+
+A second chain runs the supervised sharded recovery path (chip-scoped
+NaN → rollback + topology degrade) and asserts the rollback counter
+reaches the metrics exposition and the registry row reads
+``recovered`` under kind ``supervised``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fdtd3d_tpu import cli, faults, registry, telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plan(monkeypatch):
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _run_tool(args, timeout=120):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=timeout, env=env,
+                          cwd=ROOT)
+
+
+def test_supervised_batch_lane_nan_fleet_chain(tmp_path, monkeypatch):
+    reg = str(tmp_path / "runs.jsonl")
+    tele = str(tmp_path / "t.jsonl")
+    mets = str(tmp_path / "m.prom")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    specs = []
+    for i, eps in enumerate((1.0, 2.0, 4.0)):
+        p = tmp_path / f"lane{i}.txt"
+        p.write_text(f"--3d\n--same-size 12\n--time-steps 8\n"
+                     f"--courant-factor 0.4\n--wavelength 0.008\n"
+                     f"--eps {eps}\n")
+        specs.append(str(p))
+    faults.install("nan@t=4,field=Ez,lane=1")
+    rc = cli.main(["--batch", *specs, "--batch-chunk", "4",
+                   "--supervise", "--telemetry", tele,
+                   "--metrics", mets])
+    assert rc == 0   # lane isolation: the other tenants completed
+
+    # (1) registry row: recovered, batch of 3, tenant lane 1 named
+    rows = registry.read(reg)
+    assert [r["type"] for r in rows] == ["run_begin", "run_final"]
+    begin, final = rows
+    assert begin["kind"] == "batch" and begin["batch"] == 3
+    assert final["status"] == "recovered"
+    assert final["unhealthy_lanes"] == [[1, 8]]
+    rid = final["run_id"]
+
+    # (2) the telemetry stream joins the registry (run_id) and holds
+    # the per-lane verdict rows
+    recs = telemetry.read_jsonl(tele)
+    start = next(r for r in recs if r["type"] == "run_start")
+    assert start["run_id"] == rid and start["batch"] == 3
+    bad = [r for r in recs
+           if r["type"] == "batch_lane" and not r["finite"]]
+    assert bad and all(r["lane"] == 1 for r in bad)
+
+    # (3) metrics exposition: the unhealthy-lane counter, per tenant
+    text = open(mets).read()
+    assert 'fdtd3d_lane_unhealthy_total{lane="1"} 1' in text
+    assert "fdtd3d_chunks_total 2" in text
+    assert text.strip().endswith("# EOF")
+
+    # (4) slo_gate fires the unhealthy-lane rule: exit 1, rule named
+    proc = _run_tool([os.path.join(TOOLS, "slo_gate.py"), tele,
+                      "--emit-alerts"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "unhealthy-lane-fraction" in proc.stdout
+    assert "VIOLATION" in proc.stdout
+    # the emitted alert landed in the stream, schema-valid
+    alerts = [r for r in telemetry.read_jsonl(tele)
+              if r["type"] == "alert"]
+    assert any(a["rule"] == "unhealthy-lane-fraction"
+               for a in alerts)
+
+    # (5) fleet_report --json names the tenant
+    proc = _run_tool([os.path.join(TOOLS, "fleet_report.py"), reg,
+                      "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rollup = json.loads(proc.stdout)
+    assert rollup["fleet"]["by_status"] == {"recovered": 1}
+    assert {"run": rid, "lane": 1, "first_unhealthy_t": 8} in \
+        rollup["fleet"]["unhealthy_tenants"]
+    assert any(a["rule"] == "unhealthy-lane-fraction"
+               for a in rollup["fleet"]["alerts"])
+
+
+def test_supervised_rollback_reaches_metrics_and_registry(
+        tmp_path, monkeypatch):
+    """Supervised sharded run, chip-scoped NaN: the kernel ladder has
+    no rung below jnp, so the supervisor rolls back and degrades the
+    TOPOLOGY; the run completes, the registry row reads recovered
+    (kind supervised), and the rollback counter reaches the
+    OpenMetrics exposition."""
+    reg = str(tmp_path / "runs.jsonl")
+    tele = str(tmp_path / "t.jsonl")
+    mets = str(tmp_path / "m.prom")
+    d = str(tmp_path / "run")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    faults.install("nan@t=8,field=Ez,chip=3")
+    rc = cli.main(["--3d", "--same-size", "24", "--time-steps", "24",
+                   "--courant-factor", "0.4",
+                   "--wavelength", "0.008",
+                   "--use-pml", "--pml-size", "3",
+                   "--point-source", "Ez",
+                   "--topology", "manual",
+                   "--manual-topology", "2x2x2",
+                   "--checkpoint-every", "8", "--save-dir", d,
+                   "--supervise", "--telemetry", tele,
+                   "--metrics", mets])
+    assert rc == 0
+
+    rows = registry.read(reg)
+    assert [r["type"] for r in rows] == ["run_begin", "run_final"]
+    begin, final = rows
+    assert begin["kind"] == "supervised"
+    assert begin["topology"] == [2, 2, 2]
+    assert final["status"] == "recovered"
+    assert final["recovery_events"]["rollback"] == 1
+    assert final["recovery_events"]["topology_change"] == 1
+    assert final["t"] == 24
+
+    text = open(mets).read()
+    assert 'fdtd3d_recovery_events_total{kind="rollback"} 1' in text
+    assert ('fdtd3d_recovery_events_total{kind="topology_change"} 1'
+            in text)
+
+    # the cadence snapshots carry the run_id stamp: ckpt_inspect
+    # --json traces the newest one back to this run
+    from fdtd3d_tpu import io
+    newest = io.find_latest_checkpoint(d)
+    proc = _run_tool([os.path.join(TOOLS, "ckpt_inspect.py"),
+                      newest, "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    meta = json.loads(proc.stdout)["meta"]
+    assert meta["run_id"] == final["run_id"]
+
+    # the default recovery-rate SLO fires on this stream (2 events
+    # in 24+8 replayed steps is far over 5/kstep): gate exits 1
+    proc = _run_tool([os.path.join(TOOLS, "slo_gate.py"), tele])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "recovery-rate" in proc.stdout

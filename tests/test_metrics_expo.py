@@ -1,0 +1,118 @@
+"""OpenMetrics exposition (fdtd3d_tpu/metrics.py): the scraper-facing
+counters/gauges/histograms fed from telemetry records, written
+atomically at Simulation close.
+"""
+
+import os
+
+from fdtd3d_tpu import metrics, telemetry
+from fdtd3d_tpu.config import (OutputConfig, PmlConfig,
+                               PointSourceConfig, SimConfig)
+from fdtd3d_tpu.sim import Simulation
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "fixtures")
+
+
+def test_counter_gauge_histogram_render():
+    reg = metrics.MetricsRegistry()
+    reg.inc("chunks_total", help_="chunks")
+    reg.inc("chunks_total")
+    reg.set_gauge("throughput_mcells_per_s", 5.5, help_="tp")
+    reg.observe("chunk_wall_seconds", 0.02, help_="wall")
+    reg.inc("lane_unhealthy_total", lane=1, help_="lanes")
+    text = reg.render()
+    assert "# TYPE fdtd3d_chunks_total counter" in text
+    assert "fdtd3d_chunks_total 2" in text
+    assert "fdtd3d_throughput_mcells_per_s 5.5" in text
+    assert "# TYPE fdtd3d_chunk_wall_seconds histogram" in text
+    assert 'fdtd3d_chunk_wall_seconds_bucket{le="0.05"} 1' in text
+    assert 'fdtd3d_chunk_wall_seconds_bucket{le="+Inf"} 1' in text
+    assert "fdtd3d_chunk_wall_seconds_count 1" in text
+    assert 'fdtd3d_lane_unhealthy_total{lane="1"} 1' in text
+    assert text.strip().endswith("# EOF")
+    assert reg.value("chunks_total") == 2
+    assert reg.value("lane_unhealthy_total", lane=1) == 1
+
+
+def test_from_jsonl_v6_batch_fixture():
+    reg = metrics.MetricsRegistry.from_jsonl(
+        os.path.join(FIX, "telemetry_v6.jsonl"))
+    assert reg.value("chunks_total") == 2
+    assert reg.value("steps_total") == 8
+    assert reg.value("unhealthy_chunks_total") == 1
+    assert reg.value("lane_unhealthy_total", lane=1) == 1
+    assert reg.value("lane_unhealthy_total", lane=0) is None
+    assert reg.value("runs_finished_total") == 1
+    assert reg.value("aot_cache_misses") == 1
+
+
+def test_recovery_and_alert_feed():
+    reg = metrics.MetricsRegistry.from_jsonl(
+        os.path.join(FIX, "telemetry_v7.jsonl"))
+    assert reg.value("recovery_events_total", kind="retry") == 1
+    assert reg.value("alerts_total", rule="straggler-ratio") == 1
+    assert reg.value("straggler_ratio") == 3.0
+    assert reg.value("straggler_chip") == 5.0
+    # registry rows feed the fleet-status counter
+    reg2 = metrics.MetricsRegistry.from_jsonl(
+        os.path.join(FIX, "registry_v7.jsonl"))
+    assert reg2.value("runs_total", status="recovered") == 2
+
+
+def test_sim_writes_exposition_without_telemetry_file(tmp_path):
+    """--metrics without --telemetry: a file-less sink feeds the
+    registry; the exposition is published at close; no JSONL is
+    written."""
+    mpath = str(tmp_path / "run.prom")
+    cfg = SimConfig(
+        scheme="3D", size=(12, 12, 12), time_steps=8, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(3, 3, 3)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(6, 6, 6)),
+        output=OutputConfig(save_dir=str(tmp_path / "out"),
+                            metrics_path=mpath))
+    sim = Simulation(cfg)
+    try:
+        assert sim.telemetry is not None
+        assert sim.telemetry.path is None    # file-less event bus
+        sim.advance(4)
+        sim.advance(4)
+    finally:
+        sim.close()
+    text = open(mpath).read()
+    assert "fdtd3d_chunks_total 2" in text
+    assert "fdtd3d_steps_total 8" in text
+    assert "fdtd3d_runs_finished_total 1" in text
+    assert text.strip().endswith("# EOF")
+    # no telemetry JSONL anywhere (path was None)
+    assert not os.path.exists(str(tmp_path / "t.jsonl"))
+
+
+def test_metrics_mismatched_type_is_named_error():
+    import pytest
+    reg = metrics.MetricsRegistry()
+    reg.inc("x_total")
+    with pytest.raises(ValueError, match="counter"):
+        reg.set_gauge("x_total", 1.0)
+
+
+def test_pct_summary_shared_helper():
+    """Satellite: the ONE percentile implementation — StepClock,
+    telemetry_report and the fleet rollups all route through it."""
+    from fdtd3d_tpu import profiling
+    vals = [1.0, 2.0, 3.0, 4.0]
+    out = telemetry.pct_summary(vals)
+    assert out["p50"] == 2.5 and out["max"] == 4.0
+    assert profiling.pct_summary is telemetry.pct_summary
+    assert telemetry.pct_summary([]) == {"p50": 0.0, "p95": 0.0,
+                                         "max": 0.0}
+    # StepClock.summary derives its percentiles from the shared helper
+    clock = profiling.StepClock()
+    clock.record(4, 1.0, 1e6)
+    clock.record(4, 2.0, 1e6)
+    s = clock.summary()
+    rates = [r.mcells_per_s for r in clock.records]
+    assert s["p50_mcells_per_s"] == telemetry.pct_summary(rates)["p50"]
+    assert s["max_mcells_per_s"] == telemetry.pct_summary(rates)["max"]

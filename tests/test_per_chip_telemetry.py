@@ -167,9 +167,9 @@ def test_sink_scrubs_nested_nonfinite(tmp_path):
     assert rec["counters"]["energy"] == [1.0, None]
 
 
-def test_fixture_corpus_round_trips_v1_to_v6():
+def test_fixture_corpus_round_trips_v1_to_v7():
     """Satellite acceptance: every checked-in telemetry JSONL fixture
-    still validates, and the corpus spans schema v1..v6 so no version
+    still validates, and the corpus spans schema v1..v7 so no version
     can silently rot out of the read path."""
     paths = sorted(glob.glob(os.path.join(FIX, "*.jsonl")))
     assert paths, "no JSONL fixtures found"
@@ -201,3 +201,22 @@ def test_fixture_corpus_round_trips_v1_to_v6():
     assert isinstance(start["aot_cache"], dict) and start["batch"] == 3
     end = next(r for r in v6 if r["type"] == "run_end")
     assert isinstance(end["compile_ms"], (int, float))
+    # the v7 file carries the SLO alert records (rule id + firing
+    # window) and the run-registry join stamp on run_start
+    v7 = telemetry.read_jsonl(os.path.join(FIX, "telemetry_v7.jsonl"))
+    alerts = [r for r in v7 if r["type"] == "alert"]
+    assert alerts and all(
+        isinstance(a["rule"], str) and a["t_end"] >= a["t_start"]
+        for a in alerts)
+    start7 = next(r for r in v7 if r["type"] == "run_start")
+    assert isinstance(start7["run_id"], str)
+    # the registry fixture's row types validate under the SAME schema
+    # (runs.jsonl shares the telemetry validator — by construction)
+    reg = telemetry.read_jsonl(os.path.join(FIX, "registry_v7.jsonl"))
+    assert {r["type"] for r in reg} == {"run_begin", "run_final"}
+    assert any(r.get("unhealthy_lanes") for r in reg
+               if r["type"] == "run_final")
+    # alerts older than v7 must reject (version-gated record type)
+    import pytest
+    with pytest.raises(ValueError, match="unknown record type"):
+        telemetry.validate_record(dict(alerts[0], v=6))

@@ -1,0 +1,214 @@
+"""Run registry (fdtd3d_tpu/registry.py): the append-only fleet index.
+
+Load-bearing claims (ISSUE 13 tentpole piece 1):
+
+* with ``FDTD3D_RUN_REGISTRY`` set, a run appends exactly one
+  ``run_begin`` (status running) and one ``run_final`` row, both
+  schema-v7-valid, via single atomic O_APPEND writes;
+* the ``run_id`` is stamped into the telemetry ``run_start`` AND the
+  checkpoint metadata, so streams and snapshots join the index;
+* the ``exec_key_comparable`` digest is stable across runs of the
+  same scenario (the fleet's scenario-identity join key);
+* status derivation: completed / failed (exception or unrecovered
+  non-finite) / recovered (recovery events or isolated lanes);
+* supervisor sim-swaps never double-register (suppress + transfer);
+* the knob unset is a true no-op.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import io, registry, telemetry
+from fdtd3d_tpu.config import (OutputConfig, PmlConfig,
+                               PointSourceConfig, SimConfig)
+from fdtd3d_tpu.sim import Simulation
+
+
+def _cfg(tmp_path, **out_kw):
+    out_kw.setdefault("telemetry_path", str(tmp_path / "t.jsonl"))
+    return SimConfig(
+        scheme="3D", size=(12, 12, 12), time_steps=8, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(3, 3, 3)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(6, 6, 6)),
+        output=OutputConfig(save_dir=str(tmp_path / "out"), **out_kw))
+
+
+def test_no_registry_without_knob(tmp_path, monkeypatch):
+    monkeypatch.delenv("FDTD3D_RUN_REGISTRY", raising=False)
+    sim = Simulation(_cfg(tmp_path))
+    try:
+        assert sim.run_registry is None and sim.run_id is None
+        sim.advance(8)
+    finally:
+        sim.close()
+    recs = telemetry.read_jsonl(str(tmp_path / "t.jsonl"))
+    assert "run_id" not in recs[0]
+
+
+def test_registry_rows_and_joins(tmp_path, monkeypatch):
+    reg = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    sim = Simulation(_cfg(tmp_path))
+    try:
+        rid = sim.run_id
+        assert isinstance(rid, str) and rid
+        # begin row already on disk, status running
+        rows = registry.read(reg)
+        assert [r["type"] for r in rows] == ["run_begin"]
+        assert rows[0]["status"] == "running"
+        assert rows[0]["run_id"] == rid
+        assert rows[0]["grid"] == [12, 12, 12]
+        assert rows[0]["telemetry_path"] == str(tmp_path / "t.jsonl")
+        digest = rows[0]["exec_key_comparable"]
+        assert isinstance(digest, str) and len(digest) == 64
+        sim.advance(4)
+        sim.advance(4)
+    finally:
+        sim.close()
+    rows = registry.read(reg)  # validates every row (schema v7)
+    assert [r["type"] for r in rows] == ["run_begin", "run_final"]
+    final = rows[1]
+    assert final["status"] == "completed"
+    assert final["run_id"] == rid
+    assert final["steps"] == 8 and final["t"] == 8
+    assert final["recovery_events"]["total"] == 0
+    assert final["first_unhealthy_t"] is None
+    assert isinstance(final["compile_ms"], (int, float))
+    # joins: telemetry run_start + checkpoint meta carry the run_id
+    recs = telemetry.read_jsonl(str(tmp_path / "t.jsonl"))
+    assert recs[0]["run_id"] == rid
+    assert sim.extra_ckpt_meta["run_id"] == rid
+    # close() is idempotent: no duplicate final row
+    sim.close()
+    assert len(registry.read(reg)) == 2
+    # a second run of the SAME scenario shares the comparable digest
+    # (the scenario-identity join key) under a fresh run_id
+    sim2 = Simulation(_cfg(tmp_path))
+    try:
+        assert sim2.run_id != rid
+        sim2.advance(8)
+    finally:
+        sim2.close()
+    folded = registry.fold(registry.read(reg))
+    assert len(folded) == 2
+    assert folded[sim2.run_id]["exec_key_comparable"] == digest
+    assert all(r["status"] == "completed" for r in folded.values())
+
+
+def test_registry_failed_on_health_trip(tmp_path, monkeypatch):
+    reg = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    sim = Simulation(_cfg(tmp_path, check_finite=True))
+    try:
+        bad = np.full((12, 12, 12), np.nan, np.float32)
+        sim.set_field("Ez", bad)
+        with pytest.raises(FloatingPointError):
+            sim.advance(4)
+    finally:
+        sim.close()   # inside the test frame: no live exception here
+    # the sink recorded the unhealthy chunk -> unrecovered non-finite
+    # completion reads as failed
+    final = registry.read(reg)[-1]
+    assert final["type"] == "run_final"
+    assert final["status"] == "failed"
+    assert final["first_unhealthy_t"] == 4
+
+
+def test_registry_failed_when_exception_propagates(tmp_path,
+                                                   monkeypatch):
+    reg = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    sim = Simulation(_cfg(tmp_path, telemetry_path=None))
+    try:
+        raise RuntimeError("simulated driver crash")
+    except RuntimeError:
+        sim.close()   # the CLI-finally shape: close amid propagation
+    final = registry.read(reg)[-1]
+    assert final["status"] == "failed"
+
+
+def test_registry_recovered_from_recovery_events(tmp_path,
+                                                 monkeypatch):
+    """A run whose sink recorded recovery events folds to
+    'recovered' (the supervisor path emits these through the same
+    sink; the derivation is what's under test here — the full
+    supervised chain runs in tests/test_fleet_e2e.py)."""
+    reg = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    sim = Simulation(_cfg(tmp_path))
+    try:
+        sim.advance(8)
+        sim.telemetry.emit("rollback", t_failed=8, t_restored=0,
+                           source="initial-snapshot",
+                           reason="test", chip=None, host=None)
+    finally:
+        sim.close()
+    final = registry.read(reg)[-1]
+    assert final["status"] == "recovered"
+    assert final["recovery_events"]["rollback"] == 1
+
+
+def test_registry_without_telemetry_sink(tmp_path, monkeypatch):
+    reg = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    sim = Simulation(_cfg(tmp_path, telemetry_path=None))
+    try:
+        sim.advance(8)
+    finally:
+        sim.close()
+    final = registry.read(reg)[-1]
+    assert final["status"] == "completed"
+    assert final["t"] == 8
+
+
+def test_suppress_and_transfer(tmp_path, monkeypatch):
+    reg = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    with registry.suppress_registration():
+        sim = Simulation(_cfg(tmp_path, telemetry_path=None))
+    assert sim.run_registry is None
+    assert not os.path.exists(reg)
+    # transfer moves the handle + stamps (the supervisor swap shape)
+    sim_a = Simulation(_cfg(tmp_path, telemetry_path=None))
+    handle = sim_a.run_registry
+    assert handle is not None
+    with registry.suppress_registration():
+        sim_b = Simulation(_cfg(tmp_path, telemetry_path=None))
+    registry.transfer(sim_a, sim_b)
+    assert sim_a.run_registry is None
+    assert sim_b.run_registry is handle
+    assert sim_b.run_id == handle.run_id
+    assert sim_b.extra_ckpt_meta["run_id"] == handle.run_id
+    sim_b.close()
+    rows = registry.read(reg)
+    assert [r["type"] for r in rows] == ["run_begin", "run_final"]
+    sim_a.close()  # no handle anymore: must not write a second final
+    assert len(registry.read(reg)) == 2
+
+
+def test_atomic_append_whole_lines(tmp_path):
+    path = str(tmp_path / "idx.jsonl")
+    io.atomic_append(path, json.dumps({"a": 1}) + "\n")
+    io.atomic_append(path, json.dumps({"b": 2}) + "\n")
+    lines = open(path).read().splitlines()
+    assert [json.loads(ln) for ln in lines] == [{"a": 1}, {"b": 2}]
+
+
+def test_fold_last_status_wins():
+    rows = [
+        {"v": 7, "type": "run_begin", "run_id": "x",
+         "status": "running", "kind": "cli", "wall_time": "w",
+         "git_sha": "s", "platform": "cpu", "grid": [4, 4, 4]},
+        {"v": 7, "type": "run_final", "run_id": "x",
+         "status": "recovered", "t": 8, "steps": 8, "wall_s": 0.1,
+         "mcells_per_s": 1.0},
+    ]
+    folded = registry.fold(rows)
+    assert folded["x"]["status"] == "recovered"
+    assert folded["x"]["grid"] == [4, 4, 4]   # begin fields survive
+    assert folded["x"]["kind"] == "cli"

@@ -1,0 +1,189 @@
+"""SLO rules engine (fdtd3d_tpu/slo.py): declarative objectives over
+telemetry streams, with explicit OK / VIOLATION / INCONCLUSIVE /
+SKIPPED verdicts and schema-v7 alert records for every firing rule.
+"""
+
+import pytest
+
+from fdtd3d_tpu import slo, telemetry
+
+
+def _start(**kw):
+    rec = {"v": 7, "type": "run_start", "wall_time": "w",
+           "git_sha": "s", "jax_version": "j", "platform": "cpu",
+           "device_kind": "cpu", "hbm_gbps": None}
+    rec.update(kw)
+    return rec
+
+
+def _chunk(chunk, t, steps=4, wall=0.01, mcps=5.0, finite=True):
+    return {"v": 7, "type": "chunk", "chunk": chunk, "t": t,
+            "steps": steps, "wall_s": wall, "mcells_per_s": mcps,
+            "energy": 1.0, "div_l2": 0.1, "div_linf": 0.2,
+            "max_e": 0.1, "max_h": 0.1, "finite": finite,
+            "vmem_rung": 0}
+
+
+def _end(t=8, steps=8, mcps=5.0, **kw):
+    rec = {"v": 7, "type": "run_end", "t": t, "steps": steps,
+           "wall_s": 0.02, "mcells_per_s": mcps,
+           "first_unhealthy_t": None}
+    rec.update(kw)
+    return rec
+
+
+def _rule(kind, threshold, rid=None):
+    return [slo.SloRule(rid or kind.replace("_", "-"), kind,
+                       threshold)]
+
+
+def _one(run, rules, context=None):
+    out = slo.evaluate_run(run, rules=rules, context=context)
+    assert len(out["results"]) == 1
+    return out["results"][0], out["status"]
+
+
+def test_unknown_rule_kind_is_a_named_error():
+    with pytest.raises(ValueError, match="unknown SLO rule kind"):
+        slo.SloRule("x", "nope", 1.0)
+    with pytest.raises(ValueError, match="missing"):
+        slo.rules_from_json([{"id": "x", "kind": "recovery_rate"}])
+
+
+def test_chunk_wall_p95():
+    run = [_start(), _chunk(1, 4, wall=0.01), _chunk(2, 8, wall=5.0),
+           _end()]
+    res, status = _one(run, _rule("chunk_wall_p95", 1.0))
+    assert res["status"] == "VIOLATION" and status == "VIOLATION"
+    assert res["value"] > 1.0
+    res, status = _one(run, _rule("chunk_wall_p95", 10.0))
+    assert res["status"] == "OK" and status == "OK"
+
+
+def test_unhealthy_lane_fraction_names_lanes():
+    def lane(chunk, t, lane, finite):
+        return {"v": 7, "type": "batch_lane", "chunk": chunk, "t": t,
+                "lane": lane, "energy": None if not finite else 1.0,
+                "div_l2": None if not finite else 0.1,
+                "div_linf": None if not finite else 0.1,
+                "max_e": None if not finite else 0.1,
+                "max_h": None if not finite else 0.1,
+                "finite": finite}
+    run = [_start(batch=3),
+           lane(1, 4, 0, True), lane(1, 4, 1, True),
+           lane(1, 4, 2, True),
+           lane(2, 8, 0, True), lane(2, 8, 1, False),
+           lane(2, 8, 2, True), _end()]
+    res, status = _one(run, _rule("unhealthy_lane_fraction", 0.0))
+    assert res["status"] == "VIOLATION"
+    assert "[1]" in res["message"]
+    assert res["window"] == [8, 8]
+    # threshold above the fraction: OK
+    res, _ = _one(run, _rule("unhealthy_lane_fraction", 0.5))
+    assert res["status"] == "OK"
+    # not a batch: SKIPPED, never a silent pass of nothing
+    res, _ = _one([_start(), _chunk(1, 4), _end()],
+                  _rule("unhealthy_lane_fraction", 0.0))
+    assert res["status"] == "SKIPPED"
+
+
+def test_recovery_rate():
+    retry = {"v": 7, "type": "retry", "t": 4, "attempt": 1,
+             "delay_s": 0.0, "error": "x", "chip": None, "host": None}
+    run = [_start(), _chunk(1, 4), retry, _chunk(2, 8), _end()]
+    res, _ = _one(run, _rule("recovery_rate", 5.0))
+    assert res["status"] == "VIOLATION"     # 125/kstep
+    res, _ = _one(run, _rule("recovery_rate", 200.0))
+    assert res["status"] == "OK"
+
+
+def test_straggler_ratio_and_diverged_chip():
+    imb = {"v": 7, "type": "imbalance", "chunk": 1, "t": 4,
+           "metric": "energy", "max": 3.0, "mean": 1.0, "ratio": 3.0,
+           "argmax": 5, "n_chips": 8}
+    run = [_start(), _chunk(1, 4), imb, _end()]
+    res, _ = _one(run, _rule("straggler_ratio", 2.0))
+    assert res["status"] == "VIOLATION" and "chip 5" in res["message"]
+    res, _ = _one(run, _rule("straggler_ratio", 4.0))
+    assert res["status"] == "OK"
+    # a diverged chip fires regardless of any ratio threshold
+    dead = dict(imb, ratio=None, nonfinite_chips=[2])
+    run = [_start(), _chunk(1, 4), dead, _end()]
+    res, _ = _one(run, _rule("straggler_ratio", 1e9))
+    assert res["status"] == "VIOLATION" and "[2]" in res["message"]
+
+
+def test_throughput_floor_modes():
+    run = [_start(step_kind="jnp"), _chunk(1, 4), _chunk(2, 8),
+           _end(mcps=5.0)]
+    # absolute floor
+    res, _ = _one(run, _rule("throughput_floor", 0.5),
+                  context={"min_mcells_per_s": 10.0})
+    assert res["status"] == "VIOLATION"
+    res, _ = _one(run, _rule("throughput_floor", 0.5),
+                  context={"min_mcells_per_s": 1.0})
+    assert res["status"] == "OK"
+    # BENCH_BEST reference on a CPU run: inconclusive, never a
+    # silent pass and never a false regression (the sentinel rule)
+    res, status = _one(run, _rule("throughput_floor", 0.5),
+                       context={"bench_best": {"jnp_mcells": 100.0}})
+    assert res["status"] == "INCONCLUSIVE"
+    assert status == "INCONCLUSIVE"
+    # on-TPU provenance gates against the matching path key
+    tpu = [_start(platform="tpu", step_kind="jnp"), _chunk(1, 4),
+           _end(mcps=5.0)]
+    res, _ = _one(tpu, _rule("throughput_floor", 0.5),
+                  context={"bench_best": {"jnp_mcells": 100.0}})
+    assert res["status"] == "VIOLATION"   # 5 < 0.5*100
+    assert res["threshold"] == 50.0
+    # no floor configured at all: SKIPPED with the reason named
+    res, _ = _one(run, _rule("throughput_floor", 0.5))
+    assert res["status"] == "SKIPPED" and "floor" in res["message"]
+
+
+def test_compile_budget_equal_key():
+    run = [_start(), _chunk(1, 4), _end(compile_ms=1000.0)]
+    # absolute budget
+    res, _ = _one(run, _rule("compile_budget", 1.25),
+                  context={"compile_budget_ms": 500.0})
+    assert res["status"] == "VIOLATION"
+    # equal-key reference: 1000 > 1.25 * 700
+    ctx = {"compile_refs": {"dig": 700.0},
+           "exec_key_comparable": "dig"}
+    res, _ = _one(run, _rule("compile_budget", 1.25), context=ctx)
+    assert res["status"] == "VIOLATION"
+    ctx["compile_refs"] = {"dig": 900.0}
+    res, _ = _one(run, _rule("compile_budget", 1.25), context=ctx)
+    assert res["status"] == "OK"
+    # references exist but none at this key: INCONCLUSIVE (compile
+    # cost only compares at equal comparable key)
+    ctx = {"compile_refs": {"other": 1.0},
+           "exec_key_comparable": "dig"}
+    res, _ = _one(run, _rule("compile_budget", 1.25), context=ctx)
+    assert res["status"] == "INCONCLUSIVE"
+
+
+def test_alerts_validate_and_overall_status():
+    imb = {"v": 7, "type": "imbalance", "chunk": 1, "t": 4,
+           "metric": "energy", "max": 3.0, "mean": 1.0, "ratio": 3.0,
+           "argmax": 5, "n_chips": 8}
+    run = [_start(), _chunk(1, 4, wall=100.0), imb, _end()]
+    summary = slo.evaluate_run(run)   # default rule set
+    assert summary["status"] == "VIOLATION"
+    alerts = slo.alerts_for(summary["results"])
+    assert {a["rule"] for a in alerts} >= {"chunk-wall-p95",
+                                           "straggler-ratio"}
+    for a in alerts:
+        telemetry.validate_record(a)   # schema-v7 alert records
+        assert a["t_end"] >= a["t_start"]
+    # a stream with nothing gateable is INCONCLUSIVE, not a pass
+    empty = [_start()]
+    assert slo.evaluate_run(empty)["status"] == "INCONCLUSIVE"
+
+
+def test_evaluate_stream_splits_runs():
+    records = [_start(), _chunk(1, 4), _end(),
+               _start(), _chunk(1, 4, wall=100.0), _end()]
+    out = slo.evaluate_stream(records,
+                              rules=_rule("chunk_wall_p95", 1.0))
+    assert [s["status"] for s in out] == ["OK", "VIOLATION"]

@@ -70,7 +70,8 @@ def test_legacy_tools_refuse_without_flag(tool):
 
 def test_telemetry_report_runs_on_fixtures():
     for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl",
-                    "telemetry_v5.jsonl", "telemetry_v6.jsonl"):
+                    "telemetry_v5.jsonl", "telemetry_v6.jsonl",
+                    "telemetry_v7.jsonl"):
         proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
                      os.path.join(FIX, fixture), "--json"])
         assert proc.returncode == 0, (fixture, proc.stderr)
@@ -88,6 +89,64 @@ def test_telemetry_report_runs_on_fixtures():
     assert "batch: 3 lanes" in proc.stdout
     assert "lane 1" in proc.stdout
     assert "compile:" in proc.stdout
+    # the v7 text form prints the SLO alert records (rule id +
+    # firing window) in the survived-events summary
+    proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
+                 os.path.join(FIX, "telemetry_v7.jsonl")])
+    assert proc.returncode == 0, proc.stderr
+    assert "ALERT [straggler-ratio] fired over (8, 8]" in proc.stdout
+    assert "2 SLO alert(s) fired" in proc.stdout
+
+
+def test_slo_gate_runs_on_fixtures(tmp_path):
+    """tools/slo_gate.py: exit-code contract on the fixture corpus —
+    the v7 stream (straggler ratio 3.0, one retry in 8 steps) fires
+    VIOLATION/exit 1; the quiet v2 stream gates clean."""
+    tool = os.path.join(TOOLS, "slo_gate.py")
+    proc = _run([tool, os.path.join(FIX, "telemetry_v7.jsonl")])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "straggler-ratio" in proc.stdout
+    assert "VIOLATION" in proc.stdout
+    proc = _run([tool, os.path.join(FIX, "telemetry_v2.jsonl")])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # --json round-trips; every rule row carries an explicit status
+    proc = _run([tool, os.path.join(FIX, "telemetry_v7.jsonl"),
+                 "--json"])
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert out[0]["status"] == "VIOLATION"
+    assert all(r["status"] in ("OK", "VIOLATION", "INCONCLUSIVE",
+                               "SKIPPED")
+               for s in out for r in s["results"])
+
+
+def test_fleet_report_runs_on_fixture():
+    """tools/fleet_report.py: fold the registry fixture + join the
+    telemetry fixtures it points at (relative paths resolve against
+    the registry's directory)."""
+    tool = os.path.join(TOOLS, "fleet_report.py")
+    proc = _run([tool, os.path.join(FIX, "registry_v7.jsonl"),
+                 "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rollup = json.loads(proc.stdout)
+    fleet = rollup["fleet"]
+    assert fleet["by_status"] == {"recovered": 2, "running": 1}
+    assert {"run": "r20260804T110302-4243-0-1b2c", "lane": 1,
+            "first_unhealthy_t": 8} in fleet["unhealthy_tenants"]
+    assert any(a["rule"] == "straggler-ratio"
+               for a in fleet["alerts"])
+    assert {s["chip"] for s in fleet["straggler_leaderboard"]} == \
+        {0, 5}
+    assert fleet["run_mcells_per_s"]["max"] == 4.8
+    # text form names the tenant and the straggler
+    proc = _run([tool, os.path.join(FIX, "registry_v7.jsonl")])
+    assert proc.returncode == 0, proc.stderr
+    assert "UNHEALTHY TENANT" in proc.stdout
+    assert "straggler chip" in proc.stdout
+    # a missing registry is a friendly exit 1
+    proc = _run([tool, os.path.join(FIX, "nope.jsonl")])
+    assert proc.returncode == 1
+    assert "no such registry" in proc.stderr
 
 
 def test_ckpt_inspect_runs_and_verifies(tmp_path):

@@ -32,8 +32,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from fdtd3d_tpu import io  # noqa: E402
 from fdtd3d_tpu.log import report, warn  # noqa: E402
 
+# run_id (round 16): the run-registry stamp (fdtd3d_tpu/registry.py,
+# FDTD3D_RUN_REGISTRY) Simulation writes into extra_ckpt_meta — a
+# snapshot is traceable back to its runs.jsonl row and telemetry
+# stream; absent on registry-less runs.
 META_KEYS = ("t", "scheme", "size", "topology", "psi_slabs", "dtype",
-             "step_kind", "state_keys", "supervisor")
+             "step_kind", "state_keys", "supervisor", "run_id")
 
 
 def inspect(path: str, verify: bool = False) -> dict:
@@ -120,6 +124,10 @@ def format_text(out: dict) -> str:
             f"restore reshards onto any valid plan)")
         if meta.get("state_keys") is not None:
             lines.append(f"  carry family: {meta['state_keys']}")
+        if meta.get("run_id"):
+            lines.append(f"  run_id: {meta['run_id']}  (run-registry "
+                         f"stamp — join against runs.jsonl with "
+                         f"tools/fleet_report.py)")
         sup = meta.get("supervisor")
         if sup:
             lines.append(

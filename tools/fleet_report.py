@@ -1,0 +1,282 @@
+"""Fleet monitor: join the run registry against N telemetry streams.
+
+Usage:
+    python tools/fleet_report.py RUNS.jsonl [--json]
+        [--follow [--interval S]]
+
+Reads the append-only run registry (``FDTD3D_RUN_REGISTRY`` →
+``runs.jsonl``, fdtd3d_tpu/registry.py), folds the ``run_begin``/
+``run_final`` rows by ``run_id``, joins each run's telemetry stream
+(the ``telemetry_path`` artifact pointer; relative paths resolve
+against the registry file's directory), and prints the fleet rollup
+ROADMAP items 2c/3's queue and scheduler will select against:
+
+* run table: status (running/completed/failed/recovered), kind,
+  step kind, topology, throughput;
+* cross-run throughput percentiles (the shared
+  ``telemetry.pct_summary`` — fleet and per-run numbers cannot
+  drift);
+* per-tenant/lane health table: every batch lane that went
+  non-finite, named by (run_id, lane) with its first-bad-step bound;
+* AOT-cache hit rate over the fleet (compile amortization actually
+  amortizing?);
+* recovery-event rate per 1000 steps, and fired SLO alerts by rule;
+* straggler-chip leaderboard (which chip ids keep winning the
+  per-chunk imbalance argmax across runs).
+
+``--json`` emits the rollup as one JSON object (deterministic — the
+tests' surface); ``--follow`` tails the registry live (re-folding
+when the file grows; Ctrl-C exits cleanly).
+
+Exit codes: 0 = report produced; 1 = registry unreadable; 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
+
+from fdtd3d_tpu import registry as run_registry  # noqa: E402
+from fdtd3d_tpu import telemetry  # noqa: E402
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+
+def _resolve(base_dir: str, path: Optional[str]) -> Optional[str]:
+    if not path:
+        return None
+    if not os.path.isabs(path):
+        path = os.path.join(base_dir, path)
+    return path if os.path.exists(path) else None
+
+
+def _stream_facts(path: str) -> Dict[str, Any]:
+    """One telemetry stream -> the per-run facts the rollup joins:
+    lane verdicts, recovery events, alerts, straggler argmax tally."""
+    out: Dict[str, Any] = {"lanes": [], "recoveries": 0,
+                           "alerts": [], "stragglers": {},
+                           "chunk_rates": []}
+    try:
+        records = telemetry.read_jsonl(path)
+    except (OSError, ValueError) as exc:
+        out["error"] = f"unreadable telemetry ({exc})"
+        return out
+    bad_lanes: Dict[int, int] = {}
+    for rec in records:
+        rtype = rec["type"]
+        if rtype == "batch_lane" and not rec["finite"] \
+                and rec["lane"] not in bad_lanes:
+            bad_lanes[rec["lane"]] = rec["t"]
+        elif rtype in telemetry.RECOVERY_TYPES:
+            out["recoveries"] += 1
+        elif rtype == "alert":
+            out["alerts"].append(
+                {"rule": rec["rule"],
+                 "window": [rec["t_start"], rec["t_end"]],
+                 "message": rec["message"]})
+        elif rtype == "imbalance":
+            chip = str(rec["argmax"])
+            out["stragglers"][chip] = \
+                out["stragglers"].get(chip, 0) + 1
+        elif rtype == "chunk":
+            out["chunk_rates"].append(rec["mcells_per_s"])
+    out["lanes"] = [{"lane": lane, "first_unhealthy_t": t}
+                    for lane, t in sorted(bad_lanes.items())]
+    return out
+
+
+def build_rollup(registry_path: str) -> Dict[str, Any]:
+    """The one-shot fleet snapshot (``--json`` emits it verbatim)."""
+    rows = run_registry.read(registry_path)
+    runs = run_registry.fold(rows)
+    base_dir = os.path.dirname(os.path.abspath(registry_path))
+
+    by_status: Dict[str, int] = {}
+    run_table: Dict[str, Dict[str, Any]] = {}
+    run_rates: List[float] = []
+    tenants: List[Dict[str, Any]] = []
+    alerts: List[Dict[str, Any]] = []
+    stragglers: Dict[str, int] = {}
+    recoveries = 0
+    total_ksteps = 0.0
+    cache_hits = cache_misses = 0
+
+    for rid, row in sorted(runs.items()):
+        status = row.get("status", "running")
+        by_status[status] = by_status.get(status, 0) + 1
+        entry: Dict[str, Any] = {
+            "status": status,
+            "kind": row.get("kind"),
+            "step_kind": row.get("step_kind"),
+            "topology": row.get("topology"),
+            "batch": row.get("batch"),
+            "mcells_per_s": row.get("mcells_per_s"),
+            "steps": row.get("steps"),
+            "exec_key_comparable": row.get("exec_key_comparable"),
+        }
+        if isinstance(row.get("mcells_per_s"), (int, float)) \
+                and row["mcells_per_s"] > 0:
+            run_rates.append(float(row["mcells_per_s"]))
+        total_ksteps += float(row.get("steps") or 0) / 1000.0
+        rec_ev = row.get("recovery_events")
+        rec_from_registry = None
+        if isinstance(rec_ev, dict):
+            rec_from_registry = int(rec_ev.get("total") or 0)
+            recoveries += rec_from_registry
+        cache = row.get("aot_cache")
+        if isinstance(cache, dict):
+            cache_hits += int(cache.get("hits") or 0) \
+                + int(cache.get("disk_hits") or 0)
+            cache_misses += int(cache.get("misses") or 0)
+        for pair in row.get("unhealthy_lanes") or []:
+            if isinstance(pair, (list, tuple)) and pair:
+                tenants.append({"run": rid, "lane": int(pair[0]),
+                                "first_unhealthy_t":
+                                    (pair[1] if len(pair) > 1
+                                     else None)})
+        tpath = _resolve(base_dir, row.get("telemetry_path"))
+        if tpath is not None:
+            facts = _stream_facts(tpath)
+            entry["telemetry"] = os.path.basename(tpath)
+            if facts.get("error"):
+                entry["telemetry_error"] = facts["error"]
+            for lane in facts["lanes"]:
+                t = {"run": rid, **lane}
+                if t not in tenants:
+                    tenants.append(t)
+            for a in facts["alerts"]:
+                alerts.append({"run": rid, **a})
+            for chip, n in facts["stragglers"].items():
+                stragglers[chip] = stragglers.get(chip, 0) + n
+            if facts["chunk_rates"]:
+                entry["chunk_mcells_per_s"] = telemetry.pct_summary(
+                    facts["chunk_rates"])
+            if rec_from_registry is None and facts["recoveries"]:
+                # a run killed without close() has no run_final
+                # rollup — its stream's recovery records are exactly
+                # what a monitor most needs to still count
+                entry["recovery_events_from_stream"] = \
+                    facts["recoveries"]
+                recoveries += facts["recoveries"]
+        run_table[rid] = entry
+
+    leaderboard = [{"chip": int(chip), "chunks_worst": n}
+                   for chip, n in sorted(stragglers.items(),
+                                         key=lambda kv: -kv[1])]
+    total_cache = cache_hits + cache_misses
+    return {
+        "registry": registry_path,
+        "runs": run_table,
+        "fleet": {
+            "n_runs": len(runs),
+            "by_status": dict(sorted(by_status.items())),
+            "run_mcells_per_s": telemetry.pct_summary(run_rates),
+            "unhealthy_tenants": tenants,
+            "alerts": alerts,
+            "recovery_events": recoveries,
+            "recovery_events_per_kstep":
+                (recoveries / total_ksteps) if total_ksteps > 0
+                else 0.0,
+            "aot_cache": {
+                "hits": cache_hits, "misses": cache_misses,
+                "hit_rate": (cache_hits / total_cache)
+                if total_cache else None,
+            },
+            "straggler_leaderboard": leaderboard,
+        },
+    }
+
+
+def format_text(rollup: Dict[str, Any]) -> str:
+    fleet = rollup["fleet"]
+    lines = [f"fleet: {fleet['n_runs']} run(s) "
+             + " ".join(f"{k}={v}" for k, v in
+                        fleet["by_status"].items())]
+    p = fleet["run_mcells_per_s"]
+    lines.append(f"  throughput Mcells/s  p50 {p['p50']:.1f}  "
+                 f"p95 {p['p95']:.1f}  max {p['max']:.1f}")
+    cache = fleet["aot_cache"]
+    if cache["hit_rate"] is not None:
+        lines.append(f"  aot cache: {cache['hits']} hits / "
+                     f"{cache['misses']} misses "
+                     f"({cache['hit_rate']:.0%} hit rate)")
+    lines.append(f"  recovery events: {fleet['recovery_events']} "
+                 f"({fleet['recovery_events_per_kstep']:.2f}/kstep)")
+    for t in fleet["unhealthy_tenants"]:
+        lines.append(f"  UNHEALTHY TENANT: run {t['run']} lane "
+                     f"{t['lane']} (first bad step <= "
+                     f"{t['first_unhealthy_t']})")
+    for a in fleet["alerts"]:
+        lines.append(f"  ALERT [{a['rule']}] run {a['run']} over "
+                     f"({a['window'][0]}, {a['window'][1]}]: "
+                     f"{a['message']}")
+    for s in fleet["straggler_leaderboard"][:5]:
+        lines.append(f"  straggler chip {s['chip']}: worst in "
+                     f"{s['chunks_worst']} chunk(s)")
+    for rid, row in rollup["runs"].items():
+        lines.append(
+            f"  run {rid}: {row['status']:9s} kind={row['kind']} "
+            f"step={row.get('step_kind')} topo={row.get('topology')}"
+            + (f" batch={row['batch']}" if row.get("batch") else "")
+            + (f" {row['mcells_per_s']:.1f} Mcells/s"
+               if isinstance(row.get("mcells_per_s"), (int, float))
+               and row["mcells_per_s"] else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fold the run registry + telemetry streams into "
+                    "a fleet rollup (throughput percentiles, tenant "
+                    "health, cache hit rate, straggler leaderboard)")
+    ap.add_argument("registry", help="runs.jsonl (FDTD3D_RUN_REGISTRY)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rollup as one JSON object")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail mode: re-fold and re-print whenever "
+                         "the registry grows (Ctrl-C exits)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll interval, seconds")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.registry):
+        warn(f"{args.registry}: no such registry (set "
+             f"FDTD3D_RUN_REGISTRY to start one)")
+        return 1
+    try:
+        rollup = build_rollup(args.registry)
+    except ValueError as exc:
+        warn(f"{args.registry}: {exc}")
+        return 1
+    if args.json:
+        report(json.dumps(rollup, indent=1))
+    else:
+        report(format_text(rollup))
+    if not args.follow:
+        return 0
+    last_size = os.path.getsize(args.registry)
+    try:
+        while True:
+            time.sleep(args.interval)
+            try:
+                size = os.path.getsize(args.registry)
+            except OSError:
+                continue
+            if size == last_size:
+                continue
+            last_size = size
+            rollup = build_rollup(args.registry)
+            report("")
+            report(format_text(rollup))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+"""SLO gate: verdict a telemetry stream against the declarative rules.
+
+Usage:
+    python tools/slo_gate.py TELEMETRY.jsonl [--best BENCH_BEST.json]
+        [--rules RULES.json] [--registry RUNS.jsonl]
+        [--floor-mcells X] [--compile-budget-ms X]
+        [--emit-alerts] [--json]
+
+Evaluates every run in the (validated) telemetry JSONL against the
+rule set of ``fdtd3d_tpu/slo.py`` (defaults; ``--rules`` overrides
+with a JSON list of ``{"id", "kind", "threshold"}``), printing a
+perf-sentinel-style verdict table per run. Exit codes — never a
+silent pass:
+
+* 0 — every run OK (or rules SKIPPED as not applicable; each row
+  still prints its status)
+* 1 — any rule VIOLATION in any run (the gate fired)
+* 0 with a loud stderr warning — INCONCLUSIVE (a rule could not
+  judge: platform mismatch vs the BENCH_BEST reference, no equal-key
+  compile reference); like the perf sentinel, an unjudgeable window
+  must not cry wolf, and must not pretend it judged either
+* 2 — usage error (argparse)
+
+``--registry RUNS.jsonl`` joins the stream's ``run_id`` against the
+run-registry rows (FDTD3D_RUN_REGISTRY) to build the equal-key
+compile references the ``compile-budget`` rule gates against (best
+completed-run ``compile_ms`` per comparable ExecKey digest).
+``--emit-alerts`` appends one schema-v7 ``alert`` record per firing
+rule to the INPUT stream (atomic append), so
+``tools/telemetry_report.py`` and the fleet monitor surface them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
+
+from fdtd3d_tpu import slo  # noqa: E402
+from fdtd3d_tpu import telemetry  # noqa: E402
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+
+def compile_refs_from_registry(folded) -> dict:
+    """comparable ExecKey digest -> best (smallest) compile_ms over
+    the registry's completed/recovered runs (``folded`` is the
+    registry.fold output) — the equal-key references the
+    compile-budget rule gates against."""
+    refs: dict = {}
+    for row in folded.values():
+        dig = row.get("exec_key_comparable")
+        cm = row.get("compile_ms")
+        if not dig or not isinstance(cm, (int, float)) or cm <= 0:
+            continue
+        if row.get("status") not in ("completed", "recovered"):
+            continue
+        if dig not in refs or cm < refs[dig]:
+            refs[dig] = float(cm)
+    return refs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="evaluate SLO rules over a flight-recorder JSONL "
+                    "(exit 1 on any violation; inconclusive is "
+                    "warned, never silent)")
+    ap.add_argument("path", help="telemetry JSONL (schema-validated)")
+    ap.add_argument("--best", default=None,
+                    help="BENCH_BEST.json throughput reference for "
+                         "the throughput-floor rule")
+    ap.add_argument("--rules", default=None,
+                    help="rules JSON: a list of {id, kind, threshold} "
+                         "(default: fdtd3d_tpu.slo.DEFAULT_RULES)")
+    ap.add_argument("--registry", default=None,
+                    help="runs.jsonl run registry: joins run_id to "
+                         "build equal-key compile references for the "
+                         "compile-budget rule")
+    ap.add_argument("--floor-mcells", type=float, default=None,
+                    help="absolute throughput floor (Mcells/s) "
+                         "instead of the BENCH_BEST fraction")
+    ap.add_argument("--compile-budget-ms", type=float, default=None,
+                    help="absolute compile-wall budget (ms) instead "
+                         "of the equal-key reference")
+    ap.add_argument("--emit-alerts", action="store_true",
+                    help="append one schema-v7 alert record per "
+                         "firing rule to the input stream")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the per-run verdicts as one JSON "
+                         "array")
+    args = ap.parse_args(argv)
+
+    records = telemetry.read_jsonl(args.path)  # validates
+    rules = slo.DEFAULT_RULES
+    if args.rules:
+        with open(args.rules) as f:
+            rules = slo.rules_from_json(json.load(f))
+
+    context: dict = {}
+    if args.floor_mcells is not None:
+        context["min_mcells_per_s"] = args.floor_mcells
+    if args.compile_budget_ms is not None:
+        context["compile_budget_ms"] = args.compile_budget_ms
+    if args.best:
+        try:
+            with open(args.best) as f:
+                context["bench_best"] = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            warn(f"slo_gate: BENCH_BEST reference unreadable "
+                 f"({exc}); throughput-floor will be inconclusive "
+                 f"or skipped")
+    folded = None
+    if args.registry:
+        from fdtd3d_tpu import registry as _registry
+        folded = _registry.fold(_registry.read(args.registry))
+        context["compile_refs"] = compile_refs_from_registry(folded)
+
+    runs = telemetry.split_runs(records)
+    summaries = []
+    for run in runs:
+        ctx = dict(context)
+        if folded is not None:
+            start = next((r for r in run
+                          if r["type"] == "run_start"), {})
+            row = folded.get(start.get("run_id")) or {}
+            if row.get("exec_key_comparable"):
+                ctx["exec_key_comparable"] = \
+                    row["exec_key_comparable"]
+        summaries.append(slo.evaluate_run(run, rules=rules,
+                                          context=ctx))
+
+    all_alerts = []
+    for summary in summaries:
+        all_alerts.extend(slo.alerts_for(summary["results"]))
+    if args.emit_alerts and all_alerts:
+        from fdtd3d_tpu.io import atomic_append
+        atomic_append(args.path, "".join(json.dumps(a) + "\n"
+                                         for a in all_alerts))
+        warn(f"slo_gate: appended {len(all_alerts)} alert record(s) "
+             f"to {args.path}")
+
+    if args.json:
+        report(slo.to_json(summaries))
+    else:
+        for i, summary in enumerate(summaries):
+            report(f"run {i + 1}: " + slo.format_results(summary))
+    violated = any(s["status"] == "VIOLATION" for s in summaries)
+    for summary in summaries:
+        for r in summary["results"]:
+            if r["status"] == "INCONCLUSIVE":
+                warn(f"slo_gate (inconclusive): {r['rule']}: "
+                     f"{r['message']}")
+    return 1 if violated else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

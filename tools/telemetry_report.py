@@ -19,6 +19,9 @@ bench stages append):
   retries, checkpoint rollbacks, kernel-ladder degrades and topology
   changes — how the run survived, not just whether it did — with the
   implicated chip/host named when the failure was attributable (v5)
+* SLO alerts (schema v7, fdtd3d_tpu/slo.py via tools/slo_gate.py
+  --emit-alerts): each firing rule's id, window and message, counted
+  beside the recovery events in the survived-events summary
 
 ``--json`` emits the same summary as one JSON object per run instead
 of text (for dashboards / the driver).
@@ -40,25 +43,11 @@ from fdtd3d_tpu import telemetry  # noqa: E402
 from fdtd3d_tpu.log import report  # noqa: E402
 
 
-def split_runs(records):
-    """Group a validated record list into runs at run_start markers."""
-    runs, cur = [], None
-    for rec in records:
-        if rec["type"] == "run_start":
-            if cur:
-                runs.append(cur)
-            cur = [rec]
-        else:
-            if cur is None:
-                cur = []  # tolerate a truncated head
-            cur.append(rec)
-    if cur:
-        runs.append(cur)
-    return runs
-
-
-def _pct(vals, q):
-    return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
+# the shared run splitter + percentile helper (fdtd3d_tpu/telemetry):
+# the SLO engine and tools/fleet_report.py consume the same two, so
+# "a run" and its percentiles mean one thing across every tool
+split_runs = telemetry.split_runs
+pct_summary = telemetry.pct_summary
 
 
 def summarize_run(run):
@@ -85,7 +74,13 @@ def summarize_run(run):
             "topology_changes": [r for r in run
                                  if r["type"] == "topology_change"],
         },
+        # SLO alerts (schema v7): rule id + firing window + message
+        "alerts": [r for r in run if r["type"] == "alert"],
     }
+    if start.get("run_id"):
+        # the run-registry join key (v7): trace this stream back to
+        # its runs.jsonl row (tools/fleet_report.py)
+        out["run_id"] = start["run_id"]
     # compile-amortization lane (schema v6 optional keys): the run's
     # compile wall + whether the exec cache was warm at start
     if end is not None and end.get("compile_ms") is not None:
@@ -132,11 +127,8 @@ def summarize_run(run):
     rates = [c["mcells_per_s"] for c in chunks]
     out["steps"] = sum(c["steps"] for c in chunks)
     out["wall_s"] = sum(walls)
-    out["wall_s_per_chunk"] = {"p50": _pct(walls, 50),
-                               "p95": _pct(walls, 95),
-                               "max": float(max(walls))}
-    out["mcells_per_s"] = {"p50": _pct(rates, 50), "p95": _pct(rates, 95),
-                           "max": float(max(rates))}
+    out["wall_s_per_chunk"] = pct_summary(walls)
+    out["mcells_per_s"] = pct_summary(rates)
     half = len(rates) // 2
     if half >= 1:
         first = float(np.mean(rates[:half]))
@@ -266,13 +258,20 @@ def format_text(summaries) -> str:
                          f"{tuple(r['old_topology'])} -> "
                          f"{tuple(r['new_topology'])}{_at(r)}: "
                          f"{r['reason']}")
+        for a in s.get("alerts", []):
+            lines.append(f"  ALERT [{a['rule']}] fired over "
+                         f"({a['t_start']}, {a['t_end']}]: "
+                         f"{a['message']}")
         n_rec = sum(len(v) for v in rec.values())
-        if n_rec:
+        n_alerts = len(s.get("alerts", []))
+        if n_rec or n_alerts:
             lines.append(f"  survived {n_rec} recovery events "
                          f"(retries {len(rec['retries'])}, rollbacks "
                          f"{len(rec['rollbacks'])}, degrades "
                          f"{len(rec['degrades'])}, topology changes "
-                         f"{len(rec.get('topology_changes', []))})")
+                         f"{len(rec.get('topology_changes', []))})"
+                         + (f", {n_alerts} SLO alert(s) fired"
+                            if n_alerts else ""))
     return "\n".join(lines)
 
 
