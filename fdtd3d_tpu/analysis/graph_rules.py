@@ -45,11 +45,20 @@ _KERNEL_TARGETS: Tuple[Tuple[str, str, str], ...] = (
     ("pallas_fused",     "fdtd3d_tpu.ops.pallas_fused",    "make_fused_eh_step"),
     ("pallas_packed",    "fdtd3d_tpu.ops.pallas_packed",   "make_packed_eh_step"),
     ("pallas_packed_tb", "fdtd3d_tpu.ops.pallas_packed_tb", "make_packed_tb_step"),
+    # the round-14 widened sharded build: TFSF value-plane + tfofs +
+    # coefficient-grid + Drude-J operands all present alongside the
+    # depth-k ghost operands, so their donation structure is gated too
+    ("pallas_packed_tb_widened",
+     "fdtd3d_tpu.ops.pallas_packed_tb", "make_packed_tb_step"),
     ("pallas_packed_ds", "fdtd3d_tpu.ops.pallas_packed_ds", "make_packed_ds_step"),
 )
 
 
 def _target_config(label: str):
+    """-> (cfg, topology or None): the canonical config that engages
+    the labeled kernel build; a topology makes the capture a SHARDED
+    build (mesh axis NAMES only — constructing the pallas_call needs
+    no live mesh)."""
     from fdtd3d_tpu import costs
     from fdtd3d_tpu.config import (PmlConfig, PointSourceConfig,
                                    SimConfig)
@@ -61,11 +70,13 @@ def _target_config(label: str):
             courant_factor=0.4, wavelength=8e-3, use_pallas=True,
             pml=PmlConfig(size=(3, 3, 3)),
             point_source=PointSourceConfig(enabled=True, component="Ez",
-                                           position=(24, 8, 8)))
+                                           position=(24, 8, 8))), None
+    if label == "pallas_packed_tb_widened":
+        return costs.config_tb_widened(), (1, 2, 2)
     kind = label if label in costs.STEP_KINDS else "pallas"
     cfg = costs.config_for_kind(kind)
     import dataclasses
-    return dataclasses.replace(cfg, use_pallas=True)
+    return dataclasses.replace(cfg, use_pallas=True), None
 
 
 def _index_tuple(index_map, idx: Tuple[int, ...]) -> Tuple[int, ...]:
@@ -165,11 +176,14 @@ def check_pallas_capture(label: str, kw: Dict[str, Any]) -> List[str]:
     return problems
 
 
-def capture_kernel_calls(module, builder_name: str, static
+def capture_kernel_calls(module, builder_name: str, static,
+                         mesh_axes=None, mesh_shape=None
                          ) -> List[Dict[str, Any]]:
     """Build the kernel with ``pl.pallas_call`` spied, returning every
     captured call's kwargs (a builder may issue several calls — the
-    two-pass kernels build one per family)."""
+    two-pass kernels build one per family). ``mesh_axes``/
+    ``mesh_shape`` make it a SHARDED build (the widened-wedge
+    target)."""
     captured: List[Dict[str, Any]] = []
     pl = module.pl
     real_call = pl.pallas_call
@@ -180,7 +194,11 @@ def capture_kernel_calls(module, builder_name: str, static
 
     pl.pallas_call = spy
     try:
-        step = getattr(module, builder_name)(static)
+        if mesh_axes is not None:
+            step = getattr(module, builder_name)(static, mesh_axes,
+                                                 mesh_shape)
+        else:
+            step = getattr(module, builder_name)(static)
     finally:
         pl.pallas_call = real_call
     if step is None:
@@ -223,10 +241,20 @@ class DonationSafetyRule(Rule):
                             f"with a canonical config"))
         for label, modname, builder in _KERNEL_TARGETS:
             module = importlib.import_module(modname)
-            cfg = _target_config(label)
+            cfg, topo = _target_config(label)
             static = build_static(cfg)
+            mesh_axes = mesh_shape = None
+            if topo is not None:
+                import dataclasses
+
+                from fdtd3d_tpu.parallel.mesh import (mesh_axis_map,
+                                                      mesh_shape_map)
+                static = dataclasses.replace(static, topology=topo)
+                mesh_axes = mesh_axis_map(topo)
+                mesh_shape = mesh_shape_map(topo)
             try:
-                calls = capture_kernel_calls(module, builder, static)
+                calls = capture_kernel_calls(module, builder, static,
+                                             mesh_axes, mesh_shape)
             except RuntimeError as exc:
                 findings.append(Finding(
                     self.name, modname.replace(".", "/") + ".py", None,
@@ -325,24 +353,32 @@ class ScopeCoverageRule(Rule):
                 f"before jax initializes (tools/fdtd_lint.py does)")
         findings: List[Finding] = []
         stats: Dict[str, Any] = {}
-        for kind in costs.SHARDED_STEP_KINDS:
+        # the round-14 widened sharded tb path (TFSF wedge incident-
+        # line port + Drude-J ring + material-grid sub-blocks) traces
+        # as its own lane: new exchange/psum sites in the widened
+        # wedge must be mesh-scoped like every other collective
+        lanes = [(kind, costs.config_for_kind(kind, n=16, pml=2),
+                  kind) for kind in costs.SHARDED_STEP_KINDS]
+        lanes.append(("pallas_packed_tb_widened",
+                      costs.config_tb_widened(),
+                      "pallas_packed_tb"))
+        for label, cfg, kind in lanes:
             # pml=2 keeps the CPML slabs inside the 8-cell shards of a
             # 16^3 grid on (2,2,2) (solver.slab_axes needs
             # local_n > 2*(pml+1)) — the tests/test_comm_costs.py probe
-            cfg = costs.config_for_kind(kind, n=16, pml=2)
             _runner, closed, _static, _topo, _spc = costs.trace_chunk(
                 cfg, n_steps=8, kind=kind, topology=_SCOPE_TOPOLOGY)
             colls = collect_collectives(closed.jaxpr)
             unscoped = unscoped_collectives(colls)
-            stats[kind] = {"collectives": len(colls),
-                           "unscoped_collectives": len(unscoped)}
+            stats[label] = {"collectives": len(colls),
+                            "unscoped_collectives": len(unscoped)}
             for prim, sec, stack in unscoped:
                 want = ("the halo-exchange scope"
                         if prim == "ppermute"
                         else "a telemetry.GRAPH_SPANS scope")
                 findings.append(Finding(
                     self.name, "", None,
-                    f"step kind {kind!r} on {_SCOPE_TOPOLOGY}: "
+                    f"step kind {label!r} on {_SCOPE_TOPOLOGY}: "
                     f"{prim} does not carry {want} (attributed: "
                     f"{sec}; stack: "
                     f"{stack.strip('/')[:110] or '<empty>'}) — wrap "

@@ -842,13 +842,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         # engaged-path observability (VERDICT r2 item 7): which kernel
         # actually runs, its x-tile size, and the VMEM working set.
         line = f"step_kind={sim.step_kind}"
-        if sim.step_diag:
+        # a non-kernel diag (e.g. a jnp step's tb_fallback record) has
+        # no tile/VMEM rows — print what is actually there
+        if sim.step_diag and sim.step_diag.get("tile"):
             tiles = ",".join(f"{k}:{v}"
                              for k, v in sim.step_diag["tile"].items())
             vmem = ",".join(
                 f"{k}:{v / 1048576:.1f}MiB"
                 for k, v in sim.step_diag["vmem_block_bytes"].items())
             line += f" tile=[{tiles}] vmem_block=[{vmem}]"
+        if (sim.step_diag or {}).get("tb_fallback"):
+            line += (f" tb_fallback="
+                     f"{sim.step_diag['tb_fallback'].get('reason')}")
         log(line)
 
         # NTFF: resolve cadence defaults and build the collector (reference

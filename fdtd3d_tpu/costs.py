@@ -382,6 +382,30 @@ def config_for_kind(kind: str, n: int = 16, pml: int = 3,
         use_pallas=kind != "jnp")
 
 
+def config_tb_widened(n: int = 16, pml: int = 2, time_steps: int = 8):
+    """The round-14 WIDENED-scenario probe: TFSF plane-wave injection
+    plus an electric-Drude sphere — whose merged eps grids also
+    exercise the per-cell material-grid operands — in ONE config, so a
+    single trace covers all three operand classes the sharded
+    boundary-wedge pre-pass gained (incident-line port, J ring, tiled
+    coefficients). Temporal-block-eligible sharded or not; the
+    scope-coverage lint rule, bench stage 3f and the comm-lane tests
+    all probe with it."""
+    from fdtd3d_tpu.config import (MaterialsConfig, PmlConfig,
+                                   SimConfig, SphereConfig, TfsfConfig)
+    return SimConfig(
+        scheme="3D", size=(n, n, n), time_steps=time_steps, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(pml, pml, pml)),
+        tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2)),
+        materials=MaterialsConfig(
+            use_drude=True, eps_inf=1.5, omega_p=1e11, gamma=1e10,
+            drude_sphere=SphereConfig(enabled=True,
+                                      center=(n // 2,) * 3,
+                                      radius=n // 5)),
+        use_pallas=True)
+
+
 # --------------------------------------------------------------------------
 # the comm model (ledger v2 lane)
 # --------------------------------------------------------------------------
@@ -762,6 +786,12 @@ def chunk_ledger(cfg, n_steps: int = 8,
             "bytes_per_cell": step_b / cells,
         },
         "comm": None,
+        # why the traced kind is NOT the temporal-blocked kernel
+        # ({"reason": token}, stamped on the step at BUILD time under
+        # the env that shaped the dispatch — solver.tb_fallback_reason;
+        # null when the trace IS pallas_packed_tb), so a ledger names
+        # the 2x-HBM downgrade it is charging
+        "tb_fallback": (runner.diag or {}).get("tb_fallback"),
         "model": ("jaxpr-walk: unfused byte upper bound; pallas_call "
                   "operands counted once; step scan body counted once "
                   "(per-step); cond takes its max branch"
@@ -800,7 +830,8 @@ def chunk_ledger(cfg, n_steps: int = 8,
 LEDGER_KEYS = frozenset((
     "schema", "ledger_version", "step_kind", "scheme", "grid", "dtype",
     "cells", "n_steps", "steps_per_call", "topology", "sections",
-    "per_chunk_sections", "per_step", "comm", "model", "roofline"))
+    "per_chunk_sections", "per_step", "comm", "tb_fallback", "model",
+    "roofline"))
 COMM_KEYS = frozenset((
     "topology", "n_chips", "per_step", "per_chunk",
     "collectives_per_step", "plan", "strategy", "topology_table",

@@ -400,6 +400,107 @@ def _pick_tile_packed(n1: int, plane_cells: int, block_bytes_at,
     return 0
 
 
+def packed_vmem_models(static):
+    """Host-math ``(block_bytes_at, scratch_bytes_at)`` closures for
+    THE packed kernel's tile pick, or None when the thin-grid
+    full-length psi layout puts the config out of scope. No
+    coefficient arrays are built — the grid-operand counts come from
+    the static inference (plan._coeff_grid_counts, asserted equal to
+    the real allocation by tests/test_plan.py) — so planners
+    (ops/pallas_packed_tb.plan_tb's tile-too-thin bail, dry-run
+    plans at pod scale) can score the pick allocation-free.
+    make_packed_eh_step routes its own tile pick through the SAME
+    closures, so planner and builder cannot drift."""
+    from fdtd3d_tpu import solver as solver_mod
+    from fdtd3d_tpu.plan import _coeff_grid_counts
+
+    slabs = solver_mod.slab_axes(static)
+    for a in static.pml_axes:
+        if a not in slabs:
+            return None  # thin-grid full-length psi: not covered
+    mode = static.mode
+    topo = static.topology
+    sharded_axes = tuple(a for a in range(3) if topo[a] > 1)
+    n1, n2, n3 = (static.grid_shape[a] // topo[a] for a in range(3))
+    fbytes = np.dtype(static.field_dtype).itemsize
+    ne = len(mode.e_components)
+    nh = len(mode.h_components)
+    drude = static.use_drude
+    drude_m = static.use_drude_m
+    comp = static.cfg.compensated
+    rows_e = psi_rows(static, slabs, "E")
+    rows_h = psi_rows(static, slabs, "H")
+    psi_axes_e = sorted(rows_e)
+    psi_axes_h = sorted(rows_h)
+    setup = static.tfsf_setup
+    x_pml = 0 in static.pml_axes
+    src_free = setup is None and not static.cfg.point_source.enabled
+    fuse_x = x_pml and (src_free or _sources_interior(static))
+    rows_x_e = [c for c in mode.e_components
+                if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
+                ] if fuse_x else []
+    rows_x_h = [c for c in mode.h_components
+                if any(t[0] == 0 for t in CURL_TERMS[component_axis(c)])
+                ] if fuse_x else []
+    kxe, kxh = len(rows_x_e), len(rows_x_h)
+    per_e, per_h = _coeff_grid_counts(static)
+    n_arr = per_e * ne + per_h * nh
+
+    def _stack_shape(a: int, k: int) -> Tuple[int, int, int, int]:
+        s = [k, n1, n2, n3]
+        s[1 + a] = 2 * slabs[a]
+        return tuple(s)
+
+    def _block_bytes(t: int) -> int:
+        plane = n2 * n3
+        total = 0
+        total += 2 * ne * t * plane * fbytes       # E in + out
+        total += 2 * nh * t * plane * fbytes       # H in + out
+        for (axes, rows) in ((psi_axes_e, rows_e), (psi_axes_h, rows_h)):
+            for a in axes:                         # psi stacks in + out
+                s = _stack_shape(a, len(rows[a]))
+                total += 2 * s[0] * t * s[2] * s[3] * 4
+        if drude:
+            total += 2 * ne * t * plane * 4        # J in + out
+        if drude_m:
+            total += 2 * nh * t * plane * 4        # K in + out
+        if comp:                                   # bf16 residuals
+            total += 2 * (ne + nh) * t * plane * 2
+        total += n_arr * t * plane * 4
+        for a in psi_axes_e + psi_axes_h:
+            total += 3 * 2 * slabs[a] * 4          # profile packs
+        if fuse_x:
+            # x-psi stacks in + out (one tile-shaped block each) plus
+            # the per-tile full-length profile blocks
+            total += 2 * (kxe + kxh) * t * plane * 4
+            total += 2 * 3 * t * 4
+        if 0 in sharded_axes:
+            total += nh * plane * fbytes           # xgh
+        for a in sharded_axes:
+            if a != 0:
+                total += nh * t * (plane // (n2, n3)[a - 1]) * fbytes
+        total += (t + n2 + n3) * 4                 # walls
+        return total
+
+    def _scratch_bytes(t: int) -> int:
+        return (ne + nh) * t * n2 * n3 * 4 + nh * n2 * n3 * 4
+
+    return _block_bytes, _scratch_bytes
+
+
+def packed_tile(static) -> int:
+    """The packed kernel's budgeted x-tile from the host-math VMEM
+    model (0 = no tile fits, or the thin-grid psi layout is out of
+    scope) — what the tb planner's tile-too-thin bail consults
+    without building coefficient arrays."""
+    models = packed_vmem_models(static)
+    if models is None:
+        return 0
+    n1, n2, n3 = (static.grid_shape[a] // static.topology[a]
+                  for a in range(3))
+    return _pick_tile_packed(n1, n2 * n3, *models)
+
+
 def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None,
                         force_tile=None):
     """One-pallas-call pipelined leapfrog step, or None if out of scope.
@@ -500,39 +601,12 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None,
         s[1 + a] = 2 * slabs[a]
         return tuple(s)
 
-    def _block_bytes(t: int) -> int:
-        plane = n2 * n3
-        total = 0
-        total += 2 * ne * t * plane * fbytes       # E in + out
-        total += 2 * nh * t * plane * fbytes       # H in + out
-        for (axes, rows) in ((psi_axes_e, rows_e), (psi_axes_h, rows_h)):
-            for a in axes:                         # psi stacks in + out
-                s = _stack_shape(a, len(rows[a]))
-                total += 2 * s[0] * t * s[2] * s[3] * 4
-        if drude:
-            total += 2 * ne * t * plane * 4        # J in + out
-        if drude_m:
-            total += 2 * nh * t * plane * 4        # K in + out
-        if comp:                                   # bf16 residuals
-            total += 2 * (ne + nh) * t * plane * 2
-        total += (len(arr_e) + len(arr_h)) * t * plane * 4
-        for a in psi_axes_e + psi_axes_h:
-            total += 3 * 2 * slabs[a] * 4          # profile packs
-        if fuse_x:
-            # x-psi stacks in + out (one tile-shaped block each) plus
-            # the per-tile full-length profile blocks
-            total += 2 * (kxe + kxh) * t * plane * 4
-            total += 2 * 3 * t * 4
-        if 0 in sharded_axes:
-            total += nh * plane * fbytes           # xgh
-        for a in sharded_axes:
-            if a != 0:
-                total += nh * t * (plane // (n2, n3)[a - 1]) * fbytes
-        total += (t + n2 + n3) * 4                 # walls
-        return total
-
-    def _scratch_bytes(t: int) -> int:
-        return (ne + nh) * t * n2 * n3 * 4 + nh * n2 * n3 * 4
+    # VMEM footprint: the shared host-math model (packed_vmem_models —
+    # also the tb planner's bail oracle), never None here: the
+    # thin-grid psi check above already returned. Its static grid-
+    # operand count equals len(arr_e) + len(arr_h) (the
+    # _coeff_grid_counts invariant tests/test_plan.py asserts).
+    _block_bytes, _scratch_bytes = packed_vmem_models(static)
 
     if force_tile is not None:
         if n1 % force_tile != 0 or n1 // force_tile < 2:

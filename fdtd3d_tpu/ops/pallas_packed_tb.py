@@ -49,19 +49,26 @@ generation's lag:
   ``onehot(coord == plane) * value`` inside the matching phase. The
   corrections never enter the psi recursions (they are accumulator
   adds, exactly the jnp form), and ``_sources_interior`` keeps the
-  fused-x argument intact. Unsharded only (the boundary wedge
-  pre-pass has no incident-line port).
+  fused-x argument intact. Under sharding the onehot masks compare
+  LOCAL coordinates against the global face plane through a traced
+  shard-offset operand (``tfofs``, the ``srcpos`` pattern), the value
+  planes are already shard-local (corr_plane_term reads the SHARDED
+  gx/gy/gz coordinate arrays), and the boundary-wedge pre-pass gets
+  its own incident-line port (round 14, below).
 * Drude ADE — the electric current J is one extra generation stack in
   the ring scratch: phase E_g computes J(t+g) = kj J(t+g-1) + bj
   E(t+g-1) alongside E, generation k lands in HBM at the E lag — so
   Drude runs get the same k-fold traffic saving on J. Magnetic Drude
-  (K) stays out of scope. Unsharded only.
+  (K) stays out of scope. Sharded runs carry a J ring through the
+  wedge pre-pass (round 14, below).
 * material grids — spatially-varying ca/cb/kj/bj (da/db) stream as
   per-generation tiled operands at each phase's lag: each grid is
   read k times per PASS = once per step, the same per-step coefficient
   traffic as the single-step kernel (the k-fold saving is on fields;
-  ring-buffering coefficients would buy nothing but VMEM). Unsharded
-  only (the wedge pre-pass reads scalar coefficients).
+  ring-buffering coefficients would buy nothing but VMEM). The wedge
+  pre-pass gathers each grid's per-cell plane sub-blocks instead of
+  assuming scalar coefficients (round 14, below), so sharded
+  material-grid runs stay in scope too.
 
 **VMEM-calibrated auto-depth picker.** ``pick_depth`` scores every
 k in {4, 3, 2} against the central Mosaic-temporaries calibration
@@ -91,7 +98,22 @@ component stack at field dtype:
           included; cross-axis halo lines slice from the other axes'
           already-received full ghost planes of the SAME generation,
           so NO corner messages exist). Phase E_{j+1} consumes gh[j]
-          as its lo ghost.
+          as its lo ghost. Round 14 widens the wedge to the three
+          remaining operand classes, so sharded TFSF / electric-Drude
+          / material-grid runs no longer fall back to the single-step
+          kernel: (a) an INCIDENT-LINE PORT — each wedge generation
+          applies the TFSF corrections whose face planes intersect
+          its boundary planes, from per-generation ``corr_plane_term``
+          value planes gated by the SHARDED gx/gy/gz coordinate
+          arrays (shard-local recomputation of replicated incident
+          values: zero extra ICI bytes, so the per-step exchange
+          stays depth-invariant and byte-exact vs the traced ledger);
+          (b) a J RING — the wedge carries J(t+j) = kj J(t+j-1) + bj
+          E(t+j-1) plane by plane through the k generations, exactly
+          like the in-kernel ring scratch; (c) TILED COEFFICIENTS —
+          the wedge slices each 3D material grid's per-cell plane
+          sub-block at its (axis, plane) instead of embedding a
+          scalar.
   k+1..2k-1. ``hi_e[j]`` (j = 1..k-1) — E(t+j) first-plane stacks,
           upstream (from the same wedge); phase H_j consumes hi_e[j]
           as its hi ghost, making H(t+j) exact in-kernel including
@@ -117,8 +139,13 @@ Scope (everything else falls back to ops/pallas_packed.py): 3D, real
 f32/bf16 storage, sharded or not (sharded axes need mesh axis names),
 slab-fitting CPML on any axes; point sources inside the CPML identity
 region (sharded or not); TFSF / electric-Drude ADE / material grids
-UNSHARDED (widening their sharded wedge is open); no magnetic Drude,
-no compensated mode, no double-single. ``FDTD3D_NO_TEMPORAL=1`` is the
+sharded or not (round 14); no magnetic Drude, no compensated mode, no
+double-single. Every dispatch that falls OUTSIDE this scope is named:
+``plan_tb`` is the single decision authority (eligibility + depth +
+tile, consulted by the dispatch, the planner and the ledger alike)
+and its machine-readable ``reason`` token is recorded as the
+``tb_fallback`` field in telemetry run_start and the cost ledger so
+the 2x-HBM downgrade is never silent. ``FDTD3D_NO_TEMPORAL=1`` is the
 escape hatch that forces the round-6 kernel bit-for-bit.
 
 The step object advances k steps per call: ``step.steps_per_call ==
@@ -140,6 +167,7 @@ tests/test_pallas_packed_tb.py::test_tb_donation_fetch_before_write.
 
 from __future__ import annotations
 
+import dataclasses as _dataclasses
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -183,34 +211,43 @@ def _coeff_grids_static(static) -> bool:
     return per_e > 0 or per_h > 0
 
 
-def eligible(static, mesh_axes=None) -> bool:
-    """Temporal-blocked scope: a strict subset of the packed kernel's
-    (module docstring). The dispatch falls back to ``pallas_packed``
-    outside it, so this must never admit a config the kernel cannot
-    advance k exact steps for in one pass.
+def _reject_reason(static, mesh_axes=None):
+    """Machine-readable scope-rejection token, or None when the config
+    is inside the temporal-blocked kernel's scope (module docstring).
+    THE eligibility decision ``plan_tb`` (and through it the dispatch,
+    the planner and the fallback records) consumes — the dispatch
+    falls back to ``pallas_packed`` outside it, so this must never
+    admit a config the kernel cannot advance k exact steps for in one
+    pass.
 
-    Round-12 widening: TFSF (in-kernel plane-value corrections),
-    electric-Drude ADE (J in the ring scratch) and material grids
-    (per-generation tiled operands) are IN scope unsharded; sharded
-    topologies keep the round-11 plain scope (+ interior point
-    sources) — the boundary-wedge pre-pass reads scalar coefficients
-    and has no incident-line/J port yet."""
+    Round-14 widening: TFSF (in-kernel plane-value corrections +
+    the wedge incident-line port), electric-Drude ADE (J in the ring
+    scratch + the wedge J ring) and material grids (per-generation
+    tiled operands + wedge plane sub-blocks) are IN scope sharded and
+    unsharded alike."""
+    if getattr(static, "paired_complex", False):
+        return "paired_complex"
+    if static.cfg.ds_fields:
+        return "ds_fields"
     if not _pk.eligible(static, mesh_axes):
-        return False
+        return "packed_ineligible"
     if static.cfg.compensated:
-        return False          # Kahan residuals would double traffic
+        return "compensated"  # Kahan residuals would double traffic
     if static.use_drude_m:
-        return False          # magnetic ADE K: not temporally blocked
+        return "magnetic_drude"  # ADE K rings: ROADMAP item 1(c)
     src_like = static.tfsf_setup is not None \
         or static.cfg.point_source.enabled
     if src_like and not _pk._sources_interior(static):
-        return False          # in-absorber injection: legacy path only
-    if static.topology != (1, 1, 1):
-        if static.use_drude or static.tfsf_setup is not None:
-            return False      # wedge pre-pass: no J / incident line
-        if _coeff_grids_static(static):
-            return False      # wedge pre-pass reads scalar coefficients
-    return True
+        return "source_in_absorber"  # in-absorber injection: legacy
+    return None
+
+
+def eligible(static, mesh_axes=None) -> bool:
+    """Whether the config is inside the temporal-blocked kernel's
+    SCOPE (``_reject_reason``); depth/tile viability is a separate
+    question — ``plan_tb`` answers both and is what the dispatch and
+    the planner consult."""
+    return _reject_reason(static, mesh_axes) is None
 
 
 # ---------------------------------------------------------------------------
@@ -316,6 +353,8 @@ def _vmem_models(static, geo, k: int, n_arr_e: int, n_arr_h: int):
                 total += gens * ncorr * plane * 4
             else:
                 total += gens * ncorr * t * (n3, n2)[ax - 1] * 4
+        if tf_sizes and sharded_axes:
+            total += 3 * 4                         # tfofs
         if 0 in sharded_axes:                      # xgh[0..k-1], xe[1..k-1]
             total += (k * nh + (k - 1) * ne) * plane * fbytes
         for a in yz_sharded:                       # ygh/ye thin blocks
@@ -354,23 +393,17 @@ def _arr_counts_static(static, geo) -> Tuple[int, int]:
     return per_e * geo["ne"], per_h * geo["nh"]
 
 
-def pick_depth(static, mesh_axes=None):
-    """The VMEM-calibrated auto-depth pick (host math only; no coeffs
-    are built, no backend touched — plan.CommStrategy scores the same
-    function). Returns ``(k, tile, candidates, source)`` or None when
-    no depth is viable:
-
-    * candidates: {k: budgeted tile} for every allowed depth;
-    * the pick is the DEEPEST k with tile >= 2, else the deepest with
-      tile == 1 (the caller applies the single-step tile>=4 bail),
-      honoring the ``FDTD3D_TB_DEPTH`` pin (source records it).
-    """
+def _depth_pick(static, geo):
+    """The VMEM-calibrated depth scan (host math only; no coeffs are
+    built, no backend touched). -> ``(best or None, tiles, source)``
+    with tiles = {k: budgeted tile} per allowed depth; the pick is the
+    DEEPEST k with tile >= 2, else the deepest with tile == 1,
+    honoring the ``FDTD3D_TB_DEPTH`` pin (source records it). Raises a
+    NAMED config error for an unviable pin — never a silent 48 B/cell
+    family switch (the registered-knob convention; a user A/B-ing
+    depths would otherwise blame the kernel for the fallback's
+    slowdown)."""
     from fdtd3d_tpu.config import tb_depth_env, vmem_temps
-    if not eligible(static, mesh_axes):
-        return None
-    geo = _geometry(static)
-    if geo is None:
-        return None
     pinned = tb_depth_env()
     cands = (pinned,) if pinned else tuple(sorted(DEPTHS, reverse=True))
     n1, n2, n3 = geo["ldims"]
@@ -389,22 +422,78 @@ def pick_depth(static, mesh_axes=None):
     if best is None:
         best = max((k for k, t in tiles.items() if t == 1),
                    default=None)
+    if best is None and pinned:
+        raise ValueError(
+            f"FDTD3D_TB_DEPTH={pinned}: the pinned temporal-block "
+            f"depth is not viable for this configuration — the "
+            f"k-1-plane boundary wedge must fit every sharded "
+            f"axis's local extent and the depth-{pinned} ring "
+            f"scratch must fit a VMEM tile (candidates: {tiles}). "
+            f"Unset the pin for the auto-depth pick, or force the "
+            f"single-step kernel with FDTD3D_NO_TEMPORAL=1.")
+    return best, tiles, source
+
+
+@_dataclasses.dataclass(frozen=True)
+class TbPlan:
+    """THE temporal-blocking decision for one (config, mesh): made
+    once, consumed everywhere — the dispatch (solver.make_step), the
+    builder (make_packed_tb_step), the planner (plan._infer_step_kind
+    / CommStrategy.ghost_depth) and the fallback records (telemetry
+    run_start / cost-ledger ``tb_fallback``) all read the SAME object,
+    so they can never disagree about whether/why/at-what-depth a run
+    temporal-blocks (the round-13 bug: pick_depth was consulted after
+    eligible() in two call sites, and the planner skipped the
+    tile-too-thin bail the builder applied).
+
+    ``reason`` is None when eligible, else one machine-readable token:
+    scope tokens (paired_complex / ds_fields / packed_ineligible /
+    compensated / magnetic_drude / source_in_absorber), geometry
+    (thin_grid_psi), or viability (no_viable_depth / tile_too_thin).
+    The dispatch layer adds its own env/contract tokens
+    (env:FDTD3D_NO_TEMPORAL, pallas_disabled, ...) on top —
+    solver.tb_fallback_reason."""
+
+    eligible: bool
+    depth: Optional[int]
+    tile: int
+    candidates: Dict[int, int]
+    source: str
+    reason: Optional[str]
+
+
+def plan_tb(static, mesh_axes=None) -> TbPlan:
+    """Scope + depth + tile in one deterministic host-math decision
+    (no coefficient arrays are built, no backend touched — dry-run
+    planning at pod scale stays allocation-free)."""
+    reason = _reject_reason(static, mesh_axes)
+    if reason is not None:
+        return TbPlan(False, None, 0, {}, "n/a", reason)
+    geo = _geometry(static)
+    if geo is None:
+        return TbPlan(False, None, 0, {}, "n/a", "thin_grid_psi")
+    best, tiles, source = _depth_pick(static, geo)
     if best is None:
-        if pinned:
-            # a pin the kernel cannot honor must be a NAMED config
-            # error, never a silent 48 B/cell family switch (the
-            # registered-knob convention; a user A/B-ing depths would
-            # otherwise blame the kernel for the fallback's slowdown)
-            raise ValueError(
-                f"FDTD3D_TB_DEPTH={pinned}: the pinned temporal-block "
-                f"depth is not viable for this configuration — the "
-                f"k-1-plane boundary wedge must fit every sharded "
-                f"axis's local extent and the depth-{pinned} ring "
-                f"scratch must fit a VMEM tile (candidates: {tiles}). "
-                f"Unset the pin for the auto-depth pick, or force the "
-                f"single-step kernel with FDTD3D_NO_TEMPORAL=1.")
+        return TbPlan(False, None, 0, tiles, source, "no_viable_depth")
+    if tiles[best] == 1 and source == "auto" \
+            and _pk.packed_tile(static) >= 4:
+        # too thin: the deep pipeline at T=1 multiplies per-iteration
+        # setup cost and ring-rotation VPU work; if the single-step
+        # kernel affords a healthy tile, take its 48 B/cell instead
+        # (the measured fused-vs-two-pass tile>=4 heuristic). An
+        # explicit FDTD3D_TB_DEPTH pin skips the bail.
+        return TbPlan(False, None, 1, tiles, source, "tile_too_thin")
+    return TbPlan(True, best, tiles[best], tiles, source, None)
+
+
+def pick_depth(static, mesh_axes=None):
+    """Back-compat view of ``plan_tb``: ``(k, tile, candidates,
+    source)`` or None when the kernel is not viable (scope, geometry,
+    depth or the tile-too-thin bail)."""
+    tbp = plan_tb(static, mesh_axes)
+    if not tbp.eligible:
         return None
-    return best, tiles[best], tiles, source
+    return tbp.depth, tbp.tile, tbp.candidates, tbp.source
 
 
 def planned_depth(static) -> Optional[int]:
@@ -414,8 +503,7 @@ def planned_depth(static) -> Optional[int]:
     axis names are derived from the static topology (the planner has
     no live mesh; eligibility only needs the NAMES to exist)."""
     from fdtd3d_tpu.parallel.mesh import mesh_axis_map
-    pick = pick_depth(static, mesh_axis_map(static.topology))
-    return pick[0] if pick is not None else None
+    return plan_tb(static, mesh_axis_map(static.topology)).depth
 
 
 # ---------------------------------------------------------------------------
@@ -487,10 +575,8 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
              if v and key.split("_")[0] in pairs_e]
     arr_h = [key for key, v in coeff_is_array.items()
              if v and key.split("_")[0] in pairs_h]
-    if sharded_axes and (arr_e or arr_h or drude or setup is not None):
-        return None           # guarded by eligible(); belt and braces
 
-    # ---- depth + tile ----------------------------------------------------
+    # ---- depth + tile (plan_tb is the single decision authority) ---------
     if depth is not None:
         if depth not in DEPTHS:
             raise ValueError(f"temporal-block depth {depth} not in "
@@ -507,20 +593,12 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
         k = depth
         depth_diag = {"candidates": {depth: T}, "source": "arg"}
     else:
-        pick = pick_depth(static, mesh_axes)
-        if pick is None:
+        tbp = plan_tb(static, mesh_axes)
+        if not tbp.eligible:
             return None
-        k, T, cands, source = pick
-        depth_diag = {"candidates": cands, "source": source}
-        if T == 1 and source == "auto":
-            # too thin: the deep pipeline at T=1 multiplies per-
-            # iteration setup cost and ring-rotation VPU work; if the
-            # single-step kernel affords a healthy tile, take its 48
-            # B/cell instead (the measured fused-vs-two-pass tile>=4
-            # heuristic). An explicit depth pin skips the bail.
-            free = _pk.make_packed_eh_step(static, mesh_axes, mesh_shape)
-            if free is not None and free.diag["tile"]["EH"] >= 4:
-                return None
+        k, T = tbp.depth, tbp.tile
+        depth_diag = {"candidates": tbp.candidates,
+                      "source": tbp.source}
     bb_k, sb_k = _vmem_models(static, geo, k, len(arr_e), len(arr_h))
 
     # the planned communication strategy (module docstring): message
@@ -709,6 +787,11 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                     add_in(f"{tag}{g}_{ax_}",
                            pl.BlockSpec(tuple(bs), lag_imap(lag),
                                         memory_space=pltpu.VMEM))
+    if setup is not None and sharded_axes:
+        # traced shard origin for the TFSF onehot masks (the srcpos
+        # pattern): local coordinates + tfofs == the global face plane
+        add_in("tfofs", pl.BlockSpec((3, 1, 1), const3,
+                                     memory_space=pltpu.VMEM))
     if src_on:
         add_in("src", pl.BlockSpec((k, 1, 1), const3,
                                    memory_space=pltpu.VMEM))
@@ -905,8 +988,10 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
         def tfsf_term(fam, c, g, tile_lo):
             """Sum of comp c's TFSF plane-value corrections at
             generation g: onehot(static face plane) x the traced value
-            plane (module docstring). Unsharded only (local == global
-            coordinates)."""
+            plane (module docstring). Under sharding the face plane is
+            GLOBAL and the iota local, so the traced shard origin
+            (tfofs) closes the gap — off-shard face planes mask to
+            zero, one SPMD program."""
             recs = tf_records[fam].get(c) if setup is not None else None
             if not recs:
                 return None
@@ -917,6 +1002,8 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                 gi = lax.broadcasted_iota(jnp.int32, (T, n2, n3), ax_)
                 if ax_ == 0:
                     gi = gi + tile_lo * T
+                if sharded_axes:
+                    gi = gi + idx["tfofs"][ax_, 0, 0]
                 mask = (gi == plane).astype(fdt)
                 term = mask * blk[row]
                 tot = term if tot is None else tot + term
@@ -1395,14 +1482,63 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                 offs.append(jnp.int32(0))
         return offs
 
+    active_axes = mode.active_axes
+
+    def _wedge_coef(cc, key, a, p):
+        """Coefficient at wedge plane (a, p): a 3D material grid
+        slices its per-cell plane sub-block (the round-14 widened-
+        operand port — the wedge gathers the ghost planes' tiled
+        coefficients instead of assuming scalars), a scalar embeds as
+        a constant exactly like the kernel's ``coef``."""
+        if coeff_is_array.get(key):
+            return lax.slice_in_dim(cc[key], p, p + 1,
+                                    axis=a).astype(fdt)
+        return _coefv(key)
+
+    def _wedge_tfsf_sum(cc, tf_terms, c, a, p):
+        """Comp c's TFSF accumulator corrections restricted to wedge
+        plane (a, p) — the incident-line port (round 14). ``tf_terms``
+        is this generation+family's [(corr, plane term)] list
+        (``tfsf.corr_plane_term`` on the per-generation incident
+        line): the normal-axis onehot is applied here from the SHARDED
+        gx/gy/gz coordinate arrays — a traced 0/1 scalar when the
+        correction is normal to the wedge axis, a 1D line mask
+        otherwise — so the same SPMD program is exact on every shard
+        and face planes owned by other shards contribute zero.
+        Incident values are shard-local recomputation (the line is
+        replicated): the port adds ZERO ICI bytes."""
+        tot = None
+        for corr, term in tf_terms or ():
+            if corr.comp != c or term is None:
+                continue
+            t3 = term.astype(fdt)
+            if jnp.ndim(t3) == 3 and t3.shape[a] > 1:
+                t3 = lax.slice_in_dim(t3, p, p + 1, axis=a)
+            ga = cc["g" + AXES[corr.axis]]
+            if corr.axis == a:
+                oh = (ga[p] == corr.plane).astype(fdt)
+            else:
+                shp = [1, 1, 1]
+                shp[corr.axis] = ga.shape[0]
+                oh = (ga == corr.plane).reshape(shp).astype(fdt)
+            tv = t3 * oh
+            tot = tv if tot is None else tot + tv
+        return tot
+
     def _wedge_e_plane(cc, a, p, h_at, gh_prev, e_old_pl, psi_get,
-                       psi_set, offs, tstep):
+                       psi_set, offs, tstep, j_old_pl=None,
+                       tf_terms=None):
         """E(t+j) comps on plane (a, p) of a sharded axis (f32).
         ``h_at(jd, q)`` returns H(t+j-1) comp jd at plane q (q == -1:
         the received downstream ghost); ``gh_prev[ax]`` the other
         sharded axes' generation-(j-1) ghost stacks (cross-axis lo
-        ghost lines slice from them — no corner messages)."""
+        ghost lines slice from them — no corner messages).
+        ``j_old_pl``: the Drude J(t+j-1) planes (the wedge's J ring,
+        round 14); ``tf_terms``: this generation's TFSF plane terms.
+        Returns (new E comps, new J comps or None) — term order
+        mirrors the kernel's e_update (curl, TFSF, Drude, source)."""
         out = []
+        new_j = [] if drude else None
         for jc, c in enumerate(e_comps):
             acc = None
             for (ax, jd, s) in CURL_TERMS[component_axis(c)]:
@@ -1425,6 +1561,15 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                     term = _cross_psi_term(cc, "e", c, a, p, ax, dfa,
                                            s, psi_get, psi_set)
                 acc = term if acc is None else acc + term
+            if tf_terms is not None:
+                tv = _wedge_tfsf_sum(cc, tf_terms, c, a, p)
+                if tv is not None:
+                    acc = acc + tv
+            if drude:
+                jn = _wedge_coef(cc, f"kj_{c}", a, p) * j_old_pl[jc] \
+                    + _wedge_coef(cc, f"bj_{c}", a, p) * e_old_pl[jc]
+                new_j.append(jn)
+                acc = acc - jn
             if src_on and c == ps.component:
                 with _named("source"):
                     wf = waveform(ps.waveform, tstep, 0.5, static.omega,
@@ -1438,8 +1583,8 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                         m_ = mb if m_ is None else (m_ & mb)
                     acc = acc + np.float32(ps.amplitude) * wf \
                         * m_.astype(fdt)
-            e = _coefv(f"ca_{c}") * e_old_pl[jc] \
-                + _coefv(f"cb_{c}") * acc
+            e = _wedge_coef(cc, f"ca_{c}", a, p) * e_old_pl[jc] \
+                + _wedge_coef(cc, f"cb_{c}", a, p) * acc
             ca_ax = component_axis(c)
             for b in range(3):
                 if b == ca_ax:
@@ -1449,14 +1594,14 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                     w = lax.slice_in_dim(w, p, p + 1, axis=b)
                 e = e * w
             out.append(e)
-        return out
+        return out, new_j
 
     def _wedge_h_plane(cc, a, p, e_at, hi_cross, h_old_pl, psi_get,
-                       psi_set):
+                       psi_set, tf_terms=None):
         """H(t+j) comps on plane (a, p): ``e_at(jd, q)`` returns the
         SAME generation's E at plane q (q == n_a: the received
         upstream ghost); ``hi_cross[ax]`` its cross-axis hi-ghost
-        stacks."""
+        stacks; ``tf_terms`` the generation's H-side TFSF terms."""
         out = []
         for jc, c in enumerate(h_comps):
             acc = None
@@ -1480,17 +1625,47 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                     term = _cross_psi_term(cc, "h", c, a, p, ax, dfa,
                                            s, psi_get, psi_set)
                 acc = term if acc is None else acc + term
-            out.append(_coefv(f"da_{c}") * h_old_pl[jc]
-                       - _coefv(f"db_{c}") * acc)
+            if tf_terms is not None:
+                tv = _wedge_tfsf_sum(cc, tf_terms, c, a, p)
+                if tv is not None:
+                    acc = acc + tv
+            out.append(_wedge_coef(cc, f"da_{c}", a, p) * h_old_pl[jc]
+                       - _wedge_coef(cc, f"db_{c}", a, p) * acc)
         return out
 
-    def _exchange_ghosts(pstate, cc, t):
+    def _exchange_ghosts(pstate, cc, t, inc_gen=None):
         """The 2k-1-message depth-k exchange schedule (module
         docstring; message 2k is the post-kernel hi-edge fix): returns
         (gh, hi_e, offs) with gh[j][a] the H(t+j) downstream stacks
-        and hi_e[j][a] (j >= 1) the E(t+j) upstream stacks."""
+        and hi_e[j][a] (j >= 1) the E(t+j) upstream stacks.
+        ``inc_gen``: the per-generation incident-line states
+        [(after-E-advance, after-H-advance)] the step computed — the
+        wedge's incident-line port evaluates each generation's TFSF
+        corrections from them, shard-locally (zero extra ICI)."""
         E_arr, H_arr = pstate["E"], pstate["H"]
+        J_arr = pstate["J"] if drude else None
         offs = _shard_offsets()
+
+        # per-generation TFSF plane terms for the wedge (j = 1..k-1):
+        # corr_plane_term is the SAME authority the kernel's value-
+        # plane operands ride, so wedge and kernel cannot drift
+        tf_wedge: Dict[str, Dict[int, list]] = {"E": {}, "H": {}}
+        if setup is not None:
+            with _named("tfsf"):
+                for j in range(1, k):
+                    inc_e, inc_h = inc_gen[j - 1]
+                    tf_wedge["E"][j] = [
+                        (corr, tfsf_mod.corr_plane_term(
+                            corr, setup, cc, inc_e, active_axes,
+                            static.dx))
+                        for corr in setup.corrections
+                        if corr.field == "E"]
+                    tf_wedge["H"][j] = [
+                        (corr, tfsf_mod.corr_plane_term(
+                            corr, setup, cc, inc_h, active_axes,
+                            static.dx))
+                        for corr in setup.corrections
+                        if corr.field == "H"]
 
         def _ex(stack, a, down):
             name = mesh_axes[a]
@@ -1504,10 +1679,13 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
         hi_e: List[Optional[Dict[int, jnp.ndarray]]] = [None]
         Ew: Dict[int, Dict[int, list]] = {a: {} for a in sharded_axes}
         Hw: Dict[int, Dict[int, list]] = {a: {} for a in sharded_axes}
+        Jw: Dict[int, Dict[int, list]] = {a: {} for a in sharded_axes}
         psiwE: Dict[int, Dict[int, dict]] = {a: {} for a in sharded_axes}
         psiwH: Dict[int, Dict[int, dict]] = {a: {} for a in sharded_axes}
         for j in range(1, k):
             newE: Dict[int, Dict[int, list]] = {a: {}
+                                                for a in sharded_axes}
+            newJ: Dict[int, Dict[int, list]] = {a: {}
                                                 for a in sharded_axes}
             newPsiE: Dict[int, Dict[int, dict]] = {a: {}
                                                    for a in sharded_axes}
@@ -1531,19 +1709,29 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                                 E_arr[jc], p, p + 1,
                                 axis=a).astype(fdt)
                                 for jc in range(ne)]
+                            j_old_pl = ([lax.slice_in_dim(
+                                J_arr[jc], p, p + 1,
+                                axis=a).astype(fdt)
+                                for jc in range(ne)]
+                                if drude else None)
                             store = None
                         else:
                             e_old_pl = Ew[a][p]
+                            j_old_pl = Jw[a][p] if drude else None
                             store = psiwE[a][p]
                         new_store: dict = {}
                         pset = (lambda c, ax, v, _ns=new_store:
                                 _ns.__setitem__((c, ax), v))
-                        newE[a][p] = _wedge_e_plane(
+                        newE[a][p], j_new = _wedge_e_plane(
                             cc, a, p, h_at, gh[j - 1], e_old_pl,
                             _mk_psi_get(pstate, "e", a, p, store),
-                            pset, offs, t + (j - 1))
+                            pset, offs, t + (j - 1),
+                            j_old_pl=j_old_pl,
+                            tf_terms=tf_wedge["E"].get(j))
+                        if drude:
+                            newJ[a][p] = j_new
                         newPsiE[a][p] = new_store
-            Ew, psiwE = newE, newPsiE
+            Ew, psiwE, Jw = newE, newPsiE, newJ
             hi_e.append({a: _ex(jnp.stack(Ew[a][0]).astype(fst), a,
                                 False)
                          for a in sharded_axes})
@@ -1577,7 +1765,7 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                         newH[a][p] = _wedge_h_plane(
                             cc, a, p, e_at, hi_e[j], h_old_pl,
                             _mk_psi_get(pstate, "h", a, p, store),
-                            pset)
+                            pset, tf_terms=tf_wedge["H"].get(j))
                         newPsiH[a][p] = new_store
             Hw, psiwH = newH, newPsiH
             gh.append({a: _ex(jnp.stack(Hw[a][ldims[a] - 1])
@@ -1585,10 +1773,9 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                        for a in sharded_axes})
         return gh, hi_e, offs
 
-    # ---- TFSF value-plane builder (unsharded; module docstring) ----------
+    # ---- TFSF value-plane builder (module docstring; shard-local:
+    # corr_plane_term reads the SHARDED gx/gy/gz coordinate arrays) ---
     if setup is not None:
-        active_axes = mode.active_axes
-
         def _tf_stacks(fam, inc_d, coeffs):
             out = {}
             for ax_, grp in sorted(tf_groups[fam].items()):
@@ -1613,9 +1800,28 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
             coeffs = prepare(coeffs)
         t = pstate["t"]
         new_state = dict(pstate)
+        # advance the 1D incident line through all k generations FIRST
+        # (thin jnp): the wedge pre-pass and the kernel's value-plane
+        # operands both read the per-generation states (the E side
+        # samples Hinc at t+g-1/2 — before the Hinc advance — and the
+        # H side Einc at t+g, mirroring the jnp ordering)
+        inc_gen = None
+        if setup is not None:
+            with _named("tfsf"):
+                inc_gen = []
+                inc_d = pstate["inc"]
+                for g in range(1, k + 1):
+                    inc_d = tfsf_mod.advance_einc(
+                        inc_d, coeffs, t + (g - 1), static.dt,
+                        static.omega, setup)
+                    inc_e_g = inc_d
+                    inc_d = tfsf_mod.advance_hinc(inc_d, coeffs, setup)
+                    inc_gen.append((inc_e_g, inc_d))
+                new_state["inc"] = inc_d
         offs = None
         if sharded_axes:
-            gh, hi_e, offs = _exchange_ghosts(pstate, coeffs, t)
+            gh, hi_e, offs = _exchange_ghosts(pstate, coeffs, t,
+                                              inc_gen)
         operands: Dict[str, jnp.ndarray] = {
             "e_in": pstate["E"], "h_in": pstate["H"],
             "wall_y": coeffs["_pk_wall_y"],
@@ -1654,24 +1860,22 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
             for key in arr_h:
                 operands[f"ch{g}_{key}"] = coeffs[key]
         if setup is not None:
-            # advance the 1D incident line k times; the per-generation
-            # correction value planes ride as traced operands (E side
-            # samples Hinc at t+g-1/2 — before the Hinc advance — and
-            # the H side Einc at t+g, mirroring the jnp ordering)
+            # the per-generation correction value planes ride as
+            # traced operands, evaluated from the already-advanced
+            # incident-line states
             with _named("tfsf"):
-                inc_d = pstate["inc"]
                 for g in range(1, k + 1):
-                    inc_d = tfsf_mod.advance_einc(
-                        inc_d, coeffs, t + (g - 1), static.dt,
-                        static.omega, setup)
-                    for nm, v in _tf_stacks("E", inc_d,
+                    inc_e_g, inc_h_g = inc_gen[g - 1]
+                    for nm, v in _tf_stacks("E", inc_e_g,
                                             coeffs).items():
                         operands[nm.format(g=g)] = v
-                    inc_d = tfsf_mod.advance_hinc(inc_d, coeffs, setup)
-                    for nm, v in _tf_stacks("H", inc_d,
+                    for nm, v in _tf_stacks("H", inc_h_g,
                                             coeffs).items():
                         operands[nm.format(g=g)] = v
-                new_state["inc"] = inc_d
+                if sharded_axes:
+                    operands["tfofs"] = jnp.stack(
+                        [jnp.int32(0) + offs[b]
+                         for b in range(3)]).reshape(3, 1, 1)
         if src_on:
             with _named("source"):
                 wf = jnp.stack([

@@ -344,7 +344,11 @@ def _infer_step_kind(static, topo) -> str:
     if static.cfg.ds_fields:
         return "pallas_packed_ds"
     from fdtd3d_tpu.ops import pallas_packed, pallas_packed_tb
-    if pallas_packed_tb.eligible(static, mesh_axes):
+    # plan_tb is the FULL temporal-blocking decision (scope + depth
+    # viability + the tile-too-thin bail) — the same authority the
+    # dispatch consults, so the planner can never model a tb run the
+    # builder would decline (the round-13 disagreement)
+    if pallas_packed_tb.plan_tb(static, mesh_axes).eligible:
         return "pallas_packed_tb"
     if pallas_packed.eligible(static, mesh_axes):
         return "pallas_packed"

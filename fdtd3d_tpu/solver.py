@@ -472,6 +472,65 @@ def _want_pallas(static: StaticSetup, mesh_axes) -> bool:
             or pallas_packed.eligible(static, mesh_axes))
 
 
+def tb_fallback_reason(static: StaticSetup, mesh_axes=None,
+                       allow_multistep: bool = True):
+    """Machine-readable reason the dispatch did NOT engage the
+    temporal-blocked kernel, or None when it would. Config-level scope
+    and viability tokens come from the single decision authority
+    (ops/pallas_packed_tb.plan_tb); this layer only adds the
+    dispatch-context tokens a pure config analysis cannot see (env
+    escape hatches, the one-step contract, pallas disabled). Recorded
+    as ``tb_fallback{reason}`` in telemetry run_start and the cost
+    ledger so fleets can see which scenarios are paying the 2x-HBM
+    tax — the downgrade used to be silent.
+
+    Order matters: scope tokens first (most informative), then the
+    dispatch-context tokens, and the DEPTH-VIABILITY scan strictly
+    last — when the context declined tb (the escape hatch, pallas
+    off, the one-step contract) the dispatch never consulted the
+    depth picker, so neither may this stamp: an unviable
+    ``FDTD3D_TB_DEPTH`` pin must not raise from a run that was never
+    going to temporal-block (the pin error itself recommends
+    FDTD3D_NO_TEMPORAL=1 as the remedy)."""
+    import os as _os
+
+    from fdtd3d_tpu.ops import pallas_packed_tb
+    reason = pallas_packed_tb._reject_reason(static, mesh_axes)
+    if reason is not None:
+        return reason
+    # config is in tb scope: the dispatch context declined it
+    if not allow_multistep:
+        return "single_step_contract"
+    if _os.environ.get("FDTD3D_NO_TEMPORAL"):
+        return "env:FDTD3D_NO_TEMPORAL"
+    if not _want_pallas(static, mesh_axes):
+        return "pallas_disabled"
+    if _os.environ.get("FDTD3D_NO_PACKED"):
+        return "env:FDTD3D_NO_PACKED"
+    if _os.environ.get("FDTD3D_FORCE_FUSED"):
+        return "env:FDTD3D_FORCE_FUSED"
+    # in scope, context allowed: the dispatch DID consult plan_tb and
+    # declined on geometry/viability (an unviable pin would already
+    # have raised there, before any step reached this stamp)
+    return pallas_packed_tb.plan_tb(static, mesh_axes).reason
+
+
+def _stamp_tb_fallback(step, static, mesh_axes, allow_multistep=True):
+    """Attach the tb_fallback record to a non-tb step's diag (the
+    telemetry/ledger writers read it from there — the reason is
+    computed at BUILD time, under the env that shaped the dispatch)."""
+    if getattr(step, "kind", None) == "pallas_packed_tb":
+        return step
+    reason = tb_fallback_reason(static, mesh_axes, allow_multistep)
+    diag = getattr(step, "diag", None)
+    if diag is None:
+        diag = {}
+        step.diag = diag
+    diag["tb_fallback"] = {
+        "reason": reason if reason is not None else "unknown"}
+    return step
+
+
 def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
               allow_multistep: bool = True):
     """Build the pure leapfrog step. mesh_axes/mesh_shape: see stencil.py.
@@ -485,9 +544,16 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
     callers that require the one-step contract (the paired-complex leg
     builder) pass it; make_chunk_runner handles multi-step steps via
     ``step.steps_per_call`` / ``step.tail_step``.
+
+    Every step built by a kind OTHER than ``pallas_packed_tb`` carries
+    a ``diag["tb_fallback"]`` record naming WHY temporal blocking did
+    not engage (tb_fallback_reason) — surfaced in telemetry run_start,
+    the cost ledger and tools/telemetry_report.py.
     """
     if static.paired_complex:
-        return _make_paired_complex_step(static, mesh_axes, mesh_shape)
+        return _stamp_tb_fallback(
+            _make_paired_complex_step(static, mesh_axes, mesh_shape),
+            static, mesh_axes, allow_multistep)
     if static.cfg.ds_fields:
         # float32x2 hot path: the packed double-single Pallas kernel
         # (ops/pallas_packed_ds.py) — same dispatch policy as the f32
@@ -508,10 +574,12 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
                 static, mesh_axes, mesh_shape)
             if pk is not None:
                 pk.kind = "pallas_packed_ds"
-                return pk
+                return _stamp_tb_fallback(pk, static, mesh_axes,
+                                          allow_multistep)
         step = _make_ds_step(static, mesh_axes, mesh_shape)
         step.kind = "jnp_ds"
-        return step
+        return _stamp_tb_fallback(step, static, mesh_axes,
+                                  allow_multistep)
     if _want_pallas(static, mesh_axes):
         import os as _os
 
@@ -546,7 +614,8 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
                                                    mesh_shape)
             if pk is not None:
                 pk.kind = "pallas_packed"
-                return pk
+                return _stamp_tb_fallback(pk, static, mesh_axes,
+                                          allow_multistep)
 
         # single-pass E+H kernel where its (stricter) scope allows —
         # ~2/3 the HBM traffic of the two-pass kernels, but ONLY when
@@ -568,12 +637,14 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
         if eh is not None and (eh.diag["tile"]["EH"] >= 4
                                or _os.environ.get("FDTD3D_FORCE_FUSED")):
             eh.kind = "pallas_fused"
-            return eh
+            return _stamp_tb_fallback(eh, static, mesh_axes,
+                                      allow_multistep)
         from fdtd3d_tpu.ops import pallas3d
         fused = pallas3d.make_pallas_step(static, mesh_axes, mesh_shape)
         if fused is not None:
             fused.kind = "pallas"
-            return fused
+            return _stamp_tb_fallback(fused, static, mesh_axes,
+                                      allow_multistep)
         # (no eh fallback here: single-pass eligibility is a strict
         # subset of two-pass eligibility, so eh is None whenever
         # make_pallas_step returned None)
@@ -776,7 +847,9 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
         new_state["t"] = t + 1
         return new_state
 
-    return step
+    step.kind = "jnp"
+    return _stamp_tb_fallback(step, static, mesh_axes,
+                              allow_multistep)
 
 
 def _make_ds_step(static: StaticSetup, mesh_axes=None, mesh_shape=None):

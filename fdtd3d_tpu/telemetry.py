@@ -414,12 +414,18 @@ def provenance(sim=None) -> Dict[str, Any]:
             vmem_rung=int(getattr(sim, "_vmem_rung", 0)),
         )
         if sim.step_diag:
-            rec["tile"] = dict(sim.step_diag.get("tile") or {})
+            if sim.step_diag.get("tile") is not None:
+                rec["tile"] = dict(sim.step_diag.get("tile") or {})
             if sim.step_diag.get("temporal_block") is not None:
                 # the temporal-blocked pipeline depth the step consumed
                 # (the auto-depth decision, ops/pallas_packed_tb.py)
                 rec["ghost_depth"] = int(
                     sim.step_diag["temporal_block"])
+            if sim.step_diag.get("tb_fallback") is not None:
+                # why this run is NOT temporal-blocked (the 2x-HBM
+                # downgrade, named: solver.tb_fallback_reason) — so a
+                # fleet can see which scenarios pay the tax
+                rec["tb_fallback"] = dict(sim.step_diag["tb_fallback"])
         if tuple(sim.topology) != (1, 1, 1):
             # the communication-strategy record (ROADMAP item 1), so a
             # run's exchange posture is auditable from its telemetry
@@ -579,9 +585,12 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # count of a batched executor's sink. run_id (v7): the run-
     # registry stamp (fdtd3d_tpu/registry.py) joining this stream to
     # its runs.jsonl row; absent when FDTD3D_RUN_REGISTRY is unset.
+    # tb_fallback (round 17): {"reason": <token>} when the engaged
+    # kind is NOT pallas_packed_tb — the named 2x-HBM downgrade
+    # (solver.tb_fallback_reason); absent on temporal-blocked runs.
     "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
                   "vmem_rung", "tile", "comm_strategy", "ghost_depth",
-                  "aot_cache", "batch", "run_id"),
+                  "aot_cache", "batch", "run_id", "tb_fallback"),
     # sim.close_telemetry (round 15): the run's compile wall
     # (exec-cache misses only; a fully-warm run reads 0.0) + the final
     # counter snapshot — the compile-amortization proof per run.

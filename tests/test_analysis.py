@@ -76,7 +76,9 @@ def test_scope_coverage_is_enumerated_zero(structural_report):
     percentage) for every sharded step kind on the (2,2,2) mesh."""
     from fdtd3d_tpu import costs
     stats = structural_report["rules"]["scope-coverage"]["stats"]
-    assert set(stats) == set(costs.SHARDED_STEP_KINDS)
+    # + the round-14 widened sharded tb lane (TFSF/Drude/grid wedge)
+    assert set(stats) == set(costs.SHARDED_STEP_KINDS) \
+        | {"pallas_packed_tb_widened"}
     for kind, row in stats.items():
         assert row["unscoped_collectives"] == 0, (kind, row)
         assert row["collectives"] > 0, (kind, row)   # lane not empty
@@ -85,7 +87,9 @@ def test_scope_coverage_is_enumerated_zero(structural_report):
 def test_donation_rule_covered_every_kernel(structural_report):
     stats = structural_report["rules"]["donation-safety"]["stats"]
     assert set(stats) == {"pallas", "pallas_fused", "pallas_packed",
-                          "pallas_packed_tb", "pallas_packed_ds"}
+                          "pallas_packed_tb",
+                          "pallas_packed_tb_widened",
+                          "pallas_packed_ds"}
     for label, row in stats.items():
         assert row["aliased_operands"] > 0, (label, row)
 

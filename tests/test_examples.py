@@ -192,12 +192,15 @@ def test_save_cmd_survives_default_drift(tmp_path):
 @pytest.mark.parametrize("name", ["sphere3D_mie.txt",
                                   "drude3D_nanoantenna.txt"])
 def test_baseline_multichip_configs_engage_packed(name):
-    """VERDICT r4 item 1 done-criterion: the BASELINE multi-chip
-    validation workloads (#4 Mie sphere, #5 Drude nanoantenna — both
-    SOURCED: TFSF) must run the flagship 48 B/cell packed kernel under
-    --topology auto on a mesh, not the 72 B/cell two-pass fallback.
-    Overrides come from CASES so this stays in lockstep with the
-    acceptance replay's shrunk geometry."""
+    """VERDICT r4 item 1 done-criterion, round-17 tightened: the
+    BASELINE multi-chip validation workloads (#4 Mie sphere, #5 Drude
+    nanoantenna — both SOURCED: TFSF, #5 also Drude + material grids)
+    must run the flagship kernel under --topology auto on a mesh —
+    since the widened sharded boundary wedge that is the TEMPORAL-
+    BLOCKED kernel (~24 B/cell/step), no longer the 48 B/cell
+    single-step packed kernel, and never the 72 B/cell two-pass
+    fallback. Overrides come from CASES so this stays in lockstep
+    with the acceptance replay's shrunk geometry."""
     from fdtd3d_tpu import cli as _cli
     argv = _cli.read_cmd_file(os.path.join(EXAMPLES_DIR, name)) \
         + CASES[name][0] + ["--use-pallas", "on"]
@@ -206,7 +209,8 @@ def test_baseline_multichip_configs_engage_packed(name):
     from fdtd3d_tpu.sim import Simulation
     sim = Simulation(cfg)
     assert sim.mesh is not None, "auto topology did not engage the mesh"
-    assert sim.step_kind == "pallas_packed", sim.step_kind
+    assert sim.step_kind == "pallas_packed_tb", sim.step_kind
+    assert "tb_fallback" not in (sim.step_diag or {})
     sim.advance(2)
     import numpy as np
     for c, v in sim.fields().items():

@@ -59,7 +59,15 @@ def reference_fields():
 
 
 @pytest.mark.parametrize("topo", TOPOLOGIES)
-def test_sharded_packed_with_sources(topo, reference_fields):
+def test_sharded_packed_with_sources(topo, reference_fields,
+                                     monkeypatch):
+    # round 17: the widened wedge makes sharded TFSF/Drude/grid runs
+    # dispatch pallas_packed_tb by default — this test targets the
+    # SINGLE-STEP kernel's patch machinery, so pin the escape hatch
+    # (the round-13 test_packed_sharded_parity precedent); the tb
+    # path's own sourced-sharded parity lives in
+    # tests/test_pallas_packed_tb.py's widened tests
+    monkeypatch.setenv("FDTD3D_NO_TEMPORAL", "1")
     cfg = _cfg(ParallelConfig(topology="manual", manual_topology=topo),
                use_pallas=True)
     sim = Simulation(cfg)
@@ -74,12 +82,14 @@ def test_sharded_packed_with_sources(topo, reference_fields):
         assert err < 1e-5 * scale, f"{comp}: {err/scale:.2e} on {topo}"
 
 
-def test_psi_state_parity_sharded_sourced():
+def test_psi_state_parity_sharded_sourced(monkeypatch):
     """The CPML psi recursion state must match too: the traced patch
     corrections may not leak into the slab psi stacks (the interior
     condition guarantees no psi term arises from the patches). Compared
     against the sharded jnp step on the SAME topology so the per-shard
-    slab-compacted psi layouts coincide."""
+    slab-compacted psi layouts coincide. FDTD3D_NO_TEMPORAL pinned:
+    this targets the single-step kernel (round-17 note above)."""
+    monkeypatch.setenv("FDTD3D_NO_TEMPORAL", "1")
     topo = ParallelConfig(topology="manual", manual_topology=(2, 2, 2))
     ref = Simulation(_cfg(topo, use_pallas=False))
     assert ref.step_kind == "jnp"
@@ -94,6 +104,26 @@ def test_psi_state_parity_sharded_sourced():
             rn = pdist.gather_to_host(rv)
             scale = np.abs(rn).max() + 1e-30
             assert np.abs(gv - rn).max() < 1e-5 * scale, key
+
+
+def test_sharded_tb_with_sources_default_dispatch(reference_fields):
+    """Round 17: the SAME oblique-TFSF + Drude + mu-grid sourced
+    config under the DEFAULT dispatch — now the widened temporal-
+    blocked kernel — must match the unsharded jnp reference too: the
+    wedge's incident-line port under oblique incidence (teta/phi/psi
+    all nonzero), its J ring, and per-cell da/db sub-blocks from the
+    mu sphere, all in one run."""
+    cfg = _cfg(ParallelConfig(topology="manual",
+                              manual_topology=(2, 2, 2)),
+               use_pallas=True)
+    sim = Simulation(cfg)
+    assert sim.step_kind == "pallas_packed_tb", sim.step_kind
+    sim.run()
+    got = sim.fields()
+    for comp, ref in reference_fields.items():
+        scale = np.abs(ref).max() + 1e-30
+        err = np.abs(got[comp] - ref).max()
+        assert err < 1e-5 * scale, f"{comp}: {err/scale:.2e}"
 
 
 def test_source_near_pml_falls_back():

@@ -436,6 +436,127 @@ def test_tb_sharded_strategy_override_parity(monkeypatch):
 
 
 # -------------------------------------------------------------------------
+# round-14 widened SHARDED scenarios: the wedge pre-pass's three new
+# ports (incident line / J ring / tiled coefficients)
+# -------------------------------------------------------------------------
+
+WIDENED_KW = {
+    "tfsf": dict(pml=PmlConfig(size=(2, 2, 2)),
+                 tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2))),
+    "drude": dict(pml=PmlConfig(size=(0, 2, 2)),
+                  materials=MaterialsConfig(
+                      use_drude=True, eps_inf=1.5, omega_p=1e11,
+                      gamma=1e10,
+                      drude_sphere=SphereConfig(enabled=True,
+                                                center=(8, 8, 8),
+                                                radius=3))),
+    "grid": dict(pml=PmlConfig(size=(2, 2, 2)),
+                 materials=MaterialsConfig(
+                     eps=2.0,
+                     eps_sphere=SphereConfig(enabled=True,
+                                             center=(8, 8, 8),
+                                             radius=4, value=6.0))),
+}
+
+
+def _sharded_widened_parity(monkeypatch, topo, scenario, steps=8,
+                            depth=None, seed=0, tol=2e-6,
+                            extra_state=()):
+    """ISSUE-14 acceptance: a widened sharded scenario dispatches
+    ``pallas_packed_tb`` and matches BOTH the jnp step and the
+    single-step ``pallas_packed`` reference (FDTD3D_NO_TEMPORAL) at
+    f32 roundoff — fields, psi recursion state and (Drude) J — over a
+    MULTI-CHUNK run (two advance() calls, non-divisible first chunk
+    when steps allows)."""
+    from fdtd3d_tpu.parallel import distributed as pdist
+    par = ParallelConfig(topology="manual", manual_topology=topo)
+    base = dict(BASE, time_steps=steps, parallel=par,
+                **WIDENED_KW[scenario])
+
+    def run(use_pallas, no_temporal=False):
+        if no_temporal:
+            monkeypatch.setenv("FDTD3D_NO_TEMPORAL", "1")
+        else:
+            monkeypatch.delenv("FDTD3D_NO_TEMPORAL", raising=False)
+        sim = Simulation(SimConfig(**dict(base, use_pallas=use_pallas)))
+        _seed_fields(sim, seed=seed)
+        n1 = steps // 2 + (steps % 2)
+        sim.advance(n1)                 # multi-chunk: two compiled
+        sim.advance(steps - n1)         # chunk lengths
+        return sim
+
+    j = run(False)
+    pk = run(True, no_temporal=True)
+    assert pk.step_kind == "pallas_packed", pk.step_kind
+    assert pk.step_diag["tb_fallback"]["reason"] == \
+        "env:FDTD3D_NO_TEMPORAL"
+    p = run(True)
+    assert p.step_kind == "pallas_packed_tb", (scenario, p.step_kind)
+    if depth is not None:
+        assert p.step_diag["temporal_block"] == depth
+    assert "tb_fallback" not in (p.step_diag or {})
+    for ref, tag in ((j, "jnp"), (pk, "packed")):
+        for c in ("Ex", "Ey", "Ez", "Hx", "Hy", "Hz"):
+            a = np.asarray(pdist.gather_to_host(ref.field(c)),
+                           np.float32)
+            b = np.asarray(pdist.gather_to_host(p.field(c)),
+                           np.float32)
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < tol, \
+                f"{scenario} {c} vs {tag}: rel {rel:.2e} on {topo}"
+    for grp in ("psi_E", "psi_H") + tuple(extra_state):
+        if grp not in j.state:
+            continue
+        for key in j.state[grp]:
+            a = np.asarray(pdist.gather_to_host(j.state[grp][key]))
+            b = np.asarray(pdist.gather_to_host(p.state[grp][key]))
+            rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-30)
+            assert rel < tol, \
+                f"{scenario} {grp}/{key}: rel {rel:.2e} on {topo}"
+    return p
+
+
+def test_tb_sharded_tfsf_widened_k2(monkeypatch, tb_depth):
+    """Sharded TFSF through the wedge incident-line port at k=2 on
+    (2,2,2) — tier-1 representative; more depths/topologies in the
+    slow-lane matrix."""
+    tb_depth(2)
+    _sharded_widened_parity(monkeypatch, (2, 2, 2), "tfsf", depth=2)
+
+
+def test_tb_sharded_drude_widened_k3(monkeypatch, tb_depth):
+    """Sharded electric-Drude through the wedge J ring at k=3 on
+    (1,2,2), including the J state (the drude sphere also makes
+    ca/cb/bj per-cell GRIDS, so the tiled-coefficient port is
+    exercised in the same run). Odd horizon: blocked passes + a
+    sharded single-step tail."""
+    tb_depth(3)
+    _sharded_widened_parity(monkeypatch, (1, 2, 2), "drude", steps=7,
+                            depth=3, extra_state=("J",))
+
+
+def test_tb_sharded_material_grid_widened_k2(monkeypatch, tb_depth):
+    """Sharded material grids (eps sphere -> 3D ca/cb) through the
+    wedge's per-cell coefficient sub-blocks at k=2 on (2,1,1) — the
+    x-sharded wedge slices the grids along the tiled axis."""
+    tb_depth(2)
+    _sharded_widened_parity(monkeypatch, (2, 1, 1), "grid", depth=2)
+
+
+@pytest.mark.slow
+def test_tb_sharded_widened_matrix(monkeypatch, tb_depth):
+    """The full widened-scenario x depth x topology matrix (tier-1
+    spreads one representative per scenario)."""
+    for k in (2, 3):
+        for scenario in ("tfsf", "drude", "grid"):
+            for topo in ((2, 2, 2), (1, 2, 2)):
+                tb_depth(k)
+                _sharded_widened_parity(
+                    monkeypatch, topo, scenario, depth=k,
+                    extra_state=("J",) if scenario == "drude" else ())
+
+
+# -------------------------------------------------------------------------
 # eligibility: widened scenarios dispatch tb; the rest stays on packed
 # -------------------------------------------------------------------------
 
@@ -499,40 +620,26 @@ def test_tb_widened_scenarios_depth_matrix(tb_depth):
 
 def test_tb_fallbacks_stay_on_packed():
     """Out-of-tb-scope configs must land on the round-6 packed kernel
-    (never jnp, never silently tb): in-absorber sources, SHARDED
-    TFSF/Drude/material grids (the wedge pre-pass has no port),
-    magnetic Drude. The widened unsharded scenarios are asserted IN
-    scope above so the dispatch can never silently regress."""
+    (never jnp, never silently tb) WITH a machine-readable
+    tb_fallback reason in the step diag: in-absorber sources and
+    magnetic Drude. The round-14 widened SHARDED scenarios
+    (TFSF/Drude/material grids — the wedge pre-pass gained all three
+    ports) now dispatch tb and are asserted in the widened sharded
+    parity tests, so the dispatch can never silently regress."""
     absorber = Simulation(SimConfig(
         **BASE, use_pallas=True, pml=PmlConfig(size=(3, 3, 3)),
         point_source=PointSourceConfig(enabled=True, component="Ez",
                                        position=(2, 8, 8))))
     assert absorber.step_kind == "pallas_packed", absorber.step_kind
+    assert absorber.step_diag["tb_fallback"]["reason"] == \
+        "source_in_absorber"
 
     sharded = Simulation(SimConfig(
         **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
         parallel=ParallelConfig(topology="manual",
                                 manual_topology=(1, 2, 2))))
     assert sharded.step_kind == "pallas_packed_tb", sharded.step_kind
-
-    tfsf_sharded = Simulation(SimConfig(
-        **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
-        tfsf=TfsfConfig(enabled=True, margin=(2, 2, 2)),
-        parallel=ParallelConfig(topology="manual",
-                                manual_topology=(1, 2, 2))))
-    assert tfsf_sharded.step_kind == "pallas_packed", \
-        tfsf_sharded.step_kind
-
-    grid_sharded = Simulation(SimConfig(
-        **BASE, use_pallas=True, pml=PmlConfig(size=(2, 2, 2)),
-        materials=MaterialsConfig(
-            eps=2.0, eps_sphere=SphereConfig(enabled=True,
-                                             center=(8, 8, 8),
-                                             radius=4, value=6.0)),
-        parallel=ParallelConfig(topology="manual",
-                                manual_topology=(1, 2, 2))))
-    assert grid_sharded.step_kind == "pallas_packed", \
-        grid_sharded.step_kind
+    assert "tb_fallback" not in (sharded.step_diag or {})
 
     drude_m = Simulation(SimConfig(
         **BASE, use_pallas=True, pml=PmlConfig(size=(0, 3, 3)),
@@ -541,6 +648,76 @@ def test_tb_fallbacks_stay_on_packed():
             drude_m_sphere=SphereConfig(enabled=True, center=(8, 8, 8),
                                         radius=3))))
     assert drude_m.step_kind == "pallas_packed", drude_m.step_kind
+    assert drude_m.step_diag["tb_fallback"]["reason"] == \
+        "magnetic_drude"
+
+
+def test_tb_fallback_reason_env_and_jnp(monkeypatch):
+    """Dispatch-context fallbacks are named too: the escape hatch
+    records its env knob, a pallas-off run records pallas_disabled —
+    the ledger and telemetry run_start carry the same record (the
+    2x-HBM tax is never silent; ISSUE-14 satellite 1)."""
+    cfg = SimConfig(**BASE, use_pallas=True,
+                    pml=PmlConfig(size=(3, 3, 3)))
+    monkeypatch.setenv("FDTD3D_NO_TEMPORAL", "1")
+    sim = Simulation(cfg)
+    assert sim.step_kind == "pallas_packed"
+    assert sim.step_diag["tb_fallback"]["reason"] == \
+        "env:FDTD3D_NO_TEMPORAL"
+    monkeypatch.delenv("FDTD3D_NO_TEMPORAL")
+    j = Simulation(SimConfig(**BASE, use_pallas=False,
+                             pml=PmlConfig(size=(3, 3, 3))))
+    assert j.step_kind == "jnp"
+    assert j.step_diag["tb_fallback"]["reason"] == "pallas_disabled"
+
+
+def test_tb_fallback_stamp_never_raises_on_unviable_pin(monkeypatch):
+    """The fallback STAMP may not consult the depth picker when the
+    dispatch context already declined tb: an unviable FDTD3D_TB_DEPTH
+    pin combined with the escape hatch (the exact remedy the pin's
+    error message recommends) or with pallas off must yield a stamped
+    step, not a ValueError. The pin still raises when the dispatch
+    actually consults the picker (third leg)."""
+    thin = dict(BASE, pml=PmlConfig(size=(2, 0, 2)),
+                parallel=ParallelConfig(topology="manual",
+                                        manual_topology=(1, 8, 1)))
+    monkeypatch.setenv("FDTD3D_TB_DEPTH", "4")  # 2-cell shards: k=4
+    monkeypatch.setenv("FDTD3D_NO_TEMPORAL", "1")  # can't wedge
+    s = Simulation(SimConfig(**thin, use_pallas=True))
+    assert s.step_kind != "pallas_packed_tb"
+    assert s.step_diag["tb_fallback"]["reason"] == \
+        "env:FDTD3D_NO_TEMPORAL"
+    monkeypatch.delenv("FDTD3D_NO_TEMPORAL")
+    j = Simulation(SimConfig(**thin, use_pallas=False))
+    assert j.step_diag["tb_fallback"]["reason"] == "pallas_disabled"
+    with pytest.raises(ValueError, match="FDTD3D_TB_DEPTH=4"):
+        Simulation(SimConfig(**thin, use_pallas=True))
+
+
+def test_tb_plan_is_single_authority():
+    """ISSUE-14 satellite 2: plan_tb is the ONE decision — the
+    dispatch (make_step), the planner (plan._infer_step_kind /
+    CommStrategy.ghost_depth) and the builder agree with it on
+    eligibility AND depth for widened sharded configs."""
+    import dataclasses as dc
+
+    from fdtd3d_tpu import costs, solver
+    from fdtd3d_tpu.ops import pallas_packed_tb
+    from fdtd3d_tpu.parallel.mesh import mesh_axis_map
+    from fdtd3d_tpu.plan import comm_strategy
+    cfg = costs.config_tb_widened()
+    topo = (2, 2, 2)
+    static = dc.replace(solver.build_static(cfg), topology=topo)
+    tbp = pallas_packed_tb.plan_tb(static, mesh_axis_map(topo))
+    assert tbp.eligible and tbp.reason is None
+    strat = comm_strategy(cfg, topo)
+    assert strat.step_kind == "pallas_packed_tb"
+    assert strat.ghost_depth == tbp.depth
+    sim = Simulation(dc.replace(
+        cfg, parallel=ParallelConfig(topology="manual",
+                                     manual_topology=topo)))
+    assert sim.step_kind == "pallas_packed_tb"
+    assert sim.step_diag["temporal_block"] == tbp.depth
 
 
 def test_tb_paired_complex_legs_stay_single_step(monkeypatch):

@@ -81,6 +81,10 @@ def summarize_run(run):
         # the run-registry join key (v7): trace this stream back to
         # its runs.jsonl row (tools/fleet_report.py)
         out["run_id"] = start["run_id"]
+    if start.get("tb_fallback"):
+        # the named 2x-HBM downgrade (round 17): why this run did not
+        # temporal-block (solver.tb_fallback_reason tokens)
+        out["tb_fallback"] = start["tb_fallback"]
     # compile-amortization lane (schema v6 optional keys): the run's
     # compile wall + whether the exec cache was warm at start
     if end is not None and end.get("compile_ms") is not None:
@@ -194,6 +198,11 @@ def format_text(summaries) -> str:
                 + (f"{fe:.3e} J" if fe is not None else "n/a")
                 + ", max div_l2 "
                 + (f"{dv:.3e}" if dv is not None else "n/a"))
+        if s.get("tb_fallback"):
+            lines.append(f"  tb fallback: reason="
+                         f"{s['tb_fallback'].get('reason')} (not "
+                         f"temporal-blocked: ~2x the HBM bytes/step "
+                         f"of the blocked kernel)")
         for d in s["ladder_downgrades"]:
             lines.append(f"  LADDER DOWNGRADE at t={d['t']}: tile "
                          f"{d['old_tile']} -> {d['new_tile']} "
