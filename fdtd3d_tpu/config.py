@@ -144,6 +144,17 @@ ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
           "(fdtd3d_tpu/batch.py run_batch / CLI --batch): vmap is "
           "linear in lanes for HBM and compile time, so an unbounded "
           "batch is an OOM with extra steps."),
+    _knob("FDTD3D_JOB_QUEUE_DIR", "path", None,
+          "Default queue directory for the durable multi-tenant job "
+          "queue (fdtd3d_tpu/jobqueue.py; operator CLI tools/"
+          "fdtd_queue.py submit/serve/status/cancel). The append-"
+          "only journal.jsonl plus per-job/group artifact dirs live "
+          "under it. Unset: --queue-dir must be passed explicitly."),
+    _knob("FDTD3D_QUEUE_TENANT", "str", "default",
+          "Default tenant name for job-queue submissions "
+          "(tools/fdtd_queue.py submit without --tenant): per-tenant "
+          "quotas, the jobs_total{tenant} metrics and the fleet "
+          "rollups key on it."),
     _knob("FDTD3D_RUN_REGISTRY", "path", None,
           "Append-only fleet run index (fdtd3d_tpu/registry.py): "
           "every Simulation/BatchSimulation run appends one "

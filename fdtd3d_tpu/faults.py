@@ -57,6 +57,14 @@ Fault kinds:
     the file / zero bytes mid-file; for an orbax directory, delete its
     COMMIT marker) — proving the integrity checks catch it and resume
     falls back to an older snapshot.
+``sched_crash@job=N``
+    Kill the job-queue SCHEDULER (fdtd3d_tpu/jobqueue.py) between its
+    journal writes: the Nth dispatched job's run finishes, and the
+    :class:`SimulatedPreemption` fires BEFORE its post-run journal row
+    lands — the stand-in for the scheduler process dying mid-commit.
+    The journal then still reads the job as ``running``; a restarted
+    scheduler must re-drive it to a terminal state from the append-only
+    journal alone (the crash-safety contract docs/SERVICE.md proves).
 
 All faults are one-shot (``times`` generalizes that for ``error``), so
 a rolled-back run does not re-fire them — exactly the semantics of a
@@ -98,7 +106,7 @@ class InjectedWriteError(OSError):
 
 
 _KINDS = ("nan", "preempt", "error", "fail_write", "corrupt_ckpt",
-          "host_lost")
+          "host_lost", "sched_crash")
 
 # Keys each kind actually reads: a key the kind would silently ignore
 # (e.g. fail_write@...,chip=1 where host= was meant) is a plan that
@@ -110,6 +118,7 @@ _KIND_KEYS = {
     "fail_write": ("n", "host"),
     "corrupt_ckpt": ("n", "mode"),
     "host_lost": ("n",),
+    "sched_crash": ("job",),
 }
 
 
@@ -126,6 +135,8 @@ class Fault:
     chip: Optional[int] = None  # chip scope (nan): mesh-linearized id
     host: Optional[int] = None  # host scope (fail_write)
     lane: Optional[int] = None  # batch-lane scope (nan): vmap lane id
+    job: Optional[int] = None   # dispatch ordinal (sched_crash): the
+    #                             Nth job the scheduler dispatched
     fired: int = 0        # firings so far (one-shot bookkeeping)
 
 
@@ -163,13 +174,14 @@ class FaultPlan:
                 key, _, val = kv.partition("=")
                 key, val = key.strip(), val.strip()
                 if key in ("t", "n", "times", "chip", "host", "lane",
-                           "field", "mode") \
+                           "job", "field", "mode") \
                         and key not in _KIND_KEYS[kind]:
                     raise ValueError(
                         f"fault-plan key {key!r} does not apply to "
                         f"kind {kind!r} in {entry!r} (valid for "
                         f"{kind}: {', '.join(_KIND_KEYS[kind])})")
-                if key in ("t", "n", "times", "chip", "host", "lane"):
+                if key in ("t", "n", "times", "chip", "host", "lane",
+                           "job"):
                     try:
                         setattr(f, key, int(val))
                     except ValueError:
@@ -182,7 +194,7 @@ class FaultPlan:
                     raise ValueError(
                         f"unknown fault-plan key {key!r} in {entry!r} "
                         f"(valid: t, n, times, field, mode, chip, "
-                        f"host, lane)")
+                        f"host, lane, job)")
             if f.mode not in ("truncate", "zero"):
                 raise ValueError(
                     f"fault plan entry {entry!r}: mode must be "
@@ -305,6 +317,30 @@ def on_host_publish(host: int) -> None:
             raise SimulatedHostLoss(
                 f"fault plan: host {host} lost during coordinated "
                 f"commit (injected)")
+
+
+def on_sched_journal(job_ordinal: int) -> None:
+    """From the job-queue dispatcher (fdtd3d_tpu/jobqueue.py),
+    immediately BEFORE the first post-run journal write of each
+    dispatched job: a ``sched_crash@job=N`` fault kills the scheduler
+    right there when ``job_ordinal`` (the dispatch counter since the
+    scheduler process started, 1-based; a coalesced group is ONE
+    dispatch, even when its constructor rejects it and the jobs fall
+    back to solo — EVERY consumed ordinal is offered here, so fault
+    targeting can never silently shift) matches. The job's run (or
+    failed build) already finished — the journal is left one
+    transition short, which is exactly the window the
+    replay-on-restart contract must cover."""
+    if _PLAN is None:
+        return
+    for f in _PLAN.faults:
+        if f.kind == "sched_crash" and not f.fired \
+                and f.job == job_ordinal:
+            f.fired = 1
+            raise SimulatedPreemption(
+                f"fault plan: scheduler crashed after dispatch "
+                f"#{job_ordinal}'s run, before its journal write "
+                f"(injected)")
 
 
 def on_checkpoint(path: str) -> None:

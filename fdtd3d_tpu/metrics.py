@@ -40,6 +40,11 @@ straggler_chip                      gauge      imbalance.argmax (worst)
 alerts_total{rule}                  counter    alert (fdtd3d_tpu/slo.py)
 aot_cache_hits / _misses /
   _disk_hits / _traces              gauge      run_end.aot_cache
+jobs_submitted_total{tenant}        counter    job_submit (queue journal)
+jobs_total{status,tenant}           counter    job_state terminal rows
+queue_depth                         gauge      journal fold (last-status
+                                               == queued job count)
+queue_wait_seconds                  histogram  job_state running.wait_s
 ==================================  =========  =========================
 """
 
@@ -53,6 +58,16 @@ PREFIX = "fdtd3d_"
 # test chunks through minute-class tunnel dispatches)
 WALL_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                 30.0, 60.0)
+
+# queue-wait histogram buckets, seconds: queue waits live on a longer
+# clock than chunk walls (an aged job can sit behind quota for
+# minutes), so the ladder runs out to an hour
+QUEUE_WAIT_BUCKETS = (0.1, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0)
+
+# the queue-journal statuses that end a job (fdtd3d_tpu/jobqueue.py
+# owns the lifecycle; this module only needs to know which rows close
+# the jobs_total{status,tenant} counter)
+_JOB_TERMINAL = ("completed", "failed", "cancelled")
 
 
 def _esc(v: Any) -> str:
@@ -98,6 +113,10 @@ class MetricsRegistry:
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self._metrics: Dict[str, _Metric] = {}
+        # queue-journal fold: job_id -> last status, so queue_depth is
+        # a true gauge (a requeued job re-enters the depth) instead of
+        # an ever-growing counter difference
+        self._job_status: Dict[str, str] = {}
 
     # -- primitives ----------------------------------------------------
 
@@ -211,6 +230,34 @@ class MetricsRegistry:
             # registry rows (runs.jsonl): the fleet-status counter
             self.inc("runs_total", status=rec["status"],
                      help_="registry run_final rows by status")
+        elif rtype == "job_submit":
+            # queue-journal rows (fdtd3d_tpu/jobqueue.py): admission
+            self.inc("jobs_submitted_total", tenant=rec["tenant"],
+                     help_="queue jobs admitted, by tenant")
+            self._observe_job(rec)
+        elif rtype == "job_state":
+            if rec["status"] in _JOB_TERMINAL:
+                self.inc("jobs_total", status=rec["status"],
+                         tenant=rec["tenant"],
+                         help_="queue jobs reaching a terminal "
+                               "state, by status and tenant")
+            if rec["status"] == "running" \
+                    and isinstance(rec.get("wait_s"), (int, float)):
+                self.observe("queue_wait_seconds", rec["wait_s"],
+                             buckets=QUEUE_WAIT_BUCKETS,
+                             help_="queue wait between submit and "
+                                   "dispatch, seconds")
+            self._observe_job(rec)
+
+    def _observe_job(self, rec: Dict[str, Any]) -> None:
+        """Update the journal fold + the queue_depth gauge from one
+        job row (shared by the submit/state branches)."""
+        self._job_status[rec["job_id"]] = rec["status"]
+        depth = sum(1 for s in self._job_status.values()
+                    if s == "queued")
+        self.set_gauge("queue_depth", depth,
+                       help_="jobs whose latest journal status is "
+                             "queued")
 
     # -- exposition ----------------------------------------------------
 

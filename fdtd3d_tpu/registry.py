@@ -78,6 +78,33 @@ def set_default_kind(kind: str) -> None:
     _DEFAULT_KIND = str(kind)
 
 
+# the queue-job stamp job_context() installs: runs registered inside
+# the block carry it on their run_begin row AND on the sim itself
+# (sim.job_id -> telemetry run_start), so a registry row, a telemetry
+# stream and a queue-journal row are all joinable by job_id/run_id
+_JOB_CONTEXT: Optional[Dict[str, str]] = None
+
+
+@contextlib.contextmanager
+def job_context(job_id: str, tenant: Optional[str] = None):
+    """Attribute every run registered inside the block to queue job
+    ``job_id`` (fdtd3d_tpu/jobqueue.py dispatches runs under it; a
+    coalesced batch passes its GROUP id). The stamp lands on the
+    run_begin row and on the telemetry run_start, which is how
+    tools/fleet_report.py and tools/telemetry_report.py print
+    job-id-joined lines without parsing the journal."""
+    global _JOB_CONTEXT
+    old = _JOB_CONTEXT
+    ctx = {"job_id": str(job_id)}
+    if tenant:
+        ctx["tenant"] = str(tenant)
+    _JOB_CONTEXT = ctx
+    try:
+        yield
+    finally:
+        _JOB_CONTEXT = old
+
+
 @contextlib.contextmanager
 def suppress_registration():
     """No new registrations inside the block: the supervisor's ladder
@@ -128,6 +155,9 @@ class RunHandle:
         self.kind = kind
         self._writer = writer
         self._finalized = False
+        # queue-job attribution, captured at construction (the
+        # dispatcher wraps the whole run in one job_context block)
+        self._job = dict(_JOB_CONTEXT) if _JOB_CONTEXT else None
 
     @classmethod
     def open_for(cls, sim, kind: Optional[str] = None
@@ -170,6 +200,9 @@ class RunHandle:
         then traceable to its run, tools/ckpt_inspect.py)."""
         sim.run_id = self.run_id
         sim.run_registry = self
+        if self._job is not None:
+            # telemetry.provenance picks this up into run_start
+            sim.job_id = self._job["job_id"]
         meta = getattr(sim, "extra_ckpt_meta", None)
         if meta is not None:
             meta["run_id"] = self.run_id
@@ -206,6 +239,10 @@ class RunHandle:
             "save_dir": out_cfg.save_dir,
             "trace_dir": out_cfg.profile_dir,
         }
+        if self._job is not None:
+            out["job_id"] = self._job["job_id"]
+            if "tenant" in self._job:
+                out["tenant"] = self._job["tenant"]
         # executable identity: the provenance-free comparable digest
         # (exec_cache.registry_identity also carries step_kind and
         # ghost_depth, the engaged step's)
@@ -344,3 +381,23 @@ def read(path: str) -> List[Dict[str, Any]]:
     """Parse + validate a runs.jsonl registry (the telemetry
     validator owns the row schema)."""
     return _telemetry.read_jsonl(path)
+
+
+def resolve_artifact(registry_path: str,
+                     path: Optional[str]) -> Optional[str]:
+    """Resolve a registry row's artifact pointer (telemetry_path,
+    save_dir, ...) to a readable absolute path, or None.
+
+    Relative paths resolve against the REGISTRY file's directory,
+    never the reading tool's CWD: queue jobs run from per-job
+    save_dirs and fleet tools run from wherever the operator stands,
+    so the registry's own location is the only base both sides agree
+    on. THE shared resolver for tools/fleet_report.py and
+    tools/slo_gate.py --registry (one rule, so a stream a monitor can
+    join is by construction a stream the gate can judge)."""
+    if not path:
+        return None
+    if not os.path.isabs(path):
+        base = os.path.dirname(os.path.abspath(registry_path))
+        path = os.path.join(base, path)
+    return path if os.path.exists(path) else None

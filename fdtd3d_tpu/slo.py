@@ -36,6 +36,11 @@ table):
   topology_change) per 1000 steps <= ``threshold``.
 * ``straggler-ratio`` — worst per-chip max/mean imbalance ratio <=
   ``threshold``; a diverged (non-finite) chip fires outright.
+* ``queue-wait-p95`` — p95 queue wait (``job_state`` running rows'
+  ``wait_s``, the v8 job-queue journal) <= ``threshold`` seconds.
+  SKIPPED on streams with no job records, so pointing the gate at a
+  queue journal (``tools/fdtd_queue.py`` writes one telemetry-schema
+  JSONL) gates the queue with the same exit-code contract.
 """
 
 from __future__ import annotations
@@ -48,7 +53,7 @@ from fdtd3d_tpu import telemetry as _telemetry
 
 RULE_KINDS = ("throughput_floor", "chunk_wall_p95",
               "unhealthy_lane_fraction", "compile_budget",
-              "recovery_rate", "straggler_ratio")
+              "recovery_rate", "straggler_ratio", "queue_wait_p95")
 
 # step_kind -> BENCH_BEST/bench-artifact throughput keys (the
 # perf-sentinel PATHS table's run-level projection)
@@ -87,6 +92,7 @@ DEFAULT_RULES = (
     SloRule("compile-budget", "compile_budget", 1.25),
     SloRule("recovery-rate", "recovery_rate", 5.0),
     SloRule("straggler-ratio", "straggler_ratio", 2.0),
+    SloRule("queue-wait-p95", "queue_wait_p95", 300.0),
 )
 
 
@@ -302,6 +308,29 @@ def _eval_straggler_ratio(rule, run, ctx):
                 threshold=rule.threshold)
 
 
+def _eval_queue_wait_p95(rule, run, ctx):
+    """p95 of the queue waits the journal recorded at dispatch time
+    (``job_state`` running rows, v8). The journal has no run_start,
+    so ``run`` here is the whole journal read as one truncated-head
+    span (telemetry.split_runs tolerates that by design)."""
+    waits = [float(r["wait_s"]) for r in run
+             if r["type"] == "job_state"
+             and r["status"] == "running"
+             and isinstance(r.get("wait_s"), (int, float))]
+    if not waits:
+        return _res(rule, "SKIPPED",
+                    message="no job_state dispatch rows (not a queue "
+                            "journal, or nothing dispatched yet)")
+    p95 = _telemetry.pct_summary(waits)["p95"]
+    if p95 > rule.threshold:
+        return _res(rule, "VIOLATION", value=p95,
+                    threshold=rule.threshold, window=(0, 0),
+                    message=f"p95 queue wait {p95:.1f}s over the "
+                            f"{rule.threshold:.1f}s objective "
+                            f"({len(waits)} dispatches)")
+    return _res(rule, "OK", value=p95, threshold=rule.threshold)
+
+
 _EVALUATORS = {
     "throughput_floor": _eval_throughput_floor,
     "chunk_wall_p95": _eval_chunk_wall_p95,
@@ -309,6 +338,7 @@ _EVALUATORS = {
     "compile_budget": _eval_compile_budget,
     "recovery_rate": _eval_recovery_rate,
     "straggler_ratio": _eval_straggler_ratio,
+    "queue_wait_p95": _eval_queue_wait_p95,
 }
 
 

@@ -83,10 +83,18 @@ import numpy as np
 # run-registry row types "run_begin"/"run_final" (fdtd3d_tpu/
 # registry.py: the append-only runs.jsonl fleet index shares this
 # validator), and the optional `run_id` on run_start that makes a
-# telemetry stream joinable against its registry row. v1-v6 files
-# still read/validate (READ_VERSIONS).
-SCHEMA_VERSION = 7
-READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+# telemetry stream joinable against its registry row. v8 (multi-tenant
+# job queue, round 18): the queue journal's row types "job_submit"
+# (one per admitted job: tenant, priority, spec, device-cells) and
+# "job_state" (one per scheduler state transition: queued/running/
+# preempted/completed/failed/cancelled, carrying the run-registry
+# run_id, the placement topology and the queue-wait seconds), plus
+# the optional `job_id`/`tenant` stamps on run_start and the registry
+# run_begin row that join a run back to the queue job that owns it
+# (fdtd3d_tpu/jobqueue.py). v1-v7 files still read/validate
+# (READ_VERSIONS).
+SCHEMA_VERSION = 8
+READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -400,6 +408,11 @@ def provenance(sim=None) -> Dict[str, Any]:
         rid = getattr(sim, "run_id", None)
         if rid:
             rec["run_id"] = str(rid)
+        # queue-job stamp (v8, registry.job_context): joins this
+        # stream to its journal rows; absent outside queue runs
+        jid = getattr(sim, "job_id", None)
+        if jid:
+            rec["job_id"] = str(jid)
         nlanes = getattr(sim, "batch_size", None)
         if nlanes:
             rec["batch"] = int(nlanes)
@@ -562,6 +575,23 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "run_id": (str,), "status": (str,), "t": (int,),
         "steps": (int,), "wall_s": _NUM, "mcells_per_s": _NUM,
     },
+    # v8 (durable job queue, fdtd3d_tpu/jobqueue.py): the queue
+    # journal's row types. "job_submit" is the admission row (one per
+    # accepted job: tenant, priority, the scenario spec path and the
+    # device-cell footprint the quota accounting charges);
+    # "job_state" is one scheduler state transition — the journal is
+    # append-only and replayed on restart, folding by job_id with the
+    # LAST status winning, so a kill between writes loses at most the
+    # transition that was about to land (the job then reads as still
+    # in its previous state and the restarted scheduler re-drives it).
+    "job_submit": {
+        "job_id": (str,), "tenant": (str,), "status": (str,),
+        "priority": (int,), "wall_time": (str,), "spec": (str,),
+        "cells": _NUM,
+    },
+    "job_state": {
+        "job_id": (str,), "tenant": (str,), "status": (str,),
+    },
 }
 
 
@@ -588,9 +618,12 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # tb_fallback (round 17): {"reason": <token>} when the engaged
     # kind is NOT pallas_packed_tb — the named 2x-HBM downgrade
     # (solver.tb_fallback_reason); absent on temporal-blocked runs.
+    # job_id (v8): the queue-job stamp (registry.job_context) joining
+    # this stream to its journal rows; absent outside queue runs.
     "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
                   "vmem_rung", "tile", "comm_strategy", "ghost_depth",
-                  "aot_cache", "batch", "run_id", "tb_fallback"),
+                  "aot_cache", "batch", "run_id", "tb_fallback",
+                  "job_id"),
     # sim.close_telemetry (round 15): the run's compile wall
     # (exec-cache misses only; a fully-warm run reads 0.0) + the final
     # counter snapshot — the compile-amortization proof per run.
@@ -610,14 +643,32 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # the n_steps=0 sentinel (scenario identity, stable across
     # commits); artifact paths are as-configured (fleet_report
     # resolves relative ones against the registry file's directory).
+    # job_id/tenant (v8): the queue-job stamp (registry.job_context);
+    # a coalesced batch run carries the GROUP id as its job_id (the
+    # journal maps member jobs to the shared run_id).
     "run_begin": ("scheme", "grid", "dtype", "topology", "step_kind",
                   "ghost_depth", "batch", "jax_version",
                   "device_kind", "config_fp", "exec_key_comparable",
                   "telemetry_path", "metrics_path", "save_dir",
-                  "trace_dir"),
+                  "trace_dir", "job_id", "tenant"),
     "run_final": ("recovery_events", "unhealthy_lanes",
                   "first_unhealthy_t", "compile_ms", "aot_cache",
                   "exit_reason"),
+    # v8 queue-journal optional keys. job_submit: `unix` (submit epoch
+    # seconds — the queue-wait clock), `resume` (the job's resume
+    # policy token), `time_steps` (the horizon, for operator tables).
+    # job_state: run_id (the registry join key, on running/terminal
+    # rows), reason (why a job failed / was requeued), wait_s (queue
+    # wait at dispatch — the SLO queue-wait rule's input), topology
+    # (the placement decision), group (the coalesce-group id shared
+    # by vmap-batched jobs), lane (the job's vmap lane in its group),
+    # t (the solver step reached), excluded_chips (straggler chips
+    # the placement refused to schedule onto), unix (on `queued`
+    # requeue rows: resets the wait clock so a requeued job's next
+    # wait_s measures QUEUE time, not its previous run's duration).
+    "job_submit": ("unix", "resume", "time_steps"),
+    "job_state": ("run_id", "reason", "wait_s", "topology", "group",
+                  "lane", "t", "excluded_chips", "unix"),
 }
 
 
@@ -640,6 +691,8 @@ _V5_ONLY_KEYS = {"retry": ("chip", "host"),
 _V6_ONLY_TYPES = ("batch_lane",)
 # and from v7 on: the SLO alert record + the run-registry row types
 _V7_ONLY_TYPES = ("alert", "run_begin", "run_final")
+# and from v8 on: the job-queue journal row types
+_V8_ONLY_TYPES = ("job_submit", "job_state")
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
@@ -658,7 +711,8 @@ def validate_record(rec: Dict[str, Any]) -> None:
             (v < 4 and rtype in _V4_ONLY_TYPES) or \
             (v < 5 and rtype in _V5_ONLY_TYPES) or \
             (v < 6 and rtype in _V6_ONLY_TYPES) or \
-            (v < 7 and rtype in _V7_ONLY_TYPES):
+            (v < 7 and rtype in _V7_ONLY_TYPES) or \
+            (v < 8 and rtype in _V8_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):
@@ -779,6 +833,18 @@ class TelemetrySink:
             div_linf=health["div_linf"],
             max_e=health["max_e"], max_h=health["max_h"],
             finite=bool(health["finite"]), vmem_rung=int(vmem_rung))
+
+    def abandon(self) -> None:
+        """Drop the sink WITHOUT a run_end record — the job queue's
+        preemption path (fdtd3d_tpu/jobqueue.py): a preempted run's
+        stream must end exactly the way a killed process leaves it
+        (truncated, run_end-less, so the fleet tools' truncated-run
+        handling sees the real thing), but the fd is still released
+        because the in-process scheduler outlives the dead job."""
+        self._closed = True
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
     def close(self, t: int = 0, **extra) -> None:
         if self._closed:

@@ -167,7 +167,7 @@ def test_sink_scrubs_nested_nonfinite(tmp_path):
     assert rec["counters"]["energy"] == [1.0, None]
 
 
-def test_fixture_corpus_round_trips_v1_to_v7():
+def test_fixture_corpus_round_trips_v1_to_v8():
     """Satellite acceptance: every checked-in telemetry JSONL fixture
     still validates, and the corpus spans schema v1..v7 so no version
     can silently rot out of the read path."""
@@ -220,3 +220,17 @@ def test_fixture_corpus_round_trips_v1_to_v7():
     import pytest
     with pytest.raises(ValueError, match="unknown record type"):
         telemetry.validate_record(dict(alerts[0], v=6))
+    # the v8 queue-journal fixture (fdtd3d_tpu/jobqueue.py writers):
+    # submit + state rows validate, the preempted->queued->running->
+    # completed chain is present, and the job row types are
+    # version-gated to v8
+    v8 = telemetry.read_jsonl(os.path.join(FIX, "queue_v8.jsonl"))
+    assert {r["type"] for r in v8} == {"job_submit", "job_state"}
+    resumed = [r for r in v8 if r["job_id"] == "j-00002-cc33"]
+    assert [r["status"] for r in resumed] == \
+        ["queued", "running", "preempted", "queued", "running",
+         "completed"]
+    assert any(isinstance(r.get("wait_s"), float) for r in v8
+               if r["type"] == "job_state")
+    with pytest.raises(ValueError, match="unknown record type"):
+        telemetry.validate_record(dict(v8[0], v=7))

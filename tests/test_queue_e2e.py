@@ -1,0 +1,191 @@
+"""Chip-free job-queue crash-safety e2e (ISSUE 15 acceptance).
+
+One multi-tenant queue run drives the whole loop deterministically on
+CPU: three jobs from two tenants — two coalescible (tenant acme, one
+of them hit by a ``nan@...,lane=1`` fault inside the shared vmap
+executable) and one solo (tenant globex, preempted mid-run at t=16) —
+plus a quota rejection at the door, and a ``sched_crash@job=2`` fault
+that kills the scheduler BETWEEN journal writes. A restarted
+scheduler replays the append-only journal and drives every job to a
+terminal state:
+
+* the preempted job resumes from its committed checkpoint and its
+  final snapshot is BIT-IDENTICAL to an uninterrupted run of the same
+  spec;
+* the coalesced pair provably shared one compiled executable (the
+  exec-cache trace counter moved by exactly 2 for 3 jobs: one trace
+  for the pair's shared vmap chunk, one for the solo job — the
+  resumed dispatch re-used its executable);
+* the lane-NaN tenant's job fails with the lane and first-bad-step
+  named; the healthy lane's job completes;
+* ``tools/fleet_report.py --json`` names per-tenant outcomes joined
+  by run_id/job_id, and ``tools/slo_gate.py`` gates the journal via
+  the queue-wait rule.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import exec_cache, faults, io, jobqueue, registry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plan(monkeypatch):
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("FDTD3D_AOT_CACHE_DIR", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _run_tool(args, cwd=ROOT, timeout=120):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run([sys.executable] + args,
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=cwd)
+
+
+def test_queue_crash_restart_reaches_all_terminal(tmp_path,
+                                                  monkeypatch):
+    reg = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    base = ("--3d\n--same-size 12\n--time-steps 8\n"
+            "--courant-factor 0.4\n--wavelength 0.008\n")
+    spec_a = tmp_path / "a.txt"
+    spec_a.write_text(base + "--eps 1.0\n")
+    spec_b = tmp_path / "b.txt"
+    spec_b.write_text(base + "--eps 2.0\n")
+    spec_c = tmp_path / "c.txt"
+    spec_c.write_text("--3d\n--same-size 12\n--time-steps 24\n"
+                      "--courant-factor 0.4\n--wavelength 0.008\n"
+                      "--point-source Ez\n--checkpoint-every 8\n")
+
+    q = jobqueue.JobQueue(str(tmp_path / "queue"))
+    # priorities: the coalescible pair dispatches first (the fault
+    # plan's t thresholds rely on that deterministic order)
+    a = q.submit(str(spec_a), tenant="acme", priority=1)
+    b = q.submit(str(spec_b), tenant="acme", priority=1)
+    c = q.submit(str(spec_c), tenant="globex", priority=0)
+    # quota rejection, named: a third acme job over max_queued=2
+    with pytest.raises(jobqueue.QuotaError,
+                       match="'acme'.*max_queued"):
+        q.submit(str(spec_a), tenant="acme",
+                 policy=jobqueue.QuotaPolicy(max_queued=2))
+
+    # dispatch 1 = the (a, b) batch: lane 1's NaN fires at its t=4
+    # chunk boundary (batch horizon 8 < 16 keeps the preempt fault
+    # out of it). dispatch 2 = c: preempted at t=16 (after the t=16
+    # cadence snapshot), then sched_crash kills the scheduler before
+    # c's post-run journal row lands.
+    faults.install("nan@t=4,field=Ez,lane=1; preempt@t=16; "
+                   "sched_crash@job=2")
+    exec_cache.clear_memory()
+    traces0 = exec_cache.stats()["traces"]
+    sched = jobqueue.Scheduler(q, batch_chunk=4)
+    with pytest.raises(faults.SimulatedPreemption,
+                       match="scheduler crashed"):
+        sched.serve()
+
+    # the journal is exactly one transition short: c still "running"
+    jobs = q.jobs()
+    assert jobs[a]["status"] == "completed"
+    assert jobs[b]["status"] == "failed"
+    assert "lane 1 non-finite" in jobs[b]["reason"]
+    assert jobs[c]["status"] == "running"
+    # the coalesced pair shared ONE run (one executable, one group)
+    assert jobs[a]["run_id"] == jobs[b]["run_id"]
+    assert jobs[a]["group"] == jobs[b]["group"]
+    assert jobs[a]["group"].startswith("g-")
+    assert jobs[a]["lane"] == 0 and jobs[b]["lane"] == 1
+
+    # restart: the incident is over (the fault plan's fired flags ARE
+    # the record); a fresh scheduler replays the journal
+    faults.clear()
+    out = jobqueue.Scheduler(q).serve()
+    jobs = out["jobs"]
+    assert {j["status"] for j in jobs.values()} <= \
+        set(jobqueue.TERMINAL_STATES)
+    assert jobs[c]["status"] == "completed" and jobs[c]["t"] == 24
+    assert jobs[a]["status"] == "completed"
+    assert jobs[b]["status"] == "failed"
+
+    # trace-counter proof: 3 jobs, 2 executables — the pair shared
+    # one vmap chunk; the resumed solo dispatch re-used its cached
+    # n=8 chunk executable instead of tracing again
+    assert exec_cache.stats()["traces"] - traces0 == 2
+
+    # bit-identical resume: an uninterrupted run of c's spec ends in
+    # the same final snapshot, array for array
+    monkeypatch.delenv("FDTD3D_RUN_REGISTRY")
+    from fdtd3d_tpu import cli
+    ref_dir = str(tmp_path / "ref")
+    rc = cli.main(["--cmd-from-file", str(spec_c),
+                   "--save-dir", ref_dir])
+    assert rc == 0
+    ref_ck = io.find_latest_checkpoint(ref_dir)
+    job_ck = io.find_latest_checkpoint(q.job_dir(c))
+    sref, mref = io.load_checkpoint(ref_ck)
+    sjob, mjob = io.load_checkpoint(job_ck)
+    assert mref["t"] == mjob["t"] == 24
+
+    def _leaves(tree, prefix=""):
+        for k in sorted(tree):
+            v = tree[k]
+            if isinstance(v, dict):
+                yield from _leaves(v, f"{prefix}{k}/")
+            else:
+                yield f"{prefix}{k}", v
+
+    ref_leaves = dict(_leaves(sref))
+    job_leaves = dict(_leaves(sjob))
+    assert set(ref_leaves) == set(job_leaves)
+    for key, arr in ref_leaves.items():
+        assert np.array_equal(arr, job_leaves[key]), key
+
+    # fleet view: per-tenant outcomes joined by run_id/job_id. The
+    # killed first dispatch of c stays "running" (a run killed
+    # without close is exactly that); the batch folded "recovered"
+    # (lane isolation IS its recovery); the resumed run completed.
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg)
+    proc = _run_tool([os.path.join(TOOLS, "fleet_report.py"), reg,
+                      "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rollup = json.loads(proc.stdout)
+    assert rollup["fleet"]["by_status"] == \
+        {"completed": 1, "recovered": 1, "running": 1}
+    runs = rollup["runs"]
+    batch_run = runs[jobs[a]["run_id"]]
+    assert batch_run["job_id"] == jobs[a]["group"]
+    assert batch_run["tenant"] == "acme"
+    assert batch_run["status"] == "recovered"
+    solo_run = runs[jobs[c]["run_id"]]
+    assert solo_run["job_id"] == c
+    assert solo_run["tenant"] == "globex"
+    assert solo_run["status"] == "completed"
+    # the unhealthy tenant (lane 1 = job b) is named in the rollup
+    assert any(t["run"] == jobs[b]["run_id"] and t["lane"] == 1
+               for t in rollup["fleet"]["unhealthy_tenants"])
+
+    # the journal itself gates: the queue-wait-p95 rule judges the
+    # dispatch rows (OK at the default 300s objective), exit 0
+    proc = _run_tool([os.path.join(TOOLS, "slo_gate.py"),
+                      q.journal])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue-wait-p95" in proc.stdout
+    assert "OK" in proc.stdout
+
+    # and the operator CLI folds the same journal
+    proc = _run_tool([os.path.join(TOOLS, "fdtd_queue.py"),
+                      "status", "--queue-dir", q.dirpath, "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    folded = json.loads(proc.stdout)["jobs"]
+    assert folded[c]["status"] == "completed"
+    assert folded[b]["status"] == "failed"

@@ -181,6 +181,30 @@ def test_alerts_validate_and_overall_status():
     assert slo.evaluate_run(empty)["status"] == "INCONCLUSIVE"
 
 
+def test_queue_wait_p95():
+    """v8 queue journal rows (fdtd3d_tpu/jobqueue.py): the rule
+    judges dispatch-time waits; a journal has no run_start, so the
+    whole file reads as one truncated-head run."""
+    def running(jid, wait):
+        return {"v": 8, "type": "job_state", "job_id": jid,
+                "tenant": "t", "status": "running", "wait_s": wait}
+    run = [running("a", 1.0), running("b", 2.0), running("c", 400.0)]
+    res, status = _one(run, _rule("queue_wait_p95", 300.0))
+    assert res["status"] == "VIOLATION" and status == "VIOLATION"
+    assert res["value"] > 300.0
+    res, _ = _one(run, _rule("queue_wait_p95", 1000.0))
+    assert res["status"] == "OK"
+    # a terminal row without wait_s does not count as a dispatch
+    done = {"v": 8, "type": "job_state", "job_id": "a",
+            "tenant": "t", "status": "completed", "t": 8}
+    res, _ = _one([done], _rule("queue_wait_p95", 300.0))
+    assert res["status"] == "SKIPPED"
+    # not a queue journal at all: SKIPPED, never a silent pass
+    res, _ = _one([_start(), _chunk(1, 4), _end()],
+                  _rule("queue_wait_p95", 300.0))
+    assert res["status"] == "SKIPPED"
+
+
 def test_evaluate_stream_splits_runs():
     records = [_start(), _chunk(1, 4), _end(),
                _start(), _chunk(1, 4, wall=100.0), _end()]

@@ -71,7 +71,7 @@ def test_legacy_tools_refuse_without_flag(tool):
 def test_telemetry_report_runs_on_fixtures():
     for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl",
                     "telemetry_v5.jsonl", "telemetry_v6.jsonl",
-                    "telemetry_v7.jsonl"):
+                    "telemetry_v7.jsonl", "queue_v8.jsonl"):
         proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
                      os.path.join(FIX, fixture), "--json"])
         assert proc.returncode == 0, (fixture, proc.stderr)
@@ -147,6 +147,47 @@ def test_fleet_report_runs_on_fixture():
     proc = _run([tool, os.path.join(FIX, "nope.jsonl")])
     assert proc.returncode == 1
     assert "no such registry" in proc.stderr
+
+
+def test_fdtd_queue_status_runs_on_fixture(tmp_path):
+    """tools/fdtd_queue.py: status folds the checked-in v8 journal
+    fixture (the operator's queue table), --json round-trips, and a
+    journal-less dir / missing queue-dir exit 1 with named errors."""
+    import shutil
+    qdir = tmp_path / "queue"
+    qdir.mkdir()
+    shutil.copy(os.path.join(FIX, "queue_v8.jsonl"),
+                str(qdir / "journal.jsonl"))
+    tool = os.path.join(TOOLS, "fdtd_queue.py")
+    proc = _run([tool, "status", "--queue-dir", str(qdir)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "completed=2" in proc.stdout and "failed=1" in proc.stdout
+    assert "lane 1 non-finite" in proc.stdout
+    proc = _run([tool, "status", "--queue-dir", str(qdir), "--json"])
+    assert proc.returncode == 0, proc.stderr
+    jobs = json.loads(proc.stdout)["jobs"]
+    assert jobs["j-00002-cc33"]["status"] == "completed"
+    assert jobs["j-00002-cc33"]["run_id"] == \
+        "r20260804T120009-5002-0-11ee"
+    # an empty queue dir is a friendly exit 1
+    proc = _run([tool, "status", "--queue-dir",
+                 str(tmp_path / "empty")])
+    assert proc.returncode == 1
+    assert "no journal" in proc.stderr
+    # no --queue-dir and no FDTD3D_JOB_QUEUE_DIR: named exit 1
+    env = {k: v for k, v in os.environ.items()
+           if k != "FDTD3D_JOB_QUEUE_DIR"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, tool, "status"],
+                          capture_output=True, text=True,
+                          timeout=120, env=env, cwd=ROOT)
+    assert proc.returncode == 1
+    assert "FDTD3D_JOB_QUEUE_DIR" in proc.stderr
+    # the queue-wait SLO rule reads the same fixture journal
+    proc = _run([os.path.join(TOOLS, "slo_gate.py"),
+                 str(qdir / "journal.jsonl")])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "queue-wait-p95" in proc.stdout
 
 
 def test_ckpt_inspect_runs_and_verifies(tmp_path):

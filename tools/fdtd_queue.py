@@ -1,0 +1,233 @@
+"""Operator CLI for the durable multi-tenant job queue.
+
+Usage:
+    python tools/fdtd_queue.py submit SPEC.txt [--tenant T]
+        [--priority P] [--queue-dir DIR] [--max-queued N]
+    python tools/fdtd_queue.py serve [--queue-dir DIR]
+        [--max-cycles N] [--max-cells X]
+        [--batch-chunk N] [--no-coalesce] [--metrics PATH] [--json]
+    python tools/fdtd_queue.py status [--queue-dir DIR] [--json]
+    python tools/fdtd_queue.py cancel JOB_ID [--queue-dir DIR]
+
+The thin shell over :mod:`fdtd3d_tpu.jobqueue` (docs/SERVICE.md has
+the runbook: quota semantics, coalescing eligibility, the journal
+format and the recovery matrix). ``--queue-dir`` defaults to
+``FDTD3D_JOB_QUEUE_DIR``; ``--tenant`` to ``FDTD3D_QUEUE_TENANT``.
+
+Exit codes:
+
+* 0 — command succeeded (``serve``: every dispatched job reached a
+  terminal state; jobs deferred by quota are reported, not failed)
+* 1 — named refusal/failure: a quota rejection at submit, a missing
+  queue/journal, an unknown job id — or ``serve`` ending with any
+  job ``failed`` (the queue's own gate posture: a lost tenant must
+  not exit 0)
+* 2 — usage error (argparse)
+
+A scheduler killed by a ``sched_crash`` fault (or a real signal) dies
+loudly mid-``serve``; re-running ``serve`` replays the journal and
+drives every interrupted job to a terminal state — that recovery is
+the tier-1-proven contract (tests/test_queue_e2e.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
+
+from fdtd3d_tpu import jobqueue  # noqa: E402
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+
+def _queue(args, need_journal: bool = False, metrics=None):
+    qdir = args.queue_dir or jobqueue.queue_dir_env()
+    if not qdir:
+        warn("no queue directory: pass --queue-dir or set "
+             "FDTD3D_JOB_QUEUE_DIR")
+        raise SystemExit(1)
+    q = jobqueue.JobQueue(qdir, metrics=metrics)
+    if need_journal and not os.path.exists(q.journal):
+        warn(f"{q.journal}: no journal (nothing ever submitted to "
+             f"this queue dir)")
+        raise SystemExit(1)
+    return q
+
+
+def _policy(args) -> jobqueue.QuotaPolicy:
+    kw = {}
+    if getattr(args, "max_queued", None) is not None:
+        kw["max_queued"] = args.max_queued
+    if getattr(args, "max_cells", None) is not None:
+        kw["max_concurrent_cells"] = args.max_cells
+    if getattr(args, "aging", None) is not None:
+        kw["aging"] = args.aging
+    return jobqueue.QuotaPolicy(**kw)
+
+
+def _job_line(job) -> str:
+    extra = ""
+    if job.get("run_id"):
+        extra += f" run={job['run_id']}"
+    if job.get("group"):
+        extra += f" group={job['group']}"
+    if job.get("reason"):
+        extra += f" ({job['reason']})"
+    return (f"  job {job['job_id']}: {job.get('status', '?'):9s} "
+            f"tenant={job.get('tenant')} prio={job.get('priority')}"
+            f"{extra}")
+
+
+def cmd_submit(args) -> int:
+    q = _queue(args)
+    try:
+        job_id = q.submit(args.spec, tenant=args.tenant,
+                          priority=args.priority,
+                          policy=_policy(args))
+    except ValueError as exc:   # incl. QuotaError
+        warn(f"submit refused: {exc}")
+        return 1
+    report(f"submitted {job_id} -> {q.journal}")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    metrics = None
+    if args.metrics:
+        from fdtd3d_tpu.metrics import MetricsRegistry
+        metrics = MetricsRegistry(path=args.metrics)
+    q = _queue(args, need_journal=True, metrics=metrics)
+    sched = jobqueue.Scheduler(
+        q, policy=_policy(args), batch_chunk=args.batch_chunk,
+        coalesce=not args.no_coalesce,
+        straggler_threshold=args.straggler_threshold,
+        registry_path=args.registry)
+    summary = sched.serve(max_cycles=args.max_cycles)
+    jobs = summary["jobs"]
+    if args.json:
+        report(json.dumps(summary, indent=1, sort_keys=True))
+    else:
+        report(f"serve: {summary['cycles']} cycle(s), "
+               f"{len(jobs)} job(s)")
+        for jid in sorted(jobs):
+            report(_job_line(jobs[jid]))
+    failed = [j for j in jobs.values() if j.get("status") == "failed"]
+    if failed:
+        warn(f"serve: {len(failed)} job(s) failed — per-job reasons "
+             f"above / in the journal")
+        return 1
+    return 0
+
+
+def cmd_status(args) -> int:
+    q = _queue(args, need_journal=True)
+    jobs = q.jobs()
+    if args.json:
+        report(json.dumps({"journal": q.journal, "jobs": jobs},
+                          indent=1, sort_keys=True))
+        return 0
+    by_status = {}
+    for job in jobs.values():
+        s = job.get("status", "?")
+        by_status[s] = by_status.get(s, 0) + 1
+    report(f"queue {q.dirpath}: {len(jobs)} job(s) "
+           + " ".join(f"{k}={v}" for k, v in sorted(by_status.items())))
+    for jid in sorted(jobs):
+        report(_job_line(jobs[jid]))
+    return 0
+
+
+def cmd_cancel(args) -> int:
+    q = _queue(args, need_journal=True)
+    try:
+        q.cancel(args.job_id)
+    except ValueError as exc:
+        warn(str(exc))
+        return 1
+    report(f"cancelled {args.job_id}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description="durable multi-tenant job queue: submit scenario "
+                    "specs, serve them to terminal states "
+                    "(crash-safe journal; docs/SERVICE.md runbook)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def _common(p):
+        p.add_argument("--queue-dir", default=None,
+                       help="queue directory (default: "
+                            "FDTD3D_JOB_QUEUE_DIR)")
+
+    p = sub.add_parser("submit", help="admit one job (quota-checked)")
+    p.add_argument("spec", help="scenario spec: a CLI command file "
+                                "(--save-cmd-to-file format)")
+    p.add_argument("--tenant", default=None,
+                   help="owning tenant (default: FDTD3D_QUEUE_TENANT "
+                        "or 'default')")
+    p.add_argument("--priority", type=int, default=0,
+                   help="base priority (higher dispatches first; "
+                        "aging lifts starved jobs)")
+    p.add_argument("--max-queued", type=int, default=None,
+                   help="per-tenant queued-job quota for this "
+                        "admission (default 16)")
+    _common(p)
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("serve",
+                       help="dispatch queued jobs until all terminal")
+    p.add_argument("--max-cycles", type=int, default=None,
+                   help="stop after N scheduling cycles (default: "
+                        "run until drained)")
+    # no --max-queued here: the queued-backlog quota is enforced at
+    # admission (submit), never by the dispatcher
+    p.add_argument("--max-cells", type=float, default=None,
+                   help="per-tenant concurrent device-cells quota")
+    p.add_argument("--aging", type=float, default=None,
+                   help="priority points per terminal transition a "
+                        "queued job waits through (default 1.0)")
+    p.add_argument("--batch-chunk", type=int, default=0,
+                   help="steps per compiled dispatch for coalesced "
+                        "groups (0 = whole horizon)")
+    p.add_argument("--no-coalesce", action="store_true",
+                   help="pin every job solo (A/B lever for the "
+                        "shared-executable win)")
+    p.add_argument("--straggler-threshold", type=int, default=3,
+                   help="exclude chips crowned imbalance-argmax in "
+                        ">= N chunks across the registry's streams")
+    p.add_argument("--registry", default=None,
+                   help="runs.jsonl run registry for straggler "
+                        "exclusion (default: FDTD3D_RUN_REGISTRY)")
+    p.add_argument("--metrics", default=None,
+                   help="write the OpenMetrics exposition (queue "
+                        "depth, wait histogram, jobs_total) here "
+                        "after every cycle")
+    p.add_argument("--json", action="store_true",
+                   help="emit the terminal summary as JSON")
+    _common(p)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("status", help="fold the journal into a table")
+    p.add_argument("--json", action="store_true")
+    _common(p)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("cancel", help="cancel a non-terminal job")
+    p.add_argument("job_id")
+    _common(p)
+    p.set_defaults(fn=cmd_cancel)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

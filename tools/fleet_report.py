@@ -38,7 +38,7 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
@@ -46,14 +46,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from fdtd3d_tpu import registry as run_registry  # noqa: E402
 from fdtd3d_tpu import telemetry  # noqa: E402
 from fdtd3d_tpu.log import report, warn  # noqa: E402
-
-
-def _resolve(base_dir: str, path: Optional[str]) -> Optional[str]:
-    if not path:
-        return None
-    if not os.path.isabs(path):
-        path = os.path.join(base_dir, path)
-    return path if os.path.exists(path) else None
 
 
 def _stream_facts(path: str) -> Dict[str, Any]:
@@ -95,7 +87,6 @@ def build_rollup(registry_path: str) -> Dict[str, Any]:
     """The one-shot fleet snapshot (``--json`` emits it verbatim)."""
     rows = run_registry.read(registry_path)
     runs = run_registry.fold(rows)
-    base_dir = os.path.dirname(os.path.abspath(registry_path))
 
     by_status: Dict[str, int] = {}
     run_table: Dict[str, Dict[str, Any]] = {}
@@ -119,6 +110,10 @@ def build_rollup(registry_path: str) -> Dict[str, Any]:
             "mcells_per_s": row.get("mcells_per_s"),
             "steps": row.get("steps"),
             "exec_key_comparable": row.get("exec_key_comparable"),
+            # queue-job join (v8, registry.job_context): which queue
+            # job/tenant owns this run — absent outside queue runs
+            "job_id": row.get("job_id"),
+            "tenant": row.get("tenant"),
         }
         if isinstance(row.get("mcells_per_s"), (int, float)) \
                 and row["mcells_per_s"] > 0:
@@ -140,7 +135,11 @@ def build_rollup(registry_path: str) -> Dict[str, Any]:
                                 "first_unhealthy_t":
                                     (pair[1] if len(pair) > 1
                                      else None)})
-        tpath = _resolve(base_dir, row.get("telemetry_path"))
+        # relative artifact paths resolve against the REGISTRY's
+        # directory, never this tool's CWD (queue jobs run from
+        # per-job save_dirs — registry.resolve_artifact rationale)
+        tpath = run_registry.resolve_artifact(
+            registry_path, row.get("telemetry_path"))
         if tpath is not None:
             facts = _stream_facts(tpath)
             entry["telemetry"] = os.path.basename(tpath)
@@ -224,6 +223,9 @@ def format_text(rollup: Dict[str, Any]) -> str:
             f"  run {rid}: {row['status']:9s} kind={row['kind']} "
             f"step={row.get('step_kind')} topo={row.get('topology')}"
             + (f" batch={row['batch']}" if row.get("batch") else "")
+            + (f" job={row['job_id']}" if row.get("job_id") else "")
+            + (f" tenant={row['tenant']}" if row.get("tenant")
+               else "")
             + (f" {row['mcells_per_s']:.1f} Mcells/s"
                if isinstance(row.get("mcells_per_s"), (int, float))
                and row["mcells_per_s"] else ""))

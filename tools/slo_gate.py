@@ -5,6 +5,7 @@ Usage:
         [--rules RULES.json] [--registry RUNS.jsonl]
         [--floor-mcells X] [--compile-budget-ms X]
         [--emit-alerts] [--json]
+    python tools/slo_gate.py --registry RUNS.jsonl [...]
 
 Evaluates every run in the (validated) telemetry JSONL against the
 rule set of ``fdtd3d_tpu/slo.py`` (defaults; ``--rules`` overrides
@@ -28,6 +29,14 @@ completed-run ``compile_ms`` per comparable ExecKey digest).
 ``--emit-alerts`` appends one schema-v7 ``alert`` record per firing
 rule to the INPUT stream (atomic append), so
 ``tools/telemetry_report.py`` and the fleet monitor surface them.
+
+With ``--registry`` and NO positional stream, the gate judges EVERY
+registered run's telemetry stream: each row's ``telemetry_path``
+resolves against the REGISTRY file's directory when relative
+(``registry.resolve_artifact`` — queue jobs run from per-job
+save_dirs, so the gate must never resolve against its own CWD), the
+verdict lines are run_id-joined, and rows whose stream is missing
+are warned, never silently passed.
 """
 
 from __future__ import annotations
@@ -68,7 +77,10 @@ def main(argv=None) -> int:
         description="evaluate SLO rules over a flight-recorder JSONL "
                     "(exit 1 on any violation; inconclusive is "
                     "warned, never silent)")
-    ap.add_argument("path", help="telemetry JSONL (schema-validated)")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="telemetry JSONL (schema-validated); "
+                         "omittable with --registry, which then "
+                         "gates every registered run's stream")
     ap.add_argument("--best", default=None,
                     help="BENCH_BEST.json throughput reference for "
                          "the throughput-floor rule")
@@ -92,8 +104,10 @@ def main(argv=None) -> int:
                     help="emit the per-run verdicts as one JSON "
                          "array")
     args = ap.parse_args(argv)
+    if args.path is None and not args.registry:
+        ap.error("a telemetry stream or --registry RUNS.jsonl is "
+                 "required")
 
-    records = telemetry.read_jsonl(args.path)  # validates
     rules = slo.DEFAULT_RULES
     if args.rules:
         with open(args.rules) as f:
@@ -118,35 +132,71 @@ def main(argv=None) -> int:
         folded = _registry.fold(_registry.read(args.registry))
         context["compile_refs"] = compile_refs_from_registry(folded)
 
-    runs = telemetry.split_runs(records)
+    # the streams to judge: the positional one, or (registry mode)
+    # every registered run's telemetry_path — relative paths resolve
+    # against the REGISTRY's directory (registry.resolve_artifact),
+    # never this tool's CWD: queue jobs run from per-job save_dirs
+    streams = []
+    if args.path is not None:
+        streams.append((args.path,
+                        telemetry.read_jsonl(args.path)))  # validates
+    else:
+        from fdtd3d_tpu import registry as _registry
+        seen = set()
+        for rid, row in sorted(folded.items()):
+            tp = _registry.resolve_artifact(args.registry,
+                                            row.get("telemetry_path"))
+            if tp is None:
+                if row.get("telemetry_path"):
+                    warn(f"slo_gate: run {rid}: telemetry "
+                         f"{row['telemetry_path']!r} not found "
+                         f"relative to the registry — not judged")
+                continue
+            if tp in seen:
+                continue    # bench stages share one stream file
+            seen.add(tp)
+            streams.append((tp, telemetry.read_jsonl(tp)))
+        if not streams:
+            warn("slo_gate: no registered telemetry stream "
+                 "resolvable — nothing was judged")
+
     summaries = []
-    for run in runs:
-        ctx = dict(context)
-        if folded is not None:
+    labeled = []    # (label, stream path, summary) for the text form
+    for spath, records in streams:
+        for run in telemetry.split_runs(records):
+            ctx = dict(context)
             start = next((r for r in run
                           if r["type"] == "run_start"), {})
-            row = folded.get(start.get("run_id")) or {}
-            if row.get("exec_key_comparable"):
-                ctx["exec_key_comparable"] = \
-                    row["exec_key_comparable"]
-        summaries.append(slo.evaluate_run(run, rules=rules,
-                                          context=ctx))
+            label = start.get("run_id") or os.path.basename(spath)
+            if folded is not None:
+                row = folded.get(start.get("run_id")) or {}
+                if row.get("exec_key_comparable"):
+                    ctx["exec_key_comparable"] = \
+                        row["exec_key_comparable"]
+            summary = slo.evaluate_run(run, rules=rules, context=ctx)
+            summaries.append(summary)
+            labeled.append((label, spath, summary))
 
-    all_alerts = []
-    for summary in summaries:
-        all_alerts.extend(slo.alerts_for(summary["results"]))
-    if args.emit_alerts and all_alerts:
+    if args.emit_alerts:
         from fdtd3d_tpu.io import atomic_append
-        atomic_append(args.path, "".join(json.dumps(a) + "\n"
-                                         for a in all_alerts))
-        warn(f"slo_gate: appended {len(all_alerts)} alert record(s) "
-             f"to {args.path}")
+        by_stream: dict = {}
+        for _label, spath, summary in labeled:
+            alerts = slo.alerts_for(summary["results"])
+            if alerts:
+                by_stream.setdefault(spath, []).extend(alerts)
+        for spath, alerts in by_stream.items():
+            atomic_append(spath, "".join(json.dumps(a) + "\n"
+                                         for a in alerts))
+            warn(f"slo_gate: appended {len(alerts)} alert "
+                 f"record(s) to {spath}")
 
     if args.json:
         report(slo.to_json(summaries))
     else:
-        for i, summary in enumerate(summaries):
-            report(f"run {i + 1}: " + slo.format_results(summary))
+        for i, (label, _spath, summary) in enumerate(labeled):
+            head = f"run {i + 1}" + \
+                (f" [{label}]" if label else "")
+            report(f"{head}: " + slo.format_results(summary))
     violated = any(s["status"] == "VIOLATION" for s in summaries)
     for summary in summaries:
         for r in summary["results"]:

@@ -81,6 +81,10 @@ def summarize_run(run):
         # the run-registry join key (v7): trace this stream back to
         # its runs.jsonl row (tools/fleet_report.py)
         out["run_id"] = start["run_id"]
+    if start.get("job_id"):
+        # the queue-job join key (v8, registry.job_context): which
+        # tools/fdtd_queue.py job (or coalesce group) owns this run
+        out["job_id"] = start["job_id"]
     if start.get("tb_fallback"):
         # the named 2x-HBM downgrade (round 17): why this run did not
         # temporal-block (solver.tb_fallback_reason tokens)
@@ -165,7 +169,9 @@ def format_text(summaries) -> str:
                      f"kernel={p.get('step_kind', '?')} "
                      f"platform={p.get('platform', '?')} "
                      f"sha={p.get('git_sha', '?')} "
-                     f"jax={p.get('jax_version', '?')}")
+                     f"jax={p.get('jax_version', '?')}"
+                     + (f" job={s['job_id']}" if s.get("job_id")
+                        else ""))
         if not s["chunks"]:
             lines.append("  (no chunk records)")
             continue
