@@ -51,6 +51,12 @@ _KERNEL_TARGETS: Tuple[Tuple[str, str, str], ...] = (
     ("pallas_packed_tb_widened",
      "fdtd3d_tpu.ops.pallas_packed_tb", "make_packed_tb_step"),
     ("pallas_packed_ds", "fdtd3d_tpu.ops.pallas_packed_ds", "make_packed_ds_step"),
+    # the round-16 lane-capable BATCHED build (batch=3): the packed
+    # pallas_call under the batch_lane-surcharged tile pick — the
+    # executable batch.BatchSimulation vmaps; its donation structure
+    # is gated like every solo build
+    ("pallas_packed_batch",
+     "fdtd3d_tpu.ops.pallas_packed", "make_packed_eh_step_batched"),
 )
 
 
@@ -73,6 +79,11 @@ def _target_config(label: str):
                                            position=(24, 8, 8))), None
     if label == "pallas_packed_tb_widened":
         return costs.config_tb_widened(), (1, 2, 2)
+    if label == "pallas_packed_batch":
+        import dataclasses
+        return dataclasses.replace(
+            costs.config_for_kind("pallas_packed"),
+            use_pallas=True), None
     kind = label if label in costs.STEP_KINDS else "pallas"
     cfg = costs.config_for_kind(kind)
     import dataclasses
@@ -358,16 +369,24 @@ class ScopeCoverageRule(Rule):
         # as its own lane: new exchange/psum sites in the widened
         # wedge must be mesh-scoped like every other collective
         lanes = [(kind, costs.config_for_kind(kind, n=16, pml=2),
-                  kind) for kind in costs.SHARDED_STEP_KINDS]
+                  kind, 0) for kind in costs.SHARDED_STEP_KINDS]
         lanes.append(("pallas_packed_tb_widened",
                       costs.config_tb_widened(),
-                      "pallas_packed_tb"))
-        for label, cfg, kind in lanes:
+                      "pallas_packed_tb", 0))
+        # the round-16 SHARDED BATCHED lane: the vmapped packed runner
+        # inside shard_map — the batch's ONE shared halo exchange per
+        # step must be mesh-scoped like every solo collective
+        lanes.append(("pallas_packed_batch",
+                      costs.config_for_kind("pallas_packed",
+                                            n=16, pml=2),
+                      "pallas_packed", 3))
+        for label, cfg, kind, batch in lanes:
             # pml=2 keeps the CPML slabs inside the 8-cell shards of a
             # 16^3 grid on (2,2,2) (solver.slab_axes needs
             # local_n > 2*(pml+1)) — the tests/test_comm_costs.py probe
             _runner, closed, _static, _topo, _spc = costs.trace_chunk(
-                cfg, n_steps=8, kind=kind, topology=_SCOPE_TOPOLOGY)
+                cfg, n_steps=8, kind=kind, topology=_SCOPE_TOPOLOGY,
+                batch=batch)
             colls = collect_collectives(closed.jaxpr)
             unscoped = unscoped_collectives(colls)
             stats[label] = {"collectives": len(colls),

@@ -639,6 +639,18 @@ def _run_batch_cli(parser, args) -> int:
     except ValueError as exc:
         raise SystemExit(f"--batch: {exc}")
     wall = _time.time() - t0
+    # the batch dispatch verdict, mirroring the solo step-kind line:
+    # the engaged kind, and when the batch could NOT ride the
+    # lane-capable packed kernels the named batch_unsupported:<token>
+    # (solver.batch_fallback_reason) — the ~6x-HBM downgrade is never
+    # silent
+    kind_line = f"step_kind={bsim.step_kind}"
+    tile = ((bsim.step_diag or {}).get("tile") or {}).get("EH")
+    if tile is not None:
+        kind_line += f" tile={tile}"
+    if bsim.batch_fallback:
+        kind_line += f" {bsim.batch_fallback}"
+    log(f"batch: {bsim.batch_size} lanes {kind_line}")
     # (run_batch has already run the verify_final_lanes end-of-run
     # sweep, so the verdicts below reflect damage landing after the
     # last chunk's in-graph measurement too)

@@ -193,6 +193,15 @@ VMEM_TEMPS_DEFAULTS: Dict[str, int] = {
     "tb2": 40,
     "tb3": 52,
     "tb4": 64,
+    # batch_lane — PER-EXTRA-LANE surcharge the tile picker charges on
+    # a lane-capable batched build (ops/pallas_packed._pick_tile_packed
+    # with batch=B adds (B-1) x this row). The vmap batching rule runs
+    # ONE lane's blocks per outer-grid iteration, so the true
+    # per-iteration footprint is unchanged; this row is conservative
+    # headroom for Mosaic's cross-iteration prefetch of the lane-major
+    # grid dimension. UNCALIBRATED (no chip window yet) — re-run the
+    # 128^3/512^3 probe with a 3-lane batch on the first window.
+    "batch_lane": 6,
 }
 
 

@@ -579,7 +579,8 @@ def _comm_lane(cfg, acc: _Acc, topo, n_chips: int,
 # --------------------------------------------------------------------------
 
 def trace_chunk(cfg, n_steps: int = 8, kind: Optional[str] = None,
-                topology: Optional[Sequence[int]] = None):
+                topology: Optional[Sequence[int]] = None,
+                batch: int = 0):
     """Trace cfg's PRODUCTION chunk runner (no compile, no execution)
     -> ``(runner, closed_jaxpr, static, topo, steps_per_call)``.
 
@@ -590,6 +591,13 @@ def trace_chunk(cfg, n_steps: int = 8, kind: Optional[str] = None,
     via the measurement env knobs and raises if it did not engage;
     ``topology`` traces inside shard_map over the host-device mesh
     (CPU-deterministic on the virtual mesh).
+
+    ``batch=B`` (B >= 2) traces the LANE-CAPABLE batched executable
+    exactly as batch.BatchSimulation dispatches it: the runner is
+    built with the batch axis (per-lane VMEM surcharge in the tile
+    pick) and vmapped over lane-leading state/coeff shapes — inside
+    shard_map on a sharded trace, so the comm lane sees the ONE
+    halo exchange the whole batch shares.
     """
     import jax
 
@@ -612,9 +620,9 @@ def trace_chunk(cfg, n_steps: int = 8, kind: Optional[str] = None,
             static = dataclasses.replace(static, topology=topo)
             runner = make_chunk_runner(static, pmesh.mesh_axis_map(topo),
                                        pmesh.mesh_shape_map(topo),
-                                       health=True)
+                                       health=True, batch=batch)
         else:
-            runner = make_chunk_runner(static, health=True)
+            runner = make_chunk_runner(static, health=True, batch=batch)
     if kind is not None and runner.kind != kind:
         raise RuntimeError(
             f"requested step kind {kind!r} but the runner engaged "
@@ -679,6 +687,17 @@ def trace_chunk(cfg, n_steps: int = 8, kind: Optional[str] = None,
             f"per-step/per-chunk split — trace a k-divisible horizon")
 
     traced = lambda s, c: runner(s, c, n=n_steps)  # noqa: E731
+    if batch and batch > 1:
+        # Same dispatch as batch.BatchSimulation: vmap the chunk
+        # runner over a lane-leading axis on every state/coeff leaf
+        # (scalars stack to shape (B,) exactly as _stack_trees does).
+        b = int(batch)
+
+        def _lane(sd):
+            return jax.ShapeDtypeStruct((b,) + tuple(sd.shape), sd.dtype)
+        state_sh = jax.tree.map(_lane, state_sh)
+        coeffs_sh = jax.tree.map(_lane, coeffs_sh)
+        traced = jax.vmap(traced)
     if topo is not None:
         from jax.sharding import PartitionSpec as P
 
@@ -691,6 +710,16 @@ def trace_chunk(cfg, n_steps: int = 8, kind: Optional[str] = None,
                 f"set XLA_FLAGS=--xla_force_host_platform_device_count"
                 f"=N before jax initializes") from exc
         coeff_specs = pmesh.coeff_specs(coeffs_np, topo)
+        if batch and batch > 1:
+            # Lane axis is unsharded: prepend None to every spec
+            # (mirrors batch._prepend_specs) so the whole batch
+            # shares ONE halo exchange per step inside shard_map.
+            def _pre(tree):
+                return jax.tree.map(
+                    lambda s: P(*((None,) + tuple(s))), tree,
+                    is_leaf=lambda x: isinstance(x, P))
+            specs = _pre(specs)
+            coeff_specs = _pre(coeff_specs)
         traced = pmesh.shard_map_compat(
             traced, mesh, in_specs=(specs, coeff_specs),
             out_specs=(specs, {k: P() for k in telemetry.HEALTH_KEYS}))
@@ -703,8 +732,8 @@ def chunk_ledger(cfg, n_steps: int = 8,
                  kind: Optional[str] = None,
                  topology: Optional[Sequence[int]] = None,
                  ici_gbps: Optional[float] = None,
-                 overlap: Optional[Dict[str, Any]] = None
-                 ) -> Dict[str, Any]:
+                 overlap: Optional[Dict[str, Any]] = None,
+                 batch: int = 0) -> Dict[str, Any]:
     """Trace cfg's chunk runner and attribute per-step flops/bytes.
 
     ``kind`` forces one of STEP_KINDS via the same environment knobs
@@ -721,6 +750,13 @@ def chunk_ledger(cfg, n_steps: int = 8,
     model, the per-topology table and the modeled overlap window.
     ``overlap`` embeds a tools/aot_overlap.py artifact's async window
     counts; ``ici_gbps`` overrides the modeled ICI bandwidth.
+
+    ``batch=B`` traces the lane-capable batched executable (the same
+    vmapped packed runner batch.BatchSimulation dispatches) and
+    normalizes every per-step table to PER-LANE per-step — so a
+    batched ledger compares directly against its solo counterpart
+    (the <= 1.15x packed-bytes gate in tests/test_costs.py divides
+    the two). ``cells`` stays the single-lane cell count.
     """
     from fdtd3d_tpu import telemetry
 
@@ -730,7 +766,7 @@ def chunk_ledger(cfg, n_steps: int = 8,
                          "comm.async_windows; silently dropping it "
                          "would disable the sentinel's overlap gates)")
     runner, closed, static, topo, spc = trace_chunk(
-        cfg, n_steps=n_steps, kind=kind, topology=topology)
+        cfg, n_steps=n_steps, kind=kind, topology=topology, batch=batch)
     acc = _Acc(n_steps // spc)
     _walk(acc, closed.jaxpr, "", 1.0, False, True)
     if not acc.step_scan_seen:
@@ -746,6 +782,19 @@ def chunk_ledger(cfg, n_steps: int = 8,
             for cell in tbl.values():
                 cell[0] /= spc
                 cell[1] /= spc
+    if batch and batch > 1:
+        # Per-lane normalization: the vmapped batched trace carries B
+        # lanes of cost on every leaf; dividing EVERY table by B makes
+        # batched ledgers directly comparable to their solo
+        # counterparts. Comm counts divide too — halo messages are
+        # shared by the whole batch, so the per-lane message share is
+        # fractional by design (that sub-1 share IS the amortization
+        # being ledgered).
+        for tbl in (acc.step, acc.chunk, acc.comm_step, acc.comm_chunk,
+                    acc.coll_step, acc.coll_chunk):
+            for cell in tbl.values():
+                cell[0] /= batch
+                cell[1] /= batch
 
     def _table(src: Dict[str, list]) -> Dict[str, Dict[str, float]]:
         tf = sum(f for f, _ in src.values()) or 1.0
@@ -774,6 +823,10 @@ def chunk_ledger(cfg, n_steps: int = 8,
         "cells": int(cells),
         "n_steps": int(n_steps),
         "steps_per_call": spc,
+        # lane count of the batched trace (null: solo trace); tables
+        # are already normalized PER-LANE, so comparisons against solo
+        # ledgers need no further division
+        "batch": int(batch) if batch and batch > 1 else None,
         "topology": list(topo) if topo is not None else None,
         "sections": _table(acc.step),
         "per_chunk_sections": _table(acc.chunk),
@@ -796,7 +849,10 @@ def chunk_ledger(cfg, n_steps: int = 8,
                   "operands counted once; step scan body counted once "
                   "(per-step); cond takes its max branch"
                   + ("; sharded trace: sections/per_step/cells are "
-                     "PER-CHIP" if topo is not None else "")),
+                     "PER-CHIP" if topo is not None else "")
+                  + ("; batched trace: all tables normalized PER-LANE "
+                     "(comm message shares fractional by design)"
+                     if batch and batch > 1 else "")),
     }
     gbps = hbm_gbps if hbm_gbps is not None else telemetry.get_hbm_probe()
     if topo is not None:
@@ -829,9 +885,9 @@ def chunk_ledger(cfg, n_steps: int = 8,
 # it here fails the lint gate.
 LEDGER_KEYS = frozenset((
     "schema", "ledger_version", "step_kind", "scheme", "grid", "dtype",
-    "cells", "n_steps", "steps_per_call", "topology", "sections",
-    "per_chunk_sections", "per_step", "comm", "tb_fallback", "model",
-    "roofline"))
+    "cells", "n_steps", "steps_per_call", "batch", "topology",
+    "sections", "per_chunk_sections", "per_step", "comm", "tb_fallback",
+    "model", "roofline"))
 COMM_KEYS = frozenset((
     "topology", "n_chips", "per_step", "per_chunk",
     "collectives_per_step", "plan", "strategy", "topology_table",

@@ -63,9 +63,13 @@ stand-in for a killed TPU window) re-queues the job rather than
 failing it, and the re-dispatch restores the newest committed
 checkpoint exactly like CLI ``--resume auto`` (adopting persisted
 supervisor recovery state first), so the resumed job's final state is
-bit-identical to an uninterrupted run. Coalesced groups have no
-per-lane snapshots; a preempted group restarts from t=0 (documented
-in docs/SERVICE.md's recovery matrix).
+bit-identical to an uninterrupted run. Coalesced groups are durable
+too (round 16): every chunk boundary commits ONE whole-group snapshot
+under ``<queue>/groups/<gid>/ckpt_t*.npz`` (atomic writer, newest two
+kept), and a preempted group's re-dispatch restores every lane from
+the newest committed one — bit-identical to an uninterrupted run,
+with the resume t journaled on the re-dispatch's ``running`` rows as
+``resumed_from`` (docs/SERVICE.md's recovery matrix).
 
 Every dispatch runs inside :func:`fdtd3d_tpu.registry.job_context`,
 so the run-registry row and the telemetry run_start carry the
@@ -624,7 +628,8 @@ class Scheduler:
                group: Optional[str] = None,
                lane: Optional[int] = None,
                t: Optional[int] = None,
-               excluded_chips: Optional[List[int]] = None) -> None:
+               excluded_chips: Optional[List[int]] = None,
+               resumed_from: Optional[int] = None) -> None:
         """One journal transition; None-valued optionals are omitted
         (the schema's optional-key table, telemetry.RECORD_OPTIONAL,
         names every parameter here). ``queued`` transitions stamp a
@@ -649,6 +654,8 @@ class Scheduler:
         if excluded_chips is not None:
             fields["excluded_chips"] = [int(c)
                                         for c in excluded_chips]
+        if resumed_from is not None:
+            fields["resumed_from"] = int(resumed_from)
         self.queue._emit("job_state", job_id=job["job_id"],
                          tenant=str(job.get("tenant", "default")),
                          status=status, **fields)
@@ -787,6 +794,45 @@ class Scheduler:
 
     # -- dispatch: coalesced group (one vmap executable) --------------------
 
+    def _group_snapshots(self, gdir: str) -> List[str]:
+        """The group's committed snapshots, newest first (an .npz
+        under its final name IS committed — io.save_checkpoint writes
+        through the atomic renamer)."""
+        import re as _re
+        try:
+            names = [f for f in os.listdir(gdir)
+                     if _re.fullmatch(r"ckpt_t\d+\.npz", f)]
+        except OSError:
+            return []
+        return [os.path.join(gdir, f)
+                for f in sorted(names, reverse=True)]
+
+    def _restore_group(self, bsim, gdir: str) -> int:
+        """-> the committed t every lane resumed from (0 = from
+        scratch). Newest snapshot passing its integrity + membership
+        guards wins; a corrupt or mismatched one falls back OLDER
+        (the solo _restore_latest discipline) — never a crash, never
+        a silent wrong-state adoption."""
+        from fdtd3d_tpu import io as _io
+        for path in self._group_snapshots(gdir):
+            try:
+                bsim.restore(path)
+                return int(bsim.t)
+            except (_io.CheckpointCorrupt, ValueError, OSError) as exc:
+                _log.warn(f"jobqueue: group snapshot {path} unusable "
+                          f"({type(exc).__name__}: {str(exc)[:120]}); "
+                          f"trying an older one")
+        return 0
+
+    def _prune_group_snapshots(self, gdir: str, keep: int = 2):
+        """Keep the newest ``keep`` snapshots (>= 2: the corrupt-
+        fallback needs an older committed one to land on)."""
+        for path in self._group_snapshots(gdir)[keep:]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
     def _dispatch_batch(self, unit: List[Dict[str, Any]]) -> int:
         from fdtd3d_tpu import registry as _registry
         from fdtd3d_tpu.batch import BatchSimulation
@@ -838,26 +884,51 @@ class Scheduler:
             for j in unit:
                 n += self._dispatch_solo(j)
             return n
+        # durable group resume: adopt the newest committed snapshot in
+        # the group's directory (written at every chunk boundary
+        # below) so a preempted group's re-dispatch continues every
+        # lane bit-identical from the committed t, not from t=0 — the
+        # recovery-matrix row docs/SERVICE.md used to mark open
+        os.makedirs(gdir, exist_ok=True)
+        resumed = self._restore_group(bsim, gdir)
+        if resumed:
+            _log.log(f"jobqueue: group {gid} resumes from its "
+                     f"committed snapshot at t={resumed}")
         for i, (j, wait) in enumerate(zip(unit, waits)):
             self._state(j, "running", run_id=bsim.run_id, group=gid,
                         lane=i, wait_s=wait,
                         topology=list(bsim.topology),
                         excluded_chips=(placement["excluded_chips"]
                                         if placement is not None
-                                        else None))
+                                        else None),
+                        resumed_from=int(resumed))
         try:
-            bsim.run(chunk=self.batch_chunk)
+            total = int(bsim.cfg.time_steps)
+            chunk = self.batch_chunk \
+                if self.batch_chunk and self.batch_chunk > 0 else total
+            while bsim.t < total:
+                bsim.advance(min(chunk, total - bsim.t))
+                # one committed snapshot per chunk boundary: the
+                # atomic .npz write is the durability point a later
+                # re-dispatch resumes from (preemption fires on the
+                # chunk boundary BEFORE its snapshot, so the resume
+                # lands on the previous committed one)
+                bsim.checkpoint(os.path.join(
+                    gdir, f"ckpt_t{bsim.t:06d}.npz"))
+                self._prune_group_snapshots(gdir)
             bsim.verify_final_lanes()
         except _faults.SimulatedPreemption as exc:
             if bsim.telemetry is not None:
                 bsim.telemetry.abandon()
             _faults.on_sched_journal(ordinal)
+            snaps = self._group_snapshots(gdir)
+            ct = int(os.path.basename(snaps[0])[6:-4]) if snaps else 0
             reason = (f"{type(exc).__name__}: {str(exc)[:160]} "
-                      f"(coalesced groups have no per-lane "
-                      f"snapshots; restarting from t=0)")
+                      f"(group re-dispatch resumes every lane from "
+                      f"the committed snapshot t={ct})")
             for j in unit:
                 self._state(j, "preempted", reason=reason,
-                            group=gid)
+                            group=gid, t=int(bsim.t))
                 self._state(j, "queued",
                             reason="requeued after group preemption")
             return 2 * len(unit)

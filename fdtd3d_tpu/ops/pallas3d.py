@@ -1038,7 +1038,14 @@ def point_source_patch(static, fields, coeffs, t, collect=None):
     scale = cb
     if jnp.ndim(cb) == 3:
         scale = cb[tuple(idxs)]
-    val = ps.amplitude * scale * wf
+    # amplitude from the TRACED ps_amp coefficient (build_coeffs), not
+    # the static config float: per-lane amplitudes must reach a
+    # vmap-batched kernel step through the operand tree. Bit-identical
+    # to the old static multiply — ps_amp is the f32 round of
+    # cfg.point_source.amplitude, exactly what weak-type promotion of
+    # the python float produced here before.
+    amp = coeffs["ps_amp"] if "ps_amp" in coeffs else ps.amplitude
+    val = amp * scale * wf
     if own is not None:
         val = jnp.where(own, val, 0.0)
     val = val.astype(fdt)

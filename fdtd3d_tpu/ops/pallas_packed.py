@@ -362,19 +362,30 @@ _RUNTIME_BUDGET: "int | None" = None
 
 def _pick_tile_packed(n1: int, plane_cells: int, block_bytes_at,
                       scratch_bytes_at,
-                      temps_f32_per_cell: "int | None" = None) -> int:
+                      temps_f32_per_cell: "int | None" = None,
+                      batch: int = 0) -> int:
     """Largest divisor T (with >= 2 tiles) fitting physical VMEM.
 
     Footprint model: 2*blocks (Mosaic double-buffers every operand
     window) + scratch carry + measured per-tile temporaries
     (``temps_f32_per_cell`` lets the temporal-blocked kernel supply its
     own, larger, calibration constant — ops/pallas_packed_tb.py).
+
+    ``batch=B`` (B >= 2): the LANE-CAPABLE build — the vmap batching
+    rule prepends a lane-major grid dimension over the same VMEM rings,
+    and the picker charges (B-1) x the ``batch_lane`` calibration row
+    on top of the kind's own temporaries constant
+    (config.VMEM_TEMPS_DEFAULTS; per-iteration blocks stay ONE lane's,
+    the surcharge covers Mosaic's cross-lane prefetch headroom).
     """
     import os
 
     from fdtd3d_tpu.config import vmem_temps
     if temps_f32_per_cell is None:
         temps_f32_per_cell = vmem_temps("packed")
+    if batch and batch > 1:
+        temps_f32_per_cell = temps_f32_per_cell \
+            + vmem_temps("batch_lane") * (batch - 1)
     env_budget = _vmem_budget() if os.environ.get(
         "FDTD3D_VMEM_BUDGET_MB") else None
     if _RUNTIME_BUDGET is not None:
@@ -488,27 +499,70 @@ def packed_vmem_models(static):
     return _block_bytes, _scratch_bytes
 
 
-def packed_tile(static) -> int:
+def packed_tile(static, batch: int = 0) -> int:
     """The packed kernel's budgeted x-tile from the host-math VMEM
     model (0 = no tile fits, or the thin-grid psi layout is out of
-    scope) — what the tb planner's tile-too-thin bail consults
-    without building coefficient arrays."""
+    scope) — what the tb planner's tile-too-thin bail and the batch
+    dispatch authority (solver.batch_fallback_reason) consult without
+    building coefficient arrays. ``batch=B`` charges the lane-capable
+    build's per-lane VMEM surcharge."""
     models = packed_vmem_models(static)
     if models is None:
         return 0
     n1, n2, n3 = (static.grid_shape[a] // static.topology[a]
                   for a in range(3))
-    return _pick_tile_packed(n1, n2 * n3, *models)
+    return _pick_tile_packed(n1, n2 * n3, *models, batch=batch)
+
+
+def baked_coeff_keys(static) -> Tuple[str, ...]:
+    """Coefficient keys the packed kernel BAKES as compile-time floats
+    when their host value is scalar (np.ndim < 3) — the exact pairs_e /
+    pairs_h construction inside make_packed_eh_step.
+
+    The batch dispatch authority (solver.batch_fallback_reason) sweeps
+    these across lanes: any scalar-valued key differing between lanes
+    makes the lane-capable build silently wrong (every lane would run
+    lane 0's baked constant), so such batches must fall back to the
+    vmap-jnp path with token ``scalar_coeff_divergence``. Grid-valued
+    (ndim == 3) entries are traced operands and exempt.
+    """
+    mode = static.mode
+    pairs_e = ["ca", "cb"] + (["kj", "bj"] if static.use_drude else [])
+    pairs_h = ["da", "db"] + (["km", "bm"] if static.use_drude_m else [])
+    keys = [f"{p}_{c}" for c in mode.e_components for p in pairs_e]
+    keys += [f"{p}_{c}" for c in mode.h_components for p in pairs_h]
+    return tuple(keys)
+
+
+def make_packed_eh_step_batched(static, mesh_axes=None, mesh_shape=None):
+    """The lane-capable packed build at a representative batch width
+    (B=3) — the donation-safety lint target (analysis/graph_rules
+    _KERNEL_TARGETS "pallas_packed_batch"): captures the same
+    pallas_call the batched chunk runner vmaps over, with the per-lane
+    VMEM surcharge charged, so index-map/donation hazards in the
+    lane-capable configuration are linted like every other kernel."""
+    return make_packed_eh_step(static, mesh_axes=mesh_axes,
+                               mesh_shape=mesh_shape, batch=3)
 
 
 def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None,
-                        force_tile=None):
+                        force_tile=None, batch: int = 0):
     """One-pallas-call pipelined leapfrog step, or None if out of scope.
 
     ``force_tile`` pins the x-tile size instead of running the VMEM
     picker: the temporal-blocked kernel (ops/pallas_packed_tb.py) uses
     it to build its odd-step-count tail at ITS tile so both steps share
     one packed-carry layout (the x-psi stacks are tile-aligned).
+
+    ``batch=B`` builds the LANE-CAPABLE variant: the step itself is
+    unchanged (jax.vmap over the chunk runner supplies the lane-major
+    grid dimension — pallas_call's vmap batching rule), but the tile
+    picker charges the per-lane ``batch_lane`` VMEM surcharge so the
+    chosen T leaves headroom for B lanes' rings. Scalar coefficients
+    stay BAKED (compile-time floats) — per-lane scalar divergence must
+    be rejected upstream (solver.batch_fallback_reason consults
+    baked_coeff_keys); coefficient GRIDS are traced operands and may
+    vary per lane freely.
     """
     from fdtd3d_tpu import solver as solver_mod
 
@@ -613,7 +667,8 @@ def make_packed_eh_step(static, mesh_axes=None, mesh_shape=None,
             return None
         T = force_tile
     else:
-        T = _pick_tile_packed(n1, n2 * n3, _block_bytes, _scratch_bytes)
+        T = _pick_tile_packed(n1, n2 * n3, _block_bytes, _scratch_bytes,
+                              batch=batch)
     if T == 0:
         return None
     ntiles = n1 // T

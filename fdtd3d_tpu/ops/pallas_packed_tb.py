@@ -393,7 +393,7 @@ def _arr_counts_static(static, geo) -> Tuple[int, int]:
     return per_e * geo["ne"], per_h * geo["nh"]
 
 
-def _depth_pick(static, geo):
+def _depth_pick(static, geo, batch: int = 0):
     """The VMEM-calibrated depth scan (host math only; no coeffs are
     built, no backend touched). -> ``(best or None, tiles, source)``
     with tiles = {k: budgeted tile} per allowed depth; the pick is the
@@ -416,7 +416,7 @@ def _depth_pick(static, geo):
         bb, sb = _vmem_models(static, geo, k, n_arr_e, n_arr_h)
         tiles[k] = _pk._pick_tile_packed(
             n1, n2 * n3, bb, sb,
-            temps_f32_per_cell=vmem_temps("tb", k))
+            temps_f32_per_cell=vmem_temps("tb", k), batch=batch)
     source = f"env:FDTD3D_TB_DEPTH={pinned}" if pinned else "auto"
     best = max((k for k, t in tiles.items() if t >= 2), default=None)
     if best is None:
@@ -462,21 +462,27 @@ class TbPlan:
     reason: Optional[str]
 
 
-def plan_tb(static, mesh_axes=None) -> TbPlan:
+def plan_tb(static, mesh_axes=None, batch: int = 0) -> TbPlan:
     """Scope + depth + tile in one deterministic host-math decision
     (no coefficient arrays are built, no backend touched — dry-run
-    planning at pod scale stays allocation-free)."""
+    planning at pod scale stays allocation-free).
+
+    ``batch=B`` plans the LANE-CAPABLE build: the depth scan and the
+    tile-too-thin bail both charge the per-lane ``batch_lane`` VMEM
+    surcharge, so a depth viable solo may legitimately shallow (the
+    lanes -> fewer-lanes rung of the batch ladder) or bail entirely
+    under a wide batch."""
     reason = _reject_reason(static, mesh_axes)
     if reason is not None:
         return TbPlan(False, None, 0, {}, "n/a", reason)
     geo = _geometry(static)
     if geo is None:
         return TbPlan(False, None, 0, {}, "n/a", "thin_grid_psi")
-    best, tiles, source = _depth_pick(static, geo)
+    best, tiles, source = _depth_pick(static, geo, batch=batch)
     if best is None:
         return TbPlan(False, None, 0, tiles, source, "no_viable_depth")
     if tiles[best] == 1 and source == "auto" \
-            and _pk.packed_tile(static) >= 4:
+            and _pk.packed_tile(static, batch=batch) >= 4:
         # too thin: the deep pipeline at T=1 multiplies per-iteration
         # setup cost and ring-rotation VPU work; if the single-step
         # kernel affords a healthy tile, take its 48 B/cell instead
@@ -512,9 +518,12 @@ def planned_depth(static) -> Optional[int]:
 
 
 def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
-                        depth: Optional[int] = None):
+                        depth: Optional[int] = None, batch: int = 0):
     """k-steps-per-pass pipelined step, or None if out of scope.
     ``depth`` pins k (tests / the bench k-sweep); default: pick_depth.
+    ``batch=B`` builds the lane-capable variant (per-lane VMEM
+    surcharge in every tile pick, threaded into the tail build) — see
+    pallas_packed.make_packed_eh_step.
     """
     from fdtd3d_tpu import solver as solver_mod
     from fdtd3d_tpu.config import vmem_temps
@@ -587,13 +596,13 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                               len(arr_h))
         T = _pk._pick_tile_packed(
             n1, n2 * n3, bb, sb,
-            temps_f32_per_cell=vmem_temps("tb", depth))
+            temps_f32_per_cell=vmem_temps("tb", depth), batch=batch)
         if T == 0:
             return None
         k = depth
         depth_diag = {"candidates": {depth: T}, "source": "arg"}
     else:
-        tbp = plan_tb(static, mesh_axes)
+        tbp = plan_tb(static, mesh_axes, batch=batch)
         if not tbp.eligible:
             return None
         k, T = tbp.depth, tbp.tile
@@ -623,7 +632,7 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
     # layout (the x-psi stacks are tile-aligned); it also supplies
     # pack/unpack and the chunk-entry prepare() for both kernels.
     tail = _pk.make_packed_eh_step(static, mesh_axes, mesh_shape,
-                                   force_tile=T)
+                                   force_tile=T, batch=batch)
     if tail is None:
         return None
     tail.kind = "pallas_packed"
@@ -1581,7 +1590,11 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                             + jnp.int32(p if b == a else 0)
                         mb = gi == jnp.int32(ps.position[b])
                         m_ = mb if m_ is None else (m_ & mb)
-                    acc = acc + np.float32(ps.amplitude) * wf \
+                    # traced amplitude (coeffs ps_amp): per-lane drive
+                    # strength under a vmap-batched executor; ps_amp is
+                    # the f32 round of the config float, bit-identical
+                    # to the static multiply it replaces
+                    acc = acc + cc["ps_amp"] * wf \
                         * m_.astype(fdt)
             e = _wedge_coef(cc, f"ca_{c}", a, p) * e_old_pl[jc] \
                 + _wedge_coef(cc, f"cb_{c}", a, p) * acc
@@ -1882,7 +1895,10 @@ def make_packed_tb_step(static, mesh_axes=None, mesh_shape=None,
                     waveform(ps.waveform, t + j, 0.5, static.omega,
                              static.dt, np.float32)
                     for j in range(k)])
-                operands["src"] = (np.float32(ps.amplitude)
+                # traced amplitude (see the wedge source above): the
+                # per-step drive vector rides the operand tree so a
+                # vmap batch can give every lane its own strength
+                operands["src"] = (coeffs["ps_amp"]
                                    * wf).reshape(k, 1, 1)
                 if sharded_axes:
                     operands["srcpos"] = jnp.stack(

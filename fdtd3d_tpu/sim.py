@@ -776,7 +776,12 @@ class Simulation:
         One compiled executable, one dispatch (and one halo exchange)
         per step for the whole batch; bit-identical per lane to B
         sequential runs on the same step kind, with per-lane health
-        flags so one tenant's NaN trips only its lane. Returns the
+        flags so one tenant's NaN trips only its lane. Batches in
+        packed-kernel scope ride the LANE-CAPABLE packed kernels
+        (solver.batch_fallback_reason is the dispatch authority) at
+        packed per-lane HBM cost; ineligible batches fall back to the
+        vmap-jnp path with ``batch_unsupported:<token>`` recorded in
+        run_start telemetry. Returns the
         finished :class:`fdtd3d_tpu.batch.BatchSimulation` — per-lane
         results via ``.lane_state(i)`` / ``.lane_field(i, comp)``,
         per-lane verdicts via ``.lane_finite`` /

@@ -301,10 +301,11 @@ def build_coeffs(static: StaticSetup) -> Dict[str, Any]:
         # (fdtd3d_tpu/batch.py) can give every lane its own amplitude
         # under ONE compiled executable. Same value bit-for-bit for a
         # single run (the float was rounded to rd at trace time
-        # anyway). The packed/tb kernels keep the static in-kernel
-        # amplitude (they are per-scenario executables), and the ds
-        # step its host-side hi+lo split (float32x2 does not batch —
-        # fdtd3d_tpu/batch.py names the limit).
+        # anyway). The packed/tb kernels read it too — their
+        # post-kernel point_source_patch (ops/pallas3d.py) threads the
+        # traced value, which is what makes them lane-capable; only
+        # the ds step keeps its host-side hi+lo split (float32x2 does
+        # not batch — fdtd3d_tpu/batch.py names the limit).
         out["ps_amp"] = rd(cfg.point_source.amplitude)
 
     if static.tfsf_setup is not None:
@@ -472,6 +473,60 @@ def _want_pallas(static: StaticSetup, mesh_axes) -> bool:
             or pallas_packed.eligible(static, mesh_axes))
 
 
+def batch_fallback_reason(static: StaticSetup, mesh_axes=None,
+                          lane_coeffs=None, batch: int = 0):
+    """Machine-readable reason a coalesced batch of ``batch`` lanes
+    over this static canNOT ride the lane-capable packed kernels, or
+    None when it can. THE batch dispatch authority: run_batch
+    (batch.py), the queue dispatcher's coalesced groups, the cost
+    tracer (costs.trace_chunk) and the lint lanes all consult this one
+    function, so they can never disagree about whether/why a batch
+    fell back to the ~6x-slower vmap-jnp path. Recorded downstream as
+    ``batch_unsupported:<token>`` in telemetry run_start and the CLI
+    step-kind line — never a silent downgrade.
+
+    Token order mirrors tb_fallback_reason: dispatch-context tokens
+    first (pallas off, env escape hatches), then kernel scope/VMEM
+    viability at the batched width, and the per-lane scalar sweep
+    strictly last (it needs built coefficients; the others are pure
+    config analysis).
+
+    ``lane_coeffs``: optional list of per-lane host coefficient dicts
+    (solver.build_coeffs output). The packed kernels BAKE scalar
+    coefficients as compile-time floats (pallas_packed.
+    baked_coeff_keys), so any scalar-valued key differing across lanes
+    — or scalar in one lane, material-grid in another — is
+    ``scalar_coeff_divergence``: the lane-capable build would run lane
+    0's constant in every lane. Grid-valued (ndim >= 3) coefficients
+    everywhere are traced operands and batch freely; the traced
+    ``ps_amp`` likewise exempts per-lane source amplitudes."""
+    import os as _os
+
+    from fdtd3d_tpu.ops import pallas_packed
+    if not _want_pallas(static, mesh_axes):
+        return "pallas_disabled"
+    if _os.environ.get("FDTD3D_NO_PACKED"):
+        return "env:FDTD3D_NO_PACKED"
+    if _os.environ.get("FDTD3D_FORCE_FUSED"):
+        return "env:FDTD3D_FORCE_FUSED"
+    if not pallas_packed.eligible(static, mesh_axes) \
+            or pallas_packed.packed_tile(static, batch=batch) == 0:
+        return "kernel_ineligible"
+    if lane_coeffs:
+        for key in pallas_packed.baked_coeff_keys(static):
+            vals = [lc[key] for lc in lane_coeffs]
+            nds = [np.ndim(v) for v in vals]
+            if all(nd >= 3 for nd in nds):
+                continue      # grids are traced operands: lanes may vary
+            if any(nd >= 3 for nd in nds):
+                return "scalar_coeff_divergence"
+            v0 = np.asarray(vals[0])
+            if any(not np.array_equal(np.asarray(v), v0)
+                   for v in vals[1:]):
+                return "scalar_coeff_divergence"
+    return None
+
+
 def tb_fallback_reason(static: StaticSetup, mesh_axes=None,
                        allow_multistep: bool = True):
     """Machine-readable reason the dispatch did NOT engage the
@@ -532,8 +587,16 @@ def _stamp_tb_fallback(step, static, mesh_axes, allow_multistep=True):
 
 
 def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
-              allow_multistep: bool = True):
+              allow_multistep: bool = True, batch: int = 0):
     """Build the pure leapfrog step. mesh_axes/mesh_shape: see stencil.py.
+
+    ``batch=B`` (B >= 2) builds the LANE-CAPABLE packed step for a
+    coalesced batch: the tile/depth pickers charge the per-lane VMEM
+    surcharge (config.VMEM_TEMPS_DEFAULTS["batch_lane"]) and the
+    caller vmaps the chunk runner over the lane axis. Callers MUST
+    gate with batch_fallback_reason(...) is None first — a batched
+    build that cannot land on the packed family raises rather than
+    silently dispatching a non-lane-capable kind.
 
     Dispatches to the fused Pallas kernels (ops/pallas3d.py) when the
     configuration is eligible and use_pallas is not False; otherwise the
@@ -550,6 +613,12 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
     not engage (tb_fallback_reason) — surfaced in telemetry run_start,
     the cost ledger and tools/telemetry_report.py.
     """
+    if batch and batch > 1 \
+            and (static.paired_complex or static.cfg.ds_fields):
+        raise RuntimeError(
+            "make_step(batch>1): paired-complex and float32x2 steps "
+            "are not lane-capable; gate batched builds with "
+            "solver.batch_fallback_reason")
     if static.paired_complex:
         return _stamp_tb_fallback(
             _make_paired_complex_step(static, mesh_axes, mesh_shape),
@@ -604,18 +673,28 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
                     and not _os.environ.get("FDTD3D_NO_TEMPORAL"):
                 from fdtd3d_tpu.ops import pallas_packed_tb
                 tb = pallas_packed_tb.make_packed_tb_step(
-                    static, mesh_axes, mesh_shape)
+                    static, mesh_axes, mesh_shape, batch=batch)
                 if tb is not None:
                     tb.kind = "pallas_packed_tb"
                     # tb.tail_step.kind is set by make_packed_tb_step
                     return tb
             from fdtd3d_tpu.ops import pallas_packed
             pk = pallas_packed.make_packed_eh_step(static, mesh_axes,
-                                                   mesh_shape)
+                                                   mesh_shape,
+                                                   batch=batch)
             if pk is not None:
                 pk.kind = "pallas_packed"
                 return _stamp_tb_fallback(pk, static, mesh_axes,
                                           allow_multistep)
+        if batch and batch > 1:
+            # the dispatch authority (batch_fallback_reason) approved
+            # this batched build, yet no lane-capable kind engaged —
+            # an authority/builder disagreement, never a silent
+            # downgrade onto fused/pallas3d/jnp
+            raise RuntimeError(
+                "make_step(batch>1): no lane-capable packed kind "
+                "engaged; gate batched builds with "
+                "solver.batch_fallback_reason")
 
         # single-pass E+H kernel where its (stricter) scope allows —
         # ~2/3 the HBM traffic of the two-pass kernels, but ONLY when
@@ -648,6 +727,11 @@ def make_step(static: StaticSetup, mesh_axes=None, mesh_shape=None,
         # (no eh fallback here: single-pass eligibility is a strict
         # subset of two-pass eligibility, so eh is None whenever
         # make_pallas_step returned None)
+    if batch and batch > 1:
+        raise RuntimeError(
+            "make_step(batch>1): no lane-capable packed kind "
+            "engaged; gate batched builds with "
+            "solver.batch_fallback_reason")
     mode, cfg = static.mode, static.cfg
     diff_b, diff_f = make_diff_ops(mesh_axes, mesh_shape)
     inv_dx = 1.0 / static.dx
@@ -1162,7 +1246,15 @@ def _make_paired_complex_step(static: StaticSetup, mesh_axes=None,
 
     def step(s, coeffs):
         re = step_re(s["re"], coeffs)
-        im = step_im(s["im"], coeffs)
+        # ps_amp is a TRACED coefficient (build_coeffs): the im leg's
+        # zeroed-amplitude config no longer zeroes the drive on its
+        # own, so zero the traced value for that leg here — the re leg
+        # alone carries the sources (the decomposition's contract)
+        im_coeffs = coeffs
+        if "ps_amp" in coeffs:
+            im_coeffs = dict(coeffs)
+            im_coeffs["ps_amp"] = jnp.zeros_like(coeffs["ps_amp"])
+        im = step_im(s["im"], im_coeffs)
         return {"re": re, "im": im, "t": re["t"]}
 
     def _leg(state, part):
@@ -1211,8 +1303,18 @@ def _make_paired_complex_step(static: StaticSetup, mesh_axes=None,
 
 
 def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
-                      health: bool = False, per_chip: bool = False):
+                      health: bool = False, per_chip: bool = False,
+                      batch: int = 0):
     """scan-over-steps runner: run_chunk(state, coeffs, n) with static n.
+
+    ``batch=B`` builds the lane-capable packed runner (make_step's
+    batch axis): the runner itself stays single-lane — the caller
+    (batch.BatchSimulation / costs.trace_chunk) wraps it in jax.vmap
+    over stacked lane-major state+coeffs, which batches the
+    pallas_call, the in-step lax.ppermute halo exchanges (ONE
+    collective per axis per step, lanes ride the same message) and the
+    in-graph health reduction (per-lane counter vectors) in one
+    compiled executable. Gate with batch_fallback_reason first.
 
     When the packed kernel is engaged (``run_chunk.packed``), the scan
     carry is the PACKED state pytree (stacked E/H/psi arrays); callers
@@ -1240,7 +1342,7 @@ def make_chunk_runner(static: StaticSetup, mesh_axes=None, mesh_shape=None,
     counters into the health dict's ``per_chip`` vectors (telemetry
     schema v4's per-chip lane; ``run_chunk.per_chip`` reports it).
     """
-    step = make_step(static, mesh_axes, mesh_shape)
+    step = make_step(static, mesh_axes, mesh_shape, batch=batch)
     prep = getattr(step, "prepare", None)
     # Temporal-blocked steps advance steps_per_call (= the pipeline
     # depth k in {2, 3, 4}) steps per call: the scan runs n // k

@@ -416,6 +416,11 @@ def provenance(sim=None) -> Dict[str, Any]:
         nlanes = getattr(sim, "batch_size", None)
         if nlanes:
             rec["batch"] = int(nlanes)
+        bfb = getattr(sim, "batch_fallback", None)
+        if bfb:
+            # why this batch is NOT on the lane-capable packed path
+            # (batch_unsupported:<token>, solver.batch_fallback_reason)
+            rec["batch_fallback"] = str(bfb)
     if sim is not None:
         cfg = sim.cfg
         rec.update(
@@ -495,7 +500,12 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "vmem_rung": (int,),
     },
     "ladder_downgrade": {
-        "t": (int,), "old_budget_mb": _OPT_NUM, "new_budget_mb": _NUM,
+        # new_budget_mb null = the batched lanes ladder's terminal
+        # vmap-jnp rung (batch.BatchSimulation._vmem_fallback): no
+        # packed budget applies — the downgrade left the packed
+        # kernels entirely (batch_unsupported:vmem_exhausted)
+        "t": (int,), "old_budget_mb": _OPT_NUM,
+        "new_budget_mb": _OPT_NUM,
         "old_tile": _OPT_NUM, "new_tile": _OPT_NUM, "vmem_rung": (int,),
     },
     "run_end": {
@@ -620,10 +630,15 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # (solver.tb_fallback_reason); absent on temporal-blocked runs.
     # job_id (v8): the queue-job stamp (registry.job_context) joining
     # this stream to its journal rows; absent outside queue runs.
+    # batch_fallback: "batch_unsupported:<token>" when a coalesced
+    # batch could NOT ride the lane-capable packed kernels and fell
+    # back to the vmap-jnp path (solver.batch_fallback_reason — the
+    # ~6x-HBM downgrade, named, never silent); absent on solo runs
+    # and on batches running packed.
     "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
                   "vmem_rung", "tile", "comm_strategy", "ghost_depth",
                   "aot_cache", "batch", "run_id", "tb_fallback",
-                  "job_id"),
+                  "job_id", "batch_fallback"),
     # sim.close_telemetry (round 15): the run's compile wall
     # (exec-cache misses only; a fully-warm run reads 0.0) + the final
     # counter snapshot — the compile-amortization proof per run.
@@ -665,10 +680,14 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # t (the solver step reached), excluded_chips (straggler chips
     # the placement refused to schedule onto), unix (on `queued`
     # requeue rows: resets the wait clock so a requeued job's next
-    # wait_s measures QUEUE time, not its previous run's duration).
+    # wait_s measures QUEUE time, not its previous run's duration),
+    # resumed_from (on `running` rows of a re-dispatched coalesced
+    # group: the committed snapshot t every lane resumed from — 0
+    # means a from-scratch start).
     "job_submit": ("unix", "resume", "time_steps"),
     "job_state": ("run_id", "reason", "wait_s", "topology", "group",
-                  "lane", "t", "excluded_chips", "unix"),
+                  "lane", "t", "excluded_chips", "unix",
+                  "resumed_from"),
 }
 
 

@@ -1,0 +1,39 @@
+"""Known-bad fixture for the donation-safety rule's LANE-CAPABLE
+batched target (pallas_packed_batch): captures shaped like the packed
+kernel under the batch_lane-surcharged tile pick — more, smaller
+blocks along x1 than the solo build — whose donated field operand
+breaks the fetch-before-write contract.
+
+``stale_fetch_capture``: the donated packed input re-reads block i-1
+(a "neighbor halo" read folded into the donated operand instead of a
+separate non-aliased ghost operand) while its aliased output writes
+block i — block b is fetched at iteration b+1, AFTER the output's
+first visit, so the read can observe flushed output. This is exactly
+the hazard a batched build would introduce if the smaller surcharged
+tile tempted a fused halo re-read.
+
+``nonmonotone_capture``: the donated in-map walks the surcharged grid
+BACKWARD — non-monotone fetch order under donation.
+"""
+
+
+def stale_fetch_capture():
+    from jax.experimental import pallas as pl
+    return {
+        # 8 blocks: the batch=3 surcharge halved the solo tile
+        "grid": (8,),
+        "in_specs": [pl.BlockSpec((4, 16),
+                                  lambda i: (max(i - 1, 0), 0))],
+        "out_specs": [pl.BlockSpec((4, 16), lambda i: (i, 0))],
+        "input_output_aliases": {0: 0},
+    }
+
+
+def nonmonotone_capture():
+    from jax.experimental import pallas as pl
+    return {
+        "grid": (8,),
+        "in_specs": [pl.BlockSpec((4, 16), lambda i: (7 - i, 0))],
+        "out_specs": [pl.BlockSpec((4, 16), lambda i: (7 - i, 0))],
+        "input_output_aliases": {0: 0},
+    }

@@ -77,8 +77,10 @@ def test_scope_coverage_is_enumerated_zero(structural_report):
     from fdtd3d_tpu import costs
     stats = structural_report["rules"]["scope-coverage"]["stats"]
     # + the round-14 widened sharded tb lane (TFSF/Drude/grid wedge)
+    # + the round-16 sharded BATCHED packed lane (the batch's ONE
+    #   shared halo exchange per step must be mesh-scoped too)
     assert set(stats) == set(costs.SHARDED_STEP_KINDS) \
-        | {"pallas_packed_tb_widened"}
+        | {"pallas_packed_tb_widened", "pallas_packed_batch"}
     for kind, row in stats.items():
         assert row["unscoped_collectives"] == 0, (kind, row)
         assert row["collectives"] > 0, (kind, row)   # lane not empty
@@ -89,7 +91,8 @@ def test_donation_rule_covered_every_kernel(structural_report):
     assert set(stats) == {"pallas", "pallas_fused", "pallas_packed",
                           "pallas_packed_tb",
                           "pallas_packed_tb_widened",
-                          "pallas_packed_ds"}
+                          "pallas_packed_ds",
+                          "pallas_packed_batch"}
     for label, row in stats.items():
         assert row["aliased_operands"] > 0, (label, row)
 
@@ -225,6 +228,46 @@ def test_donation_safety_fires_on_depth_k_fixture():
     probs2 = check_pallas_capture("tb_k2",
                                   mod.unclamped_drain_capture())
     assert any("NON-MONOTONE" in p for p in probs2), probs2
+
+
+def test_donation_safety_fires_on_batched_fixture():
+    """Round-16 satellite: the lane-capable batched build's known-bad
+    fixture — a donated packed operand re-reading block i-1 under the
+    batch_lane-surcharged (smaller-tile, more-blocks) grid, and a
+    backward-walking donated in-map — must fire the generalized
+    donation-safety check; and the REAL batched build
+    (make_packed_eh_step_batched, registered as pallas_packed_batch)
+    must capture cleanly."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bad_kernel_batch", os.path.join(FIX, "bad_kernel_batch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from fdtd3d_tpu.analysis.graph_rules import (_KERNEL_TARGETS,
+                                                 _target_config,
+                                                 capture_kernel_calls,
+                                                 check_pallas_capture)
+    probs = check_pallas_capture("batch",
+                                 mod.stale_fetch_capture())
+    assert any("donation hazard" in p for p in probs), probs
+    probs2 = check_pallas_capture("batch2",
+                                  mod.nonmonotone_capture())
+    assert any("NON-MONOTONE" in p for p in probs2), probs2
+    # the real build is registered and passes the same check
+    targets = {lbl: (m, b) for lbl, m, b in _KERNEL_TARGETS}
+    assert targets["pallas_packed_batch"] == \
+        ("fdtd3d_tpu.ops.pallas_packed", "make_packed_eh_step_batched")
+    import importlib
+
+    from fdtd3d_tpu.solver import build_static
+    modname, builder = targets["pallas_packed_batch"]
+    cfg, topo = _target_config("pallas_packed_batch")
+    assert topo is None
+    calls = capture_kernel_calls(importlib.import_module(modname),
+                                 builder, build_static(cfg))
+    assert calls
+    for kw in calls:
+        assert check_pallas_capture("pallas_packed_batch", kw) == []
 
 
 def test_scope_coverage_fires_on_fixture():

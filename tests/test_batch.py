@@ -294,3 +294,205 @@ def test_verify_final_lanes_catches_boundary_damage():
     finally:
         faults.clear()
     assert b2.lane_finite == [False, True]
+
+
+# -------------------------------------------------------------------------
+# round 16: lane-capable packed dispatch (batched execution at packed-
+# kernel speed) — CPU interpret, bit-for-bit vs solo PACKED runs
+# -------------------------------------------------------------------------
+
+def _pcfg(amp=1.0, **kw):
+    """Packed-eligible lane config: use_pallas=True rides the Pallas
+    interpret path on CPU, so parity can be asserted bit-for-bit
+    against a SOLO packed run (same kernel, same rounding)."""
+    return _cfg(amp=amp, use_pallas=True, **kw)
+
+
+def _solo_packed(cfg, steps):
+    sim = Simulation(cfg)
+    sim.advance(steps)
+    return sim
+
+
+@pytest.mark.parametrize("steps", [8, 7])
+def test_batch_packed_parity_bit_identical(steps):
+    """THE tentpole acceptance: 3 amplitude-divergent lanes dispatch
+    the lane-capable PACKED kernel (batch_fallback None) under ONE
+    compiled executable, each lane bit-identical to its solo packed
+    run — even AND odd horizons (the tb tail steps batch too)."""
+    cfgs = [_pcfg(amp=a) for a in (1.0, 2.0, 0.5)]
+    s0 = exec_cache.stats()
+    bsim = BatchSimulation(cfgs)
+    assert bsim.batch_fallback is None
+    assert bsim.step_kind.startswith("pallas_packed")
+    bsim.advance(steps)
+    s1 = exec_cache.stats()
+    assert s1["traces"] - s0["traces"] == 1, \
+        "B lanes must cost exactly one trace"
+    for lane, cfg in enumerate(cfgs):
+        _assert_lane_equal(bsim, lane, _solo_packed(cfg, steps))
+
+
+def test_batch_packed_material_grid_lanes():
+    """Per-lane eps GRIDS are traced operands: sphere-value-divergent
+    lanes stay in lane-capable scope (no scalar_coeff_divergence) and
+    match their solo packed runs bit for bit."""
+    def sphere(v):
+        return MaterialsConfig(eps_sphere=SphereConfig(
+            enabled=True, center=(6.0, 6.0, 6.0), radius=3.0, value=v))
+    cfgs = [_pcfg(materials=sphere(2.0)), _pcfg(materials=sphere(4.0))]
+    bsim = BatchSimulation(cfgs)
+    assert bsim.batch_fallback is None
+    assert bsim.step_kind.startswith("pallas_packed")
+    bsim.advance(8)
+    for lane, cfg in enumerate(cfgs):
+        _assert_lane_equal(bsim, lane, _solo_packed(cfg, 8))
+
+
+def test_batch_packed_scalar_divergence_falls_back_named(tmp_path):
+    """Scalar-eps-divergent lanes are NOT lane-capable (the packed
+    kernel bakes scalar coefficients): the batch falls back to the
+    vmap-jnp path with the machine-readable token in BOTH the
+    BatchSimulation attribute and the run_start telemetry record —
+    and still matches sequential jnp runs bit for bit."""
+    path = tmp_path / "t.jsonl"
+    cfgs = [_pcfg(eps=1.0,
+                  output=OutputConfig(telemetry_path=str(path))),
+            _pcfg(eps=2.0)]
+    bsim = BatchSimulation(cfgs)
+    try:
+        assert bsim.batch_fallback == \
+            "batch_unsupported:scalar_coeff_divergence"
+        assert bsim.step_kind == "jnp"
+        bsim.advance(8)
+    finally:
+        bsim.close()
+    for lane, cfg in enumerate(cfgs):
+        _assert_lane_equal(bsim, lane, _sequential(cfg, 8))
+    recs = telemetry.read_jsonl(str(path))
+    start = next(r for r in recs if r["type"] == "run_start")
+    assert start["batch_fallback"] == \
+        "batch_unsupported:scalar_coeff_divergence"
+
+
+def test_batch_packed_lane_capable_run_start_has_no_token(tmp_path):
+    """The complement: a lane-capable batch's run_start carries NO
+    batch_fallback key (absent, not null — RECORD_OPTIONAL)."""
+    path = tmp_path / "t.jsonl"
+    cfgs = [_pcfg(amp=1.0,
+                  output=OutputConfig(telemetry_path=str(path))),
+            _pcfg(amp=2.0)]
+    bsim = BatchSimulation(cfgs)
+    try:
+        assert bsim.batch_fallback is None
+        bsim.advance(4)
+    finally:
+        bsim.close()
+    start = next(r for r in telemetry.read_jsonl(str(path))
+                 if r["type"] == "run_start")
+    assert "batch_fallback" not in start
+
+
+def test_batch_packed_nan_trips_only_its_lane():
+    """Lane-NaN isolation holds ON THE PACKED PATH: the stacked packed
+    carry's health counters unpack per lane in-graph — lane 1's NaN
+    never flags (or perturbs) lanes 0/2."""
+    cfgs = [_pcfg(output=OutputConfig(check_finite=True)),
+            _pcfg(), _pcfg()]
+    faults.clear()
+    faults.install("nan@t=4,field=Ez,lane=1")
+    try:
+        bsim = BatchSimulation(cfgs)
+        assert bsim.batch_fallback is None
+        assert bsim.step_kind.startswith("pallas_packed")
+        bsim.advance(4)
+        bsim.advance(4)
+    finally:
+        faults.clear()
+    assert bsim.lane_finite == [True, False, True]
+    assert bsim.lane_first_unhealthy_t == [None, 8, None]
+    clean = _solo_packed(_pcfg(), 8)
+    _assert_lane_equal(bsim, 0, clean)
+    _assert_lane_equal(bsim, 2, clean)
+    assert not np.isfinite(bsim.lane_field(1, "Ez")).all()
+
+
+def test_batch_vmem_lanes_ladder_downgrade(tmp_path):
+    """The lanes ladder: a (simulated) VMEM compile failure of the
+    lane-capable executable walks Simulation._VMEM_LADDER_MB rebuilds
+    and, when every packed rung is exhausted, lands on the vmap-jnp
+    runner with ``batch_unsupported:vmem_exhausted`` + a structured
+    ladder_downgrade event — and the run completes bit-identical to
+    sequential jnp runs (the live carry was routed old-unpack ->
+    new-pack)."""
+    path = tmp_path / "t.jsonl"
+    cfgs = [_pcfg(amp=1.0,
+                  output=OutputConfig(telemetry_path=str(path))),
+            _pcfg(amp=2.0)]
+    bsim = BatchSimulation(cfgs)
+    assert bsim._packed and bsim.batch_fallback is None
+    try:
+        for _ in range(len(Simulation._VMEM_LADDER_MB) + 1):
+            if not bsim._packed:
+                break
+            bsim._vmem_fallback(
+                RuntimeError("RESOURCE_EXHAUSTED: mosaic vmem"))
+        assert not bsim._packed
+        assert bsim.batch_fallback == \
+            "batch_unsupported:vmem_exhausted"
+        assert bsim.step_kind == "jnp"
+        bsim.advance(8)
+    finally:
+        bsim.close()
+    for lane, cfg in enumerate(cfgs):
+        _assert_lane_equal(bsim, lane, _sequential(cfg, 8))
+    evs = [r for r in telemetry.read_jsonl(str(path))
+           if r["type"] == "ladder_downgrade"]
+    assert evs and evs[-1]["new_budget_mb"] is None   # the jnp rung
+    # a non-packed batch never enters the ladder: re-raise, not loop
+    with pytest.raises(RuntimeError, match="boom"):
+        bsim._vmem_fallback(RuntimeError("boom"))
+
+
+def test_batch_packed_sharded_one_halo_exchange():
+    """Sharded (2,2,2) batch ON THE PACKED KIND: per-lane bit parity
+    vs the sharded solo packed run AND the compiled module's
+    collective-permute count equals the solo module's — the lanes
+    share ONE halo exchange per step at packed-kernel cost."""
+    par = ParallelConfig(topology="manual", manual_topology=(2, 2, 2))
+    cfgs = [_cfg(n=16, amp=a, pml=PmlConfig(size=(2, 2, 2)),
+                 parallel=par, use_pallas=True) for a in (1.0, 2.0)]
+    bsim = BatchSimulation(cfgs)
+    assert bsim.batch_fallback is None
+    assert bsim.step_kind.startswith("pallas_packed")
+    bsim.advance(8)
+    for lane, cfg in enumerate(cfgs):
+        sim = _solo_packed(cfg, 8)
+        for comp in ("Ez", "Hy"):
+            assert np.array_equal(np.asarray(sim.field(comp)),
+                                  bsim.lane_field(lane, comp))
+    solo = Simulation(cfgs[0])
+    solo.advance(8)
+    n_batch = _count_collective_permutes(bsim._compiled[8])
+    n_solo = _count_collective_permutes(solo._compiled[8])
+    assert n_batch > 0
+    assert n_batch == n_solo, \
+        f"batched packed module has {n_batch} collective-permutes " \
+        f"vs solo's {n_solo} — lanes must share the exchange"
+
+
+def test_batch_exec_key_distinct_per_width():
+    """ExecKey carries the batch width: a 2-lane and a 3-lane batch of
+    the same scenario, and the solo run, all compile under DISTINCT
+    keys (a cached solo executable can never serve a batch, nor one
+    width another)."""
+    b2 = BatchSimulation([_pcfg(amp=1.0), _pcfg(amp=2.0)])
+    b3 = BatchSimulation([_pcfg(amp=1.0), _pcfg(amp=2.0),
+                          _pcfg(amp=0.5)])
+    k2, k3 = b2.exec_key(8), b3.exec_key(8)
+    assert k2.batch == 2 and k3.batch == 3
+    assert k2 != k3
+    solo = Simulation(_pcfg())
+    ks = solo.exec_key(8)
+    assert ks.batch == 0
+    assert ks != k2

@@ -200,3 +200,93 @@ def test_costs_cli(tmp_path, capsys):
     assert led["roofline"]["hbm_gbps"] == 600.0
     # the CLI's stdout IS the ledger (log.report)
     assert json.loads(capsys.readouterr().out)["step_kind"] == "jnp"
+
+
+# ---------------------------------------------------------------------------
+# Lane-capable batched ledgers (round 16): the vmapped packed runner,
+# normalized PER-LANE per-step, must cost what solo packed costs.
+
+BATCH_HBM_BOUND = 1.15   # per-lane packed field bytes vs solo packed
+
+
+@pytest.fixture(scope="module")
+def batch_ledgers():
+    """Solo + 3-lane batched packed ledgers on one config (module-
+    scoped: the batched trace vmaps the packed kernel)."""
+    cfg = costs.config_for_kind("pallas_packed")
+    return {
+        "solo": costs.chunk_ledger(cfg, n_steps=8, kind="pallas_packed"),
+        "b3": costs.chunk_ledger(cfg, n_steps=8, kind="pallas_packed",
+                                 batch=3),
+    }
+
+
+def test_batch_ledger_validates_and_keys(batch_ledgers):
+    b3 = batch_ledgers["b3"]
+    costs.validate_ledger(b3)
+    costs.validate_ledger(json.loads(json.dumps(b3)))
+    assert b3["step_kind"] == "pallas_packed"
+    assert b3["batch"] == 3
+    assert batch_ledgers["solo"]["batch"] is None
+    assert "batch" in costs.LEDGER_KEYS
+    # old (pre-batch) ledger files keep validating: the key is emitted,
+    # never required
+    old = json.loads(json.dumps(batch_ledgers["solo"]))
+    del old["batch"]
+    costs.validate_ledger(old)
+
+
+def test_batch_ledger_coverage_95(batch_ledgers):
+    """Satellite acceptance: >= 95% of the BATCHED trace's per-step
+    flops and bytes land on named sections."""
+    ps = batch_ledgers["b3"]["per_step"]
+    assert ps["coverage_flops"] >= 0.95
+    assert ps["coverage_bytes"] >= 0.95
+    assert ps["flops"] > 0 and ps["bytes"] > 0
+
+
+def test_batch_ledger_per_lane_hbm_gate(batch_ledgers):
+    """THE CPU gate: batched per-lane per-step packed-kernel field HBM
+    bytes <= 1.15x the solo packed kernel's on the same config — the
+    batch executes at packed-kernel cost, not vmap-jnp cost."""
+    solo, b3 = batch_ledgers["solo"], batch_ledgers["b3"]
+    s = solo["sections"]["packed-kernel"]["bytes"] / solo["cells"]
+    b = b3["sections"]["packed-kernel"]["bytes"] / b3["cells"]
+    assert b <= BATCH_HBM_BOUND * s, \
+        f"batched per-lane packed bytes {b:.1f}/cell vs solo {s:.1f} " \
+        f"(bound {BATCH_HBM_BOUND}x)"
+    # and the whole per-step byte total stays in the same band
+    assert b3["per_step"]["bytes_per_cell"] <= \
+        BATCH_HBM_BOUND * solo["per_step"]["bytes_per_cell"]
+
+
+def test_batch_ledger_tb_kind(monkeypatch):
+    """The depth-k temporal-blocked kernel is lane-capable too: a
+    batched tb trace engages pallas_packed_tb and keeps per-lane
+    per-step parity with the solo tb ledger."""
+    monkeypatch.setenv("FDTD3D_TB_DEPTH", "2")
+    cfg = costs.config_for_kind("pallas_packed_tb")
+    solo = costs.chunk_ledger(cfg, n_steps=8, kind="pallas_packed_tb")
+    b3 = costs.chunk_ledger(cfg, n_steps=8, kind="pallas_packed_tb",
+                            batch=3)
+    assert b3["step_kind"] == "pallas_packed_tb"
+    assert b3["steps_per_call"] == solo["steps_per_call"] == 2
+    s = solo["sections"]["packed-kernel-tb"]["bytes"] / solo["cells"]
+    b = b3["sections"]["packed-kernel-tb"]["bytes"] / b3["cells"]
+    assert b <= BATCH_HBM_BOUND * s
+
+
+def test_batch_ledger_sharded_one_halo_exchange():
+    """Sharded batched trace: the whole batch shares ONE halo exchange
+    per step — per-lane halo bytes equal solo's and the per-lane
+    message share is solo's / B (fractional by design)."""
+    cfg = costs.config_for_kind("pallas_packed", n=16, pml=2)
+    solo = costs.chunk_ledger(cfg, n_steps=8, kind="pallas_packed",
+                              topology=(2, 2, 2))
+    b3 = costs.chunk_ledger(cfg, n_steps=8, kind="pallas_packed",
+                            topology=(2, 2, 2), batch=3)
+    cs, cb = solo["comm"]["per_step"], b3["comm"]["per_step"]
+    assert cb["ppermute_bytes_per_chip"] == \
+        pytest.approx(cs["ppermute_bytes_per_chip"])
+    assert cb["ppermute_messages"] == \
+        pytest.approx(cs["ppermute_messages"] / 3)

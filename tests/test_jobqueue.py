@@ -489,3 +489,83 @@ def test_resolve_artifact_uses_registry_dir_not_cwd(tmp_path,
         cwd=str(reader_cwd))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "a.jsonl" in proc.stdout and "b.jsonl" in proc.stdout
+
+
+# -------------------------------------------------------------------------
+# group snapshot resume: preempted coalesced groups continue from the
+# committed t, bit-identical (round 16)
+# -------------------------------------------------------------------------
+
+def test_group_preemption_resumes_from_committed_snapshot(tmp_path):
+    """A preempted coalesced group does NOT restart from t=0: the
+    re-dispatch adopts the group's committed snapshot (one .npz per
+    chunk boundary under <queue>/groups/<gid>/), journals the resume t
+    on its "running" rows, and finishes every lane bit-identical to an
+    uninterrupted run of the same pair."""
+    import numpy as np
+    from fdtd3d_tpu import exec_cache, io
+
+    def _serve_pair(tag, fault=None):
+        q = JobQueue(str(tmp_path / tag))
+        a = q.submit(_spec(tmp_path, f"{tag}_a.txt",
+                           BASE + "--eps 1.0\n"), tenant="acme")
+        b = q.submit(_spec(tmp_path, f"{tag}_b.txt",
+                           BASE + "--eps 2.0\n"), tenant="acme")
+        if fault:
+            faults.install(fault)
+        try:
+            out = jobqueue.Scheduler(q, batch_chunk=4).serve()
+        finally:
+            faults.clear()
+        jobs = out["jobs"]
+        assert jobs[a]["status"] == "completed"
+        assert jobs[b]["status"] == "completed"
+        assert jobs[a]["group"] == jobs[b]["group"]
+        gdir = os.path.join(q.dirpath, "groups", jobs[a]["group"])
+        final = os.path.join(gdir, "ckpt_t000008.npz")
+        assert os.path.exists(final), sorted(os.listdir(gdir))
+        return q, (a, b), final
+
+    # preempt@t=8 fires on the second chunk boundary, BEFORE that
+    # boundary's snapshot commits: the only committed snapshot is t=4
+    exec_cache.clear_memory()
+    traces0 = exec_cache.stats()["traces"]
+    q, (a, b), final = _serve_pair("faulted", fault="preempt@t=8")
+
+    rows = [r for r in q.read() if r.get("type") == "job_state"]
+    pre = [r for r in rows if r.get("status") == "preempted"]
+    assert len(pre) == 2 and {r["job_id"] for r in pre} == {a, b}
+    for r in pre:
+        assert "committed snapshot t=4" in r["reason"]
+        assert r["t"] == 8          # preempted at t=8, resumes from 4
+    runs_a = [r for r in rows
+              if r.get("status") == "running" and r["job_id"] == a]
+    assert [r.get("resumed_from") for r in runs_a] == [0, 4]
+    runs_b = [r for r in rows
+              if r.get("status") == "running" and r["job_id"] == b]
+    assert [r.get("resumed_from") for r in runs_b] == [0, 4]
+
+    # the re-dispatch re-used the cached vmap chunk executable: one
+    # trace covers both dispatches (same ExecKey, same batch width)
+    assert exec_cache.stats()["traces"] - traces0 == 1
+
+    # bit-identical: the resumed group's final snapshot matches an
+    # uninterrupted run of the same pair, array for array
+    _, _, ref_final = _serve_pair("clean")
+    s_res, m_res = io.load_checkpoint(final)
+    s_ref, m_ref = io.load_checkpoint(ref_final)
+    assert m_res["t"] == m_ref["t"] == 8
+
+    def _leaves(tree, prefix=""):
+        for k in sorted(tree):
+            v = tree[k]
+            if isinstance(v, dict):
+                yield from _leaves(v, f"{prefix}{k}/")
+            else:
+                yield f"{prefix}{k}", v
+
+    res_leaves = dict(_leaves(s_res))
+    ref_leaves = dict(_leaves(s_ref))
+    assert set(res_leaves) == set(ref_leaves) and res_leaves
+    for key, arr in ref_leaves.items():
+        assert np.array_equal(arr, res_leaves[key]), key

@@ -872,3 +872,58 @@ def test_sentinel_cli_compile_lane(tmp_path):
         capture_output=True, text=True, timeout=120, env=env)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "not amortizing" in proc.stderr
+
+
+# -------------------------------------------------------------------------
+# round 16: lane-capable batched packed paths + batched ledger fixtures
+# -------------------------------------------------------------------------
+
+def test_sentinel_batch_paths_registered():
+    """Round-16 satellite: the batched-packed paths (f32_packed_batch /
+    bf16_batch, bench stage 4b) are first-class sentinel paths with
+    their own grid keys — absent history reads NOT-MEASURED/NO-REF,
+    never a phantom regression; once a best carries the keys, drops
+    gate like every other path."""
+    ps = _sentinel()
+    cur = dict(CUR_OK, batch_mcells=7500.0, batch_n=256,
+               batch_bf16_mcells=13000.0, batch_bf16_n=256)
+    v = ps.check_artifact(cur, _best(), _history())
+    assert v["paths"]["f32_packed_batch"]["verdict"] == "NO-REF"
+    assert v["paths"]["bf16_batch"]["verdict"] == "NO-REF"
+    assert v["status"] == "OK"
+    best = dict(_best(), batch_mcells=7500.0, batch_n=256,
+                batch_bf16_mcells=13000.0, batch_bf16_n=256)
+    v = ps.check_artifact(dict(cur, batch_mcells=5000.0), best,
+                          _history())
+    assert v["paths"]["f32_packed_batch"]["verdict"] == "REGRESSION"
+    assert v["paths"]["bf16_batch"]["verdict"] == "OK"
+    # smaller-grid window than the reference's: INCONCLUSIVE, not a cry
+    v = ps.check_artifact(dict(cur, batch_mcells=5000.0, batch_n=192),
+                          best, _history())
+    assert v["paths"]["f32_packed_batch"]["verdict"] == "INCONCLUSIVE"
+
+
+def test_sentinel_batch_ledger_fixture_pair():
+    """Round-16 satellite: the ledger_batch fixture pair catches a
+    per-lane field-traffic regression chip-free, and batched ledgers
+    never diff across batch widths (nor against solo ledgers) — the
+    per-lane normalization makes magnitudes comparable, but the
+    lane-amortized comm shares and the VMEM-surcharged tile pick move
+    with the width."""
+    ps = _sentinel()
+    with open(os.path.join(FIX, "ledger_batch_ref.json")) as f:
+        ref = json.load(f)
+    with open(os.path.join(FIX, "ledger_batch_regressed.json")) as f:
+        cur = json.load(f)
+    assert ref["batch"] == 3
+    assert ps.check_ledgers(ref, ref)["status"] == "OK"
+    v = ps.check_ledgers(cur, ref)
+    assert v["status"] == "REGRESSION"
+    assert any("E-update" in m or "per-step" in m
+               for m in v["regressions"])
+    # batch-width mismatch (incl. vs a solo ledger): SKIPPED
+    with open(os.path.join(FIX, "ledger_ref.json")) as f:
+        solo = json.load(f)
+    assert ps.check_ledgers(ref, solo)["status"] == "SKIPPED"
+    assert ps.check_ledgers(dict(ref, batch=2), ref)["status"] \
+        == "SKIPPED"

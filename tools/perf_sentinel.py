@@ -71,6 +71,12 @@ PATHS = {
     "f32_packed_tb_sharded": ("tb_sharded_mcells",
                               ("tb_sharded_mcells",)),
     "float32x2": ("float32x2_mcells", ("float32x2_mcells",)),
+    # round-16 lane-capable batched packed kernels (bench batch stage):
+    # PER-LANE throughput of the vmapped packed executable — its own
+    # first-class paths so solo-packed history can never mask a
+    # batched-dispatch cliff (a silent fall to vmap-jnp is ~6x)
+    "f32_packed_batch": ("batch_mcells", ("batch_mcells",)),
+    "bf16_batch": ("batch_bf16_mcells", ("batch_bf16_mcells",)),
 }
 
 # grid-size keys per path (current artifact / reference records).
@@ -89,6 +95,8 @@ PATH_N_KEYS = {
     "f32_packed_tb_k4": ("tb_k4_n",),
     "f32_packed_tb_sharded": ("tb_sharded_n",),
     "float32x2": ("float32x2_n",),
+    "f32_packed_batch": ("batch_n",),
+    "bf16_batch": ("batch_bf16_n",),
 }
 
 
@@ -225,6 +233,18 @@ def check_ledgers(current: Dict[str, Any], reference: Dict[str, Any],
                        f"{current.get('steps_per_call', 1)} vs "
                        f"{reference.get('steps_per_call', 1)} — diff "
                        f"each depth against its own reference")
+        return out
+    if current.get("batch") != reference.get("batch"):
+        # batched ledgers are PER-LANE normalized so the magnitudes
+        # compare, but a batch-width change moves the lane-amortized
+        # comm shares and the VMEM-surcharged tile pick: gate each
+        # width against its own fixture (ledger_batch_ref.json),
+        # never across widths (nor against a solo ledger)
+        out["status"] = "SKIPPED"
+        out["note"] = (f"batch widths differ: "
+                       f"{current.get('batch')} vs "
+                       f"{reference.get('batch')} — diff each width "
+                       f"against its own reference")
         return out
     cur_cells = float(current.get("cells") or 1)
     ref_cells = float(reference.get("cells") or 1)
