@@ -178,6 +178,14 @@ class BatchSimulation:
         self._health_on = bool(out0.telemetry_path) \
             or bool(out0.metrics_path) or out0.check_finite
         self._check_finite = out0.check_finite
+        # Per-chip lane INSIDE lanes (ROADMAP item 2 remainder; the
+        # batch used to hardwire per_chip=False): the un-psummed
+        # per-chip counters ride the same single fused readback, per
+        # lane — vmap prepends the lane axis to the all_gathered
+        # vectors, so each lane names its own straggler chip.
+        self._per_chip_on = self._health_on \
+            and bool(out0.per_chip_telemetry) \
+            and bool(out0.telemetry_path)
 
         # Per-lane states + coefficients (stacked along the lane axis
         # below). Each lane's coeffs come from ITS config (material
@@ -211,6 +219,7 @@ class BatchSimulation:
                 topology=topo)
         runner = make_chunk_runner(
             self.static, mesh_axes, mesh_shape, health=self._health_on,
+            per_chip=self._per_chip_on,
             batch=self.batch_size if token is None else 0)
         self._runner = runner
         self.step_kind = runner.kind
@@ -261,6 +270,16 @@ class BatchSimulation:
         self._t_host = 0
         self._chunk_idx = 0
         self._closed = False
+        # Causal trace plane (schema v9): the queue dispatcher stamps
+        # the coalesce-group id + one {trace_id, span_id,
+        # parent_span_id} dict per lane AFTER construction
+        # (jobqueue._dispatch_batch); solo run_batch calls leave them
+        # None and the batch emits no spans. The GROUP-level
+        # trace_id/span_id land on self via registry.attach below
+        # (the leader's trace under job_context).
+        self.lane_traces: Optional[List[Optional[Dict[str, str]]]] = \
+            None
+        self.group_id: Optional[str] = None
         # per-lane health ledger: None = never measured, True/False =
         # last chunk's finite flag; first unhealthy t bound per lane
         self.lane_finite: List[Optional[bool]] = \
@@ -333,7 +352,8 @@ class BatchSimulation:
             donate = jax.default_backend() in ("tpu", "axon")
         return _exec_cache.make_key(
             self.cfg, step_kind=self.step_kind, topology=self.topology,
-            n_steps=n, health=self._runner_health, per_chip=False,
+            n_steps=n, health=self._runner_health,
+            per_chip=bool(getattr(self._runner, "per_chip", False)),
             step_diag=self.step_diag, batch=self.batch_size,
             donate=donate,
             avals_fp=_exec_cache.avals_fingerprint(self._state,
@@ -361,15 +381,20 @@ class BatchSimulation:
                     else self._state_specs
                 out_specs = carry_specs
                 if self._runner_health:
-                    out_specs = (carry_specs,
-                                 {k: P()
-                                  for k in _telemetry.HEALTH_KEYS})
+                    hspec = {k: P() for k in _telemetry.HEALTH_KEYS}
+                    if getattr(self._runner, "per_chip", False):
+                        # per-lane per-chip vectors come out of the
+                        # vmapped all_gather replicated, lane-leading
+                        hspec["per_chip"] = {
+                            k: P() for k in _telemetry.PER_CHIP_KEYS}
+                    out_specs = (carry_specs, hspec)
                 fn = shard_map_compat(fn, self.mesh,
                                       in_specs=(carry_specs,
                                                 self._coeff_specs),
                                       out_specs=out_specs)
             donate = jax.default_backend() in ("tpu", "axon")
             key = self.exec_key(n, donate=donate)
+            t_sp0 = float(time.time())
             try:
                 with _telemetry.span("compile"):
                     compiled, info = _exec_cache.jit_compile(
@@ -379,6 +404,13 @@ class BatchSimulation:
                 self._vmem_fallback(exc)   # next rung, or re-raise
                 continue
             self._compile_ms += float(info.get("compile_ms") or 0.0)
+            _telemetry.emit_trace_span(
+                self, "compile", t_sp0, float(time.time()),
+                attrs={"source": info.get("source"),
+                       "compile_ms":
+                           float(info.get("compile_ms") or 0.0),
+                       "n_steps": int(n)},
+                group=self.group_id)
             self._compiled[n] = compiled
         return self._compiled[n]
 
@@ -419,7 +451,9 @@ class BatchSimulation:
                 with _telemetry.span("vmem-ladder-rebuild"):
                     runner = make_chunk_runner(
                         self.static, self._mesh_axes, self._mesh_shape,
-                        health=self._health_on, batch=self.batch_size)
+                        health=self._health_on,
+                        per_chip=self._per_chip_on,
+                        batch=self.batch_size)
             except RuntimeError:
                 # no lane-capable kind fits this budget; smaller rungs
                 # cannot fit either — straight to the jnp rung
@@ -450,7 +484,7 @@ class BatchSimulation:
                 topology=self.topology)
             runner = make_chunk_runner(
                 self.static, self._mesh_axes, self._mesh_shape,
-                health=self._health_on)
+                health=self._health_on, per_chip=self._per_chip_on)
             self.batch_fallback = "batch_unsupported:vmem_exhausted"
         new_tile = ((getattr(runner, "diag", None) or {}).get("tile")
                     or {}).get("EH")
@@ -502,6 +536,7 @@ class BatchSimulation:
         fn = self._chunk_fn(n_steps)
         timed = self.telemetry is not None
         wall = 0.0
+        t_sp0 = float(time.time())
         if timed:
             jax.block_until_ready(self._state)
             t0 = time.perf_counter()
@@ -520,7 +555,14 @@ class BatchSimulation:
         t_prev = self._t_host
         self._t_host = t_prev + n_steps
         self._chunk_idx += 1
+        _telemetry.emit_trace_span(
+            self, "chunk", t_sp0, float(time.time()),
+            attrs={"chunk": int(self._chunk_idx),
+                   "t": int(self._t_host), "steps": int(n_steps)},
+            group=self.group_id)
         if hv is not None:
+            per = hv.get("per_chip")
+            lts = self.lane_traces or []
             tripped = []
             for lane in range(self.batch_size):
                 finite = bool(hv["finite"][lane])
@@ -530,14 +572,45 @@ class BatchSimulation:
                     self.lane_first_unhealthy_t[lane] = self._t_host
                     tripped.append(lane)
                 if self.telemetry is not None:
-                    self.telemetry.emit(
-                        "batch_lane", chunk=self._chunk_idx,
-                        t=self._t_host, lane=lane,
-                        energy=hv["energy"][lane],
-                        div_l2=hv["div_l2"][lane],
-                        div_linf=hv["div_linf"][lane],
-                        max_e=hv["max_e"][lane],
-                        max_h=hv["max_h"][lane], finite=finite)
+                    tr = lts[lane] if lane < len(lts) else None
+                    rec = {
+                        "chunk": self._chunk_idx, "t": self._t_host,
+                        "lane": lane,
+                        "energy": hv["energy"][lane],
+                        "div_l2": hv["div_l2"][lane],
+                        "div_linf": hv["div_linf"][lane],
+                        "max_e": hv["max_e"][lane],
+                        "max_h": hv["max_h"][lane], "finite": finite,
+                        "trace_id":
+                            tr.get("trace_id") if tr else None,
+                        "span_id": tr.get("span_id") if tr else None,
+                        "parent_span_id":
+                            tr.get("parent_span_id") if tr else None,
+                    }
+                    for key in ("trace_id", "span_id",
+                                "parent_span_id"):
+                        if rec[key] is None:
+                            rec.pop(key)
+                    self.telemetry.emit("batch_lane", **rec)
+                    if per is not None:
+                        # per-lane per-chip lane (ROADMAP item 2
+                        # remainder): one per_chip + imbalance row per
+                        # LANE per chunk, naming the straggler chip
+                        # inside the coalesced group — same single
+                        # fused readback, no extra device traffic
+                        chips = {k: per[k][lane] for k in per}
+                        n_chips = len(chips.get("energy") or ())
+                        self.telemetry.emit(
+                            "per_chip", chunk=self._chunk_idx,
+                            t=self._t_host, lane=lane,
+                            group=self.group_id, n_chips=n_chips,
+                            counters=chips)
+                        imb = _telemetry.imbalance_summary(chips)
+                        if imb is not None:
+                            self.telemetry.emit(
+                                "imbalance", chunk=self._chunk_idx,
+                                t=self._t_host, lane=lane,
+                                group=self.group_id, **imb)
             if self.telemetry is not None:
                 # one aggregate chunk record beside the per-lane rows,
                 # so tools/telemetry_report.py's existing summaries
@@ -567,13 +640,14 @@ class BatchSimulation:
             _faults.on_chunk_boundary(self)
         return self
 
-    def _readback(self, health) -> Dict[str, List[Optional[float]]]:
+    def _readback(self, health) -> Dict[str, Any]:
         """ONE device->host transfer of the per-lane health vectors
         (the same single-readback budget Simulation.advance holds)."""
         import jax
         with _telemetry.span("telemetry-readback"):
             vals = jax.device_get(health)
-        out: Dict[str, List[Optional[float]]] = {}
+        per = vals.pop("per_chip", None)
+        out: Dict[str, Any] = {}
         for k, v in vals.items():
             arr = np.asarray(v, dtype=np.float64).ravel()
             if k == "nonfinite":
@@ -581,6 +655,17 @@ class BatchSimulation:
             else:
                 out[k] = [float(x) if np.isfinite(x) else None
                           for x in arr]
+        if per is not None:
+            # the vmapped per-chip vectors are (lanes, n_chips):
+            # preserve the per-lane rows (advance() emits one
+            # per_chip/imbalance record per lane from them)
+            out["per_chip"] = {
+                k: [[float(x) if np.isfinite(x) else None
+                     for x in np.asarray(row,
+                                         dtype=np.float64).ravel()]
+                    for row in np.asarray(v).reshape(
+                        self.batch_size, -1)]
+                for k, v in per.items()}
         return out
 
     def run(self, time_steps: Optional[int] = None, chunk: int = 0):
@@ -696,13 +781,21 @@ class BatchSimulation:
     # -- group snapshots (the queue dispatcher's durable resume) -----------
 
     def _ckpt_meta(self) -> Dict[str, Any]:
-        return {
+        meta = {
             "kind": "batch",
             "t": int(self._t_host),
             "batch": int(self.batch_size),
             "topology": list(self.topology),
             "batch_fp": repr(self.specs[0].batch_fingerprint()),
         }
+        # v9: registry + causal-trace joins ride every group snapshot
+        # (tools/ckpt_inspect.py prints both) — stamped here because a
+        # batch has no extra_ckpt_meta for registry.attach to fill
+        if getattr(self, "run_id", None):
+            meta["run_id"] = self.run_id
+        if getattr(self, "trace_id", None):
+            meta["trace_id"] = self.trace_id
+        return meta
 
     def checkpoint(self, path: str):
         """Bit-exact snapshot of the WHOLE batch: the stacked
@@ -722,8 +815,14 @@ class BatchSimulation:
                                 self._dict_state())
         if jax.process_index() != 0:
             return self
+        t_sp0 = float(time.time())
         with _telemetry.span("checkpoint"):
             io.save_checkpoint(state_np, path, extra=self._ckpt_meta())
+        _telemetry.emit_trace_span(
+            self, "snapshot_commit", t_sp0, float(time.time()),
+            attrs={"path": os.path.basename(path),
+                   "t": int(self._t_host)},
+            group=self.group_id)
         _faults.on_checkpoint(path)  # committed: harness hook
         return self
 

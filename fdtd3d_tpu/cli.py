@@ -232,7 +232,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "per-chip health counters (schema-v4 per_chip "
                         "records, tiny all_gathered scalars on the "
                         "same readback) plus a per-chunk imbalance "
-                        "summary (max/mean ratio, straggler chip)")
+                        "summary (max/mean ratio, straggler chip). "
+                        "With --batch: per-LANE per_chip/imbalance "
+                        "rows naming each tenant's straggler chip")
 
     g = p.add_argument_group("durability (docs/ROBUSTNESS.md)")
     g.add_argument("--supervise", action=argparse.BooleanOptionalAction,
@@ -619,9 +621,12 @@ def _run_batch_cli(parser, args) -> int:
                 f"--batch: {path} itself contains --batch (nested "
                 f"batches are not a thing)")
         cfgs.append(args_to_config(largs))
-    if args.telemetry or args.metrics or args.check_finite:
+    if args.telemetry or args.metrics or args.check_finite \
+            or args.per_chip_telemetry:
         # top-level observability flags apply to the batch (lane 0's
-        # output config drives the shared sink / tripwire)
+        # output config drives the shared sink / tripwire / per-chip
+        # lane — the batched runner honors per_chip_telemetry since
+        # the trace plane, emitting per-LANE per_chip/imbalance rows)
         out0 = _dc.replace(
             cfgs[0].output,
             telemetry_path=args.telemetry
@@ -629,7 +634,9 @@ def _run_batch_cli(parser, args) -> int:
             metrics_path=args.metrics
             or cfgs[0].output.metrics_path,
             check_finite=args.check_finite
-            or cfgs[0].output.check_finite)
+            or cfgs[0].output.check_finite,
+            per_chip_telemetry=args.per_chip_telemetry
+            or cfgs[0].output.per_chip_telemetry)
         cfgs[0] = _dc.replace(cfgs[0], output=out0)
     set_level(cfgs[0].output.log_level)
     from fdtd3d_tpu.sim import Simulation

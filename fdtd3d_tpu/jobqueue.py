@@ -369,6 +369,7 @@ class JobQueue:
         dispatcher could never run must be refused at the door."""
         policy = policy or QuotaPolicy()
         tenant = tenant or default_tenant()
+        t_admit0 = float(time.time())
         cfg = load_spec(spec_path)
         cells = job_cells(cfg)
         jobs = self.jobs()
@@ -383,12 +384,22 @@ class JobQueue:
                 f"quota before submitting more")
         n_submits = sum(1 for j in jobs.values() if "spec" in j)
         job_id = f"j-{n_submits:05d}-{os.urandom(2).hex()}"
+        # the job's causal-trace identity (schema v9): minted exactly
+        # once, here — every later journal row inherits it through the
+        # jobs() fold, so a preempted job's re-dispatch continues the
+        # SAME trace across process restarts
+        trace_id = _telemetry.new_trace_id()
         self._emit("job_submit", job_id=job_id, tenant=tenant,
                    status="queued", priority=int(priority),
                    wall_time=time.strftime("%Y-%m-%dT%H:%M:%S"),
                    spec=os.path.abspath(spec_path), cells=cells,
                    unix=float(time.time()), resume=str(resume),
-                   time_steps=int(cfg.time_steps))
+                   time_steps=int(cfg.time_steps), trace_id=trace_id)
+        # admission/quota span: spec parse + quota check wall
+        self._emit("span", **_telemetry.span_fields(
+            "admission", trace_id, _telemetry.new_span_id(),
+            t_admit0, float(time.time()), job_id=job_id,
+            tenant=tenant))
         return job_id
 
     def cancel(self, job_id: str) -> None:
@@ -656,9 +667,37 @@ class Scheduler:
                                         for c in excluded_chips]
         if resumed_from is not None:
             fields["resumed_from"] = int(resumed_from)
+        if job.get("trace_id"):
+            # the causal-trace stamp (v9): the fold overlays the
+            # submit row's trace_id onto the job dict, so every
+            # transition — including post-preemption re-dispatches —
+            # journals under the job's one trace
+            fields["trace_id"] = str(job["trace_id"])
         self.queue._emit("job_state", job_id=job["job_id"],
                          tenant=str(job.get("tenant", "default")),
                          status=status, **fields)
+
+    def _span(self, job: Dict[str, Any], name: str, t0: float,
+              t1: float, span_id: Optional[str] = None,
+              parent: Optional[str] = None,
+              attrs: Optional[Dict[str, Any]] = None,
+              group: Optional[str] = None,
+              lane: Optional[int] = None,
+              run_id: Optional[str] = None) -> Optional[str]:
+        """Journal one lifecycle span for ``job`` (no-op for pre-v9
+        jobs without a trace_id). Returns the span id (for
+        parent-linking) or None."""
+        tid = job.get("trace_id")
+        if not tid:
+            return None
+        sid = span_id or _telemetry.new_span_id()
+        self.queue._emit("span", **_telemetry.span_fields(
+            name, str(tid), sid, float(t0), float(t1),
+            parent_span_id=parent, attrs=attrs,
+            job_id=str(job["job_id"]),
+            tenant=str(job.get("tenant", "default")),
+            group=group, lane=lane, run_id=run_id))
+        return sid
 
     # -- dispatch: solo (supervised, durable) -------------------------------
 
@@ -703,6 +742,11 @@ class Scheduler:
         self._dispatches += 1
         ordinal = self._dispatches
         wait = self._wait_s(job)
+        t_disp0 = float(time.time())
+        # the dispatch span id is minted UP FRONT so the run's own
+        # spans (registry/telemetry side) can parent on it; the span
+        # record itself lands at the terminal transition below
+        dsid = _telemetry.new_span_id()
         sup = None
         try:
             cfg = self._job_cfg(self._load(job["spec"]),
@@ -711,7 +755,9 @@ class Scheduler:
             resume_state = self._peek_supervisor_state(cfg) \
                 if os.path.isdir(cfg.output.save_dir) else None
             with _registry.job_context(job["job_id"],
-                                       str(job.get("tenant"))):
+                                       str(job.get("tenant")),
+                                       trace_id=job.get("trace_id"),
+                                       parent_span_id=dsid):
                 sup = Supervisor(cfg=cfg, policy=self.retry_policy,
                                  resume_state=resume_state,
                                  devices=pool)
@@ -730,6 +776,9 @@ class Scheduler:
                          reason=f"construction failed: "
                                 f"{type(exc).__name__}: "
                                 f"{str(exc)[:200]}")
+            self._span(job, "dispatch", t_disp0, float(time.time()),
+                       span_id=dsid,
+                       attrs={"status": "failed"})
             return 1
         cfg = sup.cfg
         self._state(job, "running", run_id=sim.run_id, wait_s=wait,
@@ -737,10 +786,22 @@ class Scheduler:
                     excluded_chips=(placement["excluded_chips"]
                                     if placement is not None
                                     else None))
+        if isinstance(job.get("unix"), (int, float)):
+            # queue-wait span: from the wait clock (submit, or the
+            # latest requeue) to this dispatch
+            self._span(job, "queue_wait", float(job["unix"]), t_disp0,
+                       attrs={"wait_s": round(float(wait or 0.0), 3)},
+                       run_id=str(sim.run_id or "") or None)
+        t_res0 = float(time.time())
         restored = self._restore_latest(sim, cfg)
         if restored:
             _log.log(f"jobqueue: job {job['job_id']} resumes from "
                      f"{restored} at t={sim.t}")
+            self._span(job, "resume", t_res0, float(time.time()),
+                       parent=dsid,
+                       attrs={"checkpoint": os.path.basename(restored),
+                              "t": int(sim.t)},
+                       run_id=str(sim.run_id or "") or None)
         interval = cfg.output.checkpoint_every or 0
         try:
             sup.run(time_steps=cfg.time_steps, interval=interval)
@@ -760,6 +821,11 @@ class Scheduler:
                         t=int(sup.sim._t_host))
             self._state(job, "queued",
                         reason="requeued for durable resume")
+            self._span(job, "dispatch", t_disp0, float(time.time()),
+                       span_id=dsid,
+                       attrs={"status": "preempted",
+                              "t": int(sup.sim._t_host)},
+                       run_id=str(sim.run_id or "") or None)
             return 3
         except FloatingPointError as exc:
             sup.sim.close()
@@ -769,6 +835,9 @@ class Scheduler:
                                 f"{str(exc)[:200]}",
                          run_id=str(sim.run_id or ""),
                          t=int(sup.sim._t_host))
+            self._span(job, "dispatch", t_disp0, float(time.time()),
+                       span_id=dsid, attrs={"status": "failed"},
+                       run_id=str(sim.run_id or "") or None)
             return 2
         except (RuntimeError, OSError) as exc:
             sup.sim.close()
@@ -779,6 +848,9 @@ class Scheduler:
                                 f"{str(exc)[:200]}",
                          run_id=str(sim.run_id or ""),
                          t=int(sup.sim._t_host))
+            self._span(job, "dispatch", t_disp0, float(time.time()),
+                       span_id=dsid, attrs={"status": "failed"},
+                       run_id=str(sim.run_id or "") or None)
             return 2
         sim = sup.sim
         if cfg.output.checkpoint_every:
@@ -790,6 +862,9 @@ class Scheduler:
         _faults.on_sched_journal(ordinal)
         self._state(job, "completed", run_id=str(sim.run_id or ""),
                      t=int(sim._t_host))
+        self._span(job, "dispatch", t_disp0, float(time.time()),
+                   span_id=dsid, attrs={"status": "completed"},
+                   run_id=str(sim.run_id or "") or None)
         return 2
 
     # -- dispatch: coalesced group (one vmap executable) --------------------
@@ -843,6 +918,11 @@ class Scheduler:
         ).hexdigest()[:10]
         gdir = os.path.join(self.queue.dirpath, "groups", gid)
         waits = [self._wait_s(j) for j in unit]
+        t_disp0 = float(time.time())
+        # per-member dispatch span ids (minted up front: the group's
+        # run spans parent on the LEADER's; each lane's batch_lane
+        # rows parent on its own member's)
+        dsids = [_telemetry.new_span_id() for _ in unit]
         try:
             cfgs = [self._job_cfg(self._load(j["spec"]),
                                   j["job_id"], observed=False)
@@ -866,7 +946,14 @@ class Scheduler:
                             for c in cfgs[1:]]
             tenants = ",".join(sorted({str(j.get("tenant"))
                                        for j in unit}))
-            with _registry.job_context(gid, tenants):
+            # the group's shared run registers under the LEADER's
+            # trace (the group IS one dispatch of lane 0's trace);
+            # every other member's trace joins through its own
+            # journal spans + the per-lane batch_lane stamps below
+            with _registry.job_context(
+                    gid, tenants,
+                    trace_id=unit[0].get("trace_id"),
+                    parent_span_id=dsids[0]):
                 bsim = BatchSimulation(cfgs, devices=pool)
         except (ValueError, RuntimeError, OSError) as exc:
             # the fingerprint said coalescible but the constructor
@@ -889,8 +976,22 @@ class Scheduler:
         # below) so a preempted group's re-dispatch continues every
         # lane bit-identical from the committed t, not from t=0 — the
         # recovery-matrix row docs/SERVICE.md used to mark open
+        t_built = float(time.time())
+        # per-lane causal-trace stamps (v9): BatchSimulation.advance
+        # puts them on each lane's batch_lane + imbalance rows, so a
+        # lane's health stream joins its OWN tenant's trace even
+        # though the group shares one telemetry sink
+        bsim.lane_traces = [
+            {"trace_id": j.get("trace_id"),
+             "span_id": _telemetry.new_span_id(),
+             "parent_span_id": dsids[i]}
+            if j.get("trace_id") else None
+            for i, j in enumerate(unit)]
+        bsim.group_id = gid
         os.makedirs(gdir, exist_ok=True)
+        t_res0 = float(time.time())
         resumed = self._restore_group(bsim, gdir)
+        t_res1 = float(time.time())
         if resumed:
             _log.log(f"jobqueue: group {gid} resumes from its "
                      f"committed snapshot at t={resumed}")
@@ -902,6 +1003,32 @@ class Scheduler:
                                         if placement is not None
                                         else None),
                         resumed_from=int(resumed))
+            if isinstance(j.get("unix"), (int, float)):
+                self._span(j, "queue_wait", float(j["unix"]), t_disp0,
+                           attrs={"wait_s": round(float(wait or 0.0),
+                                                  3)},
+                           run_id=str(bsim.run_id or "") or None)
+            # the coalesce decision + group build wall, one span per
+            # member so every tenant's trace shows the shared phase
+            self._span(j, "coalesce", t_disp0, t_built,
+                       parent=dsids[i], group=gid, lane=i,
+                       attrs={"lanes": len(unit)},
+                       run_id=str(bsim.run_id or "") or None)
+            if resumed:
+                prev_t = j.get("t")
+                if isinstance(prev_t, int):
+                    # the preempted dispatch's in-flight work past
+                    # the committed snapshot is discarded: the
+                    # re-dispatch rolls back to t_restored
+                    self._span(j, "rollback", t_res0, t_res1,
+                               parent=dsids[i], group=gid, lane=i,
+                               attrs={"t_failed": int(prev_t),
+                                      "t_restored": int(resumed)},
+                               run_id=str(bsim.run_id or "") or None)
+                self._span(j, "resume", t_res0, t_res1,
+                           parent=dsids[i], group=gid, lane=i,
+                           attrs={"t": int(resumed)},
+                           run_id=str(bsim.run_id or "") or None)
         try:
             total = int(bsim.cfg.time_steps)
             chunk = self.batch_chunk \
@@ -926,20 +1053,29 @@ class Scheduler:
             reason = (f"{type(exc).__name__}: {str(exc)[:160]} "
                       f"(group re-dispatch resumes every lane from "
                       f"the committed snapshot t={ct})")
-            for j in unit:
+            for i, j in enumerate(unit):
                 self._state(j, "preempted", reason=reason,
                             group=gid, t=int(bsim.t))
                 self._state(j, "queued",
                             reason="requeued after group preemption")
+                self._span(j, "dispatch", t_disp0, float(time.time()),
+                           span_id=dsids[i], group=gid, lane=i,
+                           attrs={"status": "preempted",
+                                  "t": int(bsim.t)},
+                           run_id=str(bsim.run_id or "") or None)
             return 2 * len(unit)
         except (RuntimeError, OSError) as exc:
             bsim.close()
             _faults.on_sched_journal(ordinal)
-            for j in unit:
+            for i, j in enumerate(unit):
                 self._state(j, "failed", group=gid,
                              reason=f"group dispatch failed: "
                                     f"{type(exc).__name__}: "
                                     f"{str(exc)[:160]}")
+                self._span(j, "dispatch", t_disp0, float(time.time()),
+                           span_id=dsids[i], group=gid, lane=i,
+                           attrs={"status": "failed"},
+                           run_id=str(bsim.run_id or "") or None)
             return len(unit)
         bsim.close()
         _faults.on_sched_journal(ordinal)
@@ -951,10 +1087,18 @@ class Scheduler:
                     reason=f"lane {i} non-finite (first bad step <= "
                            f"{bsim.lane_first_unhealthy_t[i]})",
                     t=int(bsim.t))
+                self._span(j, "dispatch", t_disp0, float(time.time()),
+                           span_id=dsids[i], group=gid, lane=i,
+                           attrs={"status": "failed"},
+                           run_id=str(bsim.run_id or "") or None)
             else:
                 self._state(j, "completed", group=gid,
                              run_id=str(bsim.run_id or ""),
                              t=int(bsim.t))
+                self._span(j, "dispatch", t_disp0, float(time.time()),
+                           span_id=dsids[i], group=gid, lane=i,
+                           attrs={"status": "completed"},
+                           run_id=str(bsim.run_id or "") or None)
         return len(unit)
 
     # -- the serve loop -----------------------------------------------------

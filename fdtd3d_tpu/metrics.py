@@ -30,7 +30,7 @@ unhealthy_chunks_total              counter    chunk.finite == false
 chunk_wall_seconds                  histogram  chunk.wall_s
 throughput_mcells_per_s             gauge      chunk.mcells_per_s (last)
 run_mcells_per_s                    gauge      run_end.mcells_per_s
-compile_ms                          gauge      run_end.compile_ms
+run_compile_ms                      gauge      run_end.compile_ms
 recovery_events_total{kind}         counter    retry/rollback/degrade/
                                                topology_change
 vmem_ladder_downgrades_total        counter    ladder_downgrade
@@ -44,8 +44,22 @@ jobs_submitted_total{tenant}        counter    job_submit (queue journal)
 jobs_total{status,tenant}           counter    job_state terminal rows
 queue_depth                         gauge      journal fold (last-status
                                                == queued job count)
-queue_wait_seconds                  histogram  job_state running.wait_s
+queue_wait_seconds                  histogram  queue_wait spans (v9);
+                                               job_state running.wait_s
+                                               on pre-v9 journals
+compile_ms                          histogram  compile spans (v9)
+snapshot_commit_seconds             histogram  snapshot_commit spans
+recovery_seconds                    histogram  retry/rollback/degrade/
+                                               topology_change spans
 ==================================  =========  =========================
+
+The four phase histograms are the causal-trace plane's scraper view
+(docs/OBSERVABILITY.md "Trace plane"): each observes the wall duration
+(t1 - t0) of one lifecycle span class, so a dashboard reads the same
+latency decomposition ``tools/fleet_report.py`` tabulates per tenant.
+``runs_total`` folds registry run_final rows BY TRACE when the row
+carries a ``trace_id``: a preempted-and-resumed job contributes one
+logical sample (the latest dispatch's status), not two.
 """
 
 from __future__ import annotations
@@ -63,6 +77,15 @@ WALL_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
 # clock than chunk walls (an aged job can sit behind quota for
 # minutes), so the ladder runs out to an hour
 QUEUE_WAIT_BUCKETS = (0.1, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0)
+
+# compile-span buckets, milliseconds: sub-ms cache hits through
+# minute-class cold tunnel compiles
+COMPILE_MS_BUCKETS = (1.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0,
+                      15000.0, 60000.0)
+
+# span names folded into the recovery_seconds phase histogram (the
+# supervisor's v9 spans beside its v5 recovery records)
+_RECOVERY_SPANS = ("retry", "rollback", "degrade", "topology_change")
 
 # the queue-journal statuses that end a job (fdtd3d_tpu/jobqueue.py
 # owns the lifecycle; this module only needs to know which rows close
@@ -117,6 +140,9 @@ class MetricsRegistry:
         # a true gauge (a requeued job re-enters the depth) instead of
         # an ever-growing counter difference
         self._job_status: Dict[str, str] = {}
+        # trace fold (v9): trace_id -> latest run_final status, so
+        # runs_total counts a resumed job as ONE logical run
+        self._trace_final: Dict[str, str] = {}
 
     # -- primitives ----------------------------------------------------
 
@@ -216,7 +242,7 @@ class MetricsRegistry:
             self.set_gauge("run_mcells_per_s", rec["mcells_per_s"],
                            help_="whole-run mean throughput, Mcells/s")
             if rec.get("compile_ms") is not None:
-                self.set_gauge("compile_ms", rec["compile_ms"],
+                self.set_gauge("run_compile_ms", rec["compile_ms"],
                                help_="wall spent in lower+compile "
                                      "this run, ms")
             cache = rec.get("aot_cache")
@@ -227,9 +253,26 @@ class MetricsRegistry:
                                        help_="AOT executable cache "
                                              "counter snapshot")
         elif rtype == "run_final":
-            # registry rows (runs.jsonl): the fleet-status counter
+            # registry rows (runs.jsonl): the fleet-status counter.
+            # Trace-joined (v9): a re-dispatched job's second final
+            # row REPLACES its first sample — one logical run per
+            # trace, latest status wins.
+            trace = rec.get("trace_id")
+            if trace:
+                prev = self._trace_final.get(trace)
+                if prev is not None:
+                    m = self._get("runs_total", "counter",
+                                  "registry run_final rows by status "
+                                  "(one logical run per trace)")
+                    k = m._key({"status": prev})
+                    if m.samples.get(k):
+                        m.samples[k] -= 1.0
+                self._trace_final[trace] = rec["status"]
             self.inc("runs_total", status=rec["status"],
-                     help_="registry run_final rows by status")
+                     help_="registry run_final rows by status "
+                           "(one logical run per trace)")
+        elif rtype == "span":
+            self._observe_span(rec)
         elif rtype == "job_submit":
             # queue-journal rows (fdtd3d_tpu/jobqueue.py): admission
             self.inc("jobs_submitted_total", tenant=rec["tenant"],
@@ -242,12 +285,45 @@ class MetricsRegistry:
                          help_="queue jobs reaching a terminal "
                                "state, by status and tenant")
             if rec["status"] == "running" \
-                    and isinstance(rec.get("wait_s"), (int, float)):
+                    and isinstance(rec.get("wait_s"), (int, float)) \
+                    and not rec.get("trace_id"):
+                # pre-v9 journals only: a traced job's queue wait
+                # arrives as its queue_wait span (observing both
+                # would double-count the same dispatch)
                 self.observe("queue_wait_seconds", rec["wait_s"],
                              buckets=QUEUE_WAIT_BUCKETS,
                              help_="queue wait between submit and "
                                    "dispatch, seconds")
             self._observe_job(rec)
+
+    def _observe_span(self, rec: Dict[str, Any]) -> None:
+        """One v9 ``span`` record -> the phase histograms (the
+        causal-trace plane's scraper view)."""
+        name = rec.get("name")
+        dur = float(rec["t1"]) - float(rec["t0"])
+        if name == "queue_wait":
+            self.observe("queue_wait_seconds", dur,
+                         buckets=QUEUE_WAIT_BUCKETS,
+                         help_="queue wait between submit and "
+                               "dispatch, seconds")
+        elif name == "compile":
+            attrs = rec.get("attrs") or {}
+            ms = attrs.get("compile_ms")
+            self.observe("compile_ms",
+                         float(ms) if isinstance(ms, (int, float))
+                         and not isinstance(ms, bool) else dur * 1e3,
+                         buckets=COMPILE_MS_BUCKETS,
+                         help_="AOT-compile phase wall per span, ms "
+                               "(~0 on exec-cache hits)")
+        elif name == "snapshot_commit":
+            self.observe("snapshot_commit_seconds", dur,
+                         help_="snapshot-commit phase wall per span, "
+                               "seconds")
+        elif name in _RECOVERY_SPANS:
+            self.observe("recovery_seconds", dur,
+                         help_="recovery phase wall per span (retry/"
+                               "rollback/degrade/topology_change), "
+                               "seconds")
 
     def _observe_job(self, rec: Dict[str, Any]) -> None:
         """Update the journal fold + the queue_depth gauge from one

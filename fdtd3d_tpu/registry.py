@@ -86,18 +86,31 @@ _JOB_CONTEXT: Optional[Dict[str, str]] = None
 
 
 @contextlib.contextmanager
-def job_context(job_id: str, tenant: Optional[str] = None):
+def job_context(job_id: str, tenant: Optional[str] = None,
+                trace_id: Optional[str] = None,
+                parent_span_id: Optional[str] = None):
     """Attribute every run registered inside the block to queue job
     ``job_id`` (fdtd3d_tpu/jobqueue.py dispatches runs under it; a
     coalesced batch passes its GROUP id). The stamp lands on the
     run_begin row and on the telemetry run_start, which is how
     tools/fleet_report.py and tools/telemetry_report.py print
-    job-id-joined lines without parsing the journal."""
+    job-id-joined lines without parsing the journal.
+
+    ``trace_id`` (schema v9) is the job's causal-trace identity
+    (minted once at JobQueue.submit — a re-dispatched job passes the
+    SAME id, so one trace spans every dispatch); ``parent_span_id``
+    is the dispatch span the run's own spans nest under. Both ride
+    the same stamp onto run_begin/run_final, telemetry run_start and
+    checkpoint metadata."""
     global _JOB_CONTEXT
     old = _JOB_CONTEXT
     ctx = {"job_id": str(job_id)}
     if tenant:
         ctx["tenant"] = str(tenant)
+    if trace_id:
+        ctx["trace_id"] = str(trace_id)
+    if parent_span_id:
+        ctx["parent_span_id"] = str(parent_span_id)
     _JOB_CONTEXT = ctx
     try:
         yield
@@ -158,6 +171,9 @@ class RunHandle:
         # queue-job attribution, captured at construction (the
         # dispatcher wraps the whole run in one job_context block)
         self._job = dict(_JOB_CONTEXT) if _JOB_CONTEXT else None
+        # this run's own span identity within the job trace (v9):
+        # run_start carries it; the dispatch span is its parent
+        self.span_id = _telemetry.new_span_id()
 
     @classmethod
     def open_for(cls, sim, kind: Optional[str] = None
@@ -195,17 +211,29 @@ class RunHandle:
 
     def attach(self, sim) -> None:
         """Stamp the run identity onto the sim: ``sim.run_id`` (the
-        telemetry run_start picks it up via ``provenance``) and the
-        checkpoint metadata (``extra_ckpt_meta`` — every snapshot is
-        then traceable to its run, tools/ckpt_inspect.py)."""
+        telemetry run_start picks it up via ``provenance``), the
+        causal-trace identity (``sim.trace_id`` / ``sim.span_id`` /
+        ``sim.parent_span_id``, schema v9) and the checkpoint
+        metadata (``extra_ckpt_meta`` — every snapshot is then
+        traceable to its run AND its job trace,
+        tools/ckpt_inspect.py)."""
         sim.run_id = self.run_id
         sim.run_registry = self
         if self._job is not None:
-            # telemetry.provenance picks this up into run_start
+            # telemetry.provenance picks these up into run_start
             sim.job_id = self._job["job_id"]
+            if "trace_id" in self._job:
+                sim.trace_id = self._job["trace_id"]
+                # this run IS a span of the job's trace: one span id
+                # per registered run, parented on the dispatch span
+                sim.span_id = self.span_id
+            if "parent_span_id" in self._job:
+                sim.parent_span_id = self._job["parent_span_id"]
         meta = getattr(sim, "extra_ckpt_meta", None)
         if meta is not None:
             meta["run_id"] = self.run_id
+            if self._job is not None and "trace_id" in self._job:
+                meta["trace_id"] = self._job["trace_id"]
 
     # -- rows ----------------------------------------------------------
 
@@ -243,6 +271,8 @@ class RunHandle:
             out["job_id"] = self._job["job_id"]
             if "tenant" in self._job:
                 out["tenant"] = self._job["tenant"]
+            if "trace_id" in self._job:
+                out["trace_id"] = self._job["trace_id"]
         # executable identity: the provenance-free comparable digest
         # (exec_cache.registry_identity also carries step_kind and
         # ghost_depth, the engaged step's)
@@ -322,6 +352,10 @@ class RunHandle:
         }
         if unhealthy:
             out["unhealthy_lanes"] = unhealthy
+        if self._job is not None and "trace_id" in self._job:
+            # the causal join key (v9): metrics.runs_total folds
+            # run_final rows by it so a resumed job is ONE logical run
+            out["trace_id"] = self._job["trace_id"]
         return out
 
     def finalize(self, sim, status: Optional[str] = None) -> None:

@@ -357,6 +357,7 @@ class Simulation:
             # in-process or on-disk executable with zero traces.
             from fdtd3d_tpu import exec_cache as _exec_cache
             key = self.exec_key(n, donate=donate)
+            t_sp0 = float(time.time())
             try:
                 with _telemetry.span("compile"):
                     compiled, info = _exec_cache.jit_compile(
@@ -367,6 +368,15 @@ class Simulation:
                 self._vmem_fallback(exc)   # next rung, or re-raise
                 continue
             self._compile_ms += float(info.get("compile_ms") or 0.0)
+            # causal-trace span (v9, queue runs only): the AOT-compile
+            # phase with the exec-cache verdict (memory/disk hit vs a
+            # paid compile) in its attrs
+            _telemetry.emit_trace_span(
+                self, "compile", t_sp0, float(time.time()),
+                attrs={"source": info.get("source"),
+                       "compile_ms":
+                           float(info.get("compile_ms") or 0.0),
+                       "n_steps": int(n)})
             self._compiled[n] = compiled
         return self._compiled[n]
 
@@ -415,6 +425,7 @@ class Simulation:
         #                         may have re-packed the carry
         timed = self.clock is not None or self.telemetry is not None
         wall = 0.0
+        t_sp0 = float(time.time())
         if timed:
             self.block_until_ready()
             t0 = time.perf_counter()
@@ -443,6 +454,10 @@ class Simulation:
         t_prev = self._t_host
         self._t_host = t_prev + n_steps
         self._chunk_idx += 1
+        _telemetry.emit_trace_span(
+            self, "chunk", t_sp0, float(time.time()),
+            attrs={"chunk": int(self._chunk_idx),
+                   "t": int(self._t_host), "steps": int(n_steps)})
         if self.telemetry is not None and hv is not None:
             self.telemetry.emit_chunk(
                 chunk=self._chunk_idx, t=self._t_host, steps=n_steps,
@@ -934,10 +949,16 @@ class Simulation:
         multi-host runs); `path` becomes a directory.
         """
         from fdtd3d_tpu import io
+        t_sp0 = float(time.time())
         if backend == "orbax":
             io.save_checkpoint_orbax(self.state, path,
                                      extra=self._ckpt_meta())
             if jax.process_index() == 0:
+                _telemetry.emit_trace_span(
+                    self, "snapshot_commit", t_sp0,
+                    float(time.time()),
+                    attrs={"path": os.path.basename(path),
+                           "t": int(self._t_host)})
                 _faults.on_checkpoint(path)  # committed: harness hook
             return self
         if backend != "npz":
@@ -947,6 +968,10 @@ class Simulation:
         if jax.process_index() != 0:
             return self
         io.save_checkpoint(state_np, path, extra=self._ckpt_meta())
+        _telemetry.emit_trace_span(
+            self, "snapshot_commit", t_sp0, float(time.time()),
+            attrs={"path": os.path.basename(path),
+                   "t": int(self._t_host)})
         _faults.on_checkpoint(path)  # committed: harness hook
         return self
 

@@ -41,6 +41,14 @@ table):
   SKIPPED on streams with no job records, so pointing the gate at a
   queue journal (``tools/fdtd_queue.py`` writes one telemetry-schema
   JSONL) gates the queue with the same exit-code contract.
+* ``phase-budget`` — span-backed (schema v9, the trace plane): p95
+  wall seconds of every lifecycle phase (``span`` records grouped by
+  name: queue_wait, coalesce, compile, chunk, snapshot_commit,
+  rollback, ...) <= that phase's budget. The default budget for
+  every phase is ``threshold`` seconds; context ``phase_budgets``
+  (``{"queue_wait": 60.0, ...}``) overrides per phase. SKIPPED on
+  pre-v9 streams that carry no spans, so the gate stays backward
+  compatible with old journals.
 """
 
 from __future__ import annotations
@@ -53,7 +61,8 @@ from fdtd3d_tpu import telemetry as _telemetry
 
 RULE_KINDS = ("throughput_floor", "chunk_wall_p95",
               "unhealthy_lane_fraction", "compile_budget",
-              "recovery_rate", "straggler_ratio", "queue_wait_p95")
+              "recovery_rate", "straggler_ratio", "queue_wait_p95",
+              "phase_budget")
 
 # step_kind -> BENCH_BEST/bench-artifact throughput keys (the
 # perf-sentinel PATHS table's run-level projection)
@@ -93,6 +102,7 @@ DEFAULT_RULES = (
     SloRule("recovery-rate", "recovery_rate", 5.0),
     SloRule("straggler-ratio", "straggler_ratio", 2.0),
     SloRule("queue-wait-p95", "queue_wait_p95", 300.0),
+    SloRule("phase-budget", "phase_budget", 300.0),
 )
 
 
@@ -331,6 +341,51 @@ def _eval_queue_wait_p95(rule, run, ctx):
     return _res(rule, "OK", value=p95, threshold=rule.threshold)
 
 
+def _eval_phase_budget(rule, run, ctx):
+    """Span-backed phase budgets (schema v9): group ``span`` records
+    by phase name, compare each phase's p95 wall seconds against its
+    budget. The default budget is ``rule.threshold`` seconds for
+    every phase; context ``phase_budgets`` overrides per phase (and
+    a ``null`` budget exempts a phase outright). SKIPPED — never a
+    silent pass — when the stream carries no spans (pre-v9, or
+    tracing off)."""
+    spans = [r for r in run if r["type"] == "span"]
+    if not spans:
+        return _res(rule, "SKIPPED",
+                    message="no span records (pre-v9 stream, or "
+                            "trace plane off)")
+    budgets = ctx.get("phase_budgets") or {}
+    by_name: Dict[str, List[float]] = {}
+    for s in spans:
+        dur = max(float(s["t1"]) - float(s["t0"]), 0.0)
+        by_name.setdefault(str(s["name"]), []).append(dur)
+    worst = None   # (overshoot, name, p95, budget, n)
+    top = None     # (p95, name, budget) — for the OK verdict
+    for name in sorted(by_name):
+        budget = budgets.get(name, rule.threshold)
+        if budget is None:
+            continue
+        budget = float(budget)
+        p95 = _telemetry.pct_summary(by_name[name])["p95"]
+        if top is None or p95 > top[0]:
+            top = (p95, name, budget)
+        over = p95 - budget
+        if over > 0 and (worst is None or over > worst[0]):
+            worst = (over, name, p95, budget, len(by_name[name]))
+    if worst is not None:
+        _over, name, p95, budget, n = worst
+        return _res(rule, "VIOLATION", value=p95, threshold=budget,
+                    window=(0, 0),
+                    message=f"phase {name!r} p95 wall {p95:.1f}s "
+                            f"over its {budget:.1f}s budget "
+                            f"({n} spans)")
+    if top is None:
+        return _res(rule, "SKIPPED",
+                    message="every recorded phase is budget-exempt")
+    return _res(rule, "OK", value=top[0], threshold=top[2],
+                message=f"worst phase {top[1]!r}")
+
+
 _EVALUATORS = {
     "throughput_floor": _eval_throughput_floor,
     "chunk_wall_p95": _eval_chunk_wall_p95,
@@ -339,6 +394,7 @@ _EVALUATORS = {
     "recovery_rate": _eval_recovery_rate,
     "straggler_ratio": _eval_straggler_ratio,
     "queue_wait_p95": _eval_queue_wait_p95,
+    "phase_budget": _eval_phase_budget,
 }
 
 

@@ -62,6 +62,7 @@ from typing import Callable, Dict, Optional
 
 from fdtd3d_tpu import faults as _faults
 from fdtd3d_tpu import log as _log
+from fdtd3d_tpu import telemetry as _telemetry
 
 # Errors treated as transient (retryable): the jax runtime surfaces
 # dispatch/device failures as RuntimeError subclasses (XlaRuntimeError)
@@ -274,6 +275,16 @@ class Supervisor:
         if sink is not None:
             sink.emit(rec_type, **fields)
 
+    def _trace_span(self, name: str, t0: float,
+                    attrs: Optional[Dict] = None):
+        """Recovery-phase span (schema v9) beside the matching v5
+        recovery record: rides the supervised sim's causal trace when
+        the run belongs to a queue job (registry stamped
+        sim.trace_id); a no-op everywhere else."""
+        if self.sim is not None:
+            _telemetry.emit_trace_span(self.sim, name, t0,
+                                       float(time.time()), attrs=attrs)
+
     # -- recovery ----------------------------------------------------------
 
     def _pin_env(self, pins: Dict[str, str]):
@@ -379,6 +390,7 @@ class Supervisor:
         chip, not the physics, while any sharding remains to shed)."""
         old_sim = self.sim
         old_kind = old_sim.step_kind
+        t_sp0 = float(time.time())
         chip = getattr(exc, "bad_chip", None)
         host = self._host_of(chip)
         plan = degrade_plan(old_kind)
@@ -417,6 +429,13 @@ class Supervisor:
         self._emit("degrade", t=int(self.sim._t_host),
                    old_kind=old_kind, new_kind=new_sim.step_kind,
                    reason=reason, chip=chip, host=host)
+        self._trace_span("rollback", t_sp0,
+                         attrs={"t_failed": int(t_failed),
+                                "t_restored": int(self.sim._t_host),
+                                "source": str(src)})
+        self._trace_span("degrade", t_sp0,
+                         attrs={"old_kind": old_kind,
+                                "new_kind": new_sim.step_kind})
         _log.warn(f"supervisor: health trip at t<={t_failed} "
                   f"({str(exc)[:120]}); rolled back to "
                   f"t={self.sim._t_host} ({src}) and degraded "
@@ -433,6 +452,7 @@ class Supervisor:
         new_topo = _plan_mod.degrade_topology(old_topo)
         if new_topo is None:
             raise exc  # unsharded bottom: nothing left to shed
+        t_sp0 = float(time.time())
         t_failed = self.sim._t_host
         reason = f"{type(exc).__name__}: {str(exc)[:200]}"
         cfg = _cfg_with_topology(self._cfg, new_topo)
@@ -453,6 +473,13 @@ class Supervisor:
                    old_topology=list(old_topo),
                    new_topology=list(new_topo), reason=reason,
                    chip=chip, host=host)
+        self._trace_span("rollback", t_sp0,
+                         attrs={"t_failed": int(t_failed),
+                                "t_restored": int(self.sim._t_host),
+                                "source": str(src)})
+        self._trace_span("topology_change", t_sp0,
+                         attrs={"old_topology": list(old_topo),
+                                "new_topology": list(new_topo)})
         _log.warn(f"supervisor: recovery exhausted on topology "
                   f"{old_topo} at t<={t_failed}"
                   + (f" (chip {chip} implicated)"
@@ -474,6 +501,7 @@ class Supervisor:
             self._topology_degrade(exc, chip=None, host=host)
             return True
         t = self.sim._t_host
+        t_sp0 = float(time.time())
         delay = self.policy.delay_s(consec - 1)
         reason = f"{type(exc).__name__}: {str(exc)[:200]}"
         self._emit("retry", t=int(t), attempt=int(consec),
@@ -489,6 +517,10 @@ class Supervisor:
         self._emit("rollback", t_failed=int(t),
                    t_restored=int(self.sim._t_host), source=str(src),
                    reason=reason, chip=None, host=host)
+        self._trace_span("retry", t_sp0,
+                         attrs={"attempt": int(consec),
+                                "delay_s": float(delay),
+                                "t_restored": int(self.sim._t_host)})
         self._persist()
         return False
 

@@ -91,10 +91,22 @@ import numpy as np
 # run_id, the placement topology and the queue-wait seconds), plus
 # the optional `job_id`/`tenant` stamps on run_start and the registry
 # run_begin row that join a run back to the queue job that owns it
-# (fdtd3d_tpu/jobqueue.py). v1-v7 files still read/validate
-# (READ_VERSIONS).
-SCHEMA_VERSION = 8
-READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
+# (fdtd3d_tpu/jobqueue.py). v9 (causal trace plane, round 19): the
+# "span" record type — one per lifecycle phase of a job (queue-wait,
+# admission, coalesce, AOT-compile, chunk execution, snapshot commit,
+# retry/rollback/degrade recovery, resume), carrying the trace_id
+# minted at JobQueue.submit plus a span_id/parent_span_id pair, so
+# the three streams (queue journal, run registry, telemetry) join
+# causally by trace_id and tools/trace_export.py can emit one
+# Perfetto timeline per job across preemptions (`resumed_from` is a
+# causal link: the re-dispatch continues the SAME trace). The
+# trace/span stamps also land as OPTIONAL keys on run_start,
+# run_begin/run_final, job_submit/job_state and batch_lane rows, and
+# the per-lane batched imbalance record gains optional lane/group
+# keys naming the straggler chip INSIDE a coalesced group. v1-v8
+# files still read/validate (READ_VERSIONS).
+SCHEMA_VERSION = 9
+READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -343,6 +355,83 @@ def imbalance_summary(per_chip: Dict[str, list],
 
 
 # --------------------------------------------------------------------------
+# causal trace plane (schema v9)
+# --------------------------------------------------------------------------
+
+def new_trace_id() -> str:
+    """Mint a trace id (``JobQueue.submit`` / solo-run construction).
+    One per JOB: every dispatch of the job — including a post-
+    preemption re-dispatch — carries the same trace_id, so the trace
+    is causal across process restarts."""
+    return "t-" + os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """Mint a span id (one per emitted lifecycle span)."""
+    return "s-" + os.urandom(6).hex()
+
+
+def span_fields(name: str, trace_id: str, span_id: str,
+                t0: float, t1: float,
+                parent_span_id: Optional[str] = None,
+                attrs: Optional[Dict[str, Any]] = None,
+                job_id: Optional[str] = None,
+                tenant: Optional[str] = None,
+                run_id: Optional[str] = None,
+                lane: Optional[int] = None,
+                group: Optional[str] = None) -> Dict[str, Any]:
+    """Build the field dict of one ``span`` record (schema v9).
+
+    THE span producer: every writer funnels through here (the
+    schema-drift lint resolves this dict literal, so a key drift
+    between writers and RECORD_SCHEMA fails the gate). ``t0``/``t1``
+    are wall-clock epoch seconds bounding the phase; ``attrs`` is a
+    small flat dict of phase-specific context (cache hit/miss,
+    straggler chip, ...); the identity keys (job_id/tenant/run_id/
+    lane/group) make a span self-describing without a journal join.
+    Keys with None values are dropped so the JSONL stays lean."""
+    rec = {
+        "name": str(name), "trace_id": str(trace_id),
+        "span_id": str(span_id), "t0": float(t0), "t1": float(t1),
+        "parent_span_id": parent_span_id,
+        "attrs": attrs,
+        "job_id": job_id, "tenant": tenant, "run_id": run_id,
+        "lane": lane, "group": group,
+    }
+    for key in ("parent_span_id", "attrs", "job_id", "tenant",
+                "run_id", "lane", "group"):
+        if rec[key] is None:
+            rec.pop(key)
+    return rec
+
+
+def emit_trace_span(sim, name: str, t0: float, t1: float,
+                    attrs: Optional[Dict[str, Any]] = None,
+                    lane: Optional[int] = None,
+                    group: Optional[str] = None) -> Optional[str]:
+    """Emit one lifecycle ``span`` on ``sim``'s telemetry sink when
+    the sim is a node of a causal trace (registry.RunHandle.attach
+    stamped ``sim.trace_id`` under a queue job_context) — a strict
+    no-op otherwise, so solo/library runs pay nothing and emit no
+    extra records. The span parents on the run's own span
+    (``sim.span_id``), which itself parents on the dispatch span —
+    the executor-side half of the trace tree the queue journal's
+    queue_wait/coalesce/dispatch spans begin. Returns the minted
+    span_id (None when not emitted)."""
+    sink = getattr(sim, "telemetry", None)
+    trace = getattr(sim, "trace_id", None)
+    if sink is None or not trace:
+        return None
+    sid = new_span_id()
+    sink.emit("span", **span_fields(
+        name, trace, sid, t0, t1,
+        parent_span_id=getattr(sim, "span_id", None),
+        attrs=attrs, job_id=getattr(sim, "job_id", None),
+        run_id=getattr(sim, "run_id", None), lane=lane, group=group))
+    return sid
+
+
+# --------------------------------------------------------------------------
 # provenance + schema
 # --------------------------------------------------------------------------
 
@@ -413,6 +502,18 @@ def provenance(sim=None) -> Dict[str, Any]:
         jid = getattr(sim, "job_id", None)
         if jid:
             rec["job_id"] = str(jid)
+        # causal-trace stamp (v9, registry.job_context): the trace_id
+        # minted at submit() plus this run's span identity — the
+        # run_start row is itself a node of the job's trace
+        tid = getattr(sim, "trace_id", None)
+        if tid:
+            rec["trace_id"] = str(tid)
+        sid = getattr(sim, "span_id", None)
+        if sid:
+            rec["span_id"] = str(sid)
+        psid = getattr(sim, "parent_span_id", None)
+        if psid:
+            rec["parent_span_id"] = str(psid)
         nlanes = getattr(sim, "batch_size", None)
         if nlanes:
             rec["batch"] = int(nlanes)
@@ -602,6 +703,20 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
     "job_state": {
         "job_id": (str,), "tenant": (str,), "status": (str,),
     },
+    # v9 (causal trace plane): one record per job lifecycle phase.
+    # `name` is a token from the span taxonomy (docs/OBSERVABILITY.md
+    # "Trace plane" table: admission, queue_wait, coalesce, dispatch,
+    # compile, chunk, snapshot_commit, retry, rollback, degrade,
+    # topology_change, resume); `t0`/`t1` are wall-clock epoch seconds
+    # bounding the phase; trace_id is the job's identity across ALL
+    # its dispatches (minted at JobQueue.submit, threaded by
+    # registry.job_context). Optional parent_span_id makes a coalesced
+    # group one span with per-lane children; `attrs` carries
+    # phase-specific context (cache hit/miss, straggler chip, ...).
+    "span": {
+        "name": (str,), "trace_id": (str,), "span_id": (str,),
+        "t0": _NUM, "t1": _NUM,
+    },
 }
 
 
@@ -635,10 +750,14 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # back to the vmap-jnp path (solver.batch_fallback_reason — the
     # ~6x-HBM downgrade, named, never silent); absent on solo runs
     # and on batches running packed.
+    # trace_id/span_id/parent_span_id (v9): the causal-trace stamps
+    # (registry.job_context -> RunHandle.attach) that make a telemetry
+    # stream a node of its job's trace; absent outside traced runs.
     "run_start": ("scheme", "grid", "dtype", "topology", "step_kind",
                   "vmem_rung", "tile", "comm_strategy", "ghost_depth",
                   "aot_cache", "batch", "run_id", "tb_fallback",
-                  "job_id", "batch_fallback"),
+                  "job_id", "batch_fallback", "trace_id", "span_id",
+                  "parent_span_id"),
     # sim.close_telemetry (round 15): the run's compile wall
     # (exec-cache misses only; a fully-warm run reads 0.0) + the final
     # counter snapshot — the compile-amortization proof per run.
@@ -650,8 +769,17 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # lane (round 10), and the ledger echo keys
     "attribution": ("host_spans_ms", "per_core", "imbalance",
                     "ledger_step_kind", "roofline"),
-    # imbalance_summary(): present only when a chip diverged
-    "imbalance": ("nonfinite_chips",),
+    # imbalance_summary(): nonfinite_chips present only when a chip
+    # diverged. lane/group (v9): the batched executor's PER-LANE
+    # imbalance rows (batch.BatchSimulation.advance) name the lane and
+    # the coalesce-group the straggler chip belongs to, so a fleet
+    # report attributes the straggler inside a coalesced group.
+    "imbalance": ("nonfinite_chips", "lane", "group"),
+    # per_chip lane/group (v9): the batched executor's per-lane
+    # per-chip counter rows (one per lane per chunk, same single
+    # fused readback) — lane names the vmap lane, group the
+    # coalesce-group the counters belong to.
+    "per_chip": ("lane", "group"),
     # registry rows (fdtd3d_tpu/registry.py): run identity + artifact
     # pointers on the begin row; totals + recovery rollup on the
     # final one. exec_key_comparable is ExecKey.comparable_digest at
@@ -661,14 +789,18 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # job_id/tenant (v8): the queue-job stamp (registry.job_context);
     # a coalesced batch run carries the GROUP id as its job_id (the
     # journal maps member jobs to the shared run_id).
+    # trace_id (v9) on both registry rows: the causal join key back to
+    # the queue job; a resumed job's second run_begin/run_final pair
+    # carries the SAME trace_id (metrics.runs_total folds by it so a
+    # resumed job is one logical run).
     "run_begin": ("scheme", "grid", "dtype", "topology", "step_kind",
                   "ghost_depth", "batch", "jax_version",
                   "device_kind", "config_fp", "exec_key_comparable",
                   "telemetry_path", "metrics_path", "save_dir",
-                  "trace_dir", "job_id", "tenant"),
+                  "trace_dir", "job_id", "tenant", "trace_id"),
     "run_final": ("recovery_events", "unhealthy_lanes",
                   "first_unhealthy_t", "compile_ms", "aot_cache",
-                  "exit_reason"),
+                  "exit_reason", "trace_id"),
     # v8 queue-journal optional keys. job_submit: `unix` (submit epoch
     # seconds — the queue-wait clock), `resume` (the job's resume
     # policy token), `time_steps` (the horizon, for operator tables).
@@ -684,10 +816,28 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # resumed_from (on `running` rows of a re-dispatched coalesced
     # group: the committed snapshot t every lane resumed from — 0
     # means a from-scratch start).
-    "job_submit": ("unix", "resume", "time_steps"),
+    # batch_lane (v9): the lane's causal-trace stamps — trace_id of
+    # the MEMBER job owning the lane (a coalesced group is one span
+    # with per-lane children: parent_span_id is the group dispatch
+    # span), so a lane's health rows join its tenant's trace.
+    "batch_lane": ("trace_id", "span_id", "parent_span_id"),
+    # trace_id (v9) on every journal row: minted at submit() on the
+    # job_submit row; the jobs() fold overlays it onto every later
+    # state, so a re-dispatched job's rows keep the SAME trace.
+    # span_id/parent_span_id on job_state rows tie scheduler
+    # transitions into the trace tree.
+    "job_submit": ("unix", "resume", "time_steps", "trace_id",
+                   "span_id"),
     "job_state": ("run_id", "reason", "wait_s", "topology", "group",
                   "lane", "t", "excluded_chips", "unix",
-                  "resumed_from"),
+                  "resumed_from", "trace_id", "span_id",
+                  "parent_span_id"),
+    # span (v9): parent_span_id builds the trace tree; attrs carries
+    # phase context (cache hit/miss, straggler chip, retry error ...);
+    # job_id/tenant/run_id/lane/group echo the owning identities so a
+    # span is self-describing without a journal join.
+    "span": ("parent_span_id", "attrs", "job_id", "tenant", "run_id",
+             "lane", "group"),
 }
 
 
@@ -712,11 +862,14 @@ _V6_ONLY_TYPES = ("batch_lane",)
 _V7_ONLY_TYPES = ("alert", "run_begin", "run_final")
 # and from v8 on: the job-queue journal row types
 _V8_ONLY_TYPES = ("job_submit", "job_state")
+# and from v9 on: the causal-trace span record (the trace/span stamps
+# on older row types are OPTIONAL keys, always read-legal)
+_V9_ONLY_TYPES = ("span",)
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
     """Raise ValueError when a record violates its declared schema
-    version (writers emit v7; v1-v6 files remain readable)."""
+    version (writers emit v9; v1-v8 files remain readable)."""
     if not isinstance(rec, dict):
         raise ValueError(f"record is not an object: {rec!r}")
     v = rec.get("v")
@@ -731,7 +884,8 @@ def validate_record(rec: Dict[str, Any]) -> None:
             (v < 5 and rtype in _V5_ONLY_TYPES) or \
             (v < 6 and rtype in _V6_ONLY_TYPES) or \
             (v < 7 and rtype in _V7_ONLY_TYPES) or \
-            (v < 8 and rtype in _V8_ONLY_TYPES):
+            (v < 8 and rtype in _V8_ONLY_TYPES) or \
+            (v < 9 and rtype in _V9_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):

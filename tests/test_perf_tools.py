@@ -71,14 +71,16 @@ def test_schema_v2_validation_rules():
     with pytest.raises(ValueError, match="unknown record type"):
         telemetry.validate_record({"v": 1, "type": "attribution", **att})
     # v3 (round 9), v4 (round 10), v5 (round 11), v6 (round 15),
-    # v7 (round 16) and v8 (round 18) are valid versions now — but the
-    # v2 required keys still apply
-    for v in (3, 4, 5, 6, 7, 8):
+    # v7 (round 16), v8 (round 18) and v9 (round 20, the trace
+    # plane) are valid versions now — but the v2 required keys still
+    # apply
+    for v in (3, 4, 5, 6, 7, 8, 9):
         with pytest.raises(ValueError, match="device_kind"):
             telemetry.validate_record({"v": v, "type": "run_start",
                                        **base})
     with pytest.raises(ValueError, match="not in"):
-        telemetry.validate_record({"v": 9, "type": "run_start", **base})
+        telemetry.validate_record({"v": 10, "type": "run_start",
+                                   **base})
 
 
 def test_fixture_jsonl_validates_and_reports():

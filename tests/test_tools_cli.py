@@ -71,7 +71,8 @@ def test_legacy_tools_refuse_without_flag(tool):
 def test_telemetry_report_runs_on_fixtures():
     for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl",
                     "telemetry_v5.jsonl", "telemetry_v6.jsonl",
-                    "telemetry_v7.jsonl", "queue_v8.jsonl"):
+                    "telemetry_v7.jsonl", "queue_v8.jsonl",
+                    "telemetry_v9.jsonl"):
         proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
                      os.path.join(FIX, fixture), "--json"])
         assert proc.returncode == 0, (fixture, proc.stderr)
@@ -96,6 +97,31 @@ def test_telemetry_report_runs_on_fixtures():
     assert proc.returncode == 0, proc.stderr
     assert "ALERT [straggler-ratio] fired over (8, 8]" in proc.stdout
     assert "2 SLO alert(s) fired" in proc.stdout
+    # the v9 text form names the per-LANE straggler chip and the
+    # trace-plane span census (trace_id + per-phase counts)
+    proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
+                 os.path.join(FIX, "telemetry_v9.jsonl")])
+    assert proc.returncode == 0, proc.stderr
+    assert "per-chip[lane 0]" in proc.stdout
+    assert "trace_id=t-00aa11bb22cc33dd" in proc.stdout
+
+
+def test_trace_export_runs_on_fixtures(tmp_path):
+    """tools/trace_export.py: the three-stream join renders the v9
+    fixture + the v8 queue journal as one Chrome-trace JSON; a
+    pre-v9 stream (no spans) is a clean no-op, not an error."""
+    tool = os.path.join(TOOLS, "trace_export.py")
+    out = str(tmp_path / "trace.json")
+    proc = _run([tool, os.path.join(FIX, "queue_v8.jsonl"),
+                 "--telemetry", os.path.join(FIX, "telemetry_v9.jsonl"),
+                 "--out", out])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    export = json.load(open(out))
+    assert export["traceEvents"]
+    assert "t-00aa11bb22cc33dd" in export["fdtd3d_traces"]
+    proc = _run([tool, "--telemetry",
+                 os.path.join(FIX, "telemetry_v2.jsonl")])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 def test_slo_gate_runs_on_fixtures(tmp_path):

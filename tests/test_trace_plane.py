@@ -1,0 +1,392 @@
+"""Causal trace plane (ISSUE 17 tentpole + satellites).
+
+The trace plane's contract is a JOIN: one ``trace_id`` minted at
+``JobQueue.submit`` must connect the queue journal, the run registry,
+the telemetry stream, and the checkpoint meta — across preemptions and
+scheduler crashes — well enough that ``tools/trace_export.py`` can
+render the job's whole life as ONE Perfetto timeline and
+``tools/fleet_report.py`` can decompose its wall time into phases.
+
+* unit: the ``phase_budget`` SLO rule (span p95 vs per-phase budget,
+  SKIPPED on pre-v9 streams), the slo_gate exit-code contract on a
+  clean vs inflated-queue-wait stream, the metrics trace-join
+  (``runs_total`` counts logical jobs, not dispatches) and the four
+  span-fed phase histograms, and the v9 fixture's version gate;
+* e2e (chip-free, 8 host devices): two tenants coalesce into one
+  group on a (2, 2, 2) mesh, lane 1 is hit by an injected NaN, the
+  group is preempted mid-run, and a ``sched_crash`` kills the
+  scheduler after the re-dispatch completes.  A restarted scheduler
+  drives both jobs terminal; the exported Chrome-trace JSON then
+  shows ONE causally-linked trace (queue-wait -> coalesce -> compile
+  -> chunk -> rollback -> resume) spanning all three dispatches, the
+  per-lane imbalance rows name each tenant's straggler chip, the
+  fleet latency decomposition sums to the journal-derived wall, and
+  the snapshot meta carries the trace stamp.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fdtd3d_tpu import faults, io, jobqueue, metrics, registry, slo, \
+    telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+FIX = os.path.join(ROOT, "tests", "fixtures")
+V9 = os.path.join(FIX, "telemetry_v9.jsonl")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_plan(monkeypatch):
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("FDTD3D_AOT_CACHE_DIR", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _run_tool(args, cwd=ROOT, timeout=120):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run([sys.executable] + args,
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=cwd)
+
+
+# -------------------------------------------------------------------------
+# schema: v9 span rows validate, and ONLY at v9
+# -------------------------------------------------------------------------
+
+def test_v9_fixture_spans_are_version_gated():
+    recs = telemetry.read_jsonl(V9)  # validates every record
+    spans = [r for r in recs if r["type"] == "span"]
+    assert {s["name"] for s in spans} >= {
+        "admission", "queue_wait", "coalesce", "compile", "chunk",
+        "snapshot_commit", "rollback", "resume"}
+    assert all(s["t1"] >= s["t0"] for s in spans)
+    # trace stamps ride the existing row types too
+    start = next(r for r in recs if r["type"] == "run_start")
+    assert start["trace_id"] == spans[0]["trace_id"]
+    lanes = [r for r in recs if r["type"] == "batch_lane"]
+    assert len({r["trace_id"] for r in lanes}) == 2  # per-lane traces
+    # per-lane per-chip rows carry the lane + group join keys
+    imb = next(r for r in recs if r["type"] == "imbalance")
+    assert imb["lane"] == 0 and imb["group"].startswith("g-")
+    # span is a v9-only record type
+    with pytest.raises(ValueError, match="unknown record type"):
+        telemetry.validate_record(dict(spans[0], v=8))
+
+
+# -------------------------------------------------------------------------
+# unit: the phase_budget SLO rule
+# -------------------------------------------------------------------------
+
+def _fixture_spans():
+    return [r for r in telemetry.read_jsonl(V9) if r["type"] == "span"]
+
+
+def test_phase_budget_rule_judges_span_p95():
+    rule = slo.SloRule("phase-budget", "phase_budget", 300.0)
+    spans = _fixture_spans()
+    out = slo.evaluate_run(spans, rules=(rule,))
+    assert out["results"][0]["status"] == "OK"
+
+    # inflate queue_wait past the default 300s budget -> VIOLATION
+    # naming the phase and its p95
+    inflated = [dict(s) for s in spans]
+    for s in inflated:
+        if s["name"] == "queue_wait":
+            s["t1"] = s["t0"] + 1000.0
+    out = slo.evaluate_run(inflated, rules=(rule,))
+    res = out["results"][0]
+    assert res["status"] == "VIOLATION"
+    assert "queue_wait" in res["message"]
+    assert res["value"] > 300.0
+
+    # per-phase budgets via context: a 1s queue_wait budget fires on
+    # the fixture's 3.08s wait; a null budget exempts the phase
+    out = slo.evaluate_run(
+        spans, rules=(rule,),
+        context={"phase_budgets": {"queue_wait": 1.0}})
+    res = out["results"][0]
+    assert res["status"] == "VIOLATION" and "queue_wait" in res["message"]
+    out = slo.evaluate_run(
+        inflated, rules=(rule,),
+        context={"phase_budgets": {"queue_wait": None}})
+    assert out["results"][0]["status"] == "OK"
+
+
+def test_phase_budget_skips_pre_v9_streams():
+    """Backward compat: a span-less stream must SKIP, not judge — the
+    v1..v8 corpus keeps gating exactly as before."""
+    rule = slo.SloRule("phase-budget", "phase_budget", 300.0)
+    spanless = [r for r in telemetry.read_jsonl(V9)
+                if r["type"] != "span"]
+    out = slo.evaluate_run(spanless, rules=(rule,))
+    res = out["results"][0]
+    assert res["status"] == "SKIPPED"
+    assert "span" in res["message"]
+
+
+def test_slo_gate_phase_budget_exit_codes(tmp_path):
+    """Acceptance: slo_gate exit 1 on an inflated queue-wait stream,
+    exit 0 on the same stream with sane spans."""
+    recs = telemetry.read_jsonl(V9)
+    run = [r for r in recs if r["type"] in
+           ("run_start", "chunk", "run_end")]
+    wait = next(r for r in recs if r["type"] == "span"
+                and r["name"] == "queue_wait")
+    tool = os.path.join(TOOLS, "slo_gate.py")
+
+    clean = tmp_path / "clean.jsonl"
+    with open(clean, "w") as fh:
+        for r in run[:1] + [wait] + run[1:]:
+            fh.write(json.dumps(r) + "\n")
+    proc = _run_tool([tool, str(clean)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "phase-budget" in proc.stdout
+
+    slow = dict(wait, t1=wait["t0"] + 1000.0)
+    bad = tmp_path / "slow.jsonl"
+    with open(bad, "w") as fh:
+        for r in run[:1] + [slow] + run[1:]:
+            fh.write(json.dumps(r) + "\n")
+    proc = _run_tool([tool, str(bad)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "phase-budget" in proc.stdout
+    assert "VIOLATION" in proc.stdout
+
+
+# -------------------------------------------------------------------------
+# unit: metrics — trace-join + span-fed phase histograms
+# -------------------------------------------------------------------------
+
+def test_runs_total_is_trace_joined():
+    """Two dispatches of one job share a trace_id: runs_total must
+    count the LOGICAL job once, under its latest status."""
+    reg = metrics.MetricsRegistry()
+    base = {"v": 9, "type": "run_final", "t": 8, "steps": 8,
+            "wall_s": 1.0, "mcells_per_s": 4.0}
+    reg.observe_record(dict(base, run_id="r1", status="preempted",
+                            trace_id="t-a"))
+    reg.observe_record(dict(base, run_id="r2", status="completed",
+                            trace_id="t-a"))
+    reg.observe_record(dict(base, run_id="r3", status="completed"))
+    rendered = reg.render()
+    assert 'fdtd3d_runs_total{status="preempted"} 0' in rendered
+    assert 'fdtd3d_runs_total{status="completed"} 2' in rendered
+
+
+def test_phase_histograms_fill_from_v9_spans():
+    reg = metrics.MetricsRegistry.from_jsonl(V9)
+    rendered = reg.render()
+    # queue_wait span -> queue_wait_seconds; compile span (attrs
+    # compile_ms) -> compile_ms; snapshot_commit + rollback spans ->
+    # their histograms.  resume is deliberately NOT recovery time.
+    assert "fdtd3d_queue_wait_seconds_count 1" in rendered
+    assert "fdtd3d_compile_ms_count 1" in rendered
+    assert 'le="1000"' in rendered  # 700ms lands under the 1s bucket
+    assert "fdtd3d_snapshot_commit_seconds_count 1" in rendered
+    assert "fdtd3d_recovery_seconds_count 1" in rendered
+
+
+# -------------------------------------------------------------------------
+# tools: trace_export on the fixture corpus
+# -------------------------------------------------------------------------
+
+def test_trace_export_joins_fixture_streams(tmp_path):
+    tool = os.path.join(TOOLS, "trace_export.py")
+    out = str(tmp_path / "trace.json")
+    proc = _run_tool([tool, os.path.join(FIX, "queue_v8.jsonl"),
+                      "--telemetry", V9, "--out", out])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    export = json.load(open(out))
+    assert export["traceEvents"]
+    summ = export["fdtd3d_traces"]["t-00aa11bb22cc33dd"]
+    assert summ["tenant"] == "acme"
+    assert {"queue_wait", "coalesce", "compile", "chunk",
+            "rollback", "resume"} <= set(summ["phases"])
+    # queue phases emit flow arrows; tenants get named tracks
+    evs = export["traceEvents"]
+    assert any(e.get("ph") == "s" for e in evs)
+    assert any(e.get("ph") == "M" and e["args"].get("name") ==
+               "tenant acme" for e in evs)
+
+    # pre-v9 streams: nothing to export, but exit 0 (not an error)
+    proc = _run_tool([tool, "--telemetry",
+                      os.path.join(FIX, "telemetry_v2.jsonl")])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -------------------------------------------------------------------------
+# e2e: one causally-linked trace across NaN + preempt + sched_crash
+# -------------------------------------------------------------------------
+
+def test_queue_trace_plane_e2e(tmp_path, monkeypatch):
+    reg_path = str(tmp_path / "runs.jsonl")
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY", reg_path)
+    base = ("--3d\n--same-size 16\n--time-steps 16\n"
+            "--courant-factor 0.4\n--wavelength 0.008\n"
+            "--point-source Ez\n--manual-topology 2x2x2\n")
+    spec_a = tmp_path / "a.txt"
+    spec_a.write_text(base + "--eps 1.0\n--per-chip-telemetry\n")
+    spec_b = tmp_path / "b.txt"
+    spec_b.write_text(base + "--eps 2.0\n")
+
+    q = jobqueue.JobQueue(str(tmp_path / "queue"))
+    a = q.submit(str(spec_a), tenant="acme", priority=1)
+    b = q.submit(str(spec_b), tenant="bravo", priority=1)
+    jobs = q.jobs()
+    trace_a = jobs[a]["trace_id"]
+    trace_b = jobs[b]["trace_id"]
+    assert trace_a.startswith("t-") and trace_a != trace_b
+
+    # dispatch 1 = the coalesced (a, b) group: lane 1's NaN fires at
+    # the t=4 chunk boundary, the whole group is preempted at t=8.
+    # dispatch 2 = the group's re-dispatch (SAME traces): restores
+    # the committed group snapshot, runs to t=16, then sched_crash
+    # kills the scheduler before its terminal journal rows land.
+    faults.install("nan@t=4,field=Ez,lane=1; preempt@t=8; "
+                   "sched_crash@job=2")
+    sched = jobqueue.Scheduler(q, batch_chunk=4)
+    with pytest.raises(faults.SimulatedPreemption,
+                       match="scheduler crashed"):
+        sched.serve()
+    jobs = q.jobs()
+    assert jobs[a]["status"] == "running"  # crash ate the terminal row
+    assert jobs[a]["trace_id"] == trace_a  # re-dispatch kept the trace
+    gid = jobs[a]["group"]
+    assert gid == jobs[b]["group"] and gid.startswith("g-")
+
+    # restart: dispatch 3 resumes at t=16 (nothing left to advance)
+    # and the final per-lane sweep still convicts lane 1
+    faults.clear()
+    out = jobqueue.Scheduler(q, batch_chunk=4).serve()
+    jobs = out["jobs"]
+    assert jobs[a]["status"] == "completed" and jobs[a]["t"] == 16
+    assert jobs[b]["status"] == "failed"
+    assert "lane 1 non-finite" in jobs[b]["reason"]
+    assert jobs[a]["trace_id"] == trace_a
+    assert jobs[b]["trace_id"] == trace_b
+
+    # ---- journal: every lifecycle phase became a span on the job's
+    # own trace; the re-dispatch CONTINUED it (>= 2 queue_waits, a
+    # rollback naming the restored step)
+    jrecs = telemetry.read_jsonl(q.journal)
+    jspans = [r for r in jrecs if r["type"] == "span"]
+    a_names = {s["name"] for s in jspans if s["trace_id"] == trace_a}
+    assert {"admission", "queue_wait", "coalesce", "dispatch",
+            "rollback", "resume"} <= a_names
+    waits = [s for s in jspans
+             if s["trace_id"] == trace_a and s["name"] == "queue_wait"]
+    assert len(waits) >= 2
+    rb = next(s for s in jspans
+              if s["trace_id"] == trace_a and s["name"] == "rollback")
+    assert rb["attrs"]["t_restored"] <= rb["attrs"]["t_failed"]
+    # every journal row of the job carries its trace stamp
+    assert all(r.get("trace_id") == trace_a for r in jrecs
+               if r.get("job_id") == a)
+
+    # ---- registry: the group's runs registered under the LEADER's
+    # trace (the group run identity IS lane 0's trace)
+    runs = registry.fold(registry.read(reg_path))
+    g_runs = [r for r in runs.values() if r.get("job_id") == gid]
+    assert g_runs and all(r.get("trace_id") == trace_a for r in g_runs)
+
+    # ---- telemetry: executor spans + per-LANE rows in the shared
+    # group stream; lane rows join each tenant's own trace
+    tpath = os.path.join(q.dirpath, "groups", gid, "telemetry.jsonl")
+    trecs = telemetry.read_jsonl(tpath)
+    tspans = [r for r in trecs if r["type"] == "span"]
+    assert {s["trace_id"] for s in tspans} == {trace_a}
+    assert {"compile", "chunk", "snapshot_commit"} <= \
+        {s["name"] for s in tspans}
+    lanes = [r for r in trecs if r["type"] == "batch_lane"]
+    assert lanes
+    assert all(r["trace_id"] == trace_a for r in lanes
+               if r["lane"] == 0)
+    assert all(r["trace_id"] == trace_b for r in lanes
+               if r["lane"] == 1)
+    # per-lane imbalance names the straggler chip INSIDE the group on
+    # the (2, 2, 2) mesh — one row per healthy lane, group-stamped
+    start = next(r for r in trecs if r["type"] == "run_start")
+    assert start["topology"] == [2, 2, 2] and start["batch"] == 2
+    assert start["trace_id"] == trace_a
+    imbs = [r for r in trecs if r["type"] == "imbalance"]
+    lane0 = [r for r in imbs if r.get("lane") == 0]
+    assert lane0 and all(r["n_chips"] == 8 for r in lane0)
+    assert all(r["group"] == gid for r in imbs)
+    assert any(isinstance(r.get("argmax"), int) and 0 <= r["argmax"] < 8
+               for r in lane0)
+    # the NaN lane's rows carry the nonfinite chip census instead
+    lane1 = [r for r in imbs if r.get("lane") == 1]
+    assert any(r.get("nonfinite_chips") for r in lane1)
+    pcs = [r for r in trecs if r["type"] == "per_chip"]
+    assert pcs and all(r["n_chips"] == 8 and r["lane"] in (0, 1)
+                       for r in pcs)
+    # the healthy lane's counters stay an 8-vector of real numbers
+    pc0 = next(r for r in pcs if r["lane"] == 0)
+    assert all(len(v) == 8 for v in pc0["counters"].values())
+
+    # ---- checkpoint meta: the group snapshot is trace-stamped and
+    # ckpt_inspect surfaces it
+    snaps = sorted(glob.glob(os.path.join(q.dirpath, "groups", gid,
+                                          "ckpt_t*.npz")))
+    assert snaps
+    meta = io.read_checkpoint_meta(snaps[-1])
+    assert meta["trace_id"] == trace_a
+    proc = _run_tool([os.path.join(TOOLS, "ckpt_inspect.py"),
+                      snaps[-1], "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["meta"]["trace_id"] == trace_a
+    proc = _run_tool([os.path.join(TOOLS, "ckpt_inspect.py"),
+                      snaps[-1]])
+    assert "trace_id: " + trace_a in proc.stdout
+
+    # ---- export: ONE Chrome-trace JSON joins all three streams by
+    # trace_id — queue-wait -> coalesce -> compile -> chunk ->
+    # rollback -> resume on a single causally-linked timeline
+    trace_json = str(tmp_path / "trace.json")
+    proc = _run_tool([os.path.join(TOOLS, "trace_export.py"),
+                      q.journal, "--registry", reg_path,
+                      "--trace", trace_a, "--out", trace_json])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    export = json.load(open(trace_json))
+    assert list(export["fdtd3d_traces"]) == [trace_a]
+    summ = export["fdtd3d_traces"][trace_a]
+    assert {"queue_wait", "coalesce", "compile", "chunk",
+            "rollback", "resume"} <= set(summ["phases"])
+    xev = [e for e in export["traceEvents"] if e.get("ph") == "X"]
+    assert xev
+    assert all(e["args"]["trace_id"] == trace_a for e in xev)
+    assert sum(1 for e in xev if e["name"] == "queue_wait") >= 2
+    assert any(e.get("ph") == "M" and e["args"].get("name") ==
+               "tenant acme" for e in export["traceEvents"])
+
+    # ---- fleet: the per-tenant latency decomposition closes — wall
+    # equals the attributed phases plus the scheduler-glue residual,
+    # and independently equals the journal+telemetry span envelope
+    proc = _run_tool([os.path.join(TOOLS, "fleet_report.py"),
+                      reg_path, "--journal", q.journal, "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rollup = json.loads(proc.stdout)
+    decomp = rollup["fleet"]["latency_decomposition"]
+    assert "acme" in decomp and "bravo" in decomp
+    ent = decomp["acme"]
+    assert {"queue_wait", "compile", "exec"} <= set(ent["phases"])
+    attributed = sum(p["total_s"] for p in ent["phases"].values())
+    assert ent["wall_s"] == \
+        pytest.approx(attributed + ent["residual_s"], abs=1e-3)
+    a_spans = [s for s in jspans + tspans if s["trace_id"] == trace_a]
+    wall = max(s["t1"] for s in a_spans) - \
+        min(s["t0"] for s in a_spans)
+    assert ent["wall_s"] == pytest.approx(wall, abs=1e-3)
+
+    # ---- gate: the real journal's spans pass the phase budget
+    proc = _run_tool([os.path.join(TOOLS, "slo_gate.py"), q.journal])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "phase-budget" in proc.stdout

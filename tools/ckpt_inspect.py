@@ -35,9 +35,13 @@ from fdtd3d_tpu.log import report, warn  # noqa: E402
 # run_id (round 16): the run-registry stamp (fdtd3d_tpu/registry.py,
 # FDTD3D_RUN_REGISTRY) Simulation writes into extra_ckpt_meta — a
 # snapshot is traceable back to its runs.jsonl row and telemetry
-# stream; absent on registry-less runs.
+# stream; absent on registry-less runs. trace_id (schema v9, the
+# trace plane): the owning queue job's causal-trace identity — a
+# snapshot joins tools/trace_export.py's Perfetto timeline by it;
+# absent outside queue runs.
 META_KEYS = ("t", "scheme", "size", "topology", "psi_slabs", "dtype",
-             "step_kind", "state_keys", "supervisor", "run_id")
+             "step_kind", "state_keys", "supervisor", "run_id",
+             "trace_id")
 
 
 def inspect(path: str, verify: bool = False) -> dict:
@@ -128,6 +132,10 @@ def format_text(out: dict) -> str:
             lines.append(f"  run_id: {meta['run_id']}  (run-registry "
                          f"stamp — join against runs.jsonl with "
                          f"tools/fleet_report.py)")
+        if meta.get("trace_id"):
+            lines.append(f"  trace_id: {meta['trace_id']}  (causal-"
+                         f"trace stamp — join the queue journal + "
+                         f"telemetry with tools/trace_export.py)")
         sup = meta.get("supervisor")
         if sup:
             lines.append(

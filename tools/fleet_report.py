@@ -2,7 +2,7 @@
 
 Usage:
     python tools/fleet_report.py RUNS.jsonl [--json]
-        [--follow [--interval S]]
+        [--journal QUEUE.jsonl] [--follow [--interval S]]
 
 Reads the append-only run registry (``FDTD3D_RUN_REGISTRY`` →
 ``runs.jsonl``, fdtd3d_tpu/registry.py), folds the ``run_begin``/
@@ -22,7 +22,18 @@ ROADMAP items 2c/3's queue and scheduler will select against:
   amortizing?);
 * recovery-event rate per 1000 steps, and fired SLO alerts by rule;
 * straggler-chip leaderboard (which chip ids keep winning the
-  per-chunk imbalance argmax across runs).
+  per-chunk imbalance argmax across runs) — batched runs' per-lane
+  imbalance rows name the straggler chip inside a coalesced group;
+* per-tenant LATENCY DECOMPOSITION (schema v9, the trace plane):
+  every ``span`` record in the joined streams — plus the queue
+  journal when ``--journal`` points at it — buckets into queue-wait
+  / compile / exec / snapshot / recovery with p50/p95 per phase,
+  next to the tenant's journal-derived wall time (earliest span t0
+  to latest t1 per trace, summed). An explicit ``residual_s``
+  closes the identity: wall == sum(phase totals) + residual, BY
+  CONSTRUCTION — residual is the unattributed scheduler time
+  (admission, coalesce, dispatch glue), and goes negative exactly
+  when phases overlap (a first chunk's wall contains its compile).
 
 ``--json`` emits the rollup as one JSON object (deterministic — the
 tests' surface); ``--follow`` tails the registry live (re-folding
@@ -38,7 +49,7 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
@@ -53,7 +64,7 @@ def _stream_facts(path: str) -> Dict[str, Any]:
     lane verdicts, recovery events, alerts, straggler argmax tally."""
     out: Dict[str, Any] = {"lanes": [], "recoveries": 0,
                            "alerts": [], "stragglers": {},
-                           "chunk_rates": []}
+                           "chunk_rates": [], "spans": []}
     try:
         records = telemetry.read_jsonl(path)
     except (OSError, ValueError) as exc:
@@ -78,15 +89,112 @@ def _stream_facts(path: str) -> Dict[str, Any]:
                 out["stragglers"].get(chip, 0) + 1
         elif rtype == "chunk":
             out["chunk_rates"].append(rec["mcells_per_s"])
+        elif rtype == "span":
+            out["spans"].append(rec)
     out["lanes"] = [{"lane": lane, "first_unhealthy_t": t}
                     for lane, t in sorted(bad_lanes.items())]
     return out
 
 
-def build_rollup(registry_path: str) -> Dict[str, Any]:
+# span name -> decomposition bucket (the trace-plane taxonomy,
+# docs/OBSERVABILITY.md). admission/coalesce/dispatch stay OUT: the
+# dispatch span wraps the whole run, so bucketing it would double
+# count — scheduler glue is what residual_s measures.
+_PHASE_BUCKETS = {
+    "queue_wait": "queue_wait",
+    "compile": "compile",
+    "chunk": "exec",
+    "snapshot_commit": "snapshot",
+    "retry": "recovery",
+    "rollback": "recovery",
+    "degrade": "recovery",
+    "topology_change": "recovery",
+    "resume": "recovery",
+}
+PHASE_ORDER = ("queue_wait", "compile", "exec", "snapshot",
+               "recovery")
+
+
+def latency_decomposition(spans: List[Dict[str, Any]],
+                          tenant_of_trace: Dict[str, str]
+                          ) -> Dict[str, Any]:
+    """Per-tenant phase table from joined ``span`` records: p50/p95/
+    total seconds per bucket, the tenant's journal-derived wall
+    (per-trace earliest-t0..latest-t1, summed over its traces), and
+    the residual that makes ``wall == sum(totals) + residual`` an
+    identity."""
+    by_tenant: Dict[str, Dict[str, Any]] = {}
+    walls: Dict[str, Dict[str, List[float]]] = {}
+    for s in spans:
+        tkey = str(s.get("trace_id"))
+        tenant = s.get("tenant") or tenant_of_trace.get(tkey) \
+            or "(untenanted)"
+        tw = walls.setdefault(tenant, {}).setdefault(
+            tkey, [float(s["t0"]), float(s["t1"])])
+        tw[0] = min(tw[0], float(s["t0"]))
+        tw[1] = max(tw[1], float(s["t1"]))
+        bucket = _PHASE_BUCKETS.get(str(s["name"]))
+        if bucket is None:
+            continue
+        ent = by_tenant.setdefault(tenant, {})
+        ent.setdefault(bucket, []).append(
+            max(float(s["t1"]) - float(s["t0"]), 0.0))
+    out: Dict[str, Any] = {}
+    for tenant, traces in sorted(walls.items()):
+        wall = sum(t1 - t0 for t0, t1 in traces.values())
+        phases: Dict[str, Any] = {}
+        attributed = 0.0
+        for bucket in PHASE_ORDER:
+            durs = by_tenant.get(tenant, {}).get(bucket)
+            if not durs:
+                continue
+            total = sum(durs)
+            attributed += total
+            pct = telemetry.pct_summary(durs)
+            phases[bucket] = {"total_s": round(total, 6),
+                              "p50_s": pct["p50"],
+                              "p95_s": pct["p95"],
+                              "n": len(durs)}
+        out[tenant] = {
+            "wall_s": round(wall, 6),
+            "n_traces": len(traces),
+            "phases": phases,
+            "residual_s": round(wall - attributed, 6),
+        }
+    return out
+
+
+def build_rollup(registry_path: str,
+                 journal_path: Optional[str] = None
+                 ) -> Dict[str, Any]:
     """The one-shot fleet snapshot (``--json`` emits it verbatim)."""
     rows = run_registry.read(registry_path)
     runs = run_registry.fold(rows)
+
+    # trace-plane joins: spans from the queue journal (--journal) and
+    # every run's telemetry stream; tenant attribution by trace_id
+    spans: List[Dict[str, Any]] = []
+    seen_spans: set = set()
+    tenant_of_trace: Dict[str, str] = {}
+
+    def _take_spans(records) -> None:
+        for rec in records:
+            if rec.get("trace_id") and rec.get("tenant"):
+                # a coalesced group's registry rows join tenants as
+                # "a,b" in lane order; the group run registers under
+                # the LEADER's (lane 0's) trace, so the first name
+                # owns it
+                tenant_of_trace.setdefault(
+                    str(rec["trace_id"]),
+                    str(rec["tenant"]).split(",")[0])
+            if rec.get("type") == "span" \
+                    and rec.get("span_id") not in seen_spans:
+                seen_spans.add(rec.get("span_id"))
+                spans.append(rec)
+
+    _take_spans(rows)
+    if journal_path:
+        _take_spans(telemetry.read_jsonl(journal_path))
 
     by_status: Dict[str, int] = {}
     run_table: Dict[str, Dict[str, Any]] = {}
@@ -156,6 +264,13 @@ def build_rollup(registry_path: str) -> Dict[str, Any]:
             if facts["chunk_rates"]:
                 entry["chunk_mcells_per_s"] = telemetry.pct_summary(
                     facts["chunk_rates"])
+            if facts["spans"]:
+                _take_spans(facts["spans"])
+                tid = row.get("trace_id")
+                ten = row.get("tenant")
+                if tid and ten:
+                    tenant_of_trace.setdefault(
+                        str(tid), str(ten).split(",")[0])
             if rec_from_registry is None and facts["recoveries"]:
                 # a run killed without close() has no run_final
                 # rollup — its stream's recovery records are exactly
@@ -188,6 +303,8 @@ def build_rollup(registry_path: str) -> Dict[str, Any]:
                 if total_cache else None,
             },
             "straggler_leaderboard": leaderboard,
+            "latency_decomposition": latency_decomposition(
+                spans, tenant_of_trace),
         },
     }
 
@@ -218,6 +335,23 @@ def format_text(rollup: Dict[str, Any]) -> str:
     for s in fleet["straggler_leaderboard"][:5]:
         lines.append(f"  straggler chip {s['chip']}: worst in "
                      f"{s['chunks_worst']} chunk(s)")
+    decomp = fleet.get("latency_decomposition") or {}
+    if decomp:
+        lines.append("  latency decomposition (p50/p95/total s):")
+        for tenant, ent in decomp.items():
+            lines.append(f"    tenant {tenant}: wall "
+                         f"{ent['wall_s']:.3f}s over "
+                         f"{ent['n_traces']} trace(s)")
+            for phase in PHASE_ORDER:
+                ph = ent["phases"].get(phase)
+                if ph is None:
+                    continue
+                lines.append(
+                    f"      {phase:12s} {ph['p50_s']:.3f} / "
+                    f"{ph['p95_s']:.3f} / {ph['total_s']:.3f} "
+                    f"(n={ph['n']})")
+            lines.append(f"      {'residual':12s} "
+                         f"{ent['residual_s']:.3f}")
     for rid, row in rollup["runs"].items():
         lines.append(
             f"  run {rid}: {row['status']:9s} kind={row['kind']} "
@@ -240,6 +374,10 @@ def main(argv=None) -> int:
     ap.add_argument("registry", help="runs.jsonl (FDTD3D_RUN_REGISTRY)")
     ap.add_argument("--json", action="store_true",
                     help="emit the rollup as one JSON object")
+    ap.add_argument("--journal", metavar="PATH", default=None,
+                    help="queue journal JSONL — joins its "
+                         "queue_wait/coalesce/... spans into the "
+                         "latency decomposition")
     ap.add_argument("--follow", action="store_true",
                     help="tail mode: re-fold and re-print whenever "
                          "the registry grows (Ctrl-C exits)")
@@ -252,7 +390,8 @@ def main(argv=None) -> int:
              f"FDTD3D_RUN_REGISTRY to start one)")
         return 1
     try:
-        rollup = build_rollup(args.registry)
+        rollup = build_rollup(args.registry,
+                              journal_path=args.journal)
     except ValueError as exc:
         warn(f"{args.registry}: {exc}")
         return 1
@@ -273,7 +412,8 @@ def main(argv=None) -> int:
             if size == last_size:
                 continue
             last_size = size
-            rollup = build_rollup(args.registry)
+            rollup = build_rollup(args.registry,
+                                  journal_path=args.journal)
             report("")
             report(format_text(rollup))
     except KeyboardInterrupt:

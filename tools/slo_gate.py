@@ -4,7 +4,7 @@ Usage:
     python tools/slo_gate.py TELEMETRY.jsonl [--best BENCH_BEST.json]
         [--rules RULES.json] [--registry RUNS.jsonl]
         [--floor-mcells X] [--compile-budget-ms X]
-        [--emit-alerts] [--json]
+        [--phase-budgets JSON] [--emit-alerts] [--json]
     python tools/slo_gate.py --registry RUNS.jsonl [...]
 
 Evaluates every run in the (validated) telemetry JSONL against the
@@ -97,6 +97,13 @@ def main(argv=None) -> int:
     ap.add_argument("--compile-budget-ms", type=float, default=None,
                     help="absolute compile-wall budget (ms) instead "
                          "of the equal-key reference")
+    ap.add_argument("--phase-budgets", default=None, metavar="JSON",
+                    help="per-phase span-wall budgets for the "
+                         "phase-budget rule, as an inline JSON "
+                         "object or a file path: "
+                         "'{\"queue_wait\": 60, \"compile\": null}' "
+                         "(null exempts a phase; unlisted phases "
+                         "use the rule threshold)")
     ap.add_argument("--emit-alerts", action="store_true",
                     help="append one schema-v7 alert record per "
                          "firing rule to the input stream")
@@ -118,6 +125,20 @@ def main(argv=None) -> int:
         context["min_mcells_per_s"] = args.floor_mcells
     if args.compile_budget_ms is not None:
         context["compile_budget_ms"] = args.compile_budget_ms
+    if args.phase_budgets:
+        raw = args.phase_budgets
+        if os.path.exists(raw):
+            with open(raw) as f:
+                raw = f.read()
+        try:
+            budgets = json.loads(raw)
+        except json.JSONDecodeError as exc:
+            ap.error(f"--phase-budgets is neither a readable file "
+                     f"nor inline JSON: {exc}")
+        if not isinstance(budgets, dict):
+            ap.error("--phase-budgets must be a JSON object of "
+                     "phase name -> seconds (or null)")
+        context["phase_budgets"] = budgets
     if args.best:
         try:
             with open(args.best) as f:

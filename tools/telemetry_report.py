@@ -22,6 +22,11 @@ bench stages append):
 * SLO alerts (schema v7, fdtd3d_tpu/slo.py via tools/slo_gate.py
   --emit-alerts): each firing rule's id, window and message, counted
   beside the recovery events in the survived-events summary
+* trace plane (schema v9): the run's trace_id and a per-phase span
+  census (queue_wait/compile/chunk/snapshot_commit/... counts —
+  tools/trace_export.py renders the full timeline), plus PER-LANE
+  per-chip imbalance for batched runs: each coalesced-group member's
+  own straggler chip, named by (lane, chip)
 
 ``--json`` emits the same summary as one JSON object per run instead
 of text (for dashboards / the driver).
@@ -129,6 +134,34 @@ def summarize_run(run):
         out.setdefault("imbalance", {})["nonfinite_chips"] = \
             bad["nonfinite_chips"]
         out["imbalance"]["nonfinite_t"] = bad["t"]
+    # per-LANE imbalance (schema v9, the batched per-chip lane): each
+    # coalesced-group member's own worst ratio + straggler chip
+    by_lane = {}
+    for r in imb:
+        if r.get("lane") is None:
+            continue
+        cur = by_lane.get(r["lane"])
+        if cur is None or r["ratio"] > cur["ratio"]:
+            by_lane[r["lane"]] = r
+    if by_lane:
+        out["lane_imbalance"] = {
+            str(lane): {"worst_ratio": r["ratio"],
+                        "straggler_chip": r["argmax"],
+                        "t": r["t"], "metric": r["metric"],
+                        "n_chips": r["n_chips"],
+                        "group": r.get("group")}
+            for lane, r in sorted(by_lane.items())}
+    # trace plane (schema v9): the causal-trace join key + a span
+    # census by phase (the full timeline is tools/trace_export.py)
+    if start.get("trace_id"):
+        out["trace_id"] = start["trace_id"]
+    spans = [r for r in run if r["type"] == "span"]
+    if spans:
+        phases = {}
+        for r in spans:
+            phases[r["name"]] = phases.get(r["name"], 0) + 1
+        out["spans"] = {"n": len(spans),
+                        "phases": dict(sorted(phases.items()))}
     if not chunks:
         return out
     walls = [c["wall_s"] for c in chunks]
@@ -246,6 +279,21 @@ def format_text(summaries) -> str:
                     f"{im['nonfinite_chips']} first at "
                     f"t={im['nonfinite_t']} — diverged chip(s), see "
                     f"the straggler runbook")
+        for lane, im in (s.get("lane_imbalance") or {}).items():
+            lines.append(
+                f"  per-chip[lane {lane}]: worst {im['metric']} "
+                f"imbalance {im['worst_ratio']:.3f}x at t={im['t']}, "
+                f"straggler chip {im['straggler_chip']}"
+                + (f" (group {im['group']})" if im.get("group")
+                   else ""))
+        if s.get("spans"):
+            sp = s["spans"]
+            lines.append(
+                f"  trace: {sp['n']} span(s)  "
+                + " ".join(f"{k}={v}" for k, v in
+                           sp["phases"].items())
+                + (f"  trace_id={s['trace_id']}"
+                   if s.get("trace_id") else ""))
         rec = s.get("recoveries", {})
 
         def _at(r):

@@ -1,0 +1,301 @@
+"""Export a job's causal trace as Chrome-trace/Perfetto JSON.
+
+The viewer half of the trace plane (docs/OBSERVABILITY.md "Trace
+plane"): join the queue journal, the run registry, and any number of
+telemetry streams by ``trace_id`` (schema v9 ``span`` records) and
+emit ONE trace-viewer JSON — load it in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``:
+
+    python tools/trace_export.py JOURNAL.jsonl
+        [--registry RUNS.jsonl] [--telemetry STREAM.jsonl ...]
+        [--trace TRACE_ID] [--job JOB_ID] [--out trace.json] [--json]
+
+* one TRACK PER TENANT (a trace-viewer "process"; ``process_name``
+  metadata, the tools/trace_attribution.py convention) — tenant
+  attribution joins across streams: journal spans carry ``tenant``
+  directly, executor spans resolve through the job_submit /
+  run_begin rows sharing their trace_id;
+* LANES AS CHILD TRACKS (threads): a span carrying ``lane`` (a
+  coalesced group member's queue wait, its batch-lane rollback)
+  renders under ``lane N`` inside its tenant's track;
+* QUEUE PHASES AS FLOW EVENTS (``ph: s/f`` arrows): each journal-side
+  phase span (admission, queue_wait, coalesce, rollback, resume)
+  arrows to the next span of the same trace, so the hand-off from the
+  scheduler to the executor — including a preempted group's
+  re-dispatch, which continues the SAME trace — reads as one causal
+  chain.
+
+Spans become ``ph: "X"`` complete events (ts/dur microseconds,
+re-based to the earliest span so the viewer opens at t=0); the raw
+``trace_id``/``span_id``/``parent_span_id``/attrs ride in ``args``.
+A top-level ``fdtd3d_traces`` summary (trace -> job, tenant, phase
+names, wall seconds) makes the artifact greppable without a viewer —
+trace-viewer loaders ignore unknown top-level keys by design.
+
+Pre-v9 inputs (no spans anywhere) report that and exit 0 with no
+artifact — the exporter degrades exactly like trace_attribution.py.
+
+Exit codes: 0 = exported (or cleanly nothing to export); 1 = an
+input is unreadable; 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
+
+from fdtd3d_tpu import registry as run_registry  # noqa: E402
+from fdtd3d_tpu import telemetry  # noqa: E402
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+# journal-side lifecycle phases: these arrow (flow) into whatever the
+# trace does next — the scheduler -> executor hand-off
+QUEUE_PHASES = ("admission", "queue_wait", "coalesce", "rollback",
+                "resume")
+
+
+def collect(paths: List[str],
+            registry_path: Optional[str] = None
+            ) -> Dict[str, Any]:
+    """Read every input once -> {"spans", "tenant_of_trace",
+    "tenant_of_job"}. The registry contributes the run -> job ->
+    tenant join (and each run's telemetry_path artifact pointer,
+    auto-followed so ``--registry`` alone finds the executor spans)."""
+    spans: List[Dict[str, Any]] = []
+    seen_ids: set = set()
+    tenant_of_trace: Dict[str, str] = {}
+    tenant_of_job: Dict[str, str] = {}
+
+    def _take(rec: Dict[str, Any]) -> None:
+        rtype = rec.get("type")
+        if rtype == "span":
+            sid = rec.get("span_id")
+            if sid in seen_ids:
+                return
+            seen_ids.add(sid)
+            spans.append(rec)
+        tid = rec.get("trace_id")
+        ten = rec.get("tenant")
+        if tid and ten:
+            tenant_of_trace.setdefault(str(tid), str(ten))
+        if rec.get("job_id") and ten:
+            tenant_of_job.setdefault(str(rec["job_id"]), str(ten))
+
+    stream_paths = list(paths)
+    if registry_path:
+        rows = run_registry.read(registry_path)
+        for row in rows:
+            _take(row)
+        for rid, run in sorted(run_registry.fold(rows).items()):
+            tpath = run_registry.resolve_artifact(
+                registry_path, run.get("telemetry_path"))
+            if tpath is not None and tpath not in stream_paths:
+                stream_paths.append(tpath)
+    for path in stream_paths:
+        for rec in telemetry.read_jsonl(path):
+            _take(rec)
+    return {"spans": spans, "tenant_of_trace": tenant_of_trace,
+            "tenant_of_job": tenant_of_job}
+
+
+def _tenant_of(span: Dict[str, Any], joined: Dict[str, Any]) -> str:
+    ten = span.get("tenant")
+    if ten:
+        return str(ten)
+    ten = joined["tenant_of_trace"].get(str(span.get("trace_id")))
+    if ten:
+        return ten
+    ten = joined["tenant_of_job"].get(str(span.get("job_id")))
+    return ten if ten else "(untenanted)"
+
+
+def build_export(joined: Dict[str, Any],
+                 trace_filter: Optional[str] = None,
+                 job_filter: Optional[str] = None) -> Dict[str, Any]:
+    """Spans + joins -> the Chrome-trace object (traceEvents + the
+    fdtd3d_traces summary)."""
+    spans = [s for s in joined["spans"]
+             if (trace_filter is None
+                 or s.get("trace_id") == trace_filter)
+             and (job_filter is None
+                  or s.get("job_id") == job_filter)]
+    spans.sort(key=lambda s: (float(s["t0"]), float(s["t1"])))
+    events: List[Dict[str, Any]] = []
+    if not spans:
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "fdtd3d_traces": {}}
+
+    t_base = min(float(s["t0"]) for s in spans)
+
+    def _us(t: float) -> int:
+        return int(round((float(t) - t_base) * 1e6))
+
+    # tenant -> pid, (pid, lane-or-None) -> tid; metadata events name
+    # both so Perfetto renders "tenant X" tracks with "lane N" rows
+    pids: Dict[str, int] = {}
+    tids: Dict[Any, int] = {}
+    for s in spans:
+        tenant = _tenant_of(s, joined)
+        if tenant not in pids:
+            pid = len(pids) + 1
+            pids[tenant] = pid
+            events.append({"ph": "M", "pid": pid, "tid": 0,
+                           "name": "process_name",
+                           "args": {"name": f"tenant {tenant}"}})
+            events.append({"ph": "M", "pid": pid, "tid": 0,
+                           "name": "thread_name",
+                           "args": {"name": "job"}})
+        pid = pids[tenant]
+        lane = s.get("lane")
+        key = (pid, lane)
+        if key not in tids:
+            tid = 0 if lane is None else int(lane) + 1
+            tids[key] = tid
+            if lane is not None:
+                events.append({"ph": "M", "pid": pid, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": f"lane {lane}"}})
+
+    traces: Dict[str, Dict[str, Any]] = {}
+    per_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        tenant = _tenant_of(s, joined)
+        pid = pids[tenant]
+        tid = tids[(pid, s.get("lane"))]
+        args: Dict[str, Any] = {
+            "trace_id": s.get("trace_id"),
+            "span_id": s.get("span_id"),
+        }
+        for k in ("parent_span_id", "job_id", "run_id", "group",
+                  "lane"):
+            if s.get(k) is not None:
+                args[k] = s[k]
+        if isinstance(s.get("attrs"), dict):
+            args.update(s["attrs"])
+        events.append({
+            "ph": "X", "pid": pid, "tid": tid,
+            "name": str(s["name"]), "cat": "span",
+            "ts": _us(s["t0"]),
+            # zero-duration phases stay visible (1 us floor)
+            "dur": max(_us(s["t1"]) - _us(s["t0"]), 1),
+            "args": args,
+        })
+        s["_pid"], s["_tid"] = pid, tid
+        tkey = str(s.get("trace_id"))
+        per_trace.setdefault(tkey, []).append(s)
+        summ = traces.setdefault(tkey, {
+            "tenant": tenant, "job_id": s.get("job_id"),
+            "n_spans": 0, "phases": [],
+            "t0": float(s["t0"]), "t1": float(s["t1"]),
+        })
+        summ["n_spans"] += 1
+        if s.get("job_id") and not summ["job_id"]:
+            summ["job_id"] = s["job_id"]
+        if s["name"] not in summ["phases"]:
+            summ["phases"].append(str(s["name"]))
+        summ["t0"] = min(summ["t0"], float(s["t0"]))
+        summ["t1"] = max(summ["t1"], float(s["t1"]))
+
+    # queue phases -> flow arrows into the trace's next span
+    flow_id = 0
+    for tkey, tspans in per_trace.items():
+        for i, s in enumerate(tspans):
+            if s["name"] not in QUEUE_PHASES:
+                continue
+            nxt = next((n for n in tspans[i + 1:]
+                        if float(n["t0"]) >= float(s["t0"])), None)
+            if nxt is None:
+                continue
+            flow_id += 1
+            events.append({"ph": "s", "id": flow_id, "cat": "queue",
+                           "name": "queue-flow",
+                           "ts": max(_us(s["t1"]) - 1, _us(s["t0"])),
+                           "pid": s["_pid"], "tid": s["_tid"]})
+            events.append({"ph": "f", "bp": "e", "id": flow_id,
+                           "cat": "queue", "name": "queue-flow",
+                           "ts": _us(nxt["t0"]) + 1,
+                           "pid": nxt["_pid"], "tid": nxt["_tid"]})
+    for s in spans:
+        s.pop("_pid", None)
+        s.pop("_tid", None)
+    for summ in traces.values():
+        summ["wall_s"] = round(summ["t1"] - summ["t0"], 6)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "fdtd3d_traces": traces}
+
+
+def format_text(export: Dict[str, Any]) -> str:
+    traces = export["fdtd3d_traces"]
+    n_ev = sum(1 for e in export["traceEvents"]
+               if e.get("ph") == "X")
+    lines = [f"trace export: {len(traces)} trace(s), "
+             f"{n_ev} span event(s)"]
+    for tkey, summ in sorted(traces.items()):
+        lines.append(
+            f"  {tkey}: tenant {summ['tenant']} job "
+            f"{summ['job_id']} — {summ['n_spans']} span(s) over "
+            f"{summ['wall_s']:.3f}s: " + " ".join(summ["phases"]))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="join queue journal + run registry + telemetry "
+                    "streams by trace_id into one Chrome-trace/"
+                    "Perfetto JSON (tenants as tracks, lanes as "
+                    "child tracks, queue phases as flow arrows)")
+    ap.add_argument("journal", nargs="*",
+                    help="telemetry-schema JSONL inputs (the queue "
+                         "journal and/or telemetry streams)")
+    ap.add_argument("--registry", metavar="PATH", default=None,
+                    help="runs.jsonl — joins run->job->tenant and "
+                         "auto-follows each run's telemetry_path")
+    ap.add_argument("--telemetry", metavar="PATH", action="append",
+                    default=[],
+                    help="extra telemetry stream(s) to join "
+                         "(repeatable)")
+    ap.add_argument("--trace", metavar="TRACE_ID", default=None,
+                    help="export only this trace")
+    ap.add_argument("--job", metavar="JOB_ID", default=None,
+                    help="export only this job's trace")
+    ap.add_argument("--out", metavar="PATH", default=None,
+                    help="write the trace-viewer JSON here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full export JSON (default: a "
+                         "text summary)")
+    args = ap.parse_args(argv)
+
+    paths = list(args.journal) + list(args.telemetry)
+    if not paths and not args.registry:
+        ap.error("no inputs: pass journal/telemetry JSONL paths "
+                 "and/or --registry")
+    try:
+        joined = collect(paths, registry_path=args.registry)
+    except (OSError, ValueError) as exc:
+        warn(f"trace_export: {exc}")
+        return 1
+    export = build_export(joined, trace_filter=args.trace,
+                          job_filter=args.job)
+    if not export["fdtd3d_traces"]:
+        # pre-v9 inputs carry no spans: report, no partial artifact
+        report("no span records in the inputs (pre-v9 streams, or "
+               "tracing off); nothing to export")
+        return 0
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(export, f, indent=1)
+        report(f"wrote {args.out} "
+               f"({len(export['traceEvents'])} events)")
+    report(json.dumps(export, indent=1) if args.json
+           else format_text(export))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
