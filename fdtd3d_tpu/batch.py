@@ -304,6 +304,13 @@ class BatchSimulation:
                 out0.telemetry_path or None,
                 run_meta=_telemetry.provenance(self),
                 metrics=self.metrics)
+        # Live-health heartbeats (schema v10, Simulation pattern):
+        # one "run" emitter for the whole coalesced batch — lane
+        # attribution stays on the batch_lane rows.
+        import jax as _jax
+        self._heartbeat = _telemetry.Heartbeater.maybe(
+            out0.telemetry_path
+            if _jax.process_index() == 0 else None, "run")
 
     def _bind_pack(self, runner):
         """(Re)build the vmapped pack/unpack plumbing for a packed
@@ -560,6 +567,11 @@ class BatchSimulation:
             attrs={"chunk": int(self._chunk_idx),
                    "t": int(self._t_host), "steps": int(n_steps)},
             group=self.group_id)
+        if self._heartbeat is not None:
+            self._heartbeat.beat(
+                t=int(self._t_host), run_id=self.run_id,
+                trace_id=getattr(self, "trace_id", None),
+                job_id=getattr(self, "job_id", None))
         if hv is not None:
             per = hv.get("per_chip")
             lts = self.lane_traces or []

@@ -165,6 +165,21 @@ ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
           "checkpoint metadata so streams and snapshots are "
           "joinable. Monitor with tools/fleet_report.py. Unset: no "
           "registry writes."),
+    _knob("FDTD3D_HEARTBEAT_S", "str", None,
+          "Live-health heartbeat cadence, seconds (fdtd3d_tpu/"
+          "telemetry.Heartbeater, schema v10): runs beat at chunk "
+          "boundaries, the job-queue scheduler at dispatch-loop "
+          "iterations and the supervisor at recovery boundaries — "
+          "one atomic O_APPEND row per beat onto the stream each "
+          "emitter already owns. 0 = beat at EVERY boundary (the "
+          "deterministic tier-1 mode). Unset: no heartbeats, "
+          "streams stay byte-identical to v9 emission."),
+    _knob("FDTD3D_WATCH_INTERVAL_S", "str", None,
+          "Fleet-watcher poll cadence, seconds (fdtd3d_tpu/watch.py; "
+          "tools/fleet_watch.py --interval overrides). Also the "
+          "presumed heartbeat spacing for liveness-deadline math "
+          "when a beat declares no cadence (or the 0 every-boundary "
+          "mode). Unset: 10."),
 )}
 
 

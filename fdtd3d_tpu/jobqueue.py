@@ -456,6 +456,12 @@ class Scheduler:
         self._dispatches = 0       # sched_crash@job=N ordinal clock
         self._cfgs: Dict[str, Any] = {}   # spec path -> SimConfig
         self._pool = None          # (devices, excluded_ids) cache
+        # Live-health heartbeats (schema v10): the scheduler beats
+        # onto its own journal at every cycle and dispatch boundary —
+        # None (strict no-op) unless FDTD3D_HEARTBEAT_S is set, so a
+        # heartbeat-off journal stays byte-identical to v9 emission.
+        self._heartbeat = _telemetry.Heartbeater.maybe(
+            queue.journal, "scheduler")
 
     # -- config loading -----------------------------------------------------
 
@@ -563,10 +569,15 @@ class Scheduler:
                                    j.get("submit_idx", 0)))
         transitions = 0
         used: set = set()
+        if self._heartbeat is not None:
+            self._heartbeat.beat()
         for job in queued:
             if job["job_id"] in used:
                 continue
             used.add(job["job_id"])
+            if self._heartbeat is not None:
+                self._heartbeat.beat(job_id=str(job["job_id"]),
+                                     trace_id=job.get("trace_id"))
             try:
                 cfg = self._load(job["spec"])
             except (ValueError, OSError) as exc:

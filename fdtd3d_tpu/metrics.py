@@ -295,6 +295,20 @@ class MetricsRegistry:
                              help_="queue wait between submit and "
                                    "dispatch, seconds")
             self._observe_job(rec)
+        elif rtype == "heartbeat":
+            # v10 live-health rows: the scraper sees emitter
+            # freshness without parsing the stream itself
+            self.inc("heartbeats_total", emitter=rec["emitter"],
+                     help_="heartbeat rows observed, by emitter")
+            self.set_gauge("heartbeat_last_unix", rec["unix"],
+                           emitter=rec["emitter"],
+                           help_="wall clock of the latest "
+                                 "heartbeat, by emitter")
+        elif rtype == "liveness":
+            self.inc("liveness_flags_total", emitter=rec["emitter"],
+                     status=rec["status"],
+                     help_="watcher liveness verdicts, by emitter "
+                           "and status")
 
     def _observe_span(self, rec: Dict[str, Any]) -> None:
         """One v9 ``span`` record -> the phase histograms (the
@@ -405,3 +419,22 @@ class MetricsRegistry:
         for rec in _telemetry.read_jsonl(path):
             reg.observe_record(rec)
         return reg
+
+    def observe_tail(self, tailer, path: str) -> int:
+        """Incremental replay: observe only the records appended to
+        ``path`` since ``tailer``'s cursor (fdtd3d_tpu/tail.Tailer) —
+        the streaming flavor the fleet watcher polls with. Invalid
+        rows become named tailer events instead of killing the
+        caller's poll loop. Returns the number of records observed."""
+        from fdtd3d_tpu import telemetry as _telemetry
+        n = 0
+        for rec in tailer.poll_records(path):
+            try:
+                _telemetry.validate_record(rec)
+            except ValueError as exc:
+                tailer.events.append(
+                    f"invalid record in {path}: {exc}")
+                continue
+            self.observe_record(rec)
+            n += 1
+        return n

@@ -205,6 +205,13 @@ class Simulation:
                 cfg.output.telemetry_path or None,
                 run_meta=_telemetry.provenance(self),
                 metrics=self.metrics)
+        # Live-health heartbeats (schema v10): None unless
+        # FDTD3D_HEARTBEAT_S is set AND this rank owns the stream —
+        # disabled runs append nothing, keeping the stream
+        # byte-identical to v9 emission.
+        self._heartbeat = _telemetry.Heartbeater.maybe(
+            cfg.output.telemetry_path
+            if jax.process_index() == 0 else None, "run")
         # Device-trace lane (round 7): capture starts lazily at the
         # first advance() (so construction-time failures never leave a
         # dangling profiler session) and is finalized by close() —
@@ -458,6 +465,11 @@ class Simulation:
             self, "chunk", t_sp0, float(time.time()),
             attrs={"chunk": int(self._chunk_idx),
                    "t": int(self._t_host), "steps": int(n_steps)})
+        if self._heartbeat is not None:
+            self._heartbeat.beat(
+                t=int(self._t_host), run_id=self.run_id,
+                trace_id=getattr(self, "trace_id", None),
+                job_id=getattr(self, "job_id", None))
         if self.telemetry is not None and hv is not None:
             self.telemetry.emit_chunk(
                 chunk=self._chunk_idx, t=self._t_host, steps=n_steps,

@@ -186,6 +186,7 @@ class Supervisor:
         self.rollbacks = 0
         self.degrades = 0
         self.topology_rung = 0
+        self._heartbeat = None  # live-health emitter, built lazily
         if resume_state:
             if sim is not None:
                 raise ValueError(
@@ -274,6 +275,26 @@ class Supervisor:
         sink = self.sim.telemetry if self.sim is not None else None
         if sink is not None:
             sink.emit(rec_type, **fields)
+
+    def _beat(self):
+        """Forced supervisor heartbeat (schema v10) at a recovery
+        boundary: the watcher sees the run alive the moment it
+        survives a retry/rollback/degrade, even when the run emitter's
+        next chunk beat is a whole chunk away. Lazily bound to the
+        CURRENT sim's telemetry path (a ladder swap replaces the sim
+        but the stream path survives the swap); a strict no-op when
+        FDTD3D_HEARTBEAT_S is unset or the run has no stream."""
+        sink = self.sim.telemetry if self.sim is not None else None
+        path = getattr(sink, "path", None)
+        if self._heartbeat is None:
+            self._heartbeat = _telemetry.Heartbeater.maybe(
+                path, "supervisor")
+        if self._heartbeat is not None:
+            self._heartbeat.beat(
+                t=int(self.sim._t_host),
+                run_id=getattr(self.sim, "run_id", None),
+                trace_id=getattr(self.sim, "trace_id", None),
+                job_id=getattr(self.sim, "job_id", None), force=True)
 
     def _trace_span(self, name: str, t0: float,
                     attrs: Optional[Dict] = None):
@@ -440,6 +461,7 @@ class Supervisor:
                   f"({str(exc)[:120]}); rolled back to "
                   f"t={self.sim._t_host} ({src}) and degraded "
                   f"{old_kind} -> {new_sim.step_kind}")
+        self._beat()
         self._persist()
 
     def _topology_degrade(self, exc, chip: Optional[int] = None,
@@ -486,6 +508,7 @@ class Supervisor:
                      if chip is not None else "")
                   + f"; rolled back to t={self.sim._t_host} ({src}) "
                   f"and degraded the topology to {new_topo}")
+        self._beat()
         self._persist()
 
     def _handle_transient(self, exc, consec: int) -> bool:
@@ -521,6 +544,7 @@ class Supervisor:
                          attrs={"attempt": int(consec),
                                 "delay_s": float(delay),
                                 "t_restored": int(self.sim._t_host)})
+        self._beat()
         self._persist()
         return False
 

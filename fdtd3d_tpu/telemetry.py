@@ -49,6 +49,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import socket
 import subprocess
 import time
 from typing import Any, Dict, Optional, Sequence
@@ -103,10 +104,20 @@ import numpy as np
 # trace/span stamps also land as OPTIONAL keys on run_start,
 # run_begin/run_final, job_submit/job_state and batch_lane rows, and
 # the per-lane batched imbalance record gains optional lane/group
-# keys naming the straggler chip INSIDE a coalesced group. v1-v8
-# files still read/validate (READ_VERSIONS).
-SCHEMA_VERSION = 9
-READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+# keys naming the straggler chip INSIDE a coalesced group. v10 (live
+# fleet health plane, round 20): the "heartbeat" record — one cheap
+# O_APPEND row per chunk boundary (run), dispatch-loop iteration
+# (scheduler) or recovery boundary (supervisor), carrying the emitter
+# kind, pid/host, a monotonic seq and the last committed step t, so a
+# streaming watcher (fdtd3d_tpu/watch.py) can do liveness deadline
+# math without polling the device — and the "liveness" record the
+# watcher emits when an emitter's heartbeats stop for N x cadence
+# (status stuck/lost, naming the emitter and its last t). Both are
+# gated on FDTD3D_HEARTBEAT_S: unset means strict no-op and streams
+# byte-identical to v9 emission. v1-v9 files still read/validate
+# (READ_VERSIONS).
+SCHEMA_VERSION = 10
+READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -432,6 +443,142 @@ def emit_trace_span(sim, name: str, t0: float, t1: float,
 
 
 # --------------------------------------------------------------------------
+# heartbeats (schema v10 — the live fleet health plane's sensor rows)
+# --------------------------------------------------------------------------
+
+def heartbeat_cadence_s() -> Optional[float]:
+    """The configured heartbeat cadence in seconds, or None when the
+    plane is OFF (FDTD3D_HEARTBEAT_S unset/empty — the default: no
+    emitter beats, no stream gains a single byte over v9 emission).
+    ``0`` means beat at EVERY progress boundary — the deterministic
+    mode tier-1 uses so tests never sleep waiting for a cadence."""
+    raw = os.environ.get("FDTD3D_HEARTBEAT_S", "").strip()
+    if not raw:
+        return None
+    try:
+        cadence = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"FDTD3D_HEARTBEAT_S={raw!r}: cadence must be a number of "
+            f"seconds (0 = beat at every progress boundary)") from None
+    if cadence < 0:
+        raise ValueError(
+            f"FDTD3D_HEARTBEAT_S={raw!r}: cadence must be >= 0")
+    return cadence
+
+
+def heartbeat_fields(emitter: str, pid: int, host: str, seq: int,
+                     unix: float, t: Optional[int] = None,
+                     run_id: Optional[str] = None,
+                     trace_id: Optional[str] = None,
+                     job_id: Optional[str] = None,
+                     cadence_s: Optional[float] = None
+                     ) -> Dict[str, Any]:
+    """Build the field dict of one ``heartbeat`` record (schema v10).
+
+    THE heartbeat producer (the schema-drift lint resolves this dict
+    literal — see span_fields). ``t`` is the last COMMITTED solver
+    step (None for the scheduler); identity stamps with None values
+    are dropped so untraced beats stay lean."""
+    rec = {
+        "emitter": str(emitter), "pid": int(pid), "host": str(host),
+        "seq": int(seq), "unix": float(unix),
+        "t": None if t is None else int(t),
+        "run_id": run_id, "trace_id": trace_id, "job_id": job_id,
+        "cadence_s": cadence_s,
+    }
+    for key in ("run_id", "trace_id", "job_id", "cadence_s"):
+        if rec[key] is None:
+            rec.pop(key)
+    return rec
+
+
+def liveness_fields(emitter: str, status: str, last_unix: float,
+                    last_t: Optional[int], deadline_s: float,
+                    silent_s: float, message: str,
+                    run_id: Optional[str] = None,
+                    trace_id: Optional[str] = None,
+                    job_id: Optional[str] = None,
+                    pid: Optional[int] = None,
+                    host: Optional[str] = None) -> Dict[str, Any]:
+    """Build the field dict of one ``liveness`` record (schema v10) —
+    the watcher's verdict on an emitter whose heartbeats stopped."""
+    rec = {
+        "emitter": str(emitter), "status": str(status),
+        "last_unix": float(last_unix),
+        "last_t": None if last_t is None else int(last_t),
+        "deadline_s": float(deadline_s), "silent_s": float(silent_s),
+        "message": str(message),
+        "run_id": run_id, "trace_id": trace_id, "job_id": job_id,
+        "pid": pid, "host": host,
+    }
+    for key in ("run_id", "trace_id", "job_id", "pid", "host"):
+        if rec[key] is None:
+            rec.pop(key)
+    return rec
+
+
+class Heartbeater:
+    """Rate-limited heartbeat emitter for ONE (stream, emitter) pair.
+
+    Writes whole ``heartbeat`` rows straight onto an existing JSONL
+    stream (a run's telemetry file, the queue journal) via
+    ``io.atomic_append`` — O_APPEND keeps them safe to interleave
+    with the stream's own writer, and the watcher tails the same
+    files it already knows about. Construct via :meth:`maybe`, which
+    returns None when the plane is off (FDTD3D_HEARTBEAT_S unset) or
+    the stream has no path — callers hold an Optional and guard with
+    ``if hb is not None: hb.beat(...)``, the emit_trace_span no-op
+    pattern, so disabled runs pay nothing and emit nothing."""
+
+    def __init__(self, path: str, emitter: str, cadence_s: float):
+        self.path = str(path)
+        self.emitter = str(emitter)
+        self.cadence_s = float(cadence_s)
+        self.seq = 0
+        self._last_beat: Optional[float] = None
+        self._pid = os.getpid()
+        self._host = socket.gethostname()
+
+    @classmethod
+    def maybe(cls, path: Optional[str],
+              emitter: str) -> Optional["Heartbeater"]:
+        """The gate: a Heartbeater when FDTD3D_HEARTBEAT_S is set and
+        the stream has a path, else None (strict no-op)."""
+        cadence = heartbeat_cadence_s()
+        if cadence is None or not path:
+            return None
+        return cls(path, emitter, cadence)
+
+    def beat(self, t: Optional[int] = None,
+             run_id: Optional[str] = None,
+             trace_id: Optional[str] = None,
+             job_id: Optional[str] = None,
+             force: bool = False) -> bool:
+        """Emit one beat unless the cadence says it is too soon.
+
+        ``force`` skips the rate limit — recovery boundaries
+        (supervisor retry/rollback) always beat, so the watcher sees
+        the emitter alive the moment it survives a fault. Returns
+        True when a row landed."""
+        now = time.time()
+        if not force and self._last_beat is not None \
+                and (now - self._last_beat) < self.cadence_s:
+            return False
+        self._last_beat = now
+        self.seq += 1
+        rec = {"v": SCHEMA_VERSION, "type": "heartbeat",
+               **heartbeat_fields(
+                   self.emitter, self._pid, self._host, self.seq,
+                   now, t=t, run_id=run_id, trace_id=trace_id,
+                   job_id=job_id, cadence_s=self.cadence_s)}
+        validate_record(rec)
+        from fdtd3d_tpu import io as _io
+        _io.atomic_append(self.path, json.dumps(rec) + "\n")
+        return True
+
+
+# --------------------------------------------------------------------------
 # provenance + schema
 # --------------------------------------------------------------------------
 
@@ -717,6 +864,26 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "name": (str,), "trace_id": (str,), "span_id": (str,),
         "t0": _NUM, "t1": _NUM,
     },
+    # v10 (live fleet health plane): the liveness sensor rows.
+    # "heartbeat" is one O_APPEND row per progress boundary of an
+    # emitter — `emitter` is the kind token (run / scheduler /
+    # supervisor), `seq` a per-process monotonic counter (a seq gap
+    # under a steady unix clock means lost rows, not a dead emitter),
+    # `unix` the wall clock, `t` the last COMMITTED solver step (null
+    # for the scheduler, whose progress is dispatches, not steps).
+    # "liveness" is the watcher's verdict when heartbeats stop:
+    # status stuck/lost, the silent window measured against the
+    # emitter's declared cadence, and the last heartbeat's unix/t so
+    # the alert names where progress halted.
+    "heartbeat": {
+        "emitter": (str,), "pid": (int,), "host": (str,),
+        "seq": (int,), "unix": _NUM, "t": _OPT_NUM,
+    },
+    "liveness": {
+        "emitter": (str,), "status": (str,), "last_unix": _NUM,
+        "last_t": _OPT_NUM, "deadline_s": _NUM, "silent_s": _NUM,
+        "message": (str,),
+    },
 }
 
 
@@ -838,6 +1005,14 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # span is self-describing without a journal join.
     "span": ("parent_span_id", "attrs", "job_id", "tenant", "run_id",
              "lane", "group"),
+    # heartbeat (v10): the identity stamps joining a beat to its run /
+    # queue job / causal trace (absent on a solo scheduler beat);
+    # cadence_s echoes the emitter's configured cadence so the watcher
+    # derives the liveness deadline from the stream itself.
+    "heartbeat": ("run_id", "trace_id", "job_id", "cadence_s"),
+    # liveness (v10): the same identity stamps, plus the pid/host of
+    # the emitter the verdict is about (copied from its last beat).
+    "liveness": ("run_id", "trace_id", "job_id", "pid", "host"),
 }
 
 
@@ -865,11 +1040,13 @@ _V8_ONLY_TYPES = ("job_submit", "job_state")
 # and from v9 on: the causal-trace span record (the trace/span stamps
 # on older row types are OPTIONAL keys, always read-legal)
 _V9_ONLY_TYPES = ("span",)
+# and from v10 on: the live-health-plane liveness sensor rows
+_V10_ONLY_TYPES = ("heartbeat", "liveness")
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
     """Raise ValueError when a record violates its declared schema
-    version (writers emit v9; v1-v8 files remain readable)."""
+    version (writers emit v10; v1-v9 files remain readable)."""
     if not isinstance(rec, dict):
         raise ValueError(f"record is not an object: {rec!r}")
     v = rec.get("v")
@@ -885,7 +1062,8 @@ def validate_record(rec: Dict[str, Any]) -> None:
             (v < 6 and rtype in _V6_ONLY_TYPES) or \
             (v < 7 and rtype in _V7_ONLY_TYPES) or \
             (v < 8 and rtype in _V8_ONLY_TYPES) or \
-            (v < 9 and rtype in _V9_ONLY_TYPES):
+            (v < 9 and rtype in _V9_ONLY_TYPES) or \
+            (v < 10 and rtype in _V10_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):

@@ -1,0 +1,488 @@
+"""Streaming fleet health watcher — the live half of observability.
+
+Everything else in the observability stack folds append-only JSONL
+after the fact; this module watches the same three stream kinds
+WHILE they grow (through ``tail.Tailer`` cursors, so each poll costs
+only the appended bytes) and turns them into verdicts:
+
+*liveness*
+    Every ``heartbeat`` row (schema v10) updates a per-emitter last-
+    seen state. An emitter whose beats stop for more than
+    ``deadline_n x cadence`` is declared ``stuck`` (or ``lost`` past
+    3x the deadline), surfaced as a validated schema-v10 ``liveness``
+    record naming the emitter and the last committed step t. The
+    deadline math runs on an injectable clock — tier-1 never sleeps.
+    Emitters RETIRE instead of alarming when their silence is the
+    normal end of life: a run emitter is retired once its stream's
+    ``run_end`` landed after the last beat, and the scheduler once
+    its journal folds to no non-terminal jobs.
+
+*anomaly*
+    Rolling EWMA of chunk throughput per (step_kind, grid, dtype)
+    key, scored against the run-registry history (median of
+    completed runs on the same key, falling back to a BENCH_BEST
+    reference); queued jobs aging past the queue-wait bound; and a
+    straggler-ratio EWMA trend from ``imbalance`` rows.
+
+*continuous SLO*
+    ``slo.py`` rules re-evaluated on a sliding per-stream window
+    each poll instead of a whole-run fold — firing the existing
+    ``alert`` records and ``alerts_total{rule}`` metrics, with an
+    atomic OpenMetrics exposition refresh per poll.
+
+``tools/fleet_watch.py`` is the CLI; ``--once`` runs a single
+deterministic poll for tests/CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from fdtd3d_tpu import io as _io
+from fdtd3d_tpu import metrics as _metrics
+from fdtd3d_tpu import slo as _slo
+from fdtd3d_tpu import tail as _tail
+from fdtd3d_tpu import telemetry as _telemetry
+
+# Journal/terminal states mirrored from jobqueue (not imported: the
+# watcher must load without pulling the scheduler's jax dependencies).
+_TERMINAL_STATES = ("completed", "failed", "cancelled")
+
+DEFAULT_INTERVAL_S = 10.0
+
+
+def watch_interval_s() -> float:
+    """The watcher poll cadence (``FDTD3D_WATCH_INTERVAL_S``, default
+    10s) — also the presumed heartbeat cadence for deadline math when
+    a beat declares none (or declares the 0 every-boundary mode)."""
+    raw = os.environ.get("FDTD3D_WATCH_INTERVAL_S", "").strip()
+    if not raw:
+        return DEFAULT_INTERVAL_S
+    try:
+        interval = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"FDTD3D_WATCH_INTERVAL_S={raw!r}: poll interval must be "
+            f"a number of seconds") from None
+    if interval <= 0:
+        raise ValueError(
+            f"FDTD3D_WATCH_INTERVAL_S={raw!r}: poll interval must be "
+            f"> 0")
+    return interval
+
+
+class _EmitterState:
+    """Last-seen heartbeat state of one (stream, emitter, pid)."""
+
+    def __init__(self, path: str, emitter: str):
+        self.path = path
+        self.emitter = emitter
+        self.pid: Optional[int] = None
+        self.host: Optional[str] = None
+        self.seq = 0
+        self.unix = 0.0
+        self.t: Optional[int] = None
+        self.cadence_s: Optional[float] = None
+        self.run_id: Optional[str] = None
+        self.trace_id: Optional[str] = None
+        self.job_id: Optional[str] = None
+        self.retired = False
+
+    def observe(self, rec: Dict[str, Any]) -> None:
+        self.pid = rec.get("pid")
+        self.host = rec.get("host")
+        self.seq = int(rec.get("seq", 0))
+        self.unix = float(rec.get("unix", 0.0))
+        self.t = rec.get("t")
+        self.cadence_s = rec.get("cadence_s")
+        self.run_id = rec.get("run_id") or self.run_id
+        self.trace_id = rec.get("trace_id") or self.trace_id
+        self.job_id = rec.get("job_id") or self.job_id
+        self.retired = False
+
+
+class FleetWatcher:
+    """Incremental poll loop over registry + journal + telemetry.
+
+    ``poll_once(...)`` is the whole engine: everything else (the CLI
+    serve loop, the exposition refresh) is plumbing around repeated
+    calls. ``clock`` is injectable so liveness deadlines are pure
+    arithmetic in tests."""
+
+    def __init__(self, registry: Optional[str] = None,
+                 journal: Optional[str] = None,
+                 telemetry: Sequence[str] = (),
+                 metrics_path: Optional[str] = None,
+                 out_path: Optional[str] = None,
+                 cursor_path: Optional[str] = None,
+                 clock: Callable[[], float] = time.time,
+                 interval_s: Optional[float] = None,
+                 deadline_n: int = 3,
+                 rules=None,
+                 context: Optional[Dict[str, Any]] = None,
+                 ewma_alpha: float = 0.3,
+                 drift_ratio: float = 0.5,
+                 queue_wait_max_s: float = 300.0,
+                 straggler_max: float = 2.0,
+                 window: int = 512):
+        self.registry = registry
+        self.journal = journal
+        self.telemetry = list(telemetry)
+        self.metrics_path = metrics_path
+        self.out_path = out_path
+        self.clock = clock
+        self.interval_s = (watch_interval_s()
+                           if interval_s is None else float(interval_s))
+        self.deadline_n = int(deadline_n)
+        self.rules = list(rules) if rules is not None \
+            else list(_slo.DEFAULT_RULES)
+        self.context = dict(context or {})
+        self.ewma_alpha = float(ewma_alpha)
+        self.drift_ratio = float(drift_ratio)
+        self.queue_wait_max_s = float(queue_wait_max_s)
+        self.straggler_max = float(straggler_max)
+        self.window = int(window)
+        self.tailer = _tail.Tailer(cursor_path=cursor_path)
+        self.metrics = _metrics.MetricsRegistry(path=metrics_path)
+        # emitter key -> _EmitterState (liveness bookkeeping)
+        self._emitters: Dict[tuple, _EmitterState] = {}
+        # journal fold: job_id -> {"status", "unix", "tenant"}
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        # registry fold: run_id -> merged row (baseline history)
+        self._runs: Dict[str, Dict[str, Any]] = {}
+        # per-telemetry-path sliding record window + stream identity
+        self._windows: Dict[str, List[Dict[str, Any]]] = {}
+        self._stream_key: Dict[str, tuple] = {}
+        self._run_ended: Dict[str, float] = {}
+        # (step_kind, grid, dtype) -> throughput EWMA; path -> ratio
+        self._ewma: Dict[tuple, float] = {}
+        self._straggler_ewma: Dict[str, float] = {}
+        # liveness dedup: emitter key -> status already flagged (a
+        # stuck emitter alarms once per status escalation, not once
+        # per poll)
+        self._flagged: Dict[tuple, str] = {}
+        # SLO dedup: path -> rule ids violating as of the last poll
+        # (an ongoing violation alarms once, re-arms when it clears)
+        self._violating: Dict[str, set] = {}
+
+    # -- per-record observers ----------------------------------------------
+
+    def _observe_heartbeat(self, path: str,
+                           rec: Dict[str, Any]) -> None:
+        key = (path, str(rec.get("emitter")), rec.get("pid"))
+        st = self._emitters.get(key)
+        if st is None:
+            st = self._emitters[key] = _EmitterState(
+                path, str(rec.get("emitter")))
+        st.observe(rec)
+        self._flagged.pop(key, None)
+
+    def _observe_journal(self, rec: Dict[str, Any]) -> None:
+        rtype = rec.get("type")
+        if rtype == "job_submit":
+            self._jobs[str(rec.get("job_id"))] = {
+                "status": rec.get("status", "queued"),
+                "unix": rec.get("unix"),
+                "tenant": rec.get("tenant"),
+            }
+        elif rtype == "job_state":
+            job = self._jobs.setdefault(
+                str(rec.get("job_id")),
+                {"status": None, "unix": None,
+                 "tenant": rec.get("tenant")})
+            job["status"] = rec.get("status")
+            if rec.get("unix") is not None:
+                job["unix"] = rec.get("unix")
+
+    def _observe_registry(self, rec: Dict[str, Any]) -> None:
+        if rec.get("type") not in ("run_begin", "run_final"):
+            return
+        rid = str(rec.get("run_id"))
+        row = self._runs.setdefault(rid, {})
+        row.update({k: v for k, v in rec.items()
+                    if k not in ("v", "type")})
+
+    def _observe_telemetry(self, path: str,
+                           rec: Dict[str, Any]) -> None:
+        rtype = rec.get("type")
+        if rtype == "run_start":
+            self._stream_key[path] = (rec.get("step_kind"),
+                                      str(rec.get("grid")),
+                                      rec.get("dtype"))
+        elif rtype == "run_end":
+            self._run_ended[path] = float(self.clock())
+        elif rtype == "chunk":
+            key = self._stream_key.get(path)
+            mcps = rec.get("mcells_per_s")
+            if key is not None and isinstance(mcps, (int, float)):
+                prev = self._ewma.get(key)
+                self._ewma[key] = float(mcps) if prev is None else \
+                    (self.ewma_alpha * float(mcps)
+                     + (1.0 - self.ewma_alpha) * prev)
+        elif rtype == "imbalance":
+            ratio = rec.get("ratio")
+            if isinstance(ratio, (int, float)):
+                prev = self._straggler_ewma.get(path)
+                self._straggler_ewma[path] = float(ratio) \
+                    if prev is None else \
+                    (self.ewma_alpha * float(ratio)
+                     + (1.0 - self.ewma_alpha) * prev)
+        win = self._windows.setdefault(path, [])
+        win.append(rec)
+        if len(win) > self.window:
+            del win[:len(win) - self.window]
+
+    # -- verdicts ----------------------------------------------------------
+
+    def _retire(self) -> None:
+        """Mark emitters whose silence is a normal end of life."""
+        open_jobs = any(
+            j.get("status") not in _TERMINAL_STATES
+            for j in self._jobs.values())
+        for key, st in self._emitters.items():
+            if st.retired:
+                continue
+            if st.emitter == "scheduler":
+                # journal path: green once every job is terminal
+                if self._jobs and not open_jobs:
+                    st.retired = True
+            else:
+                ended = self._run_ended.get(st.path)
+                if ended is not None:
+                    st.retired = True
+
+    def _liveness(self, now: float) -> List[Dict[str, Any]]:
+        self._retire()
+        out: List[Dict[str, Any]] = []
+        for key, st in self._emitters.items():
+            if st.retired:
+                continue
+            cadence = st.cadence_s
+            if not cadence or cadence <= 0:
+                # 0 = every-boundary mode: the watcher's own poll
+                # cadence is the honest lower bound on beat spacing
+                cadence = self.interval_s
+            deadline = self.deadline_n * float(cadence)
+            silent = now - st.unix
+            if silent <= deadline:
+                self._flagged.pop(key, None)
+                continue
+            status = "lost" if silent > 3.0 * deadline else "stuck"
+            if self._flagged.get(key) == status:
+                continue
+            self._flagged[key] = status
+            rec = {"v": _telemetry.SCHEMA_VERSION, "type": "liveness",
+                   **_telemetry.liveness_fields(
+                       st.emitter, status, st.unix, st.t, deadline,
+                       silent,
+                       f"{st.emitter} silent {silent:.1f}s "
+                       f"(deadline {deadline:.1f}s, last t="
+                       f"{st.t}, seq={st.seq})",
+                       run_id=st.run_id, trace_id=st.trace_id,
+                       job_id=st.job_id, pid=st.pid, host=st.host)}
+            _telemetry.validate_record(rec)
+            out.append(rec)
+            self.metrics.observe_record(rec)
+        return out
+
+    def _baseline(self, key: tuple) -> Optional[float]:
+        """Throughput baseline for one (step_kind, grid, dtype) key:
+        median completed-run throughput from the registry history,
+        else the BENCH_BEST reference for the step kind."""
+        hist = sorted(
+            float(r["mcells_per_s"]) for r in self._runs.values()
+            if r.get("status") == "completed"
+            and isinstance(r.get("mcells_per_s"), (int, float))
+            and (r.get("step_kind"), str(r.get("grid")),
+                 r.get("dtype")) == key)
+        if hist:
+            return hist[len(hist) // 2]
+        best = self.context.get("bench_best")
+        if isinstance(best, dict):
+            for bkey in _slo._BENCH_KEYS.get(key[0], ()):
+                v = best.get(bkey)
+                if isinstance(v, (int, float)) and v > 0:
+                    return float(v)
+        return None
+
+    def _anomalies(self, now: float) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for key, ewma in sorted(self._ewma.items(),
+                                key=lambda kv: str(kv[0])):
+            base = self._baseline(key)
+            if base is None or base <= 0:
+                continue
+            if ewma < self.drift_ratio * base:
+                out.append({
+                    "kind": "throughput_drift", "key": list(key),
+                    "ewma_mcells_per_s": round(ewma, 3),
+                    "baseline_mcells_per_s": round(base, 3),
+                    "ratio": round(ewma / base, 3),
+                    "message": f"throughput EWMA {ewma:.1f} under "
+                               f"{self.drift_ratio:.0%} of baseline "
+                               f"{base:.1f} for key {key}"})
+        for job_id, job in sorted(self._jobs.items()):
+            if job.get("status") != "queued":
+                continue
+            unix = job.get("unix")
+            if not isinstance(unix, (int, float)):
+                continue
+            wait = now - float(unix)
+            if wait > self.queue_wait_max_s:
+                out.append({
+                    "kind": "queue_wait_aging", "job_id": job_id,
+                    "tenant": job.get("tenant"),
+                    "wait_s": round(wait, 1),
+                    "max_s": self.queue_wait_max_s,
+                    "message": f"job {job_id} queued {wait:.0f}s "
+                               f"(bound {self.queue_wait_max_s:.0f}s)"})
+        for path, ratio in sorted(self._straggler_ewma.items()):
+            if ratio > self.straggler_max:
+                out.append({
+                    "kind": "straggler_trend", "path": path,
+                    "ratio_ewma": round(ratio, 3),
+                    "max": self.straggler_max,
+                    "message": f"straggler ratio EWMA {ratio:.2f} "
+                               f"over {self.straggler_max:.2f} "
+                               f"({os.path.basename(path)})"})
+        for a in out:
+            self.metrics.inc("watch_anomalies_total",
+                             help_="anomaly verdicts emitted",
+                             kind=a["kind"])
+        return out
+
+    def _slo_pass(self) -> Dict[str, Any]:
+        """Continuous SLO: the whole-run rules on each stream's
+        sliding window. Windows with no rule input fold to
+        INCONCLUSIVE (the engine's all-SKIPPED semantics), never OK —
+        absence of evidence stays visible."""
+        verdicts: Dict[str, Any] = {}
+        alerts: List[Dict[str, Any]] = []
+        for path, win in sorted(self._windows.items()):
+            if not win:
+                continue
+            summary = _slo.evaluate_run(win, rules=self.rules,
+                                        context=self.context)
+            verdicts[path] = summary
+            was = self._violating.get(path, set())
+            now_violating = set()
+            for alert in _slo.alerts_for(summary["results"]):
+                now_violating.add(alert["rule"])
+                if alert["rule"] in was:
+                    continue  # ongoing: alarmed on an earlier poll
+                alerts.append(alert)
+                self.metrics.observe_record(alert)
+            self._violating[path] = now_violating
+        return {"verdicts": verdicts, "alerts": alerts}
+
+    # -- the poll ----------------------------------------------------------
+
+    def _drain(self, path: Optional[str], observer) -> int:
+        """Tail one stream and feed its validated new records to
+        ``observer``; invalid rows become named tailer events, so a
+        corrupt line degrades to a notice, never a dead watcher."""
+        if not path:
+            return 0
+        n = 0
+        for rec in self.tailer.poll_records(path):
+            try:
+                _telemetry.validate_record(rec)
+            except ValueError as exc:
+                self.tailer.events.append(
+                    f"invalid record in {path}: {exc}")
+                continue
+            self.metrics.observe_record(rec)
+            if rec.get("type") == "heartbeat":
+                self._observe_heartbeat(path, rec)
+            else:
+                observer(rec)
+            n += 1
+        return n
+
+    def poll_once(self) -> Dict[str, Any]:
+        """One deterministic poll: drain every stream, update the
+        rolling state, emit verdicts, refresh the exposition, commit
+        the tail cursors. Returns the report dict the CLI renders."""
+        now = float(self.clock())
+        n = self._drain(self.registry, self._observe_registry)
+        n += self._drain(self.journal, self._observe_journal)
+        for path in self.telemetry:
+            n += self._drain(
+                path, lambda rec, p=path: self._observe_telemetry(
+                    p, rec))
+        liveness = self._liveness(now)
+        anomalies = self._anomalies(now)
+        slo = self._slo_pass()
+        self.metrics.set_gauge(
+            "watch_emitters", float(len(self._emitters)),
+            help_="heartbeat emitters tracked")
+        self.metrics.set_gauge(
+            "watch_last_poll_unix", now,
+            help_="wall clock of the last watcher poll")
+        for rec in liveness:
+            if self.out_path:
+                _io.atomic_append(self.out_path,
+                                  json.dumps(rec) + "\n")
+        for alert in slo["alerts"]:
+            if self.out_path:
+                _io.atomic_append(self.out_path,
+                                  json.dumps(alert) + "\n")
+        if self.metrics_path:
+            self.metrics.write(self.metrics_path)
+        self.tailer.checkpoint()
+        report = {
+            "now": now,
+            "records": n,
+            "emitters": [
+                {"path": st.path, "emitter": st.emitter,
+                 "pid": st.pid, "host": st.host, "seq": st.seq,
+                 "unix": st.unix, "t": st.t,
+                 "retired": st.retired,
+                 "run_id": st.run_id, "job_id": st.job_id}
+                for _, st in sorted(self._emitters.items(),
+                                    key=lambda kv: str(kv[0]))],
+            "liveness": liveness,
+            "anomalies": anomalies,
+            "slo": {p: s["status"]
+                    for p, s in slo["verdicts"].items()},
+            "alerts": slo["alerts"],
+            "events": self.tailer.drain_events(),
+        }
+        return report
+
+    def flagged(self, report: Dict[str, Any]) -> bool:
+        """True when the poll found anything worth an exit code 1."""
+        return bool(report["liveness"] or report["anomalies"]
+                    or report["alerts"])
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Operator-facing text rendering of one poll (the CLI surface;
+    machine consumers take --json)."""
+    lines = [f"fleet watch @ {report['now']:.1f} "
+             f"({report['records']} new records)"]
+    for st in report["emitters"]:
+        state = "retired" if st["retired"] else "live"
+        t = "-" if st["t"] is None else str(st["t"])
+        lines.append(
+            f"  EMITTER {st['emitter']:<10} {state:<7} seq={st['seq']}"
+            f" t={t} last={st['unix']:.1f}"
+            f" ({os.path.basename(st['path'])})")
+    for rec in report["liveness"]:
+        lines.append(
+            f"  LIVENESS {rec['status'].upper():<6} {rec['emitter']}"
+            f" silent={rec['silent_s']:.1f}s"
+            f" deadline={rec['deadline_s']:.1f}s last_t="
+            f"{rec.get('last_t')} — {rec['message']}")
+    for a in report["anomalies"]:
+        lines.append(f"  ANOMALY {a['kind']}: {a['message']}")
+    for path, status in sorted(report["slo"].items()):
+        lines.append(f"  SLO {status} ({os.path.basename(path)})")
+    for ev in report["events"]:
+        lines.append(f"  EVENT {ev}")
+    if len(lines) == 1:
+        lines.append("  (no streams observed)")
+    return "\n".join(lines)

@@ -1,0 +1,183 @@
+"""fdtd3d_tpu/tail.py: incremental JSONL tailing with durable cursors.
+
+The properties under test are exactly the ones the fleet watcher and
+``fleet_report --follow`` lean on:
+
+* INCREMENTAL — a poll costs the bytes appended since the last poll,
+  not the file size (``bytes_read`` is the proof surface).
+* CARRY — a partial trailing line is held back, not parsed, and
+  completes on the next poll.
+* NAMED FAILURE — rotation (inode change) and truncation (size under
+  cursor) reset to zero AND leave an explanatory event; they never
+  silently double-count or drop.
+* DURABLE — ``checkpoint()`` + a fresh Tailer on the same cursor path
+  resumes at the committed offset.
+"""
+
+import json
+import os
+
+import pytest
+
+from fdtd3d_tpu import tail
+
+
+def _append(path, text):
+    with open(path, "a") as fh:
+        fh.write(text)
+
+
+# ---------------------------------------------------------------------------
+# incrementality
+# ---------------------------------------------------------------------------
+
+def test_poll_is_incremental_bytes_do_not_rescale(tmp_path):
+    """Growing the file does NOT grow the cost of polling the delta:
+    after a large prefix is consumed once, a small append costs only
+    its own bytes."""
+    p = str(tmp_path / "stream.jsonl")
+    big = "".join(json.dumps({"type": "chunk", "i": i}) + "\n"
+                  for i in range(500))
+    _append(p, big)
+    t = tail.Tailer()
+    assert len(t.poll(p)) == 500
+    cost_prefix = t.bytes_read
+    assert cost_prefix == len(big)
+
+    small = json.dumps({"type": "chunk", "i": 500}) + "\n"
+    _append(p, small)
+    assert len(t.poll(p)) == 1
+    assert t.bytes_read - cost_prefix == len(small)
+
+    # an empty poll costs nothing at all
+    before = t.bytes_read
+    assert t.poll(p) == []
+    assert t.bytes_read == before
+
+
+def test_poll_missing_file_is_empty_not_error(tmp_path):
+    t = tail.Tailer()
+    assert t.poll(str(tmp_path / "nope.jsonl")) == []
+    assert t.bytes_read == 0
+    assert t.events == []
+
+
+# ---------------------------------------------------------------------------
+# partial-line carry
+# ---------------------------------------------------------------------------
+
+def test_partial_line_carried_until_complete(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    t = tail.Tailer()
+    _append(p, '{"a": 1}\n{"a": ')
+    assert t.poll(p) == ['{"a": 1}']
+    _append(p, '2}\n')
+    assert t.poll_records(p) == [{"a": 2}]
+    assert t.events == []
+
+
+# ---------------------------------------------------------------------------
+# rotation / truncation are named, not absorbed
+# ---------------------------------------------------------------------------
+
+def test_truncation_resets_and_names_itself(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    t = tail.Tailer()
+    _append(p, '{"a": 1}\n{"a": 2}\n')
+    assert len(t.poll(p)) == 2
+    with open(p, "w") as fh:  # rewrite shorter in place
+        fh.write('{"a": 3}\n')
+    assert t.poll_records(p) == [{"a": 3}]
+    evts = t.drain_events()
+    assert len(evts) == 1 and evts[0].startswith("truncated:")
+    assert t.drain_events() == []  # drain clears
+
+
+def test_rotation_resets_and_names_itself(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    t = tail.Tailer()
+    _append(p, '{"a": 1}\n')
+    assert len(t.poll(p)) == 1
+    os.rename(p, p + ".1")  # classic copy-then-recreate rotation
+    _append(p, '{"a": 2}\n')
+    assert t.poll_records(p) == [{"a": 2}]
+    evts = t.drain_events()
+    assert len(evts) == 1 and evts[0].startswith("rotated:")
+
+
+# ---------------------------------------------------------------------------
+# tolerant vs strict record parsing
+# ---------------------------------------------------------------------------
+
+def test_poll_records_tolerant_skips_and_names_bad_lines(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    _append(p, '{"ok": 1}\nnot json at all\n[1, 2]\n{"ok": 2}\n')
+    t = tail.Tailer()
+    assert t.poll_records(p) == [{"ok": 1}, {"ok": 2}]
+    evts = t.drain_events()
+    assert any("unparseable" in e for e in evts)
+    assert any("non-object" in e for e in evts)
+
+
+def test_poll_records_strict_raises(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    _append(p, 'garbage\n')
+    with pytest.raises(ValueError, match="unparseable"):
+        tail.Tailer().poll_records(p, strict=True)
+
+
+# ---------------------------------------------------------------------------
+# durable checkpoint / resume
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_resume_skips_consumed_history(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    cur = str(tmp_path / "cursor.json")
+    big = "".join(json.dumps({"i": i}) + "\n" for i in range(200))
+    _append(p, big)
+
+    t1 = tail.Tailer(cursor_path=cur)
+    assert len(t1.poll(p)) == 200
+    t1.checkpoint()
+
+    # a restarted tailer resumes at the committed offset: history is
+    # NOT re-read (bytes_read counts only the fresh delta)
+    _append(p, '{"i": 200}\n')
+    t2 = tail.Tailer(cursor_path=cur)
+    assert t2.poll_records(p) == [{"i": 200}]
+    assert t2.bytes_read == len('{"i": 200}\n')
+
+
+def test_checkpoint_preserves_carry(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    cur = str(tmp_path / "cursor.json")
+    _append(p, '{"a": 1}\n{"a": ')
+    t1 = tail.Tailer(cursor_path=cur)
+    assert len(t1.poll(p)) == 1
+    t1.checkpoint()
+
+    _append(p, '2}\n')
+    t2 = tail.Tailer(cursor_path=cur)
+    assert t2.poll_records(p) == [{"a": 2}]
+
+
+def test_bad_cursor_file_starts_from_zero_with_event(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    cur = str(tmp_path / "cursor.json")
+    _append(p, '{"a": 1}\n')
+    with open(cur, "w") as fh:
+        fh.write("{broken")
+    t = tail.Tailer(cursor_path=cur)
+    assert any("unreadable" in e for e in t.drain_events())
+    assert t.poll_records(p) == [{"a": 1}]
+
+
+def test_version_mismatch_cursor_starts_from_zero(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    cur = str(tmp_path / "cursor.json")
+    _append(p, '{"a": 1}\n')
+    with open(cur, "w") as fh:
+        json.dump({"version": 99, "files": {p: {"offset": 9}}}, fh)
+    t = tail.Tailer(cursor_path=cur)
+    assert any("version" in e for e in t.drain_events())
+    assert t.poll_records(p) == [{"a": 1}]

@@ -188,6 +188,97 @@ def test_schema_v5_topology_and_chip_host():
                                "chip": 3, "host": 1})
 
 
+def test_schema_v10_health_records():
+    """v10 (ISSUE 18): heartbeat + liveness join the schema — valid at
+    v10, unknown at every earlier version (old files keep validating
+    cleanly; a v9 reader meeting a heartbeat fails loudly)."""
+    hb = {"emitter": "run", "pid": 4242, "host": "worker-0", "seq": 3,
+          "unix": 1786100000.0, "t": 8, "cadence_s": 5.0,
+          "run_id": "r1", "trace_id": "t-00", "job_id": "j1"}
+    lv = {"emitter": "scheduler", "status": "stuck",
+          "last_unix": 1786100000.0, "last_t": None,
+          "deadline_s": 15.0, "silent_s": 20.0,
+          "message": "scheduler silent 20.0s"}
+    for rtype, fields in (("heartbeat", hb), ("liveness", lv)):
+        telemetry.validate_record({"v": 10, "type": rtype, **fields})
+        for v_old in range(1, 10):
+            with pytest.raises(ValueError, match="unknown record type"):
+                telemetry.validate_record({"v": v_old, "type": rtype,
+                                           **fields})
+    with pytest.raises(ValueError, match="missing 'seq'"):
+        telemetry.validate_record(
+            {"v": 10, "type": "heartbeat", "emitter": "run",
+             "pid": 1, "host": "h", "unix": 1.0, "t": None})
+
+
+def test_heartbeater_emits_at_chunk_boundaries(tmp_path, monkeypatch):
+    """FDTD3D_HEARTBEAT_S=0 (every-boundary mode): each advance()
+    chunk appends one heartbeat row onto the SAME telemetry stream —
+    monotonic seq, the last committed step t, the declared cadence."""
+    monkeypatch.setenv("FDTD3D_HEARTBEAT_S", "0")
+    cfg = _cfg3d(tmp_path)
+    sim = Simulation(cfg)
+    sim.advance(4)
+    sim.advance(4)
+    sim.close_telemetry()
+    recs = telemetry.read_jsonl(cfg.output.telemetry_path)  # validates
+    beats = [r for r in recs if r["type"] == "heartbeat"]
+    assert [(b["seq"], b["t"]) for b in beats] == [(1, 4), (2, 8)]
+    for b in beats:
+        assert b["emitter"] == "run"
+        assert b["cadence_s"] == 0.0
+        assert b["pid"] == os.getpid()
+        # no registry configured -> no run_id identity to stamp (the
+        # None key is dropped, not emitted as null)
+        assert b.get("run_id") == sim.run_id
+    # the surrounding stream is undisturbed
+    assert [r["type"] for r in recs if r["type"] != "heartbeat"] == \
+        ["run_start", "chunk", "chunk", "run_end"]
+
+
+def test_heartbeater_rate_limits_on_cadence(tmp_path, monkeypatch):
+    """A long cadence suppresses boundary beats inside the window: two
+    back-to-back chunks yield exactly one heartbeat."""
+    monkeypatch.setenv("FDTD3D_HEARTBEAT_S", "3600")
+    cfg = _cfg3d(tmp_path)
+    sim = Simulation(cfg)
+    sim.advance(4)
+    sim.advance(4)
+    sim.close_telemetry()
+    beats = [r for r in telemetry.read_jsonl(cfg.output.telemetry_path)
+             if r["type"] == "heartbeat"]
+    assert [(b["seq"], b["t"]) for b in beats] == [(1, 4)]
+
+
+def test_heartbeat_off_is_a_strict_noop(tmp_path, monkeypatch):
+    """Without FDTD3D_HEARTBEAT_S the stream is byte-identical to a
+    v9-shaped run: zero heartbeat rows, zero extra bytes — the knob
+    gates construction, not just emission."""
+    monkeypatch.delenv("FDTD3D_HEARTBEAT_S", raising=False)
+    cfg = _cfg3d(tmp_path)
+    sim = Simulation(cfg)
+    assert sim._heartbeat is None
+    sim.advance(4)
+    sim.advance(4)
+    sim.close_telemetry()
+    raw = open(cfg.output.telemetry_path, "rb").read()
+    assert b"heartbeat" not in raw
+    types = [r["type"]
+             for r in telemetry.read_jsonl(cfg.output.telemetry_path)]
+    assert types == ["run_start", "chunk", "chunk", "run_end"]
+
+
+def test_heartbeat_cadence_bad_values_are_named(monkeypatch):
+    """Garbage/negative FDTD3D_HEARTBEAT_S is a NAMED config error
+    (the registered-knob convention), not a raw float() traceback."""
+    monkeypatch.setenv("FDTD3D_HEARTBEAT_S", "fast")
+    with pytest.raises(ValueError, match="FDTD3D_HEARTBEAT_S='fast'"):
+        telemetry.heartbeat_cadence_s()
+    monkeypatch.setenv("FDTD3D_HEARTBEAT_S", "-5")
+    with pytest.raises(ValueError, match="must be >= 0"):
+        telemetry.heartbeat_cadence_s()
+
+
 # -------------------------------------------------------------------------
 # in-graph guarantee: no full-field host transfer, ≤1 scalar readback
 # -------------------------------------------------------------------------

@@ -72,7 +72,7 @@ def test_telemetry_report_runs_on_fixtures():
     for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl",
                     "telemetry_v5.jsonl", "telemetry_v6.jsonl",
                     "telemetry_v7.jsonl", "queue_v8.jsonl",
-                    "telemetry_v9.jsonl"):
+                    "telemetry_v9.jsonl", "telemetry_v10.jsonl"):
         proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
                      os.path.join(FIX, fixture), "--json"])
         assert proc.returncode == 0, (fixture, proc.stderr)
@@ -104,6 +104,36 @@ def test_telemetry_report_runs_on_fixtures():
     assert proc.returncode == 0, proc.stderr
     assert "per-chip[lane 0]" in proc.stdout
     assert "trace_id=t-00aa11bb22cc33dd" in proc.stdout
+    # the v10 text form prints heartbeat coverage per emitter and the
+    # LIVENESS verdicts in the survived-events summary
+    proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
+                 os.path.join(FIX, "telemetry_v10.jsonl")])
+    assert proc.returncode == 0, proc.stderr
+    assert "heartbeats[run]: 2 beat(s)" in proc.stdout
+    assert "heartbeats[supervisor]: 1 beat(s)" in proc.stdout
+    assert "LIVENESS STUCK: scheduler" in proc.stdout
+    assert "1 LIVENESS flag(s)" in proc.stdout
+
+
+def test_fleet_watch_runs_on_fixture(tmp_path):
+    """tools/fleet_watch.py --once on the v10 fixture: the completed
+    run retires its emitters (no liveness flag even far in the
+    future), while the continuous SLO pass catches the fixture's
+    retry+rollback recovery burst, and the exposition refreshes."""
+    tool = os.path.join(TOOLS, "fleet_watch.py")
+    metrics = str(tmp_path / "watch.prom")
+    proc = _run([tool, "--telemetry",
+                 os.path.join(FIX, "telemetry_v10.jsonl"),
+                 "--once", "--now", "1786200000", "--json",
+                 "--metrics", metrics])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["liveness"] == []  # run_end retired all emitters
+    assert list(rep["slo"].values()) == ["VIOLATION"]
+    assert any(a["rule"] == "recovery-rate" for a in rep["alerts"])
+    exposition = open(metrics).read()
+    assert 'heartbeats_total{emitter="run"} 2' in exposition
+    assert exposition.endswith("# EOF\n")
 
 
 def test_trace_export_runs_on_fixtures(tmp_path):
@@ -122,6 +152,19 @@ def test_trace_export_runs_on_fixtures(tmp_path):
     proc = _run([tool, "--telemetry",
                  os.path.join(FIX, "telemetry_v2.jsonl")])
     assert proc.returncode == 0, proc.stdout + proc.stderr
+    # v10 health rows render as instant events on the owning track,
+    # time-rebased against the trace's span envelope
+    proc = _run([tool, "--telemetry",
+                 os.path.join(FIX, "telemetry_v10.jsonl"),
+                 "--out", out])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "4 health mark(s)" in proc.stdout
+    marks = [e for e in json.load(open(out))["traceEvents"]
+             if e.get("ph") == "i"]
+    assert sorted(m["name"] for m in marks) == \
+        ["heartbeat:run", "heartbeat:run", "heartbeat:supervisor",
+         "liveness:stuck"]
+    assert all(m["cat"] == "health" and m["s"] == "t" for m in marks)
 
 
 def test_slo_gate_runs_on_fixtures(tmp_path):

@@ -1,0 +1,459 @@
+"""Live fleet health plane (ISSUE 18 acceptance): heartbeats,
+incremental tailers, and the liveness/anomaly watcher.
+
+The load-bearing claims under test:
+
+* LIVENESS is pure arithmetic on an injectable clock — no sleeps
+  anywhere in this file. An emitter silent past ``deadline_n x
+  cadence`` is ``stuck``, past 3x the deadline ``lost``; each status
+  alarms exactly once (dedup per escalation), and the emitted
+  ``liveness`` record is schema-v10-valid, naming the emitter and the
+  last committed step t.
+* RETIREMENT: silence that is the normal end of life never alarms — a
+  run emitter retires once its stream's ``run_end`` landed; the
+  scheduler retires once the journal folds all-terminal.
+* ANOMALY: throughput EWMA under the registry-history baseline,
+  queued jobs aging past the wait bound, straggler-ratio trend.
+* CONTINUOUS SLO: the slo.py rules re-fire on the sliding window with
+  per-rule dedup — an ongoing violation alarms once, not once per
+  poll.
+* E2E (chip-free): a ``sched_crash``-faulted scheduler stops
+  heartbeating mid-queue and the watcher NAMES it, while a healthy
+  completed run on the same poll stays green.
+* ``fleet_report --follow`` rides the same cursors: a poll's cost is
+  the appended bytes, not the registry size.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fdtd3d_tpu import faults, jobqueue, metrics, telemetry, watch
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_env(monkeypatch):
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("FDTD3D_HEARTBEAT_S", raising=False)
+    monkeypatch.delenv("FDTD3D_WATCH_INTERVAL_S", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _w(path, *recs):
+    with open(path, "a") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+
+
+def _hb(emitter, unix, seq=1, cadence=5.0, t=None, **kw):
+    return {"v": 10, "type": "heartbeat", "emitter": emitter,
+            "pid": 123, "host": "h0", "seq": seq, "unix": unix,
+            "t": t, "cadence_s": cadence, **kw}
+
+
+def _run_start(**kw):
+    rec = {"v": 10, "type": "run_start", "wall_time": "2026-08-07",
+           "git_sha": "deadbeef", "jax_version": "0.4.37",
+           "platform": "cpu", "device_kind": "cpu", "hbm_gbps": None,
+           "step_kind": "jnp", "grid": [16, 16, 16],
+           "dtype": "float32"}
+    rec.update(kw)
+    return rec
+
+
+def _chunk(t, mcps):
+    return {"v": 10, "type": "chunk", "chunk": t // 4, "t": t,
+            "steps": 4, "wall_s": 0.5, "mcells_per_s": mcps,
+            "energy": 1e-27, "div_l2": 0.01, "div_linf": 0.1,
+            "max_e": 1e-4, "max_h": 1e-7, "finite": True,
+            "vmem_rung": 0}
+
+
+def _run_end(t):
+    return {"v": 10, "type": "run_end", "t": t, "steps": t,
+            "wall_s": 1.0, "mcells_per_s": 5.0,
+            "first_unhealthy_t": None}
+
+
+def _watcher(now, **kw):
+    """FleetWatcher on a mutable injected clock (a 1-element list)."""
+    return watch.FleetWatcher(clock=lambda: now[0], **kw)
+
+
+# -------------------------------------------------------------------------
+# liveness: deadline math, escalation, dedup
+# -------------------------------------------------------------------------
+
+def test_watch_interval_bad_values_are_named(monkeypatch):
+    monkeypatch.setenv("FDTD3D_WATCH_INTERVAL_S", "soon")
+    with pytest.raises(ValueError, match="FDTD3D_WATCH_INTERVAL_S='soon'"):
+        watch.watch_interval_s()
+    monkeypatch.setenv("FDTD3D_WATCH_INTERVAL_S", "0")
+    with pytest.raises(ValueError, match="must be > 0"):
+        watch.watch_interval_s()
+
+
+def test_liveness_stuck_then_lost_alarms_once_per_status(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    _w(p, _run_start(), _hb("run", 1000.0, cadence=5.0, t=4,
+                            run_id="r1"))
+    now = [1005.0]
+    w = _watcher(now, telemetry=[p], interval_s=10.0)
+    # inside the deadline (3 x 5s = 15s): green
+    assert w.poll_once()["liveness"] == []
+    # past the deadline: stuck, once — the second poll at the same
+    # status is deduped
+    now[0] = 1020.0
+    rep = w.poll_once()
+    assert [r["status"] for r in rep["liveness"]] == ["stuck"]
+    rec = rep["liveness"][0]
+    telemetry.validate_record(rec)  # schema-v10-valid as emitted
+    assert rec["v"] == telemetry.SCHEMA_VERSION
+    assert rec["emitter"] == "run" and rec["last_t"] == 4
+    assert rec["run_id"] == "r1"
+    assert rec["silent_s"] == pytest.approx(20.0)
+    assert rec["deadline_s"] == pytest.approx(15.0)
+    assert w.poll_once()["liveness"] == []
+    # past 3 x deadline: the escalation to lost fires exactly once
+    now[0] = 1050.0
+    assert [r["status"] for r in w.poll_once()["liveness"]] == ["lost"]
+    assert w.poll_once()["liveness"] == []
+    # a fresh beat re-arms the emitter
+    _w(p, _hb("run", 1050.0, seq=2, cadence=5.0, t=8))
+    now[0] = 1052.0
+    rep = w.poll_once()
+    assert rep["liveness"] == []
+    assert [e["seq"] for e in rep["emitters"]] == [2]
+
+
+def test_liveness_cadence_zero_uses_watch_interval(tmp_path):
+    """FDTD3D_HEARTBEAT_S=0 (every-boundary mode) declares cadence 0;
+    the watcher's own poll interval is the deadline base then."""
+    p = str(tmp_path / "t.jsonl")
+    _w(p, _run_start(), _hb("run", 1000.0, cadence=0.0))
+    now = [1025.0]
+    w = _watcher(now, telemetry=[p], interval_s=10.0)  # deadline 30
+    assert w.poll_once()["liveness"] == []
+    now[0] = 1035.0
+    assert [r["status"] for r in w.poll_once()["liveness"]] == \
+        ["stuck"]
+
+
+def test_liveness_retires_on_run_end(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    _w(p, _run_start(), _hb("run", 1000.0, cadence=5.0, t=8),
+       _run_end(8))
+    now = [999999.0]  # arbitrarily far in the future
+    rep = _watcher(now, telemetry=[p]).poll_once()
+    assert rep["liveness"] == []
+    assert rep["emitters"][0]["retired"] is True
+
+
+def test_scheduler_retires_only_when_journal_all_terminal(tmp_path):
+    j = str(tmp_path / "journal.jsonl")
+    submit = {"v": 10, "type": "job_submit", "job_id": "j1",
+              "tenant": "acme", "spec": "a.txt", "priority": 0,
+              "cells": 4096, "status": "queued",
+              "wall_time": "2026-08-07", "unix": 1000.0}
+    running = {"v": 10, "type": "job_state", "job_id": "j1",
+               "tenant": "acme", "status": "running", "unix": 1001.0}
+    _w(j, submit, running, _hb("scheduler", 1001.0, cadence=5.0))
+    now = [999999.0]
+    w = _watcher(now, journal=j)
+    rep = w.poll_once()
+    # a job is still non-terminal: the silent scheduler is LOST
+    assert [r["status"] for r in rep["liveness"]] == ["lost"]
+    assert rep["liveness"][0]["emitter"] == "scheduler"
+    # ...until the journal folds terminal — then silence is normal
+    done = {"v": 10, "type": "job_state", "job_id": "j1",
+            "tenant": "acme", "status": "completed", "unix": 1002.0}
+    _w(j, done)
+    rep = w.poll_once()
+    assert rep["liveness"] == []
+    assert rep["emitters"][0]["retired"] is True
+
+
+# -------------------------------------------------------------------------
+# anomaly: EWMA drift, queue-wait aging, straggler trend
+# -------------------------------------------------------------------------
+
+def test_anomaly_throughput_drift_vs_registry_baseline(tmp_path):
+    reg = str(tmp_path / "runs.jsonl")
+    p = str(tmp_path / "t.jsonl")
+    # history: completed runs on the same (step_kind, grid, dtype)
+    # key at ~10 Mcells/s
+    for i, mcps in enumerate((9.0, 10.0, 11.0)):
+        _w(reg, {"v": 10, "type": "run_begin", "run_id": f"r{i}",
+                 "kind": "begin", "status": "running",
+                 "git_sha": "deadbeef", "platform": "cpu",
+                 "wall_time": "2026-08-07", "step_kind": "jnp",
+                 "grid": [16, 16, 16], "dtype": "float32"},
+           {"v": 10, "type": "run_final", "run_id": f"r{i}",
+            "status": "completed", "t": 8, "steps": 8, "wall_s": 1.0,
+            "mcells_per_s": mcps})
+    # live stream: same key crawling at 2 Mcells/s
+    _w(p, _run_start(), _chunk(4, 2.0), _chunk(8, 2.0))
+    now = [2000.0]
+    rep = _watcher(now, registry=reg, telemetry=[p]).poll_once()
+    drift = [a for a in rep["anomalies"]
+             if a["kind"] == "throughput_drift"]
+    assert len(drift) == 1
+    assert drift[0]["baseline_mcells_per_s"] == pytest.approx(10.0)
+    assert drift[0]["ewma_mcells_per_s"] == pytest.approx(2.0)
+    # a healthy stream on the same baseline stays quiet
+    p2 = str(tmp_path / "t2.jsonl")
+    _w(p2, _run_start(), _chunk(4, 9.5), _chunk(8, 10.5))
+    rep2 = _watcher(now, registry=reg, telemetry=[p2]).poll_once()
+    assert [a for a in rep2["anomalies"]
+            if a["kind"] == "throughput_drift"] == []
+
+
+def test_anomaly_queue_wait_aging(tmp_path):
+    j = str(tmp_path / "journal.jsonl")
+    _w(j, {"v": 10, "type": "job_submit", "job_id": "j9",
+           "tenant": "acme", "spec": "a.txt", "priority": 0,
+           "cells": 4096, "status": "queued",
+           "wall_time": "2026-08-07", "unix": 1000.0})
+    now = [1100.0]
+    w = _watcher(now, journal=j, queue_wait_max_s=50.0)
+    aging = [a for a in w.poll_once()["anomalies"]
+             if a["kind"] == "queue_wait_aging"]
+    assert len(aging) == 1
+    assert aging[0]["job_id"] == "j9"
+    assert aging[0]["wait_s"] == pytest.approx(100.0)
+
+
+def test_anomaly_straggler_trend(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    imb = {"v": 10, "type": "imbalance", "chunk": 1, "t": 4,
+           "metric": "wall_s", "max": 3.0, "mean": 1.0, "ratio": 3.0,
+           "argmax": 2, "n_chips": 4}
+    _w(p, _run_start(), imb)
+    now = [2000.0]
+    rep = _watcher(now, telemetry=[p], straggler_max=2.0).poll_once()
+    trend = [a for a in rep["anomalies"]
+             if a["kind"] == "straggler_trend"]
+    assert len(trend) == 1
+    assert trend[0]["ratio_ewma"] == pytest.approx(3.0)
+
+
+# -------------------------------------------------------------------------
+# continuous SLO: sliding window + per-rule dedup
+# -------------------------------------------------------------------------
+
+def test_slo_ongoing_violation_alarms_once(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    retry = {"v": 10, "type": "retry", "t": 4, "attempt": 1,
+             "delay_s": 0.0, "error": "boom", "chip": None, "host": 0}
+    _w(p, _run_start(), _chunk(4, 5.0), retry, retry, retry,
+       _chunk(8, 5.0))
+    now = [2000.0]
+    w = _watcher(now, telemetry=[p])
+    rep = w.poll_once()
+    rules = [a["rule"] for a in rep["alerts"]]
+    assert "recovery-rate" in rules
+    assert list(rep["slo"].values()) == ["VIOLATION"]
+    # nothing new appended: the ongoing violation does NOT re-alarm,
+    # and alerts_total holds still
+    fired = w.metrics.value("alerts_total", rule="recovery-rate")
+    assert fired == 1.0
+    assert w.poll_once()["alerts"] == []
+    assert w.metrics.value("alerts_total",
+                           rule="recovery-rate") == fired
+
+
+# -------------------------------------------------------------------------
+# plumbing: incremental drain, cursor resume, exposition refresh
+# -------------------------------------------------------------------------
+
+def test_poll_is_incremental_and_cursor_resumes(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    cur = str(tmp_path / "cursor.json")
+    _w(p, _run_start(), _chunk(4, 5.0))
+    now = [2000.0]
+    w = _watcher(now, telemetry=[p], cursor_path=cur)
+    assert w.poll_once()["records"] == 2
+    assert w.poll_once()["records"] == 0  # nothing appended
+    _w(p, _chunk(8, 5.0))
+    assert w.poll_once()["records"] == 1
+    # a restarted watcher resumes from the committed cursor: zero
+    # records re-read, zero bytes re-paid
+    w2 = _watcher(now, telemetry=[p], cursor_path=cur)
+    assert w2.poll_once()["records"] == 0
+    assert w2.tailer.bytes_read == 0
+
+
+def test_invalid_record_degrades_to_named_event(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    _w(p, _run_start(), {"v": 10, "type": "no_such_type"})
+    now = [2000.0]
+    rep = _watcher(now, telemetry=[p]).poll_once()
+    assert rep["records"] == 1  # the valid row still landed
+    assert any("invalid record" in e for e in rep["events"])
+
+
+def test_metrics_exposition_refreshes_per_poll(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    prom = str(tmp_path / "watch.prom")
+    _w(p, _run_start(), _hb("run", 1000.0, cadence=5.0, t=4))
+    now = [1002.0]
+    w = _watcher(now, telemetry=[p], metrics_path=prom)
+    w.poll_once()
+    text = open(prom).read()
+    assert 'heartbeats_total{emitter="run"} 1' in text
+    assert "fdtd3d_watch_last_poll_unix 1002" in text
+    assert text.endswith("# EOF\n")
+    _w(p, _hb("run", 1003.0, seq=2, cadence=5.0, t=8))
+    now[0] = 1004.0
+    w.poll_once()
+    assert 'heartbeats_total{emitter="run"} 2' in open(prom).read()
+
+
+def test_liveness_records_append_to_out_path(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    out = str(tmp_path / "watch_out.jsonl")
+    _w(p, _run_start(), _hb("run", 1000.0, cadence=5.0, t=4))
+    now = [999999.0]
+    w = _watcher(now, telemetry=[p], out_path=out)
+    rep = w.poll_once()
+    assert [r["status"] for r in rep["liveness"]] == ["lost"]
+    rows = telemetry.read_jsonl(out)  # validates every row
+    assert [r["type"] for r in rows] == ["liveness"]
+
+
+# -------------------------------------------------------------------------
+# e2e (chip-free): crashed scheduler is NAMED, healthy run stays green
+# -------------------------------------------------------------------------
+
+def test_e2e_crashed_scheduler_named_healthy_run_green(tmp_path,
+                                                       monkeypatch):
+    """The acceptance loop: FDTD3D_HEARTBEAT_S=0 turns on every-
+    boundary heartbeats; a sched_crash fault kills the scheduler
+    BEFORE its first job's post-run journal row (job left "running",
+    beats stop); a separate healthy run completes normally. One
+    watcher poll far in the future flags exactly the scheduler — the
+    finished run's emitter retires instead of alarming."""
+    monkeypatch.setenv("FDTD3D_HEARTBEAT_S", "0")
+    spec = tmp_path / "a.txt"
+    spec.write_text("--3d\n--same-size 12\n--time-steps 8\n"
+                    "--courant-factor 0.4\n--wavelength 0.008\n")
+    q = jobqueue.JobQueue(str(tmp_path / "queue"))
+    job = q.submit(str(spec), tenant="acme")
+    faults.install("sched_crash@job=1")
+    sched = jobqueue.Scheduler(q)
+    with pytest.raises(faults.SimulatedPreemption,
+                       match="scheduler crashed"):
+        sched.serve()
+    faults.clear()
+
+    # the journal now interleaves scheduler heartbeats with job rows —
+    # and the queue fold is UNAFFECTED by them: the crash left the job
+    # mid-flight
+    jobs = q.jobs()
+    assert jobs[job]["status"] == "running"
+    beats = [r for r in telemetry.read_jsonl(q.journal)
+             if r["type"] == "heartbeat"]
+    assert beats and all(b["emitter"] == "scheduler" for b in beats)
+    last_beat = max(b["unix"] for b in beats)
+
+    # a healthy run, heartbeating at every chunk boundary, completes
+    from fdtd3d_tpu.config import (OutputConfig, PmlConfig,
+                                   PointSourceConfig, SimConfig)
+    from fdtd3d_tpu.sim import Simulation
+    stream = str(tmp_path / "healthy.jsonl")
+    sim = Simulation(SimConfig(
+        scheme="3D", size=(16, 16, 16), time_steps=8, dx=1e-3,
+        courant_factor=0.4, wavelength=8e-3,
+        pml=PmlConfig(size=(3, 3, 3)),
+        point_source=PointSourceConfig(enabled=True, component="Ez",
+                                       position=(8, 8, 8)),
+        output=OutputConfig(telemetry_path=stream)))
+    sim.advance(4)
+    sim.advance(4)
+    sim.close_telemetry()
+    assert any(r["type"] == "heartbeat" and r["emitter"] == "run"
+               for r in telemetry.read_jsonl(stream))
+
+    # one poll, clock injected past the deadline (cadence 0 beats use
+    # the watcher interval, 5s -> deadline 15s): the dead scheduler is
+    # STUCK by name with its last beat time; the finished run retired
+    now = [last_beat + 16.0]
+    w = _watcher(now, journal=q.journal, telemetry=[stream],
+                 interval_s=5.0)
+    rep = w.poll_once()
+    assert [(r["emitter"], r["status"]) for r in rep["liveness"]] == \
+        [("scheduler", "stuck")]
+    assert rep["liveness"][0]["last_unix"] == pytest.approx(last_beat)
+    by_emitter = {e["emitter"]: e for e in rep["emitters"]}
+    assert by_emitter["run"]["retired"] is True
+    assert by_emitter["scheduler"]["retired"] is False
+
+    # the CLI drives the same loop: exit 1, scheduler named in text
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "fleet_watch.py"),
+         "--journal", q.journal, "--telemetry", stream,
+         "--once", "--now", str(last_beat + 16.0), "--interval", "5"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "LIVENESS STUCK" in proc.stdout
+    assert "scheduler" in proc.stdout
+
+
+# -------------------------------------------------------------------------
+# fleet_report --follow rides the same cursors (satellite)
+# -------------------------------------------------------------------------
+
+def _load_fleet_report():
+    spec = importlib.util.spec_from_file_location(
+        "fleet_report", os.path.join(TOOLS, "fleet_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_report_follow_poll_cost_is_the_delta(tmp_path):
+    """--follow's FollowState: after the initial fold, re-polling a
+    grown registry costs the appended bytes — NOT another full scan
+    that re-scales with file size."""
+    fr = _load_fleet_report()
+    reg = str(tmp_path / "runs.jsonl")
+
+    def _run_rows(i):
+        return ({"v": 10, "type": "run_begin", "run_id": f"r{i}",
+                 "kind": "begin", "status": "running",
+                 "git_sha": "deadbeef", "platform": "cpu",
+                 "wall_time": "2026-08-07"},
+                {"v": 10, "type": "run_final", "run_id": f"r{i}",
+                 "status": "completed", "t": 8, "steps": 8,
+                 "wall_s": 1.0, "mcells_per_s": 5.0})
+
+    for i in range(200):
+        _w(reg, *_run_rows(i))
+    st = fr.FollowState(reg)
+    roll = st.poll(force=True)
+    assert roll["fleet"]["by_status"] == {"completed": 200}
+    cost_initial = st.tailer.bytes_read
+    assert cost_initial >= os.path.getsize(reg)  # first fold pays all
+
+    # no growth -> no re-fold at all
+    assert st.poll() is None
+
+    # one appended run -> the poll pays ~2 rows, not 200 re-read
+    _w(reg, *_run_rows(200))
+    roll = st.poll()
+    assert roll["fleet"]["by_status"] == {"completed": 201}
+    delta = st.tailer.bytes_read - cost_initial
+    assert 0 < delta <= len("".join(
+        json.dumps(r) + "\n" for r in _run_rows(200))) + 1
+    assert delta < cost_initial / 50  # does not re-scale with size

@@ -36,8 +36,10 @@ ROADMAP items 2c/3's queue and scheduler will select against:
   when phases overlap (a first chunk's wall contains its compile).
 
 ``--json`` emits the rollup as one JSON object (deterministic — the
-tests' surface); ``--follow`` tails the registry live (re-folding
-when the file grows; Ctrl-C exits cleanly).
+tests' surface); ``--follow`` tails the registry live through an
+incremental ``fdtd3d_tpu/tail.py`` cursor (each poll reads only the
+appended bytes and re-folds from accumulated rows — never the whole
+file again; Ctrl-C exits cleanly).
 
 Exit codes: 0 = report produced; 1 = registry unreadable; 2 = usage.
 """
@@ -55,6 +57,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
 
 from fdtd3d_tpu import registry as run_registry  # noqa: E402
+from fdtd3d_tpu import tail as tail_mod  # noqa: E402
 from fdtd3d_tpu import telemetry  # noqa: E402
 from fdtd3d_tpu.log import report, warn  # noqa: E402
 
@@ -165,10 +168,16 @@ def latency_decomposition(spans: List[Dict[str, Any]],
 
 
 def build_rollup(registry_path: str,
-                 journal_path: Optional[str] = None
+                 journal_path: Optional[str] = None,
+                 rows: Optional[List[Dict[str, Any]]] = None
                  ) -> Dict[str, Any]:
-    """The one-shot fleet snapshot (``--json`` emits it verbatim)."""
-    rows = run_registry.read(registry_path)
+    """The one-shot fleet snapshot (``--json`` emits it verbatim).
+
+    ``rows`` short-circuits the registry read: ``--follow`` passes
+    the rows its tail cursor accumulated, so a poll never re-reads
+    the file it already consumed."""
+    if rows is None:
+        rows = run_registry.read(registry_path)
     runs = run_registry.fold(rows)
 
     # trace-plane joins: spans from the queue journal (--journal) and
@@ -309,6 +318,40 @@ def build_rollup(registry_path: str,
     }
 
 
+class FollowState:
+    """Incremental registry fold for ``--follow``.
+
+    One :class:`fdtd3d_tpu.tail.Tailer` cursor per registry file:
+    each poll reads only the bytes appended since the last one
+    (``tailer.bytes_read`` is the audit counter the test asserts on)
+    and accumulates validated rows, so the rollup re-folds from
+    memory — the registry is never re-read, no matter how large it
+    grows. Heartbeat rows (schema v10) sharing the stream are
+    skipped: they carry no registry state."""
+
+    def __init__(self, registry_path: str,
+                 journal_path: Optional[str] = None):
+        self.registry_path = registry_path
+        self.journal_path = journal_path
+        self.tailer = tail_mod.Tailer()
+        self.rows: List[Dict[str, Any]] = []
+
+    def poll(self, force: bool = False) -> Optional[Dict[str, Any]]:
+        """Fold in whatever was appended since the last poll; returns
+        the fresh rollup, or None when nothing changed (``force``
+        builds one regardless — the initial print)."""
+        new = self.tailer.poll_records(self.registry_path)
+        for rec in new:
+            telemetry.validate_record(rec)
+        self.rows.extend(r for r in new
+                         if r.get("type") != "heartbeat")
+        if not new and not force:
+            return None
+        return build_rollup(self.registry_path,
+                            journal_path=self.journal_path,
+                            rows=list(self.rows))
+
+
 def format_text(rollup: Dict[str, Any]) -> str:
     fleet = rollup["fleet"]
     lines = [f"fleet: {fleet['n_runs']} run(s) "
@@ -389,9 +432,14 @@ def main(argv=None) -> int:
         warn(f"{args.registry}: no such registry (set "
              f"FDTD3D_RUN_REGISTRY to start one)")
         return 1
+    follow = FollowState(args.registry, journal_path=args.journal) \
+        if args.follow else None
     try:
-        rollup = build_rollup(args.registry,
-                              journal_path=args.journal)
+        if follow is not None:
+            rollup = follow.poll(force=True)
+        else:
+            rollup = build_rollup(args.registry,
+                                  journal_path=args.journal)
     except ValueError as exc:
         warn(f"{args.registry}: {exc}")
         return 1
@@ -399,21 +447,18 @@ def main(argv=None) -> int:
         report(json.dumps(rollup, indent=1))
     else:
         report(format_text(rollup))
-    if not args.follow:
+    if follow is None:
         return 0
-    last_size = os.path.getsize(args.registry)
     try:
         while True:
             time.sleep(args.interval)
             try:
-                size = os.path.getsize(args.registry)
-            except OSError:
+                rollup = follow.poll()
+            except ValueError as exc:
+                warn(f"{args.registry}: {exc}")
+                return 1
+            if rollup is None:
                 continue
-            if size == last_size:
-                continue
-            last_size = size
-            rollup = build_rollup(args.registry,
-                                  journal_path=args.journal)
             report("")
             report(format_text(rollup))
     except KeyboardInterrupt:

@@ -1,0 +1,146 @@
+"""Live fleet health watcher: liveness + anomaly + continuous SLO.
+
+Usage:
+    python tools/fleet_watch.py [--registry RUNS.jsonl]
+        [--journal QUEUE.jsonl] [--telemetry STREAM.jsonl ...]
+        [--once | --interval S] [--now EPOCH] [--deadline-n N]
+        [--cursor CURSOR.json] [--metrics METRICS.prom]
+        [--out WATCH.jsonl] [--rules RULES.json]
+        [--bench-best BENCH_BEST.json] [--json]
+
+The streaming counterpart of ``fleet_report.py``: instead of folding
+finished runs, it tails the run registry, the queue journal and any
+number of telemetry streams INCREMENTALLY (``fdtd3d_tpu/tail.py``
+cursors — each poll costs the appended bytes, and ``--cursor`` makes
+the position durable across watcher restarts) and flags, each poll:
+
+* LIVENESS — emitters that stopped heartbeating (schema v10
+  ``heartbeat`` rows, ``FDTD3D_HEARTBEAT_S``) past ``--deadline-n``
+  x their declared cadence: ``stuck``, then ``lost`` at 3x the
+  deadline. Emitters retire silently when their end is normal (a
+  run's ``run_end`` landed; the journal folds all-terminal).
+* ANOMALY — per-(step_kind, grid, dtype) throughput EWMA under the
+  registry-history/BENCH_BEST baseline, queued jobs aging past the
+  queue-wait bound, straggler-ratio EWMA trend.
+* SLO — the ``slo.py`` rules re-evaluated on each stream's sliding
+  window, firing the usual ``alert`` records + ``alerts_total``
+  metrics (deduped while a violation is ongoing).
+
+``--now`` injects the clock (deadline math becomes pure arithmetic —
+the test surface); ``--once`` does one deterministic poll and exits.
+``--metrics`` atomically refreshes an OpenMetrics exposition per
+poll; ``--out`` appends the fired liveness/alert records as JSONL.
+
+Exit codes: 0 = all green; 1 = something flagged; 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
+
+from fdtd3d_tpu import slo as slo_mod  # noqa: E402
+from fdtd3d_tpu import watch as watch_mod  # noqa: E402
+from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="tail registry/journal/telemetry streams and "
+                    "flag liveness, anomaly and SLO verdicts while "
+                    "the fleet runs")
+    ap.add_argument("--registry", default=None,
+                    help="runs.jsonl (FDTD3D_RUN_REGISTRY)")
+    ap.add_argument("--journal", default=None,
+                    help="queue journal JSONL (scheduler heartbeats "
+                         "+ queue-wait aging)")
+    ap.add_argument("--telemetry", action="append", default=[],
+                    metavar="PATH",
+                    help="telemetry stream JSONL (repeatable)")
+    ap.add_argument("--once", action="store_true",
+                    help="one deterministic poll, then exit (tests/CI)")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="poll interval seconds (default "
+                         "FDTD3D_WATCH_INTERVAL_S or 10)")
+    ap.add_argument("--now", type=float, default=None, metavar="EPOCH",
+                    help="injectable clock: evaluate liveness "
+                         "deadlines at this wall time instead of "
+                         "time.time() (deterministic tests)")
+    ap.add_argument("--deadline-n", type=int, default=3,
+                    help="liveness deadline = N x heartbeat cadence")
+    ap.add_argument("--cursor", default=None, metavar="PATH",
+                    help="durable tail-cursor checkpoint (resume "
+                         "without re-reading history)")
+    ap.add_argument("--metrics", default=None, metavar="PATH",
+                    help="OpenMetrics exposition, atomically "
+                         "refreshed each poll")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="append fired liveness/alert records here "
+                         "as JSONL")
+    ap.add_argument("--rules", default=None, metavar="PATH",
+                    help="SLO rules JSON (tools/slo_gate.py format; "
+                         "default DEFAULT_RULES)")
+    ap.add_argument("--bench-best", default=None, metavar="PATH",
+                    help="BENCH_BEST.json throughput reference for "
+                         "the drift baseline + throughput-floor rule")
+    ap.add_argument("--queue-wait-max", type=float, default=300.0,
+                    help="queue-wait aging bound, seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="emit each poll's report as one JSON object")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        try:
+            with open(args.rules) as fh:
+                rules = slo_mod.rules_from_json(json.load(fh))
+        except (OSError, ValueError) as exc:
+            warn(f"--rules {args.rules}: {exc}")
+            return 2
+    context = {}
+    if args.bench_best:
+        try:
+            with open(args.bench_best) as fh:
+                context["bench_best"] = json.load(fh)
+        except (OSError, ValueError) as exc:
+            warn(f"--bench-best {args.bench_best}: {exc}")
+            return 2
+    if not (args.registry or args.journal or args.telemetry):
+        warn("nothing to watch: pass --registry, --journal and/or "
+             "--telemetry")
+        return 2
+
+    clock = (lambda: args.now) if args.now is not None else time.time
+    watcher = watch_mod.FleetWatcher(
+        registry=args.registry, journal=args.journal,
+        telemetry=args.telemetry, metrics_path=args.metrics,
+        out_path=args.out, cursor_path=args.cursor, clock=clock,
+        interval_s=args.interval, deadline_n=args.deadline_n,
+        rules=rules, context=context,
+        queue_wait_max_s=args.queue_wait_max)
+
+    flagged = False
+    try:
+        while True:
+            rep = watcher.poll_once()
+            flagged = watcher.flagged(rep) or flagged
+            if args.json:
+                report(json.dumps(rep, indent=1))
+            else:
+                report(watch_mod.format_report(rep))
+            if args.once:
+                break
+            time.sleep(watcher.interval_s)
+    except KeyboardInterrupt:
+        pass
+    return 1 if flagged else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

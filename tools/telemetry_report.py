@@ -27,6 +27,10 @@ bench stages append):
   tools/trace_export.py renders the full timeline), plus PER-LANE
   per-chip imbalance for batched runs: each coalesced-group member's
   own straggler chip, named by (lane, chip)
+* live health plane (schema v10): heartbeat coverage per emitter
+  (beat count, widest silent gap) and any LIVENESS verdicts the
+  watcher (tools/fleet_watch.py) appended, counted beside recovery
+  events and alerts in the survived-events summary
 
 ``--json`` emits the same summary as one JSON object per run instead
 of text (for dashboards / the driver).
@@ -162,6 +166,27 @@ def summarize_run(run):
             phases[r["name"]] = phases.get(r["name"], 0) + 1
         out["spans"] = {"n": len(spans),
                         "phases": dict(sorted(phases.items()))}
+    # live health plane (schema v10): heartbeat coverage per emitter
+    # (how often it beat, and the widest silent gap — the liveness
+    # watcher's raw material) + any liveness verdicts in the stream
+    beats = [r for r in run if r["type"] == "heartbeat"]
+    if beats:
+        by_emitter = {}
+        for r in beats:
+            by_emitter.setdefault(r["emitter"], []).append(
+                float(r["unix"]))
+        cov = {}
+        for em, times in sorted(by_emitter.items()):
+            times.sort()
+            gaps = [b - a for a, b in zip(times, times[1:])]
+            cov[em] = {"beats": len(times),
+                       "last_unix": times[-1],
+                       "max_gap_s": (round(max(gaps), 3) if gaps
+                                     else None)}
+        out["heartbeats"] = cov
+    liveness = [r for r in run if r["type"] == "liveness"]
+    if liveness:
+        out["liveness"] = liveness
     if not chunks:
         return out
     walls = [c["wall_s"] for c in chunks]
@@ -325,16 +350,30 @@ def format_text(summaries) -> str:
             lines.append(f"  ALERT [{a['rule']}] fired over "
                          f"({a['t_start']}, {a['t_end']}]: "
                          f"{a['message']}")
+        for em, cov in (s.get("heartbeats") or {}).items():
+            lines.append(
+                f"  heartbeats[{em}]: {cov['beats']} beat(s)"
+                + (f", max gap {cov['max_gap_s']:.1f}s"
+                   if cov["max_gap_s"] is not None else ""))
+        for r in s.get("liveness", []):
+            lines.append(
+                f"  LIVENESS {str(r['status']).upper()}: "
+                f"{r['emitter']} silent {r['silent_s']:.1f}s "
+                f"(deadline {r['deadline_s']:.1f}s, last t="
+                f"{r.get('last_t')}): {r['message']}")
         n_rec = sum(len(v) for v in rec.values())
         n_alerts = len(s.get("alerts", []))
-        if n_rec or n_alerts:
+        n_live = len(s.get("liveness", []))
+        if n_rec or n_alerts or n_live:
             lines.append(f"  survived {n_rec} recovery events "
                          f"(retries {len(rec['retries'])}, rollbacks "
                          f"{len(rec['rollbacks'])}, degrades "
                          f"{len(rec['degrades'])}, topology changes "
                          f"{len(rec.get('topology_changes', []))})"
                          + (f", {n_alerts} SLO alert(s) fired"
-                            if n_alerts else ""))
+                            if n_alerts else "")
+                         + (f", {n_live} LIVENESS flag(s)"
+                            if n_live else ""))
     return "\n".join(lines)
 
 

@@ -18,6 +18,10 @@ emit ONE trace-viewer JSON — load it in Perfetto
 * LANES AS CHILD TRACKS (threads): a span carrying ``lane`` (a
   coalesced group member's queue wait, its batch-lane rollback)
   renders under ``lane N`` inside its tenant's track;
+* HEALTH MARKS AS INSTANT EVENTS (``ph: "i"``, schema v10): every
+  trace-stamped ``heartbeat`` ticks on its owning tenant track and
+  every watcher ``liveness`` verdict lands as a named mark — a stuck
+  job reads as ticks that stop, then the verdict;
 * QUEUE PHASES AS FLOW EVENTS (``ph: s/f`` arrows): each journal-side
   phase span (admission, queue_wait, coalesce, rollback, resume)
   arrows to the next span of the same trace, so the hand-off from the
@@ -68,6 +72,7 @@ def collect(paths: List[str],
     tenant join (and each run's telemetry_path artifact pointer,
     auto-followed so ``--registry`` alone finds the executor spans)."""
     spans: List[Dict[str, Any]] = []
+    marks: List[Dict[str, Any]] = []
     seen_ids: set = set()
     tenant_of_trace: Dict[str, str] = {}
     tenant_of_job: Dict[str, str] = {}
@@ -80,6 +85,11 @@ def collect(paths: List[str],
                 return
             seen_ids.add(sid)
             spans.append(rec)
+        elif rtype in ("heartbeat", "liveness") \
+                and rec.get("trace_id"):
+            # v10 health rows: instant events on the owning track —
+            # a stuck job's heartbeat GAP is visible on its trace
+            marks.append(rec)
         tid = rec.get("trace_id")
         ten = rec.get("tenant")
         if tid and ten:
@@ -100,7 +110,8 @@ def collect(paths: List[str],
     for path in stream_paths:
         for rec in telemetry.read_jsonl(path):
             _take(rec)
-    return {"spans": spans, "tenant_of_trace": tenant_of_trace,
+    return {"spans": spans, "marks": marks,
+            "tenant_of_trace": tenant_of_trace,
             "tenant_of_job": tenant_of_job}
 
 
@@ -202,6 +213,38 @@ def build_export(joined: Dict[str, Any],
         summ["t0"] = min(summ["t0"], float(s["t0"]))
         summ["t1"] = max(summ["t1"], float(s["t1"]))
 
+    # v10 health rows -> instant events on the owning tenant track:
+    # each heartbeat is a tick, each liveness verdict a named mark —
+    # in Perfetto, a stuck job reads as ticks that STOP, then the
+    # liveness mark where the watcher declared it
+    for m in sorted(joined.get("marks", ()),
+                    key=lambda r: float(r.get("unix",
+                                              r.get("last_unix", 0)))):
+        if trace_filter is not None \
+                and m.get("trace_id") != trace_filter:
+            continue
+        if job_filter is not None and m.get("job_id") != job_filter:
+            continue
+        tenant = _tenant_of(m, joined)
+        pid = pids.get(tenant)
+        if pid is None:
+            continue  # no spans -> no owning track to pin it to
+        is_beat = m.get("type") == "heartbeat"
+        when = m.get("unix") if is_beat else m.get("last_unix")
+        if when is None:
+            continue
+        name = (f"heartbeat:{m.get('emitter')}" if is_beat
+                else f"liveness:{m.get('status')}")
+        args = {k: m[k] for k in ("emitter", "seq", "t", "status",
+                                  "silent_s", "deadline_s", "last_t",
+                                  "message", "trace_id", "job_id",
+                                  "run_id")
+                if m.get(k) is not None}
+        events.append({"ph": "i", "s": "t", "pid": pid,
+                       "tid": tids.get((pid, None), 0),
+                       "name": name, "cat": "health",
+                       "ts": max(_us(when), 0), "args": args})
+
     # queue phases -> flow arrows into the trace's next span
     flow_id = 0
     for tkey, tspans in per_trace.items():
@@ -234,8 +277,11 @@ def format_text(export: Dict[str, Any]) -> str:
     traces = export["fdtd3d_traces"]
     n_ev = sum(1 for e in export["traceEvents"]
                if e.get("ph") == "X")
+    n_marks = sum(1 for e in export["traceEvents"]
+                  if e.get("ph") == "i")
     lines = [f"trace export: {len(traces)} trace(s), "
-             f"{n_ev} span event(s)"]
+             f"{n_ev} span event(s)"
+             + (f", {n_marks} health mark(s)" if n_marks else "")]
     for tkey, summ in sorted(traces.items()):
         lines.append(
             f"  {tkey}: tenant {summ['tenant']} job "
