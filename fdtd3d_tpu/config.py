@@ -180,6 +180,14 @@ ENV_KNOBS: Dict[str, EnvKnob] = {k.name: k for k in (
           "presumed heartbeat spacing for liveness-deadline math "
           "when a beat declares no cadence (or the 0 every-boundary "
           "mode). Unset: 10."),
+    _knob("FDTD3D_LEASE_TTL_S", "str", None,
+          "Scheduler lease time-to-live, seconds (fdtd3d_tpu/"
+          "jobqueue.py, schema v11): a scheduler's fenced dispatch "
+          "lease on its queue journal expires this long after its "
+          "last acquire/renew row, measured on the scheduler's "
+          "injectable clock — an expired lease is what a peer (or "
+          "fleet_watch --evict) may take over with a higher fencing "
+          "token. Renewed every scheduling cycle. Unset: 30."),
 )}
 
 

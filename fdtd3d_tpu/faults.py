@@ -57,14 +57,27 @@ Fault kinds:
     the file / zero bytes mid-file; for an orbax directory, delete its
     COMMIT marker) — proving the integrity checks catch it and resume
     falls back to an older snapshot.
-``sched_crash@job=N``
+``sched_crash@job=N`` / ``sched_crash@between=acquire,dispatch``
     Kill the job-queue SCHEDULER (fdtd3d_tpu/jobqueue.py) between its
-    journal writes: the Nth dispatched job's run finishes, and the
-    :class:`SimulatedPreemption` fires BEFORE its post-run journal row
-    lands — the stand-in for the scheduler process dying mid-commit.
-    The journal then still reads the job as ``running``; a restarted
-    scheduler must re-drive it to a terminal state from the append-only
-    journal alone (the crash-safety contract docs/SERVICE.md proves).
+    journal writes. ``job=N``: the Nth dispatched job's run finishes,
+    and the :class:`SimulatedPreemption` fires BEFORE its post-run
+    journal row lands — the stand-in for the scheduler process dying
+    mid-commit. The journal then still reads the job as ``running``; a
+    restarted scheduler must re-drive it to a terminal state from the
+    append-only journal alone (the crash-safety contract
+    docs/SERVICE.md proves). ``between=acquire,dispatch`` /
+    ``between=renew,commit`` instead kill the scheduler at a LEASE
+    boundary: immediately after its ``lease_acquire`` (resp. first
+    ``lease_renew``) row lands and before the next dispatch commits —
+    the two races the fenced-lease takeover protocol must survive (a
+    held-but-idle lease expires; a renewed lease dies mid-tenure).
+``lease_expire@job=N``
+    Turn the scheduler into a deterministic ZOMBIE from its Nth
+    dispatch onward: it stops renewing its lease and stops checking
+    its own expiry, so (on the injectable clock) a peer's fenced
+    takeover and the fold's stale-token rejection are provable without
+    sleeping — the stand-in for a paused/partitioned scheduler that
+    keeps writing after its lease lapsed.
 
 All faults are one-shot (``times`` generalizes that for ``error``), so
 a rolled-back run does not re-fire them — exactly the semantics of a
@@ -106,7 +119,7 @@ class InjectedWriteError(OSError):
 
 
 _KINDS = ("nan", "preempt", "error", "fail_write", "corrupt_ckpt",
-          "host_lost", "sched_crash")
+          "host_lost", "sched_crash", "lease_expire")
 
 # Keys each kind actually reads: a key the kind would silently ignore
 # (e.g. fail_write@...,chip=1 where host= was meant) is a plan that
@@ -118,8 +131,15 @@ _KIND_KEYS = {
     "fail_write": ("n", "host"),
     "corrupt_ckpt": ("n", "mode"),
     "host_lost": ("n",),
-    "sched_crash": ("job",),
+    "sched_crash": ("job", "between"),
+    "lease_expire": ("job",),
 }
+
+# The lease-boundary windows sched_crash@between= accepts, mapped to
+# the on_lease_boundary event that arms them (the kill fires right
+# after that lease row lands, before the window's second half runs).
+_BETWEEN_EVENTS = {"acquire,dispatch": "acquire",
+                   "renew,commit": "renew"}
 
 
 @dataclasses.dataclass
@@ -135,8 +155,11 @@ class Fault:
     chip: Optional[int] = None  # chip scope (nan): mesh-linearized id
     host: Optional[int] = None  # host scope (fail_write)
     lane: Optional[int] = None  # batch-lane scope (nan): vmap lane id
-    job: Optional[int] = None   # dispatch ordinal (sched_crash): the
-    #                             Nth job the scheduler dispatched
+    job: Optional[int] = None   # dispatch ordinal (sched_crash /
+    #                             lease_expire): the Nth job the
+    #                             scheduler dispatched
+    between: Optional[str] = None  # lease-boundary window
+    #                             (sched_crash): a _BETWEEN_EVENTS key
     fired: int = 0        # firings so far (one-shot bookkeeping)
 
 
@@ -167,14 +190,23 @@ class FaultPlan:
                     f"unknown fault kind {kind!r} in plan entry "
                     f"{entry!r} (valid: {', '.join(_KINDS)})")
             f = Fault(kind=kind)
-            for kv in rest.split(","):
-                kv = kv.strip()
+            tokens = [kv.strip() for kv in rest.split(",")]
+            i = 0
+            while i < len(tokens):
+                kv = tokens[i]
+                i += 1
                 if not kv:
                     continue
                 key, _, val = kv.partition("=")
                 key, val = key.strip(), val.strip()
+                if key == "between" and i < len(tokens) \
+                        and "=" not in tokens[i]:
+                    # the window pair's second half was split off by
+                    # the comma (between=acquire,dispatch): rejoin it
+                    val = f"{val},{tokens[i]}"
+                    i += 1
                 if key in ("t", "n", "times", "chip", "host", "lane",
-                           "job", "field", "mode") \
+                           "job", "field", "mode", "between") \
                         and key not in _KIND_KEYS[kind]:
                     raise ValueError(
                         f"fault-plan key {key!r} does not apply to "
@@ -188,17 +220,35 @@ class FaultPlan:
                         raise ValueError(
                             f"fault plan entry {entry!r}: {key} must be "
                             f"an integer, got {val!r}")
+                elif key == "between":
+                    if val not in _BETWEEN_EVENTS:
+                        raise ValueError(
+                            f"fault plan entry {entry!r}: between must "
+                            f"be one of "
+                            f"{' | '.join(sorted(_BETWEEN_EVENTS))}, "
+                            f"got {val!r}")
+                    f.between = val
                 elif key in ("field", "mode"):
                     setattr(f, key, val)
                 else:
                     raise ValueError(
                         f"unknown fault-plan key {key!r} in {entry!r} "
                         f"(valid: t, n, times, field, mode, chip, "
-                        f"host, lane, job)")
+                        f"host, lane, job, between)")
             if f.mode not in ("truncate", "zero"):
                 raise ValueError(
                     f"fault plan entry {entry!r}: mode must be "
                     f"truncate|zero, got {f.mode!r}")
+            if kind == "sched_crash" and (f.job is None) \
+                    == (f.between is None):
+                raise ValueError(
+                    f"fault plan entry {entry!r}: sched_crash needs "
+                    f"exactly one of job=N or between=<window>")
+            if kind == "lease_expire" and f.job is None:
+                raise ValueError(
+                    f"fault plan entry {entry!r}: lease_expire needs "
+                    f"job=N (the dispatch ordinal the zombie window "
+                    f"opens at)")
             faults.append(f)
         return cls(faults)
 
@@ -341,6 +391,46 @@ def on_sched_journal(job_ordinal: int) -> None:
                 f"fault plan: scheduler crashed after dispatch "
                 f"#{job_ordinal}'s run, before its journal write "
                 f"(injected)")
+
+
+def on_lease_boundary(event: str) -> None:
+    """From the scheduler's lease plane (fdtd3d_tpu/jobqueue.py),
+    immediately AFTER a lease row of kind ``event`` ("acquire" /
+    "renew") landed in the journal: a ``sched_crash@between=...``
+    fault whose window opens at that event kills the scheduler right
+    there — the lease row is durable, the window's second half
+    (dispatch / cycle commit) never runs. The journal then shows a
+    held lease with zero progress behind it, which is exactly the
+    tenure a peer's deadline math must expire and fence out."""
+    if _PLAN is None:
+        return
+    for f in _PLAN.faults:
+        if f.kind == "sched_crash" and not f.fired \
+                and f.between is not None \
+                and _BETWEEN_EVENTS[f.between] == event:
+            f.fired = 1
+            a, b = f.between.split(",")
+            raise SimulatedPreemption(
+                f"fault plan: scheduler crashed between {a} and {b} "
+                f"(after its lease_{event} row landed; injected)")
+
+
+def lease_zombie(dispatch_ordinal: int) -> bool:
+    """From the scheduler's lease plane, once per cycle: True exactly
+    once, when a ``lease_expire@job=N`` fault's dispatch ordinal is
+    reached. The scheduler then flips itself into ZOMBIE mode — it
+    stops renewing its lease and stops honoring its own expiry — and
+    keeps dispatching, so the fold's stale-token rejection (not the
+    zombie's good behavior) is what the test proves. One-shot like
+    every fault; the scheduler remembers the flip itself."""
+    if _PLAN is None:
+        return False
+    for f in _PLAN.faults:
+        if f.kind == "lease_expire" and not f.fired \
+                and f.job is not None and dispatch_ordinal >= f.job:
+            f.fired = 1
+            return True
+    return False
 
 
 def on_checkpoint(path: str) -> None:

@@ -71,6 +71,41 @@ the newest committed one — bit-identical to an uninterrupted run,
 with the resume t journaled on the re-dispatch's ``running`` rows as
 ``resumed_from`` (docs/SERVICE.md's recovery matrix).
 
+**Fenced multi-scheduler leases (schema v11).** N scheduler processes
+may share ONE journal: dispatch right is a single-holder lease
+journaled as ``lease_acquire`` / ``lease_renew`` / ``lease_release``
+rows with a monotonic fencing ``token`` (max token ever granted + 1 at
+each acquire). Every ``job_state`` row a leased scheduler writes
+carries its token (``fence``) and identity (``sched``:
+host:pid:start — the same stamps its heartbeats carry), and the
+:func:`fold` REJECTS a job_state row whose fence is staler than the
+newest ``lease_acquire`` that precedes it in the journal — the classic
+fenced-lock rule. The soundness argument rides the append-only order:
+a new holder's acquire row necessarily lands before any of its
+dispatch rows, so a zombie's write is either harmless (it landed
+before any takeover — no conflicting dispatcher existed yet) or
+provably stale (it landed after, bearing a smaller token). Leases
+expire by deadline math (``unix + ttl_s``, FDTD3D_LEASE_TTL_S) on an
+injectable clock — no sleeps anywhere in tier-1 — and are renewed once
+per scheduling cycle. A dead holder's jobs are recovered by TAKEOVER:
+the next acquire (a restarted peer, or ``fleet_watch --evict`` driven
+by the watcher's lost verdict) carries ``takeover_from`` naming the
+expired holder, and the new holder requeues its orphaned
+running/preempted jobs; the per-job checkpoints and per-group
+snapshots make the re-dispatch bit-identical.
+
+**Journal compaction.** :meth:`JobQueue.compact` folds the journal
+into a snapshot row-set (one submit row + one current-state row per
+job, the lease lineage, live jobs' spans) published atomically as a
+NEW file via ``io.atomic_open`` — ``tail.py`` consumers observe a
+named rotation (inode change), never silent truncation, and re-fold to
+the identical state: ``fold(compacted) == fold(original)`` is asserted
+before publish (jobs, ages, lease, max fencing token all survive).
+Each submit row's ``age_base`` key re-bases the priority-aging clock
+so aging survives the fold. Compaction refuses while a live unexpired
+lease is held by anyone — the holder is mid-tenure and O_APPEND rows
+racing the rename would be lost.
+
 Every dispatch runs inside :func:`fdtd3d_tpu.registry.job_context`,
 so the run-registry row and the telemetry run_start carry the
 ``job_id`` — ``tools/fleet_report.py`` / ``tools/slo_gate.py`` /
@@ -95,8 +130,9 @@ import dataclasses
 import hashlib
 import json
 import os
+import socket
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from fdtd3d_tpu import faults as _faults
 from fdtd3d_tpu import log as _log
@@ -104,6 +140,7 @@ from fdtd3d_tpu import telemetry as _telemetry
 
 QUEUE_DIR_KNOB = "FDTD3D_JOB_QUEUE_DIR"
 TENANT_KNOB = "FDTD3D_QUEUE_TENANT"
+LEASE_TTL_KNOB = "FDTD3D_LEASE_TTL_S"
 JOURNAL_NAME = "journal.jsonl"
 
 # the job lifecycle (journal `status` values). queued -> running ->
@@ -126,6 +163,57 @@ def default_tenant() -> str:
     "default") — multi-tenant CI lanes export it once instead of
     passing ``--tenant`` on every submit."""
     return os.environ.get(TENANT_KNOB) or "default"
+
+
+def lease_ttl_s() -> float:
+    """The scheduler-lease time-to-live (``FDTD3D_LEASE_TTL_S``;
+    default 30 s): a lease whose last acquire/renew row is older than
+    this — on the INJECTABLE clock, never the wall clock in tier-1 —
+    is expired, and a peer may take it over with a higher fencing
+    token."""
+    raw = os.environ.get(LEASE_TTL_KNOB, "").strip()
+    if not raw:
+        return 30.0
+    try:
+        ttl = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{LEASE_TTL_KNOB}={raw!r}: lease TTL must be a number "
+            f"of seconds") from None
+    if ttl <= 0:
+        raise ValueError(
+            f"{LEASE_TTL_KNOB}={raw!r}: lease TTL must be > 0 (an "
+            f"instantly-expired lease fences nobody)")
+    return ttl
+
+
+class LeaseHeld(RuntimeError):
+    """Lease acquisition refused: another scheduler's lease is live
+    (unreleased and unexpired on the caller's clock). Always NAMES the
+    holder and its deadline — a silent wait would be a sleep, and a
+    silent steal would break the fencing argument."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedIdentity:
+    """One scheduler process's lease identity: pid + host + start
+    (the clock reading at construction) — the same stamps its
+    heartbeats carry, so lease rows join liveness verdicts without a
+    side table. ``sched`` is the canonical identity string every
+    lease row and fenced job_state row carries."""
+
+    pid: int
+    host: str
+    start: float
+
+    @property
+    def sched(self) -> str:
+        return f"{self.host}:{self.pid}:{self.start:g}"
+
+    @classmethod
+    def mine(cls, now: Optional[float] = None) -> "SchedIdentity":
+        return cls(pid=os.getpid(), host=socket.gethostname(),
+                   start=float(time.time() if now is None else now))
 
 
 class QuotaError(ValueError):
@@ -293,6 +381,102 @@ def score_topology(cfg, n_devices: int,
 # --------------------------------------------------------------------------
 
 
+def fold(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Replay a journal's rows into its current state — THE fold every
+    consumer shares (JobQueue.jobs/lease_state, compaction's identity
+    assertion, the watcher's retirement rule, the status CLI).
+
+    Returns ``{"jobs", "lease", "max_token", "stale_rejected"}``:
+
+    * ``jobs``: job_id -> current row (the submit row's fields
+      overlaid by every ACCEPTED later transition; last status wins).
+      Each row carries ``age`` — the priority-aging clock: the count
+      of terminal transitions journaled after its submit row, plus the
+      submit row's ``age_base`` when compaction re-based it.
+    * ``lease``: the current lease dict (holder identity, token,
+      last acquire/renew ``unix``, ``ttl_s``, ``released``) or None
+      when the journal has no lease rows. Expiry is the CALLER's
+      deadline math (``unix + ttl_s`` vs its injectable clock) — the
+      fold never reads a clock.
+    * ``max_token``: the highest fencing token any lease_acquire ever
+      granted — the threshold a new acquire must exceed.
+    * ``stale_rejected``: the job_state rows the fencing rule THREW
+      OUT — rows whose ``fence`` was staler than the newest
+      lease_acquire preceding them (a zombie scheduler writing after
+      its lease was taken over). Rows with no fence (pre-v11
+      journals, or schedulers driven without serve()'s lease) are
+      always accepted. Rejected rows neither change job state nor
+      tick the aging clock — a double-dispatch provably cannot be
+      journaled into existence.
+    """
+    jobs: Dict[str, Dict[str, Any]] = {}
+    terminal_idx: List[int] = []
+    lease: Optional[Dict[str, Any]] = None
+    max_token = 0
+    stale: List[Dict[str, Any]] = []
+    for i, rec in enumerate(records):
+        rtype = rec.get("type")
+        if rtype == "lease_acquire":
+            max_token = max(max_token, int(rec["token"]))
+            lease = {"sched": rec["sched"], "pid": rec["pid"],
+                     "host": rec["host"], "start": rec["start"],
+                     "token": int(rec["token"]),
+                     "unix": float(rec["unix"]),
+                     "ttl_s": float(rec["ttl_s"]),
+                     "released": False,
+                     "takeover_from": rec.get("takeover_from")}
+        elif rtype == "lease_renew":
+            # a renew bearing anything but the CURRENT token is a
+            # zombie's — ignored, exactly like its job_state rows
+            if lease is not None and not lease["released"] \
+                    and int(rec["token"]) == lease["token"]:
+                lease["unix"] = float(rec["unix"])
+                lease["ttl_s"] = float(rec["ttl_s"])
+        elif rtype == "lease_release":
+            if lease is not None \
+                    and int(rec["token"]) == lease["token"]:
+                lease["released"] = True
+                lease["unix"] = float(rec["unix"])
+        elif rtype == "job_submit":
+            row = {k: v for k, v in rec.items()
+                   if k not in ("v", "type")}
+            row["submit_idx"] = i
+            jobs[rec["job_id"]] = row
+        elif rtype == "job_state":
+            fence = rec.get("fence")
+            if fence is not None and int(fence) < max_token:
+                # the fenced-lock rule: a newer acquire precedes this
+                # row in the append-only order, so its writer's lease
+                # was already taken over when the row landed
+                stale.append(rec)
+                continue
+            row = jobs.setdefault(rec["job_id"],
+                                  {"job_id": rec["job_id"],
+                                   "submit_idx": i})
+            # `reason` rides ONE transition: a completed job must
+            # not keep wearing its requeue explanation
+            row.pop("reason", None)
+            row.update({k: v for k, v in rec.items()
+                        if k not in ("v", "type")})
+            if rec["status"] in TERMINAL_STATES:
+                terminal_idx.append(i)
+    for row in jobs.values():
+        row["age"] = int(row.get("age_base", 0)) \
+            + sum(1 for i in terminal_idx
+                  if i > row.get("submit_idx", 0))
+    return {"jobs": jobs, "lease": lease, "max_token": max_token,
+            "stale_rejected": stale}
+
+
+def lease_deadline(lease: Optional[Dict[str, Any]]
+                   ) -> Optional[float]:
+    """The epoch second a folded lease expires at (None when the
+    journal has no lease) — callers compare against THEIR clock."""
+    if lease is None:
+        return None
+    return float(lease["unix"]) + float(lease["ttl_s"])
+
+
 class JobQueue:
     """The durable queue: one directory, one append-only journal.
 
@@ -330,34 +514,256 @@ class JobQueue:
         return _telemetry.read_jsonl(self.journal)
 
     def jobs(self) -> Dict[str, Dict[str, Any]]:
-        """Replay the journal -> job_id -> current row (the submit
-        row's fields overlaid by every later transition; LAST status
-        wins). Each row also carries ``age`` — the count of terminal
-        transitions journaled after its submit row, the
-        priority-aging clock."""
-        out: Dict[str, Dict[str, Any]] = {}
-        terminal_idx: List[int] = []
-        for i, rec in enumerate(self.read()):
-            if rec["type"] == "job_submit":
-                row = {k: v for k, v in rec.items()
-                       if k not in ("v", "type")}
-                row["submit_idx"] = i
-                out[rec["job_id"]] = row
-            elif rec["type"] == "job_state":
-                row = out.setdefault(rec["job_id"],
-                                     {"job_id": rec["job_id"],
-                                      "submit_idx": i})
-                # `reason` rides ONE transition: a completed job must
-                # not keep wearing its requeue explanation
-                row.pop("reason", None)
-                row.update({k: v for k, v in rec.items()
-                            if k not in ("v", "type")})
-                if rec["status"] in TERMINAL_STATES:
-                    terminal_idx.append(i)
-        for row in out.values():
-            row["age"] = sum(1 for i in terminal_idx
-                             if i > row.get("submit_idx", 0))
-        return out
+        """Replay the journal -> job_id -> current row (the shared
+        :func:`fold`'s ``jobs`` view: submit fields overlaid by every
+        ACCEPTED transition, last status wins, ``age`` = the
+        priority-aging clock, stale-fenced zombie rows rejected)."""
+        return fold(self.read())["jobs"]
+
+    # -- the lease plane (schema v11) ---------------------------------------
+
+    def lease_state(self) -> Optional[Dict[str, Any]]:
+        """The journal's current lease (:func:`fold`'s ``lease``
+        view), or None when no scheduler ever leased it."""
+        return fold(self.read())["lease"]
+
+    def acquire_lease(self, ident: SchedIdentity, now: float,
+                      ttl_s: Optional[float] = None) -> int:
+        """Acquire the journal's dispatch lease as ``ident`` at clock
+        reading ``now`` -> the granted fencing token (max token ever
+        granted + 1 — monotonic even across takeovers and re-acquires,
+        so a stale holder's rows are rejectable forever).
+
+        Legal when the journal has no lease, the lease was released,
+        the holder's deadline passed on ``now`` (a TAKEOVER — the
+        acquire row names the expired holder in ``takeover_from``), or
+        ``ident`` already holds it (re-acquire bumps the token: the
+        holder noticed its own lapse and re-fences itself forward).
+        A live peer's lease raises :class:`LeaseHeld`, named."""
+        st = fold(self.read())
+        lease, token = st["lease"], st["max_token"] + 1
+        takeover_from = None
+        if lease is not None and not lease["released"]:
+            if lease["sched"] != ident.sched \
+                    and float(now) < lease_deadline(lease):
+                raise LeaseHeld(
+                    f"journal {self.journal} is leased to "
+                    f"{lease['sched']} (token {lease['token']}) "
+                    f"until unix {lease_deadline(lease):g}; now is "
+                    f"{float(now):g} — wait for expiry or let the "
+                    f"watcher evict it")
+            if lease["sched"] != ident.sched:
+                takeover_from = str(lease["sched"])
+        self._emit("lease_acquire", **_telemetry.lease_fields(
+            ident.sched, ident.pid, ident.host, ident.start,
+            token, float(now),
+            float(lease_ttl_s() if ttl_s is None else ttl_s),
+            takeover_from=takeover_from))
+        if takeover_from:
+            _log.warn(f"jobqueue: lease TAKEOVER — {ident.sched} "
+                      f"fenced out expired holder {takeover_from} "
+                      f"(token {token})")
+        # the acquire row is durable; a sched_crash@between=
+        # acquire,dispatch fault kills the new holder RIGHT HERE —
+        # before any orphan requeue or dispatch — leaving a held
+        # lease with zero progress, the tenure the next peer's
+        # deadline math must expire in turn
+        _faults.on_lease_boundary("acquire")
+        return token
+
+    def renew_lease(self, ident: SchedIdentity, token: int,
+                    now: float, ttl_s: Optional[float] = None) -> None:
+        """Refresh the lease deadline (one row per scheduling cycle,
+        the scheduler-heartbeat cadence made durable)."""
+        self._emit("lease_renew", **_telemetry.lease_fields(
+            ident.sched, ident.pid, ident.host, ident.start,
+            int(token), float(now),
+            float(lease_ttl_s() if ttl_s is None else ttl_s)))
+        _faults.on_lease_boundary("renew")
+
+    def release_lease(self, ident: SchedIdentity, token: int,
+                      now: float,
+                      reason: Optional[str] = None) -> None:
+        """Voluntarily end tenure (release rows carry ttl_s 0.0 —
+        there is no deadline left to compute)."""
+        self._emit("lease_release", **_telemetry.lease_fields(
+            ident.sched, ident.pid, ident.host, ident.start,
+            int(token), float(now), 0.0, reason=reason))
+
+    def requeue_orphans(self, reason: str,
+                        fence: Optional[int] = None,
+                        sched: Optional[str] = None) -> int:
+        """Requeue every job the fold reads as running/preempted —
+        the takeover/restart recovery shared by
+        Scheduler.recover_interrupted and ``fleet_watch --evict``.
+        The requeue rows carry the CALLER's fence/identity (it holds
+        the lease now), stamp a fresh ``unix`` (the wait-clock reset)
+        and keep the job's trace."""
+        n = 0
+        for job in self.jobs().values():
+            if job.get("status") not in ("running", "preempted"):
+                continue
+            fields = {"unix": float(time.time()),
+                      "reason": str(reason)}
+            if fence is not None:
+                fields["fence"] = int(fence)
+            if sched is not None:
+                fields["sched"] = str(sched)
+            if job.get("trace_id"):
+                fields["trace_id"] = str(job["trace_id"])
+            self._emit("job_state", job_id=job["job_id"],
+                       tenant=str(job.get("tenant", "default")),
+                       status="queued", **fields)
+            n += 1
+        return n
+
+    # -- compaction ---------------------------------------------------------
+
+    def compact(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Fold the journal into a snapshot row-set and publish it
+        atomically as a NEW generation file (same path, new inode —
+        tail.py consumers observe a NAMED rotation and re-fold from
+        zero; nobody ever sees silent truncation).
+
+        The snapshot layout is [submit rows][current-state rows]
+        [lease lineage], in that order on purpose:
+
+        * submit rows first (state overlay needs them), in original
+          submit order, each re-based with ``age_base`` = its folded
+          age minus the snapshot's terminal-row count — the fold's
+          positional recount adds exactly that count back, so ages
+          survive byte-for-byte and post-compaction terminals keep
+          ticking every older job's clock;
+        * ONE fully-overlaid job_state row per transitioned job (its
+          entire history folded; historical fence values ride along
+          untouched — they are validated BEFORE the lease lineage
+          re-raises max_token, exactly like the original order);
+        * the lease lineage LAST (the folded acquire + a release row
+          when released), so ``max_token`` is re-established before
+          any tail row lands and a zombie writing after compaction is
+          still rejected;
+        * spans of NON-terminal jobs survive (their trace continues
+          across the rotation); terminal jobs' spans and all
+          heartbeat/liveness sensor rows are the compaction win —
+          export timelines (tools/trace_export.py) before compacting
+          if you want finished jobs' full span history.
+
+        ``fold(compacted) == fold(original)`` (jobs incl. ages,
+        lease, max_token — modulo row indexes) is asserted before
+        publish; a mismatch aborts with the journal untouched.
+        Refuses (:class:`LeaseHeld`) while a live unexpired lease
+        exists — the holder's O_APPEND rows would race the rename."""
+        records = self.read()
+        before = fold(records)
+        lease = before["lease"]
+        if lease is not None and not lease["released"] \
+                and float(time.time() if now is None else now) \
+                < lease_deadline(lease):
+            raise LeaseHeld(
+                f"journal {self.journal} is leased to "
+                f"{lease['sched']} (token {lease['token']}, expires "
+                f"unix {lease_deadline(lease):g}) — compact from the "
+                f"holder between cycles, or after expiry/release")
+        jobs = sorted(before["jobs"].values(),
+                      key=lambda r: r.get("submit_idx", 0))
+        live_ids = {r["job_id"] for r in jobs
+                    if r.get("status") not in TERMINAL_STATES}
+        submits = {rec["job_id"]: rec for rec in records
+                   if rec.get("type") == "job_submit"}
+        # the snapshot carries exactly one terminal state row per
+        # terminal job; in the [submits][states] layout every one of
+        # them recounts into every job's age, so each age_base
+        # pre-subtracts the full count (see the docstring)
+        n_terminal = sum(1 for r in jobs
+                         if r["job_id"] in submits
+                         and r.get("status") in TERMINAL_STATES)
+        out: List[Dict[str, Any]] = []
+        for row in jobs:
+            sub = submits.get(row["job_id"])
+            if sub is None:
+                # a state-only job (no submit row survived) cannot be
+                # re-based — refuse rather than silently dropping it
+                raise RuntimeError(
+                    f"jobqueue: cannot compact {self.journal}: job "
+                    f"{row['job_id']} has state rows but no submit "
+                    f"row (truncated journal?)")
+            sub = dict(sub)
+            sub["age_base"] = int(row["age"]) - n_terminal
+            out.append(sub)
+        # ONE fully-overlaid current-state row per job — emitting it
+        # even for never-transitioned jobs is fold-identical (the
+        # overlay reproduces the submit row's own fields) and keeps
+        # this loop free of accepted-vs-rejected re-derivation
+        state_keys = set(_telemetry.RECORD_SCHEMA["job_state"]) \
+            | set(_telemetry.RECORD_OPTIONAL["job_state"])
+        for row in jobs:
+            state = {"v": _telemetry.SCHEMA_VERSION,
+                     "type": "job_state",
+                     "job_id": row["job_id"],
+                     "tenant": str(row.get("tenant", "default")),
+                     "status": row["status"]}
+            for k in state_keys - {"job_id", "tenant", "status"}:
+                if k in row:
+                    state[k] = row[k]
+            out.append(state)
+        for rec in records:
+            if rec.get("type") == "span" \
+                    and rec.get("job_id") in live_ids:
+                out.append(rec)
+        if lease is not None:
+            out.append({"v": _telemetry.SCHEMA_VERSION,
+                        "type": "lease_acquire",
+                        **_telemetry.lease_fields(
+                            lease["sched"], lease["pid"],
+                            lease["host"], lease["start"],
+                            lease["token"], lease["unix"],
+                            lease["ttl_s"],
+                            takeover_from=lease.get("takeover_from"))})
+            if lease["released"]:
+                out.append({"v": _telemetry.SCHEMA_VERSION,
+                            "type": "lease_release",
+                            **_telemetry.lease_fields(
+                                lease["sched"], lease["pid"],
+                                lease["host"], lease["start"],
+                                lease["token"], lease["unix"], 0.0,
+                                reason="compacted")})
+        for rec in out:
+            _telemetry.validate_record(rec)
+        after = fold(out)
+        if self._fold_fingerprint(after) \
+                != self._fold_fingerprint(before):
+            raise RuntimeError(
+                f"jobqueue: compaction would CHANGE the fold of "
+                f"{self.journal} — aborted, journal untouched "
+                f"(this is a bug in compact(), not your journal)")
+        from fdtd3d_tpu import io as _io
+        bytes_before = os.path.getsize(self.journal) \
+            if os.path.exists(self.journal) else 0
+        with _io.atomic_open(self.journal) as fh:
+            for rec in out:
+                fh.write(json.dumps(rec) + "\n")
+        bytes_after = os.path.getsize(self.journal)
+        _log.log(f"jobqueue: compacted {self.journal}: "
+                 f"{len(records)} -> {len(out)} rows, "
+                 f"{bytes_before} -> {bytes_after} bytes "
+                 f"({len(jobs)} jobs, lease "
+                 f"{'kept' if lease is not None else 'none'})")
+        return {"rows_before": len(records), "rows_after": len(out),
+                "bytes_before": bytes_before,
+                "bytes_after": bytes_after, "jobs": len(jobs),
+                "lease": lease, "max_token": before["max_token"]}
+
+    @staticmethod
+    def _fold_fingerprint(folded: Dict[str, Any]) -> Dict[str, Any]:
+        """The fold-identity surface compaction must preserve: every
+        job's full row (ages included; row indexes and the age_base
+        re-basing mechanics excluded), the lease, the max token."""
+        jobs = {}
+        for jid, row in folded["jobs"].items():
+            jobs[jid] = {k: v for k, v in row.items()
+                        if k not in ("submit_idx", "age_base")}
+        return {"jobs": jobs, "lease": folded["lease"],
+                "max_token": folded["max_token"]}
 
     # -- admission ----------------------------------------------------------
 
@@ -443,7 +849,9 @@ class Scheduler:
                  retry_policy=None, batch_chunk: int = 0,
                  coalesce: bool = True,
                  straggler_threshold: int = 3,
-                 registry_path: Optional[str] = None):
+                 registry_path: Optional[str] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 lease_ttl: Optional[float] = None):
         from fdtd3d_tpu import registry as _registry
         self.queue = queue
         self.policy = policy or QuotaPolicy()
@@ -462,6 +870,24 @@ class Scheduler:
         # heartbeat-off journal stays byte-identical to v9 emission.
         self._heartbeat = _telemetry.Heartbeater.maybe(
             queue.journal, "scheduler")
+        # Fenced lease plane (schema v11): ``clock`` is the injectable
+        # deadline clock (tier-1 hands in a fake and never sleeps;
+        # default wall clock), ``lease_ttl`` the tenure TTL
+        # (FDTD3D_LEASE_TTL_S when None). serve() acquires the lease
+        # before touching any job and releases it on the way out;
+        # cycle() renews once per pass. A bare cycle() without serve()
+        # runs unleased (token None): its rows carry no fence and the
+        # fold accepts them — the single-scheduler library mode.
+        self.clock: Callable[[], float] = clock or time.time
+        self.lease_ttl = float(lease_ttl_s() if lease_ttl is None
+                               else lease_ttl)
+        self.identity = SchedIdentity.mine(now=self.clock())
+        self._lease_token: Optional[int] = None
+        # lease_expire@job=N flips this: a zombie stops renewing and
+        # stops checking its own expiry, but KEEPS dispatching with
+        # its stale token — the fold's rejection is then what keeps
+        # the journal consistent, which is the property under test
+        self._zombie = False
 
     # -- config loading -----------------------------------------------------
 
@@ -557,11 +983,45 @@ class Scheduler:
         used = tenant_cells.get(str(job.get("tenant")), 0.0)
         return used + float(job.get("cells", 0.0)) <= float(cap)
 
+    def _lease_tick(self) -> None:
+        """One per-cycle lease maintenance pass (no-op unleased).
+
+        Honest holders renew; one whose own deadline lapsed (a long
+        GC pause, a laptop lid) re-acquires FIRST — the token bump
+        re-fences it forward, and if a peer took over in the gap the
+        acquire raises :class:`LeaseHeld` and this scheduler stops
+        instead of double-dispatching. A ``lease_expire@job=N``-made
+        zombie skips all of it: it keeps its stale token and keeps
+        writing, and the fold's rejection carries the proof."""
+        if self._lease_token is None:
+            return
+        if not self._zombie \
+                and _faults.lease_zombie(self._dispatches + 1):
+            self._zombie = True
+            _log.warn(f"jobqueue: scheduler {self.identity.sched} "
+                      f"went ZOMBIE (lease_expire fault): no more "
+                      f"renewals or expiry checks, stale token "
+                      f"{self._lease_token} rides every row")
+        if self._zombie:
+            return
+        now = self.clock()
+        st = self.queue.lease_state()
+        if st is None or st["token"] != self._lease_token \
+                or st["released"] or now >= lease_deadline(st):
+            # fenced out, or our own tenure lapsed: re-acquire (or
+            # find a live peer and stop — LeaseHeld propagates)
+            self._lease_token = self.queue.acquire_lease(
+                self.identity, now, self.lease_ttl)
+        else:
+            self.queue.renew_lease(self.identity, self._lease_token,
+                                   now, self.lease_ttl)
+
     def cycle(self) -> int:
         """One scheduling pass: order the queued jobs by effective
         priority, build dispatch units (coalesced groups or solos),
         run each. Returns the number of journal transitions written —
         0 means the cycle could make no progress at all."""
+        self._lease_tick()
         jobs = self.queue.jobs()
         queued = [j for j in jobs.values()
                   if j.get("status") == "queued"]
@@ -684,6 +1144,12 @@ class Scheduler:
             # transition — including post-preemption re-dispatches —
             # journals under the job's one trace
             fields["trace_id"] = str(job["trace_id"])
+        if self._lease_token is not None:
+            # the fencing stamps (v11): every row a leased scheduler
+            # writes carries its token + identity, so the fold can
+            # reject this row the moment a newer acquire precedes it
+            fields["fence"] = int(self._lease_token)
+            fields["sched"] = self.identity.sched
         self.queue._emit("job_state", job_id=job["job_id"],
                          tenant=str(job.get("tenant", "default")),
                          status=status, **fields)
@@ -1116,20 +1582,17 @@ class Scheduler:
 
     def recover_interrupted(self) -> int:
         """Re-queue every job the journal reads as ``running`` or
-        ``preempted``: this scheduler just started, so no dispatcher
-        is alive behind those rows — they are the crash window
-        (killed between journal writes) made visible, and replay is
-        the recovery."""
-        n = 0
-        for job in self.queue.jobs().values():
-            if job.get("status") in ("running", "preempted"):
-                self._state(job, "queued",
-                            reason=f"requeued on scheduler restart "
-                                   f"(journal read "
-                                   f"{job['status']!r} with no live "
-                                   f"dispatcher)")
-                n += 1
-        return n
+        ``preempted``: whoever held the lease behind those rows is
+        gone (this scheduler just acquired it — a live holder would
+        have made serve() stop with :class:`LeaseHeld`), so they are
+        the crash window made visible and replay is the recovery.
+        The requeue rows carry THIS scheduler's fence."""
+        return self.queue.requeue_orphans(
+            "requeued on scheduler restart (journal read a "
+            "running/preempted job with no live dispatcher)",
+            fence=self._lease_token,
+            sched=(self.identity.sched
+                   if self._lease_token is not None else None))
 
     def serve(self, max_cycles: Optional[int] = None
               ) -> Dict[str, Any]:
@@ -1137,7 +1600,16 @@ class Scheduler:
         Returns the terminal summary ``{"cycles", "jobs": folded
         rows}``. A cycle that makes NO progress while jobs remain
         queued stops the loop loudly (an in-process scheduler cannot
-        wait for capacity nothing will free)."""
+        wait for capacity nothing will free).
+
+        serve() is the LEASED entry point: it acquires the journal's
+        fenced dispatch lease before touching any job (raising
+        :class:`LeaseHeld`, named, when a live peer owns it — never a
+        second dispatcher), requeues the previous holder's orphans,
+        and releases on the way out — except as a zombie, whose stale
+        token must stay visible in the journal for the fold to
+        reject (a zombie's "release" would be one more stale row the
+        lease fold already ignores, so it skips the write)."""
         from fdtd3d_tpu import registry as _registry
         # runs this scheduler builds register under kind "queue" (the
         # batch executor still stamps its own "batch"); restored on
@@ -1145,6 +1617,8 @@ class Scheduler:
         old_kind = _registry._DEFAULT_KIND
         _registry.set_default_kind("queue")
         try:
+            self._lease_token = self.queue.acquire_lease(
+                self.identity, self.clock(), self.lease_ttl)
             self.recover_interrupted()
             cycles = 0
             while max_cycles is None or cycles < max_cycles:
@@ -1165,6 +1639,18 @@ class Scheduler:
                     break
             if self.metrics is not None:
                 self.metrics.maybe_write()
+            # release on ORDERLY exit only: an exception leaving this
+            # loop is the scheduler dying (sched_crash's
+            # SimulatedPreemption, a real signal, a LeaseHeld from a
+            # fenced-out re-acquire) — a dead process releases
+            # nothing, its lease must be left to EXPIRE so the
+            # takeover path recovers it. A zombie never releases
+            # either: its stale token stays visible for the fold.
+            if self._lease_token is not None and not self._zombie:
+                self.queue.release_lease(
+                    self.identity, self._lease_token, self.clock(),
+                    reason="serve loop exited")
+                self._lease_token = None
             return {"cycles": cycles, "jobs": self.queue.jobs()}
         finally:
             _registry.set_default_kind(old_kind)

@@ -114,10 +114,20 @@ import numpy as np
 # watcher emits when an emitter's heartbeats stop for N x cadence
 # (status stuck/lost, naming the emitter and its last t). Both are
 # gated on FDTD3D_HEARTBEAT_S: unset means strict no-op and streams
-# byte-identical to v9 emission. v1-v9 files still read/validate
+# byte-identical to v9 emission. v11 (multi-scheduler lease plane,
+# round 21): the "lease_acquire"/"lease_renew"/"lease_release" rows —
+# fenced ownership of a queue journal's dispatch right. Every lease
+# row carries the scheduler identity (pid+host+start, the same stamps
+# heartbeats carry) and a monotonic fencing `token`; every job_state
+# row a scheduler writes carries its token as the optional `fence`
+# key, and the jobs() fold REJECTS a row whose fence is staler than
+# the newest acquire that precedes it — the classic fenced-lock rule,
+# so N schedulers sharing one journal via io.atomic_append provably
+# cannot double-dispatch. Leases expire by deadline math (unix +
+# ttl_s) on an injectable clock. v1-v10 files still read/validate
 # (READ_VERSIONS).
-SCHEMA_VERSION = 10
-READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+SCHEMA_VERSION = 11
+READ_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
 
 HEALTH_KEYS = ("energy", "div_l2", "div_linf", "max_e", "max_h",
                "nonfinite")
@@ -518,6 +528,36 @@ def liveness_fields(emitter: str, status: str, last_unix: float,
     return rec
 
 
+def lease_fields(sched: str, pid: int, host: str, start: float,
+                 token: int, unix: float, ttl_s: float,
+                 takeover_from: Optional[str] = None,
+                 reason: Optional[str] = None) -> Dict[str, Any]:
+    """Build the field dict of one lease row (schema v11) — shared by
+    all three types (lease_acquire / lease_renew / lease_release).
+
+    THE lease producer (the schema-drift lint resolves this dict
+    literal — see span_fields). ``sched`` is the scheduler identity
+    string ``host:pid:start`` (pid+host+start — the same stamps the
+    scheduler's heartbeats carry, so a watcher joins lease rows to
+    liveness verdicts without a side table); ``token`` is the
+    monotonic fencing token the holder stamps on every job_state row
+    it writes; ``unix`` + ``ttl_s`` are the lease deadline inputs
+    (expiry = unix + ttl_s on the injectable clock — release rows
+    carry ttl_s 0.0). ``takeover_from`` (acquire rows only) names the
+    expired prior holder a fenced takeover evicted; ``reason``
+    (release rows) says why the holder let go."""
+    rec = {
+        "sched": str(sched), "pid": int(pid), "host": str(host),
+        "start": float(start), "token": int(token),
+        "unix": float(unix), "ttl_s": float(ttl_s),
+        "takeover_from": takeover_from, "reason": reason,
+    }
+    for key in ("takeover_from", "reason"):
+        if rec[key] is None:
+            rec.pop(key)
+    return rec
+
+
 class Heartbeater:
     """Rate-limited heartbeat emitter for ONE (stream, emitter) pair.
 
@@ -884,6 +924,30 @@ RECORD_SCHEMA: Dict[str, Dict[str, tuple]] = {
         "last_t": _OPT_NUM, "deadline_s": _NUM, "silent_s": _NUM,
         "message": (str,),
     },
+    # v11 (multi-scheduler lease plane): fenced ownership of a queue
+    # journal's dispatch right. All three types share one shape
+    # (telemetry.lease_fields): `sched` is the holder identity string
+    # host:pid:start, `token` the monotonic fencing token (max token
+    # ever granted + 1 at each acquire — every job_state row the
+    # holder writes carries it as the optional `fence` key and the
+    # jobs() fold rejects stale-fenced rows), `unix` + `ttl_s` the
+    # deadline inputs (expiry = unix + ttl_s on the injectable clock;
+    # release rows carry ttl_s 0.0). "lease_acquire" grants (or, with
+    # `takeover_from`, fences a dead holder out); "lease_renew"
+    # refreshes the deadline on the scheduler heartbeat cadence;
+    # "lease_release" is the voluntary end of tenure.
+    "lease_acquire": {
+        "sched": (str,), "pid": (int,), "host": (str,),
+        "start": _NUM, "token": (int,), "unix": _NUM, "ttl_s": _NUM,
+    },
+    "lease_renew": {
+        "sched": (str,), "pid": (int,), "host": (str,),
+        "start": _NUM, "token": (int,), "unix": _NUM, "ttl_s": _NUM,
+    },
+    "lease_release": {
+        "sched": (str,), "pid": (int,), "host": (str,),
+        "start": _NUM, "token": (int,), "unix": _NUM, "ttl_s": _NUM,
+    },
 }
 
 
@@ -993,12 +1057,22 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # state, so a re-dispatched job's rows keep the SAME trace.
     # span_id/parent_span_id on job_state rows tie scheduler
     # transitions into the trace tree.
+    # age_base (v11, journal compaction): the terminal-transition
+    # count the job had already aged past when `fdtd_queue compact`
+    # folded its history away — the fold adds it back so priority
+    # aging survives compaction (fold(compacted) == fold(original)).
     "job_submit": ("unix", "resume", "time_steps", "trace_id",
-                   "span_id"),
+                   "span_id", "age_base"),
+    # fence/sched (v11, fenced leases): the writing scheduler's
+    # fencing token + identity. The jobs() fold rejects a job_state
+    # row whose fence is staler than the newest lease_acquire
+    # preceding it in the journal (zombie writes lose); rows with no
+    # fence (pre-v11 journals, or runs with the lease plane off) are
+    # always accepted.
     "job_state": ("run_id", "reason", "wait_s", "topology", "group",
                   "lane", "t", "excluded_chips", "unix",
                   "resumed_from", "trace_id", "span_id",
-                  "parent_span_id"),
+                  "parent_span_id", "fence", "sched"),
     # span (v9): parent_span_id builds the trace tree; attrs carries
     # phase context (cache hit/miss, straggler chip, retry error ...);
     # job_id/tenant/run_id/lane/group echo the owning identities so a
@@ -1013,6 +1087,12 @@ RECORD_OPTIONAL: Dict[str, tuple] = {
     # liveness (v10): the same identity stamps, plus the pid/host of
     # the emitter the verdict is about (copied from its last beat).
     "liveness": ("run_id", "trace_id", "job_id", "pid", "host"),
+    # lease rows (v11): takeover_from (acquire only) names the expired
+    # holder a fenced takeover evicted; reason (release only) says why
+    # tenure ended (shutdown, evicted, ...).
+    "lease_acquire": ("takeover_from", "reason"),
+    "lease_renew": ("takeover_from", "reason"),
+    "lease_release": ("takeover_from", "reason"),
 }
 
 
@@ -1042,11 +1122,13 @@ _V8_ONLY_TYPES = ("job_submit", "job_state")
 _V9_ONLY_TYPES = ("span",)
 # and from v10 on: the live-health-plane liveness sensor rows
 _V10_ONLY_TYPES = ("heartbeat", "liveness")
+# and from v11 on: the multi-scheduler lease rows
+_V11_ONLY_TYPES = ("lease_acquire", "lease_renew", "lease_release")
 
 
 def validate_record(rec: Dict[str, Any]) -> None:
     """Raise ValueError when a record violates its declared schema
-    version (writers emit v10; v1-v9 files remain readable)."""
+    version (writers emit v11; v1-v10 files remain readable)."""
     if not isinstance(rec, dict):
         raise ValueError(f"record is not an object: {rec!r}")
     v = rec.get("v")
@@ -1063,7 +1145,8 @@ def validate_record(rec: Dict[str, Any]) -> None:
             (v < 7 and rtype in _V7_ONLY_TYPES) or \
             (v < 8 and rtype in _V8_ONLY_TYPES) or \
             (v < 9 and rtype in _V9_ONLY_TYPES) or \
-            (v < 10 and rtype in _V10_ONLY_TYPES):
+            (v < 10 and rtype in _V10_ONLY_TYPES) or \
+            (v < 11 and rtype in _V11_ONLY_TYPES):
         raise ValueError(f"unknown record type {rtype!r}")
     for key, types in RECORD_SCHEMA[rtype].items():
         if v == 1 and key in _V2_ONLY_KEYS.get(rtype, ()):

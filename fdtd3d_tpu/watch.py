@@ -14,8 +14,14 @@ only the appended bytes) and turns them into verdicts:
     deadline math runs on an injectable clock — tier-1 never sleeps.
     Emitters RETIRE instead of alarming when their silence is the
     normal end of life: a run emitter is retired once its stream's
-    ``run_end`` landed after the last beat, and the scheduler once
-    its journal folds to no non-terminal jobs.
+    ``run_end`` landed after the last beat. Scheduler retirement is
+    per scheduler IDENTITY (schema v11): on a journal carrying lease
+    rows, a scheduler emitter retires once its pid+host identity
+    holds no active lease — released, fenced out by a higher token,
+    or never acquired — so a healthy peer sharing the journal is
+    never retired alongside a dead one. Journals without lease rows
+    keep the legacy single-scheduler rule (retire once the journal
+    folds to no non-terminal jobs).
 
 *anomaly*
     Rolling EWMA of chunk throughput per (step_kind, grid, dtype)
@@ -151,6 +157,14 @@ class FleetWatcher:
         self._emitters: Dict[tuple, _EmitterState] = {}
         # journal fold: job_id -> {"status", "unix", "tenant"}
         self._jobs: Dict[str, Dict[str, Any]] = {}
+        # lease fold (schema v11): sched identity -> lease row, plus
+        # the fence high-water mark. Stale job_state rows (fence
+        # below the max token at the time they land) are rejected on
+        # the way in — the same rule as jobqueue.fold, applied
+        # incrementally since tailing preserves append order.
+        self._leases: Dict[str, Dict[str, Any]] = {}
+        self._max_token = 0
+        self._stale_rejected = 0
         # registry fold: run_id -> merged row (baseline history)
         self._runs: Dict[str, Dict[str, Any]] = {}
         # per-telemetry-path sliding record window + stream identity
@@ -189,6 +203,10 @@ class FleetWatcher:
                 "tenant": rec.get("tenant"),
             }
         elif rtype == "job_state":
+            fence = rec.get("fence")
+            if fence is not None and int(fence) < self._max_token:
+                self._stale_rejected += 1
+                return
             job = self._jobs.setdefault(
                 str(rec.get("job_id")),
                 {"status": None, "unix": None,
@@ -196,6 +214,17 @@ class FleetWatcher:
             job["status"] = rec.get("status")
             if rec.get("unix") is not None:
                 job["unix"] = rec.get("unix")
+        elif rtype == "lease_acquire":
+            token = int(rec.get("token", 0))
+            self._leases[str(rec.get("sched"))] = {
+                "pid": rec.get("pid"), "host": rec.get("host"),
+                "token": token, "released": False}
+            self._max_token = max(self._max_token, token)
+        elif rtype == "lease_release":
+            lease = self._leases.get(str(rec.get("sched")))
+            if lease is not None \
+                    and lease["token"] == int(rec.get("token", 0)):
+                lease["released"] = True
 
     def _observe_registry(self, rec: Dict[str, Any]) -> None:
         if rec.get("type") not in ("run_begin", "run_final"):
@@ -237,6 +266,19 @@ class FleetWatcher:
 
     # -- verdicts ----------------------------------------------------------
 
+    def _holds_active_lease(self, st: "_EmitterState") -> bool:
+        """True when the emitter's pid+host identity holds the
+        current (highest-token, unreleased) lease. Fenced-out and
+        released holders are done; expiry is deliberately NOT checked
+        here — a holder gone silent past its deadline is exactly the
+        stuck/lost alarm, never a quiet retirement."""
+        for lease in self._leases.values():
+            if (lease["pid"] == st.pid and lease["host"] == st.host
+                    and not lease["released"]
+                    and lease["token"] == self._max_token):
+                return True
+        return False
+
     def _retire(self) -> None:
         """Mark emitters whose silence is a normal end of life."""
         open_jobs = any(
@@ -246,8 +288,16 @@ class FleetWatcher:
             if st.retired:
                 continue
             if st.emitter == "scheduler":
-                # journal path: green once every job is terminal
-                if self._jobs and not open_jobs:
+                if self._leases:
+                    # leased journal (schema v11): retirement is per
+                    # scheduler identity — done iff this pid+host no
+                    # longer holds the active lease. A live peer on a
+                    # shared journal keeps its lease and stays live.
+                    if not self._holds_active_lease(st):
+                        st.retired = True
+                elif self._jobs and not open_jobs:
+                    # legacy single-scheduler journal: green once
+                    # every job is terminal
                     st.retired = True
             else:
                 ended = self._run_ended.get(st.path)
@@ -444,6 +494,13 @@ class FleetWatcher:
                  "run_id": st.run_id, "job_id": st.job_id}
                 for _, st in sorted(self._emitters.items(),
                                     key=lambda kv: str(kv[0]))],
+            "leases": [
+                {"sched": sched, "token": lease["token"],
+                 "released": lease["released"],
+                 "active": (not lease["released"]
+                            and lease["token"] == self._max_token)}
+                for sched, lease in sorted(self._leases.items())],
+            "stale_rejected": self._stale_rejected,
             "liveness": liveness,
             "anomalies": anomalies,
             "slo": {p: s["status"]
@@ -471,6 +528,14 @@ def format_report(report: Dict[str, Any]) -> str:
             f"  EMITTER {st['emitter']:<10} {state:<7} seq={st['seq']}"
             f" t={t} last={st['unix']:.1f}"
             f" ({os.path.basename(st['path'])})")
+    for lease in report.get("leases", ()):
+        state = ("active" if lease["active"]
+                 else "released" if lease["released"] else "fenced")
+        lines.append(f"  LEASE {lease['sched']} "
+                     f"token={lease['token']} {state}")
+    if report.get("stale_rejected"):
+        lines.append(f"  STALE {report['stale_rejected']} fenced-out "
+                     f"journal row(s) rejected")
     for rec in report["liveness"]:
         lines.append(
             f"  LIVENESS {rec['status'].upper():<6} {rec['emitter']}"

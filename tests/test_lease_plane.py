@@ -1,0 +1,652 @@
+"""Fenced multi-scheduler lease plane (ISSUE 20 acceptance).
+
+The load-bearing claims under test:
+
+* FENCING: every ``lease_acquire`` grants a monotonic token (max ever
+  granted + 1 — across takeovers, re-acquires and releases), every
+  ``job_state`` row a leased scheduler writes carries it as
+  ``fence``, and the shared :func:`jobqueue.fold` REJECTS rows whose
+  fence is staler than the newest acquire preceding them — so N
+  schedulers sharing one append-only journal provably cannot
+  double-dispatch. Rows with no fence (pre-v11 journals, bare-cycle
+  library mode) are always accepted.
+* LEASE LIFECYCLE: ``serve()`` acquires before touching any job,
+  renews once per cycle, and releases ONLY on orderly exit; expiry is
+  deadline math (``unix + ttl_s``) on an injectable clock — no sleeps
+  anywhere in this file.
+* TAKEOVER: a crashed/zombified holder's lease expires on the
+  survivor's clock; the survivor's acquire names the dead holder in
+  ``takeover_from``, requeues its orphans under the fresh token, and
+  the orphan completes BIT-IDENTICAL to an uninterrupted run
+  (snapshots make re-dispatch deterministic).
+* FAULT GRAMMAR: ``sched_crash@between=acquire,dispatch`` /
+  ``between=renew,commit`` kill the scheduler at lease boundaries;
+  ``lease_expire@job=N`` makes a deterministic zombie — per-kind
+  allowed-key validation rejects misapplied plans loudly.
+* COMPACTION: ``compact()`` folds the journal into a snapshot
+  row-set published atomically as a NEW generation file —
+  ``fold(compacted) == fold(original)``, tailing consumers observe a
+  NAMED rotation and their re-fold is identical, a live lease refuses
+  compaction by name.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fdtd3d_tpu import faults, io, jobqueue, tail
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+
+BASE = ("--3d\n--same-size 12\n--time-steps 8\n"
+        "--courant-factor 0.4\n--wavelength 0.008\n")
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch):
+    monkeypatch.delenv("FDTD3D_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("FDTD3D_LEASE_TTL_S", raising=False)
+    monkeypatch.delenv("FDTD3D_HEARTBEAT_S", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _spec(tmp_path, name="a.txt", extra=""):
+    p = tmp_path / name
+    p.write_text(BASE + extra)
+    return str(p)
+
+
+def _ident(n, start):
+    return jobqueue.SchedIdentity(pid=7000 + n, host=f"w{n}",
+                                  start=float(start))
+
+
+def _run_tool(args, extra_env=None, timeout=300):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **(extra_env or {})}
+    return subprocess.run([sys.executable] + args,
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=ROOT)
+
+
+# -------------------------------------------------------------------------
+# fault grammar: lease windows + per-kind allowed-key validation
+# -------------------------------------------------------------------------
+
+def test_fault_grammar_parses_lease_windows():
+    plan = faults.FaultPlan.parse(
+        "sched_crash@between=acquire,dispatch")
+    assert plan.faults[0].between == "acquire,dispatch"
+    assert plan.faults[0].job is None
+    plan = faults.FaultPlan.parse(
+        "sched_crash@between=renew,commit; lease_expire@job=2")
+    assert plan.faults[0].between == "renew,commit"
+    assert plan.faults[1].kind == "lease_expire"
+    assert plan.faults[1].job == 2
+
+
+def test_fault_grammar_rejects_bad_lease_plans():
+    # an unknown boundary window is named, with the valid set
+    with pytest.raises(ValueError, match="between must be one of"):
+        faults.FaultPlan.parse("sched_crash@between=lunch,dinner")
+    # sched_crash takes EXACTLY one of job= / between=
+    with pytest.raises(ValueError, match="exactly one of"):
+        faults.FaultPlan.parse(
+            "sched_crash@job=1,between=acquire,dispatch")
+    with pytest.raises(ValueError, match="exactly one of"):
+        faults.FaultPlan.parse("sched_crash")
+    # lease_expire needs its dispatch ordinal
+    with pytest.raises(ValueError, match="lease_expire needs"):
+        faults.FaultPlan.parse("lease_expire")
+    # per-kind allowed keys: a key the kind would silently ignore is
+    # a plan that "proves" a scenario that never ran
+    with pytest.raises(ValueError, match="does not apply"):
+        faults.FaultPlan.parse("lease_expire@t=3")
+    with pytest.raises(ValueError, match="does not apply"):
+        faults.FaultPlan.parse("preempt@between=acquire,dispatch")
+
+
+# -------------------------------------------------------------------------
+# the fold: fencing, lease lineage, deadline math (pure unit tests)
+# -------------------------------------------------------------------------
+
+def _acq(ident, token, unix, ttl=30.0, **kw):
+    return {"v": 11, "type": "lease_acquire", "sched": ident.sched,
+            "pid": ident.pid, "host": ident.host,
+            "start": ident.start, "token": token, "unix": unix,
+            "ttl_s": ttl, **kw}
+
+
+def _sub(jid, unix, tenant="acme"):
+    return {"v": 11, "type": "job_submit", "job_id": jid,
+            "tenant": tenant, "status": "queued", "priority": 0,
+            "wall_time": "2026-08-07", "spec": "a.txt",
+            "cells": 1728.0, "unix": unix}
+
+
+def _st(jid, status, tenant="acme", **kw):
+    return {"v": 11, "type": "job_state", "job_id": jid,
+            "tenant": tenant, "status": status, **kw}
+
+
+def test_fold_rejects_stale_fenced_rows():
+    w0, w1 = _ident(0, 100.0), _ident(1, 200.0)
+    recs = [
+        _acq(w0, 1, 100.0),
+        _sub("j1", 101.0), _sub("j2", 102.0),
+        _st("j1", "running", fence=1, sched=w0.sched),
+        _acq(w1, 2, 150.0, takeover_from=w0.sched),
+        _st("j1", "queued", fence=2, sched=w1.sched, unix=150.5),
+        # the zombie's completion lands AFTER the takeover: rejected
+        _st("j1", "completed", fence=1, sched=w0.sched, t=8),
+        _st("j1", "running", fence=2, sched=w1.sched),
+        _st("j1", "completed", fence=2, sched=w1.sched, t=8),
+    ]
+    out = jobqueue.fold(recs)
+    j1 = out["jobs"]["j1"]
+    assert j1["status"] == "completed"
+    assert j1["fence"] == 2 and j1["sched"] == w1.sched
+    assert out["max_token"] == 2
+    assert [r["fence"] for r in out["stale_rejected"]] == [1]
+    assert out["stale_rejected"][0]["status"] == "completed"
+    # the rejected terminal row did NOT tick the aging clock: j2 aged
+    # by the ONE accepted completion, not two
+    assert out["jobs"]["j2"]["age"] == 1
+    # lease view: w1 holds, unreleased, takeover lineage named
+    lease = out["lease"]
+    assert lease["sched"] == w1.sched and not lease["released"]
+    assert lease["takeover_from"] == w0.sched
+
+
+def test_fold_accepts_unfenced_rows_always():
+    """Pre-v11 journals (and bare-cycle library mode) carry no fence:
+    the fold accepts their rows even under a high max_token."""
+    w1 = _ident(1, 200.0)
+    recs = [_acq(w1, 5, 100.0), _sub("j1", 101.0),
+            _st("j1", "running"), _st("j1", "completed", t=8)]
+    out = jobqueue.fold(recs)
+    assert out["jobs"]["j1"]["status"] == "completed"
+    assert out["stale_rejected"] == []
+
+
+def test_fold_renew_and_release_token_rules():
+    w0 = _ident(0, 100.0)
+    w1 = _ident(1, 200.0)
+    recs = [_acq(w0, 1, 100.0, ttl=10.0),
+            {**_acq(w0, 1, 105.0, ttl=10.0), "type": "lease_renew"},
+            _acq(w1, 2, 120.0, ttl=10.0),
+            # a zombie's renew (stale token) is ignored like its rows
+            {**_acq(w0, 1, 125.0, ttl=10.0), "type": "lease_renew"},
+            # ...and so is a release bearing a stale token
+            {**_acq(w0, 1, 126.0, ttl=0.0), "type": "lease_release"}]
+    out = jobqueue.fold(recs)
+    lease = out["lease"]
+    assert lease["token"] == 2 and not lease["released"]
+    assert lease["unix"] == 120.0
+    assert jobqueue.lease_deadline(lease) == 130.0
+    # the current holder's release ends tenure
+    recs.append({**_acq(w1, 2, 128.0, ttl=0.0),
+                 "type": "lease_release"})
+    assert jobqueue.fold(recs)["lease"]["released"] is True
+    assert jobqueue.lease_deadline(None) is None
+
+
+# -------------------------------------------------------------------------
+# the lease API: monotonic tokens, named refusal, fenced takeover
+# -------------------------------------------------------------------------
+
+def test_acquire_takeover_and_monotonic_tokens(tmp_path):
+    q = jobqueue.JobQueue(str(tmp_path / "q"))
+    a, b = _ident(0, 100.0), _ident(1, 200.0)
+    assert q.lease_state() is None
+    t1 = q.acquire_lease(a, now=100.0, ttl_s=10.0)
+    assert t1 == 1
+    # a live peer's lease refuses by NAME: holder + deadline
+    with pytest.raises(jobqueue.LeaseHeld,
+                       match=re.escape(a.sched)):
+        q.acquire_lease(b, now=105.0, ttl_s=10.0)
+    # past the deadline the takeover names the expired holder
+    t2 = q.acquire_lease(b, now=111.0, ttl_s=10.0)
+    assert t2 == 2
+    lease = q.lease_state()
+    assert lease["sched"] == b.sched
+    assert lease["takeover_from"] == a.sched
+    # a live holder re-acquiring bumps the token (re-fences itself
+    # forward) — no takeover, no refusal
+    t3 = q.acquire_lease(b, now=112.0, ttl_s=10.0)
+    assert t3 == 3
+    assert q.lease_state()["takeover_from"] is None
+    q.release_lease(b, t3, now=113.0, reason="done")
+    assert q.lease_state()["released"] is True
+    # tokens stay monotonic across a release too
+    assert q.acquire_lease(a, now=114.0, ttl_s=10.0) == 4
+
+
+def test_requeue_orphans_carries_callers_fence(tmp_path):
+    q = jobqueue.JobQueue(str(tmp_path / "q"))
+    j1 = q.submit(_spec(tmp_path, "a.txt"), tenant="acme")
+    j2 = q.submit(_spec(tmp_path, "b.txt", "--eps 2.0\n"),
+                  tenant="acme")
+    q._emit("job_state", job_id=j1, tenant="acme", status="running")
+    q._emit("job_state", job_id=j2, tenant="acme", status="running")
+    q._emit("job_state", job_id=j2, tenant="acme",
+            status="completed", t=8)
+    n = q.requeue_orphans("lost holder", fence=7, sched="w9:1:2")
+    assert n == 1   # only the running job; terminal jobs stay put
+    job = q.jobs()[j1]
+    assert job["status"] == "queued"
+    assert job["fence"] == 7 and job["sched"] == "w9:1:2"
+    assert job["reason"] == "lost holder"
+    assert q.jobs()[j2]["status"] == "completed"
+
+
+# -------------------------------------------------------------------------
+# scheduler lifecycle: leased serve() vs unleased bare cycle()
+# -------------------------------------------------------------------------
+
+def test_serve_lease_lifecycle_rows(tmp_path, monkeypatch):
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY",
+                       str(tmp_path / "runs.jsonl"))
+    q = jobqueue.JobQueue(str(tmp_path / "q"))
+    jid = q.submit(_spec(tmp_path))
+    now = [1000.0]
+    s = jobqueue.Scheduler(q, clock=lambda: now[0], lease_ttl=30.0)
+    out = s.serve()
+    assert out["jobs"][jid]["status"] == "completed"
+    recs = q.read()
+    types = [r["type"] for r in recs]
+    assert types.count("lease_acquire") == 1
+    assert types.count("lease_renew") >= 1
+    rel = [r for r in recs if r["type"] == "lease_release"]
+    assert len(rel) == 1
+    assert rel[0]["reason"] == "serve loop exited"
+    assert rel[0]["ttl_s"] == 0.0
+    # every job_state row the leased scheduler wrote is fenced with
+    # ITS token + identity
+    for r in recs:
+        if r["type"] == "job_state":
+            assert r["fence"] == 1
+            assert r["sched"] == s.identity.sched
+    assert jobqueue.fold(recs)["stale_rejected"] == []
+
+
+def test_bare_cycle_runs_unleased(tmp_path, monkeypatch):
+    """Library mode: cycle() without serve() writes no lease rows and
+    no fence keys — the fold accepts them (single-scheduler mode)."""
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY",
+                       str(tmp_path / "runs.jsonl"))
+    q = jobqueue.JobQueue(str(tmp_path / "q"))
+    jid = q.submit(_spec(tmp_path))
+    s = jobqueue.Scheduler(q)
+    s.cycle()
+    assert q.jobs()[jid]["status"] == "completed"
+    recs = q.read()
+    assert not [r for r in recs
+                if r["type"].startswith("lease_")]
+    assert all("fence" not in r for r in recs
+               if r["type"] == "job_state")
+    assert jobqueue.fold(recs)["lease"] is None
+
+
+# -------------------------------------------------------------------------
+# the zombie: lease_expire@job=N + stale-token rejection, exactly-once
+# -------------------------------------------------------------------------
+
+def test_zombie_scheduler_is_fenced_out_exactly_once(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY",
+                       str(tmp_path / "runs.jsonl"))
+    q = jobqueue.JobQueue(str(tmp_path / "q"))
+    j1 = q.submit(_spec(tmp_path, "a.txt"))
+    now = [1000.0]
+    faults.install("lease_expire@job=1")
+    s1 = jobqueue.Scheduler(q, clock=lambda: now[0], lease_ttl=30.0)
+    out = s1.serve()
+    # the zombie completed j1 (its token was still current) but never
+    # renewed and never released: the lease is left to EXPIRE
+    assert out["jobs"][j1]["status"] == "completed"
+    assert s1._zombie
+    lease = q.lease_state()
+    assert lease["token"] == 1 and not lease["released"]
+    assert [r["type"] for r in q.read()].count("lease_renew") == 0
+
+    # a peer waits out the TTL on ITS clock and fences the zombie out
+    j2 = q.submit(_spec(tmp_path, "b.txt", "--eps 2.0\n"))
+    now[0] += 31.0
+    s2 = jobqueue.Scheduler(q, clock=lambda: now[0], lease_ttl=30.0)
+    t2 = q.acquire_lease(s2.identity, now[0], ttl_s=30.0)
+    assert t2 == 2
+    acq = [r for r in q.read() if r["type"] == "lease_acquire"]
+    assert acq[-1]["takeover_from"] == s1.identity.sched
+
+    # the zombie keeps dispatching with its stale token...
+    s1.cycle()
+    folded = jobqueue.fold(q.read())
+    # ...and EVERY row it wrote is rejected: j2 still reads queued
+    assert folded["jobs"][j2]["status"] == "queued"
+    stale = folded["stale_rejected"]
+    assert stale and all(r["fence"] == 1 for r in stale)
+    assert {r["job_id"] for r in stale} == {j2}
+
+    # the survivor dispatches j2 under its own fence — the journal
+    # folds to exactly ONE accepted completion per job
+    out2 = s2.serve()
+    jobs = out2["jobs"]
+    assert jobs[j1]["status"] == "completed"
+    assert jobs[j2]["status"] == "completed"
+    assert jobs[j2]["sched"] == s2.identity.sched
+    assert jobs[j2]["fence"] > t2  # serve's re-acquire re-fenced
+    final = jobqueue.fold(q.read())
+    completions = [r for r in q.read()
+                   if r["type"] == "job_state"
+                   and r["job_id"] == j2
+                   and r["status"] == "completed"]
+    assert len(completions) == 2          # the zombie's + the real one
+    assert sum(1 for r in completions
+               if r not in final["stale_rejected"]) == 1
+    assert final["lease"]["released"] is True
+
+
+# -------------------------------------------------------------------------
+# lease-boundary crashes: held-but-idle tenure expires, peer takes over
+# -------------------------------------------------------------------------
+
+@pytest.mark.parametrize("window", ["acquire,dispatch",
+                                    "renew,commit"])
+def test_lease_boundary_crash_then_fenced_takeover(tmp_path,
+                                                   monkeypatch,
+                                                   window):
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY",
+                       str(tmp_path / "runs.jsonl"))
+    q = jobqueue.JobQueue(str(tmp_path / "q"))
+    jid = q.submit(_spec(tmp_path))
+    now = [1000.0]
+    faults.install(f"sched_crash@between={window}")
+    s1 = jobqueue.Scheduler(q, clock=lambda: now[0], lease_ttl=30.0)
+    with pytest.raises(faults.SimulatedPreemption,
+                       match="crashed between"):
+        s1.serve()
+    # the lease row is durable, zero progress behind it
+    lease = q.lease_state()
+    assert lease["sched"] == s1.identity.sched
+    assert not lease["released"]
+    assert q.jobs()[jid]["status"] == "queued"
+
+    faults.clear()
+    # the peer's identity differs by its start stamp (same pid+host
+    # in-process — the start clock is what disambiguates restarts)
+    now[0] += 1.0
+    s2 = jobqueue.Scheduler(q, clock=lambda: now[0], lease_ttl=30.0)
+    # the dead holder's lease is still live on this clock: refused
+    with pytest.raises(jobqueue.LeaseHeld,
+                       match=re.escape(s1.identity.sched)):
+        s2.serve()
+    # ...until its deadline passes — then the takeover completes it
+    now[0] += 30.0
+    out = s2.serve()
+    assert out["jobs"][jid]["status"] == "completed"
+    lease = q.lease_state()
+    assert lease["released"] and lease["sched"] == s2.identity.sched
+    acq = [r for r in q.read() if r["type"] == "lease_acquire"]
+    assert acq[-1]["takeover_from"] == s1.identity.sched
+
+
+# -------------------------------------------------------------------------
+# chaos lane: seeded two-scheduler fault cocktails + compaction after
+# -------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chaos_two_scheduler_lease_cocktails(tmp_path, monkeypatch,
+                                             seed):
+    """Whatever one-fault cocktail kills/zombifies scheduler #1, a
+    survivor on an advanced clock drives every job terminal with an
+    internally consistent journal, and post-incident compaction
+    preserves the fold."""
+    monkeypatch.setenv("FDTD3D_RUN_REGISTRY",
+                       str(tmp_path / "runs.jsonl"))
+    rng = np.random.default_rng(seed)
+    cocktails = ["sched_crash@between=acquire,dispatch",
+                 "sched_crash@between=renew,commit",
+                 "sched_crash@job=1",
+                 "lease_expire@job=1"]
+    spec = cocktails[int(rng.integers(0, len(cocktails)))]
+    q = jobqueue.JobQueue(str(tmp_path / "q"))
+    jid = q.submit(_spec(tmp_path))
+    now = [1000.0]
+    faults.install(spec)
+    s1 = jobqueue.Scheduler(q, clock=lambda: now[0], lease_ttl=30.0)
+    try:
+        s1.serve()
+    except faults.SimulatedPreemption:
+        pass
+    faults.clear()
+    now[0] += 31.0
+    s2 = jobqueue.Scheduler(q, clock=lambda: now[0], lease_ttl=30.0)
+    out = s2.serve()
+    assert out["jobs"][jid]["status"] == "completed", spec
+    before = jobqueue.fold(q.read())
+    assert before["lease"]["released"], spec
+    # exactly-once: the accepted completion count is 1 regardless of
+    # how many stale rows the incident produced
+    accepted = [r for r in q.read()
+                if r["type"] == "job_state"
+                and r["status"] == "completed"
+                and r not in before["stale_rejected"]]
+    assert len(accepted) == 1, spec
+    # the dust settled: compaction preserves jobs, lease and tokens
+    stats = q.compact(now=now[0])
+    assert stats["rows_after"] <= stats["rows_before"]
+    after = jobqueue.fold(q.read())
+    assert after["jobs"][jid]["status"] == "completed"
+    assert after["max_token"] == before["max_token"]
+    assert after["lease"]["released"]
+
+
+# -------------------------------------------------------------------------
+# compaction under tailing: named rotation, identical re-fold
+# -------------------------------------------------------------------------
+
+def test_compact_under_tailing_named_rotation(tmp_path):
+    q = jobqueue.JobQueue(str(tmp_path / "q"))
+    ident = _ident(0, 100.0)
+    j1 = q.submit(_spec(tmp_path, "a.txt"), tenant="acme")
+    j2 = q.submit(_spec(tmp_path, "b.txt", "--eps 2.0\n"),
+                  tenant="acme")
+    j3 = q.submit(_spec(tmp_path, "c.txt", "--eps 3.0\n"),
+                  tenant="globex")
+    token = q.acquire_lease(ident, now=100.0, ttl_s=10.0)
+    for i in range(8):
+        q.renew_lease(ident, token, now=101.0 + i, ttl_s=10.0)
+    for jid in (j1, j2):
+        q._emit("job_state", job_id=jid, tenant="acme",
+                status="running", fence=token, sched=ident.sched)
+        q._emit("job_state", job_id=jid, tenant="acme",
+                status="completed", t=8, fence=token,
+                sched=ident.sched)
+
+    # a follow consumer is mid-stream before the compaction
+    t = tail.Tailer()
+    assert len(t.poll_records(q.journal)) == len(q.read())
+
+    # a LIVE lease refuses compaction, naming the holder
+    with pytest.raises(jobqueue.LeaseHeld,
+                       match=re.escape(ident.sched)):
+        q.compact(now=105.0)
+
+    q.release_lease(ident, token, now=120.0, reason="done")
+    before = jobqueue.fold(q.read())
+    stats = q.compact(now=121.0)
+    assert stats["rows_after"] < stats["rows_before"]
+    assert stats["max_token"] == token
+
+    # fold identity survives the rotation (jobs, ages, lease, token)
+    after = jobqueue.fold(q.read())
+    for jid in (j1, j2, j3):
+        assert after["jobs"][jid]["status"] == \
+            before["jobs"][jid]["status"]
+        assert after["jobs"][jid]["age"] == before["jobs"][jid]["age"]
+    assert after["max_token"] == before["max_token"]
+    assert after["lease"]["token"] == token
+    assert after["lease"]["released"]
+
+    # the tailing consumer sees a NAMED rotation (new inode), replays
+    # the new generation from zero, and its re-fold is identical;
+    # the replay cost is the compacted size, not the old history
+    read0 = t.bytes_read
+    replayed = t.poll_records(q.journal)
+    assert any(e.startswith("rotated:") for e in t.events)
+    refold = jobqueue.fold(replayed)
+    for jid in (j1, j2, j3):
+        assert refold["jobs"][jid]["status"] == \
+            before["jobs"][jid]["status"]
+    assert refold["max_token"] == before["max_token"]
+    assert t.bytes_read - read0 == os.path.getsize(q.journal)
+
+
+# -------------------------------------------------------------------------
+# the whole incident through real CLIs: crash -> watcher names it ->
+# fenced eviction -> survivor completes BIT-IDENTICAL
+# -------------------------------------------------------------------------
+
+def test_two_scheduler_cli_takeover_bit_identical(tmp_path,
+                                                  monkeypatch):
+    reg = str(tmp_path / "runs.jsonl")
+    qdir = str(tmp_path / "queue")
+    spec = tmp_path / "c.txt"
+    spec.write_text("--3d\n--same-size 12\n--time-steps 24\n"
+                    "--courant-factor 0.4\n--wavelength 0.008\n"
+                    "--point-source Ez\n--checkpoint-every 8\n")
+    qtool = os.path.join(TOOLS, "fdtd_queue.py")
+    env = {"FDTD3D_RUN_REGISTRY": reg}
+
+    proc = _run_tool([qtool, "submit", str(spec),
+                      "--queue-dir", qdir, "--tenant", "acme"],
+                     extra_env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    jid = re.search(r"submitted (\S+)", proc.stdout).group(1)
+
+    # scheduler #1: the run is preempted at t=16 (snapshot at t=16
+    # committed), then sched_crash kills the scheduler before the
+    # journal row lands — a dead dispatcher holding the lease
+    proc = _run_tool([qtool, "serve", "--queue-dir", qdir],
+                     extra_env={**env, "FDTD3D_HEARTBEAT_S": "1",
+                                "FDTD3D_FAULT_PLAN":
+                                "preempt@t=16; sched_crash@job=1"})
+    assert proc.returncode != 0
+
+    proc = _run_tool([qtool, "status", "--queue-dir", qdir,
+                      "--json"], extra_env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    st = json.loads(proc.stdout)
+    assert st["jobs"][jid]["status"] == "running"
+    lease = st["lease"]
+    assert not lease["released"] and st["max_token"] == 1
+    dead_sched = lease["sched"]
+
+    # compaction refuses while the (dead but unexpired) lease is live
+    proc = _run_tool([qtool, "compact", "--queue-dir", qdir,
+                      "--now", str(lease["unix"] + 1.0)],
+                     extra_env=env)
+    assert proc.returncode == 1
+    assert "refused" in (proc.stdout + proc.stderr)
+
+    # the watcher NAMES the lost scheduler at a clock past its lease
+    # deadline, and --evict appends the fenced takeover + requeue
+    journal = os.path.join(qdir, "journal.jsonl")
+    future = lease["unix"] + 1000.0
+    proc = _run_tool([os.path.join(TOOLS, "fleet_watch.py"),
+                      "--journal", journal, "--once", "--evict",
+                      "--now", str(future), "--json"],
+                     extra_env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    lost = [r for r in rep["liveness"]
+            if r["emitter"] == "scheduler"]
+    assert lost and lost[0]["status"] == "lost"
+    assert rep["evict"] is not None
+    assert rep["evict"]["takeover_from"] == dead_sched
+    assert rep["evict"]["requeued"] == 1
+
+    # mid-incident the fold reads the orphan QUEUED under the fresh
+    # fence — no double-dispatch can be journaled into existence
+    proc = _run_tool([qtool, "status", "--queue-dir", qdir,
+                      "--json"], extra_env=env)
+    st = json.loads(proc.stdout)
+    assert st["jobs"][jid]["status"] == "queued"
+    assert st["jobs"][jid]["fence"] == 2
+    assert st["lease"]["released"]   # the evictor released its tenure
+
+    # scheduler #2 (fresh identity) resumes from the committed t=16
+    # snapshot and completes the orphan
+    proc = _run_tool([qtool, "serve", "--queue-dir", qdir],
+                     extra_env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_tool([qtool, "status", "--queue-dir", qdir,
+                      "--json"], extra_env=env)
+    st = json.loads(proc.stdout)
+    assert st["jobs"][jid]["status"] == "completed"
+    assert st["jobs"][jid]["t"] == 24
+    assert st["jobs"][jid]["fence"] == 3
+    assert st["jobs"][jid]["sched"] != dead_sched
+
+    # the telemetry report tells the lease story from the journal
+    proc = _run_tool([os.path.join(TOOLS, "telemetry_report.py"),
+                      journal], extra_env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ACQUIRE" in proc.stdout
+    assert "TAKEOVER" in proc.stdout
+    assert "jobs by scheduler" in proc.stdout
+
+    # ...and the fleet rollup joins it across the registry
+    proc = _run_tool([os.path.join(TOOLS, "fleet_report.py"), reg,
+                      "--journal", journal, "--json"],
+                     extra_env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rollup = json.loads(proc.stdout)
+    leases = rollup["fleet"]["leases"]
+    assert leases["takeovers"] == 1
+    assert len(leases["job_rows_by_sched"]) >= 2
+
+    # compaction now succeeds and the status fold is unchanged
+    proc = _run_tool([qtool, "compact", "--queue-dir", qdir],
+                     extra_env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_tool([qtool, "status", "--queue-dir", qdir,
+                      "--json"], extra_env=env)
+    st = json.loads(proc.stdout)
+    assert st["jobs"][jid]["status"] == "completed"
+    assert st["max_token"] == 3
+
+    # BIT-IDENTICAL: an uninterrupted run of the same spec ends in
+    # the same final snapshot, array for array
+    monkeypatch.delenv("FDTD3D_RUN_REGISTRY", raising=False)
+    from fdtd3d_tpu import cli
+    ref_dir = str(tmp_path / "ref")
+    assert cli.main(["--cmd-from-file", str(spec),
+                     "--save-dir", ref_dir]) == 0
+    q = jobqueue.JobQueue(qdir)
+    sref, mref = io.load_checkpoint(io.find_latest_checkpoint(ref_dir))
+    sjob, mjob = io.load_checkpoint(
+        io.find_latest_checkpoint(q.job_dir(jid)))
+    assert mref["t"] == mjob["t"] == 24
+
+    def _leaves(tree, prefix=""):
+        for k in sorted(tree):
+            v = tree[k]
+            if isinstance(v, dict):
+                yield from _leaves(v, f"{prefix}{k}/")
+            else:
+                yield f"{prefix}{k}", v
+
+    ref_leaves = dict(_leaves(sref))
+    job_leaves = dict(_leaves(sjob))
+    assert set(ref_leaves) == set(job_leaves)
+    for key, arr in ref_leaves.items():
+        assert np.array_equal(arr, job_leaves[key]), key

@@ -71,15 +71,15 @@ def test_schema_v2_validation_rules():
     with pytest.raises(ValueError, match="unknown record type"):
         telemetry.validate_record({"v": 1, "type": "attribution", **att})
     # v3 (round 9), v4 (round 10), v5 (round 11), v6 (round 15),
-    # v7 (round 16), v8 (round 18), v9 (round 20, the trace plane)
-    # and v10 (the health plane) are valid versions now — but the v2
-    # required keys still apply
-    for v in (3, 4, 5, 6, 7, 8, 9, 10):
+    # v7 (round 16), v8 (round 18), v9 (round 20, the trace plane),
+    # v10 (the health plane) and v11 (the lease plane) are valid
+    # versions now — but the v2 required keys still apply
+    for v in (3, 4, 5, 6, 7, 8, 9, 10, 11):
         with pytest.raises(ValueError, match="device_kind"):
             telemetry.validate_record({"v": v, "type": "run_start",
                                        **base})
     with pytest.raises(ValueError, match="not in"):
-        telemetry.validate_record({"v": 11, "type": "run_start",
+        telemetry.validate_record({"v": 12, "type": "run_start",
                                    **base})
 
 

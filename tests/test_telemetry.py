@@ -211,6 +211,32 @@ def test_schema_v10_health_records():
              "pid": 1, "host": "h", "unix": 1.0, "t": None})
 
 
+def test_schema_v11_lease_records():
+    """v11 (ISSUE 20): the fenced-lease lifecycle rows join the
+    schema — valid at v11, unknown at every earlier version (a v10
+    reader meeting a lease row fails loudly, never misfolds)."""
+    ident = {"sched": "worker-0:7001:1786100000", "pid": 7001,
+             "host": "worker-0", "start": 1786100000.0, "token": 1,
+             "unix": 1786100000.0, "ttl_s": 30.0}
+    recs = {
+        "lease_acquire": {**ident,
+                          "takeover_from": "worker-1:7000:1786099000"},
+        "lease_renew": dict(ident),
+        "lease_release": {**ident, "ttl_s": 0.0,
+                          "reason": "serve loop exited"},
+    }
+    for rtype, fields in recs.items():
+        telemetry.validate_record({"v": 11, "type": rtype, **fields})
+        for v_old in range(1, 11):
+            with pytest.raises(ValueError, match="unknown record type"):
+                telemetry.validate_record({"v": v_old, "type": rtype,
+                                           **fields})
+    with pytest.raises(ValueError, match="missing 'token'"):
+        telemetry.validate_record(
+            {"v": 11, "type": "lease_acquire",
+             **{k: v for k, v in ident.items() if k != "token"}})
+
+
 def test_heartbeater_emits_at_chunk_boundaries(tmp_path, monkeypatch):
     """FDTD3D_HEARTBEAT_S=0 (every-boundary mode): each advance()
     chunk appends one heartbeat row onto the SAME telemetry stream —

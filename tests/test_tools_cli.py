@@ -72,7 +72,8 @@ def test_telemetry_report_runs_on_fixtures():
     for fixture in ("telemetry_v2.jsonl", "telemetry_v4.jsonl",
                     "telemetry_v5.jsonl", "telemetry_v6.jsonl",
                     "telemetry_v7.jsonl", "queue_v8.jsonl",
-                    "telemetry_v9.jsonl", "telemetry_v10.jsonl"):
+                    "telemetry_v9.jsonl", "telemetry_v10.jsonl",
+                    "queue_v11.jsonl"):
         proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
                      os.path.join(FIX, fixture), "--json"])
         assert proc.returncode == 0, (fixture, proc.stderr)
@@ -113,6 +114,15 @@ def test_telemetry_report_runs_on_fixtures():
     assert "heartbeats[supervisor]: 1 beat(s)" in proc.stdout
     assert "LIVENESS STUCK: scheduler" in proc.stdout
     assert "1 LIVENESS flag(s)" in proc.stdout
+    # the v11 text form prints the lease lineage (acquire, fenced
+    # takeover, release) and the per-scheduler job-row census
+    proc = _run([os.path.join(TOOLS, "telemetry_report.py"),
+                 os.path.join(FIX, "queue_v11.jsonl")])
+    assert proc.returncode == 0, proc.stderr
+    assert "ACQUIRE worker-0:7001:1786100000 token=1" in proc.stdout
+    assert "TAKEOVER worker-1:7002:1786100050" in proc.stdout
+    assert "RELEASE worker-1:7002:1786100050 token=2" in proc.stdout
+    assert "jobs by scheduler" in proc.stdout
 
 
 def test_fleet_watch_runs_on_fixture(tmp_path):
@@ -257,6 +267,49 @@ def test_fdtd_queue_status_runs_on_fixture(tmp_path):
                  str(qdir / "journal.jsonl")])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "queue-wait-p95" in proc.stdout
+
+
+def test_fdtd_queue_lease_columns_and_compact_on_fixture(tmp_path):
+    """tools/fdtd_queue.py on the checked-in v11 journal: status
+    renders the lease + fencing columns (LEASE holder/token, STALE
+    rejects, per-job fence= stamps), --json carries the fold's lease
+    state, and compact succeeds on the released journal with the
+    folded state intact afterwards."""
+    import shutil
+    qdir = tmp_path / "queue"
+    qdir.mkdir()
+    shutil.copy(os.path.join(FIX, "queue_v11.jsonl"),
+                str(qdir / "journal.jsonl"))
+    tool = os.path.join(TOOLS, "fdtd_queue.py")
+    proc = _run([tool, "status", "--queue-dir", str(qdir)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "completed=2" in proc.stdout
+    assert "LEASE worker-1:7002:1786100050 token=2" in proc.stdout
+    assert "released" in proc.stdout
+    assert "takeover_from=worker-0:7001:1786100000" in proc.stdout
+    assert "STALE 1 fenced-out" in proc.stdout
+    assert "fence=2 sched=worker-1:7002:1786100050" in proc.stdout
+    proc = _run([tool, "status", "--queue-dir", str(qdir), "--json"])
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["max_token"] == 2 and out["stale_rejected"] == 1
+    assert out["lease"]["released"] is True
+    assert all(j["status"] == "completed"
+               for j in out["jobs"].values())
+    # the lease is released: compact folds the journal down and the
+    # re-folded state is identical (minus the dropped stale rows)
+    proc = _run([tool, "compact", "--queue-dir", str(qdir), "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    stats = json.loads(proc.stdout)
+    assert stats["rows_after"] < stats["rows_before"]
+    assert stats["max_token"] == 2
+    proc = _run([tool, "status", "--queue-dir", str(qdir), "--json"])
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["max_token"] == 2 and out["stale_rejected"] == 0
+    assert out["lease"]["released"] is True
+    assert all(j["status"] == "completed"
+               for j in out["jobs"].values())
 
 
 def test_ckpt_inspect_runs_and_verifies(tmp_path):

@@ -183,6 +183,64 @@ def test_scheduler_retires_only_when_journal_all_terminal(tmp_path):
     assert rep["emitters"][0]["retired"] is True
 
 
+def test_scheduler_retirement_is_per_identity_on_leased_journal(
+        tmp_path):
+    """Schema v11: on a journal carrying lease rows, a scheduler
+    emitter retires iff its pid+host no longer holds the ACTIVE
+    (highest-token, unreleased) lease — a fenced-out dead peer goes
+    quiet without alarming, while the live holder still alarms when
+    it stops beating, even with every job terminal."""
+    j = str(tmp_path / "journal.jsonl")
+    submit = {"v": 11, "type": "job_submit", "job_id": "j1",
+              "tenant": "acme", "spec": "a.txt", "priority": 0,
+              "cells": 4096, "status": "queued",
+              "wall_time": "2026-08-07", "unix": 1000.0}
+    lease = {"pid": 123, "host": "h0", "start": 900.0,
+             "unix": 900.0, "ttl_s": 30.0}
+    acq0 = {"v": 11, "type": "lease_acquire", "sched": "h0:123:900",
+            "token": 1, **lease}
+    acq1 = {"v": 11, "type": "lease_acquire", "sched": "h0:124:950",
+            "token": 2, "takeover_from": "h0:123:900",
+            **{**lease, "pid": 124, "start": 950.0, "unix": 950.0}}
+    done = {"v": 11, "type": "job_state", "job_id": "j1",
+            "tenant": "acme", "status": "completed", "unix": 1002.0,
+            "fence": 2, "sched": "h0:124:950"}
+    # a stale row from the fenced-out scheduler rides along: rejected
+    stale = {"v": 11, "type": "job_state", "job_id": "j1",
+             "tenant": "acme", "status": "running", "unix": 1001.0,
+             "fence": 1, "sched": "h0:123:900"}
+    _w(j, submit, acq0, acq1, stale, done,
+       _hb("scheduler", 1000.0, pid=123),
+       _hb("scheduler", 1000.0, pid=124))
+    now = [999999.0]
+    w = _watcher(now, journal=j)
+    rep = w.poll_once()
+    # the fenced-out identity (pid 123) retired silently; the active
+    # holder (pid 124) is LOST — even though the journal folds
+    # all-terminal (the legacy rule would have retired both)
+    assert [r["status"] for r in rep["liveness"]] == ["lost"]
+    assert rep["liveness"][0]["pid"] == 124
+    by_pid = {e["pid"]: e["retired"] for e in rep["emitters"]}
+    assert by_pid == {123: True, 124: False}
+    # the lease fold + fencing surface on the report
+    assert [(lz["token"], lz["active"]) for lz in rep["leases"]] == \
+        [(1, False), (2, True)]
+    assert rep["stale_rejected"] == 1
+    # the stale running row did not overwrite the accepted completed
+    assert w._jobs["j1"]["status"] == "completed"
+    text = watch.format_report(rep)
+    assert "LEASE h0:124:950 token=2 active" in text
+    assert "STALE 1 fenced-out" in text
+    # once the active holder RELEASES, its silence is normal too
+    rel = {"v": 11, "type": "lease_release", "sched": "h0:124:950",
+           "token": 2, **{**lease, "pid": 124, "start": 950.0,
+                          "unix": 1003.0, "ttl_s": 0.0}}
+    _w(j, rel)
+    rep = w.poll_once()
+    assert rep["liveness"] == []
+    assert all(e["retired"] for e in rep["emitters"])
+
+
 # -------------------------------------------------------------------------
 # anomaly: EWMA drift, queue-wait aging, straggler trend
 # -------------------------------------------------------------------------

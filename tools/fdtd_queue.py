@@ -8,6 +8,8 @@ Usage:
         [--batch-chunk N] [--no-coalesce] [--metrics PATH] [--json]
     python tools/fdtd_queue.py status [--queue-dir DIR] [--json]
     python tools/fdtd_queue.py cancel JOB_ID [--queue-dir DIR]
+    python tools/fdtd_queue.py compact [--queue-dir DIR]
+        [--now EPOCH] [--json]
 
 The thin shell over :mod:`fdtd3d_tpu.jobqueue` (docs/SERVICE.md has
 the runbook: quota semantics, coalescing eligibility, the journal
@@ -19,7 +21,8 @@ Exit codes:
 * 0 — command succeeded (``serve``: every dispatched job reached a
   terminal state; jobs deferred by quota are reported, not failed)
 * 1 — named refusal/failure: a quota rejection at submit, a missing
-  queue/journal, an unknown job id — or ``serve`` ending with any
+  queue/journal, an unknown job id, a ``compact`` refused while a
+  live scheduler holds the lease — or ``serve`` ending with any
   job ``failed`` (the queue's own gate posture: a lost tenant must
   not exit 0)
 * 2 — usage error (argparse)
@@ -40,6 +43,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root for fdtd3d_tpu
 
+from fdtd3d_tpu import log as _log  # noqa: E402
 from fdtd3d_tpu import jobqueue  # noqa: E402
 from fdtd3d_tpu.log import report, warn  # noqa: E402
 
@@ -75,11 +79,24 @@ def _job_line(job) -> str:
         extra += f" run={job['run_id']}"
     if job.get("group"):
         extra += f" group={job['group']}"
+    if job.get("fence") is not None:
+        extra += f" fence={job['fence']}"
+    if job.get("sched"):
+        extra += f" sched={job['sched']}"
     if job.get("reason"):
         extra += f" ({job['reason']})"
     return (f"  job {job['job_id']}: {job.get('status', '?'):9s} "
             f"tenant={job.get('tenant')} prio={job.get('priority')}"
             f"{extra}")
+
+
+def _lease_line(lease) -> str:
+    state = "released" if lease.get("released") else "held"
+    extra = ""
+    if lease.get("takeover_from"):
+        extra += f" takeover_from={lease['takeover_from']}"
+    return (f"  LEASE {lease.get('sched')} token={lease.get('token')}"
+            f" ttl={lease.get('ttl_s')}s {state}{extra}")
 
 
 def cmd_submit(args) -> int:
@@ -125,10 +142,15 @@ def cmd_serve(args) -> int:
 
 def cmd_status(args) -> int:
     q = _queue(args, need_journal=True)
-    jobs = q.jobs()
+    folded = jobqueue.fold(q.read())
+    jobs = folded["jobs"]
     if args.json:
-        report(json.dumps({"journal": q.journal, "jobs": jobs},
-                          indent=1, sort_keys=True))
+        report(json.dumps(
+            {"journal": q.journal, "jobs": jobs,
+             "lease": folded["lease"],
+             "max_token": folded["max_token"],
+             "stale_rejected": len(folded["stale_rejected"])},
+            indent=1, sort_keys=True))
         return 0
     by_status = {}
     for job in jobs.values():
@@ -136,8 +158,35 @@ def cmd_status(args) -> int:
         by_status[s] = by_status.get(s, 0) + 1
     report(f"queue {q.dirpath}: {len(jobs)} job(s) "
            + " ".join(f"{k}={v}" for k, v in sorted(by_status.items())))
+    if folded["lease"] is not None:
+        report(_lease_line(folded["lease"]))
+    if folded["stale_rejected"]:
+        report(f"  STALE {len(folded['stale_rejected'])} fenced-out "
+               f"journal row(s) rejected by the fold")
     for jid in sorted(jobs):
         report(_job_line(jobs[jid]))
+    return 0
+
+
+def cmd_compact(args) -> int:
+    q = _queue(args, need_journal=True)
+    try:
+        stats = q.compact(now=args.now)
+    except jobqueue.LeaseHeld as exc:
+        warn(f"compact refused: {exc}")
+        return 1
+    except RuntimeError as exc:
+        warn(f"compact failed: {exc}")
+        return 1
+    if args.json:
+        report(json.dumps(stats, indent=1, sort_keys=True))
+        return 0
+    report(f"compacted {q.journal}: "
+           f"{stats['rows_before']} -> {stats['rows_after']} rows, "
+           f"{stats['bytes_before']} -> {stats['bytes_after']} bytes "
+           f"({stats['jobs']} job(s))")
+    if stats.get("lease") is not None:
+        report(_lease_line(stats["lease"]))
     return 0
 
 
@@ -221,11 +270,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("job_id")
     _common(p)
     p.set_defaults(fn=cmd_cancel)
+
+    p = sub.add_parser(
+        "compact",
+        help="fold the journal into a snapshot row-set published "
+             "atomically as a new generation file (tailing "
+             "consumers see a named rotation; fold-identity "
+             "asserted; refused while a live lease is held)")
+    p.add_argument("--now", type=float, default=None, metavar="EPOCH",
+                   help="injectable clock for the live-lease refusal "
+                        "check (deterministic tests; default "
+                        "time.time())")
+    p.add_argument("--json", action="store_true")
+    _common(p)
+    p.set_defaults(fn=cmd_compact)
     return ap
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if getattr(args, "json", False):
+        # --json promises parseable stdout: the library's progress
+        # chatter (log level 1) would interleave with the product
+        _log.set_level(0)
     return args.fn(args)
 
 

@@ -24,6 +24,10 @@ ROADMAP items 2c/3's queue and scheduler will select against:
 * straggler-chip leaderboard (which chip ids keep winning the
   per-chunk imbalance argmax across runs) — batched runs' per-lane
   imbalance rows name the straggler chip inside a coalesced group;
+* lease plane (schema v11, with ``--journal``): the journal's
+  fenced-ownership lineage — ACQUIRE/TAKEOVER/RELEASE lines and
+  per-scheduler ``job_state`` row counts (who dispatched what on a
+  shared journal);
 * per-tenant LATENCY DECOMPOSITION (schema v9, the trace plane):
   every ``span`` record in the joined streams — plus the queue
   journal when ``--journal`` points at it — buckets into queue-wait
@@ -202,8 +206,28 @@ def build_rollup(registry_path: str,
                 spans.append(rec)
 
     _take_spans(rows)
+    # lease plane (schema v11): the journal's fenced-ownership
+    # lineage (ACQUIRE/TAKEOVER/RELEASE) and per-scheduler job_state
+    # row counts — who dispatched what on a shared journal
+    lease_events: List[Dict[str, Any]] = []
+    jobs_by_sched: Dict[str, int] = {}
     if journal_path:
-        _take_spans(telemetry.read_jsonl(journal_path))
+        jrecords = telemetry.read_jsonl(journal_path)
+        _take_spans(jrecords)
+        for rec in jrecords:
+            rtype = rec.get("type")
+            if rtype in ("lease_acquire", "lease_release"):
+                ev = {"event": rtype.split("_", 1)[1],
+                      "sched": rec.get("sched"),
+                      "token": rec.get("token")}
+                if rec.get("takeover_from"):
+                    ev["takeover_from"] = rec["takeover_from"]
+                if rec.get("reason"):
+                    ev["reason"] = rec["reason"]
+                lease_events.append(ev)
+            elif rtype == "job_state" and rec.get("sched"):
+                jobs_by_sched[str(rec["sched"])] = \
+                    jobs_by_sched.get(str(rec["sched"]), 0) + 1
 
     by_status: Dict[str, int] = {}
     run_table: Dict[str, Dict[str, Any]] = {}
@@ -293,6 +317,14 @@ def build_rollup(registry_path: str,
                    for chip, n in sorted(stragglers.items(),
                                          key=lambda kv: -kv[1])]
     total_cache = cache_hits + cache_misses
+    fleet_extra: Dict[str, Any] = {}
+    if lease_events or jobs_by_sched:
+        fleet_extra["leases"] = {
+            "events": lease_events,
+            "takeovers": sum(1 for ev in lease_events
+                             if ev.get("takeover_from")),
+            "job_rows_by_sched": dict(sorted(jobs_by_sched.items())),
+        }
     return {
         "registry": registry_path,
         "runs": run_table,
@@ -314,6 +346,7 @@ def build_rollup(registry_path: str,
             "straggler_leaderboard": leaderboard,
             "latency_decomposition": latency_decomposition(
                 spans, tenant_of_trace),
+            **fleet_extra,
         },
     }
 
@@ -367,6 +400,25 @@ def format_text(rollup: Dict[str, Any]) -> str:
                      f"({cache['hit_rate']:.0%} hit rate)")
     lines.append(f"  recovery events: {fleet['recovery_events']} "
                  f"({fleet['recovery_events_per_kstep']:.2f}/kstep)")
+    lz = fleet.get("leases")
+    if lz:
+        for ev in lz["events"]:
+            if ev["event"] == "acquire" and ev.get("takeover_from"):
+                lines.append(f"  TAKEOVER {ev['sched']} fenced out "
+                             f"{ev['takeover_from']} "
+                             f"(token {ev['token']})")
+            elif ev["event"] == "acquire":
+                lines.append(f"  ACQUIRE {ev['sched']} "
+                             f"token={ev['token']}")
+            else:
+                lines.append(f"  RELEASE {ev['sched']} "
+                             f"token={ev['token']}"
+                             + (f": {ev['reason']}"
+                                if ev.get("reason") else ""))
+        if lz.get("job_rows_by_sched"):
+            lines.append("  jobs by scheduler: " + "  ".join(
+                f"{k}={v}" for k, v in
+                lz["job_rows_by_sched"].items()))
     for t in fleet["unhealthy_tenants"]:
         lines.append(f"  UNHEALTHY TENANT: run {t['run']} lane "
                      f"{t['lane']} (first bad step <= "

@@ -6,7 +6,7 @@ Usage:
         [--once | --interval S] [--now EPOCH] [--deadline-n N]
         [--cursor CURSOR.json] [--metrics METRICS.prom]
         [--out WATCH.jsonl] [--rules RULES.json]
-        [--bench-best BENCH_BEST.json] [--json]
+        [--bench-best BENCH_BEST.json] [--evict] [--json]
 
 The streaming counterpart of ``fleet_report.py``: instead of folding
 finished runs, it tails the run registry, the queue journal and any
@@ -31,6 +31,16 @@ the test surface); ``--once`` does one deterministic poll and exits.
 ``--metrics`` atomically refreshes an OpenMetrics exposition per
 poll; ``--out`` appends the fired liveness/alert records as JSONL.
 
+``--evict`` closes the takeover loop (docs/SERVICE.md lease
+protocol): when ``--journal``'s lease fold shows an unreleased lease
+whose deadline has passed at the poll clock, the watcher appends a
+fenced ``lease_acquire`` as its own identity (the takeover row names
+the dead holder), requeues the dead owner's running/preempted jobs
+under its fresh token, and releases with reason "evicted" — after
+which any surviving peer (or a restarted scheduler) re-acquires and
+dispatches the requeued work. A live, unexpired lease is never
+evicted.
+
 Exit codes: 0 = all green; 1 = something flagged; 2 = usage.
 """
 
@@ -48,6 +58,38 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from fdtd3d_tpu import slo as slo_mod  # noqa: E402
 from fdtd3d_tpu import watch as watch_mod  # noqa: E402
 from fdtd3d_tpu.log import report, warn  # noqa: E402
+
+
+def _evict_expired(journal_path: str, now: float):
+    """Fenced takeover of an EXPIRED lease on ``journal_path``:
+    acquire as the watcher's own identity, requeue the dead holder's
+    running/preempted jobs, release with reason "evicted". Returns
+    the eviction summary dict, or None when there was nothing to
+    evict (no lease, released, or the holder is still live)."""
+    from fdtd3d_tpu import jobqueue  # module import is jax-free
+    if os.path.basename(journal_path) != jobqueue.JOURNAL_NAME:
+        warn(f"--evict: {journal_path} is not a queue journal "
+             f"(expected basename {jobqueue.JOURNAL_NAME})")
+        return None
+    q = jobqueue.JobQueue(os.path.dirname(journal_path))
+    lease = q.lease_state()
+    if lease is None or lease.get("released"):
+        return None
+    if now < jobqueue.lease_deadline(lease):
+        return None  # live holder: never evicted, only alarmed on
+    ident = jobqueue.SchedIdentity.mine(now=now)
+    try:
+        token = q.acquire_lease(ident, now)
+    except jobqueue.LeaseHeld as exc:
+        warn(f"--evict: {exc}")
+        return None
+    requeued = q.requeue_orphans(
+        "requeued by fleet_watch --evict (fenced takeover from "
+        "expired lease holder)", fence=token, sched=ident.sched)
+    q.release_lease(ident, token, now, reason="evicted")
+    return {"evictor": ident.sched, "token": token,
+            "requeued": requeued,
+            "takeover_from": lease.get("sched")}
 
 
 def main(argv=None) -> int:
@@ -91,6 +133,12 @@ def main(argv=None) -> int:
                          "the drift baseline + throughput-floor rule")
     ap.add_argument("--queue-wait-max", type=float, default=300.0,
                     help="queue-wait aging bound, seconds")
+    ap.add_argument("--evict", action="store_true",
+                    help="after each poll, take over --journal's "
+                         "EXPIRED lease (fenced lease_acquire as the "
+                         "watcher's identity), requeue the dead "
+                         "holder's running jobs, release as "
+                         "'evicted'; a live lease is never touched")
     ap.add_argument("--json", action="store_true",
                     help="emit each poll's report as one JSON object")
     args = ap.parse_args(argv)
@@ -130,10 +178,18 @@ def main(argv=None) -> int:
         while True:
             rep = watcher.poll_once()
             flagged = watcher.flagged(rep) or flagged
+            if args.evict and args.journal:
+                rep["evict"] = _evict_expired(
+                    args.journal, float(clock()))
             if args.json:
                 report(json.dumps(rep, indent=1))
             else:
                 report(watch_mod.format_report(rep))
+                ev = rep.get("evict")
+                if ev:
+                    report(f"  EVICT {ev['takeover_from']} -> "
+                           f"{ev['evictor']} token={ev['token']} "
+                           f"requeued={ev['requeued']} job(s)")
             if args.once:
                 break
             time.sleep(watcher.interval_s)

@@ -187,6 +187,35 @@ def summarize_run(run):
     liveness = [r for r in run if r["type"] == "liveness"]
     if liveness:
         out["liveness"] = liveness
+    # lease plane (schema v11): fenced journal-ownership lineage —
+    # who acquired (and from whom, on a takeover), who released and
+    # why, plus per-scheduler job_state row counts (the fence/sched
+    # stamps every dispatching scheduler leaves on its transitions)
+    leases = [r for r in run if r["type"] in
+              ("lease_acquire", "lease_renew", "lease_release")]
+    if leases:
+        acquires = [r for r in leases
+                    if r["type"] == "lease_acquire"]
+        out["leases"] = {
+            "acquires": [{"sched": r["sched"], "token": r["token"],
+                          "takeover_from": r.get("takeover_from")}
+                         for r in acquires],
+            "renews": sum(1 for r in leases
+                          if r["type"] == "lease_renew"),
+            "releases": [{"sched": r["sched"], "token": r["token"],
+                          "reason": r.get("reason")}
+                         for r in leases
+                         if r["type"] == "lease_release"],
+            "takeovers": sum(1 for r in acquires
+                             if r.get("takeover_from")),
+        }
+    by_sched = {}
+    for r in run:
+        if r["type"] == "job_state" and r.get("sched"):
+            by_sched[r["sched"]] = by_sched.get(r["sched"], 0) + 1
+    if by_sched:
+        out.setdefault("leases", {})["job_rows_by_sched"] = \
+            dict(sorted(by_sched.items()))
     if not chunks:
         return out
     walls = [c["wall_s"] for c in chunks]
@@ -218,6 +247,29 @@ def summarize_run(run):
     return out
 
 
+def _lease_lines(s) -> list:
+    """ACQUIRE/TAKEOVER/RELEASE lineage + per-scheduler job counts
+    (shared by the chunked and chunk-less render paths — a queue
+    journal has lease rows but no chunk records)."""
+    lz = s.get("leases") or {}
+    lines = []
+    for r in lz.get("acquires", []):
+        if r.get("takeover_from"):
+            lines.append(f"  TAKEOVER {r['sched']} fenced out "
+                         f"{r['takeover_from']} (token {r['token']})")
+        else:
+            lines.append(f"  ACQUIRE {r['sched']} token={r['token']}")
+    for r in lz.get("releases", []):
+        lines.append(f"  RELEASE {r['sched']} token={r['token']}"
+                     + (f": {r['reason']}" if r.get("reason")
+                        else ""))
+    if lz.get("job_rows_by_sched"):
+        lines.append("  jobs by scheduler: " + "  ".join(
+            f"{k}={v}" for k, v in
+            lz["job_rows_by_sched"].items()))
+    return lines
+
+
 def format_text(summaries) -> str:
     lines = []
     for i, s in enumerate(summaries):
@@ -232,6 +284,7 @@ def format_text(summaries) -> str:
                         else ""))
         if not s["chunks"]:
             lines.append("  (no chunk records)")
+            lines.extend(_lease_lines(s))
             continue
         w, r = s["wall_s_per_chunk"], s["mcells_per_s"]
         lines.append(f"  {s['steps']} steps / {s['chunks']} chunks in "
@@ -361,10 +414,14 @@ def format_text(summaries) -> str:
                 f"{r['emitter']} silent {r['silent_s']:.1f}s "
                 f"(deadline {r['deadline_s']:.1f}s, last t="
                 f"{r.get('last_t')}): {r['message']}")
+        lines.extend(_lease_lines(s))
+        lz = s.get("leases") or {}
         n_rec = sum(len(v) for v in rec.values())
         n_alerts = len(s.get("alerts", []))
         n_live = len(s.get("liveness", []))
-        if n_rec or n_alerts or n_live:
+        n_lease = (len(lz.get("acquires", ()))
+                   + len(lz.get("releases", ())))
+        if n_rec or n_alerts or n_live or n_lease:
             lines.append(f"  survived {n_rec} recovery events "
                          f"(retries {len(rec['retries'])}, rollbacks "
                          f"{len(rec['rollbacks'])}, degrades "
@@ -373,7 +430,10 @@ def format_text(summaries) -> str:
                          + (f", {n_alerts} SLO alert(s) fired"
                             if n_alerts else "")
                          + (f", {n_live} LIVENESS flag(s)"
-                            if n_live else ""))
+                            if n_live else "")
+                         + (f", {n_lease} lease event(s) "
+                            f"({lz.get('takeovers', 0)} takeover(s))"
+                            if n_lease else ""))
     return "\n".join(lines)
 
 
